@@ -1,0 +1,32 @@
+"""TPU-native scheduling-simulation framework.
+
+A from-scratch rebuild of kube-scheduler-simulator's capabilities
+(debuggable scheduler with per-plugin result tracing, snapshot/reset,
+resource watcher, extender proxy, scenario replay) around a JAX/XLA core:
+the per-pod x per-node x per-plugin Filter/Score loop of the reference
+(upstream ScheduleOne; mirrored at reference scheduler/scheduler.go:79-344)
+is evaluated as dense ``pods x nodes x plugins`` tensors in compiled XLA
+computations instead of nested Go loops.
+
+Layering (mirrors SURVEY.md section 1 of /root/repo):
+
+- ``state``     in-memory columnar cluster store + event bus (replaces the
+                in-process kube-apiserver + etcd of the reference,
+                reference simulator/k8sapiserver/k8sapiserver.go:34-88).
+- ``config``    env-first simulator config + KubeSchedulerConfiguration
+                handling (reference simulator/config/config.go:51-123).
+- ``models``    the scheduling framework: plugin interfaces, registry,
+                wrapped (debuggable) plugins, profiles
+                (reference simulator/scheduler/plugin/*.go).
+- ``ops``       vectorized JAX kernels for the in-tree plugins.
+- ``plugins``   in-tree plugin implementations + result stores +
+                store reflector (annotation trace writer).
+- ``scheduler`` the scheduling engine: sequential debuggable loop and the
+                batched TPU scorer with lax.scan commit.
+- ``parallel``  device-mesh sharding of the node/pod axes (pjit/shard_map).
+- ``extender``  webhook-extender proxy + its result store.
+- ``scenario``  KEP-140 scenario replay engine.
+- ``api``       REST + SSE server mirroring reference simulator/server.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,19 @@
+from kube_scheduler_simulator_tpu.state.store import (
+    KINDS,
+    NAMESPACED_KINDS,
+    ClusterStore,
+    Event,
+    NotFoundError,
+    AlreadyExistsError,
+    ResourceExpiredError,
+)
+
+__all__ = [
+    "KINDS",
+    "NAMESPACED_KINDS",
+    "ClusterStore",
+    "Event",
+    "NotFoundError",
+    "AlreadyExistsError",
+    "ResourceExpiredError",
+]

@@ -1,0 +1,352 @@
+"""In-memory columnar cluster store + event bus.
+
+This is the TPU build's control plane: it replaces the reference's
+in-process kube-apiserver + external etcd (reference
+simulator/k8sapiserver/k8sapiserver.go:34-88, etcd prefix
+``kube-scheduler-simulator/`` at :121) with a single-process store over the
+same seven resource kinds the simulator manages (reference
+simulator/snapshot/snapshot.go:32-53 and
+simulator/resourcewatcher/resourcewatcher.go:61-90).
+
+Design points:
+
+- Objects are stored as plain JSON-shaped dicts (the k8s wire format), so
+  snapshot/export/import and the REST layer are serialization-free.
+- Every mutation bumps a global, monotonically increasing resourceVersion
+  (etcd revision analog) and appends to a bounded per-kind event log, which
+  gives watchers the same list-then-watch-resume-from-resourceVersion
+  protocol the reference exposes over SSE
+  (reference simulator/docs/api.md:103-130).
+- UIDs and timestamps come from injectable counters/clocks so scenario
+  replay (KEP-140 determinism rules, reference
+  keps/140-scenario-based-simulation/README.md:600-610) is bit-reproducible.
+- Update callbacks run synchronously under the store lock (reentrant), which
+  is what makes the annotation reflector deterministic where the reference
+  needs informer goroutines + conflict retries.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Iterable, Mapping
+
+from kube_scheduler_simulator_tpu.utils.retry import ConflictError
+
+Obj = dict[str, Any]
+
+KINDS: tuple[str, ...] = (
+    "pods",
+    "nodes",
+    "persistentvolumes",
+    "persistentvolumeclaims",
+    "storageclasses",
+    "priorityclasses",
+    "namespaces",
+)
+NAMESPACED_KINDS: frozenset[str] = frozenset({"pods", "persistentvolumeclaims"})
+
+KIND_NAMES: dict[str, str] = {
+    "pods": "Pod",
+    "nodes": "Node",
+    "persistentvolumes": "PersistentVolume",
+    "persistentvolumeclaims": "PersistentVolumeClaim",
+    "storageclasses": "StorageClass",
+    "priorityclasses": "PriorityClass",
+    "namespaces": "Namespace",
+}
+
+EVENT_ADDED = "ADDED"
+EVENT_MODIFIED = "MODIFIED"
+EVENT_DELETED = "DELETED"
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class AlreadyExistsError(ValueError):
+    pass
+
+
+class ResourceExpiredError(Exception):
+    """The requested resourceVersion has been compacted out of the event log.
+
+    Analog of the apiserver's 410 Gone on an expired watch resourceVersion;
+    the watcher must relist (the reference's RetryWatcher does the same,
+    reference simulator/resourcewatcher/resourcewatcher.go:128-134).
+    """
+
+
+class Event:
+    __slots__ = ("kind", "type", "obj", "resource_version")
+
+    def __init__(self, kind: str, type_: str, obj: Obj, resource_version: int):
+        self.kind = kind
+        self.type = type_
+        self.obj = obj
+        self.resource_version = resource_version
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"Event({self.kind}, {self.type}, {_key(self.obj)}, rv={self.resource_version})"
+
+
+def _key(obj: Mapping[str, Any]) -> str:
+    meta = obj.get("metadata", {})
+    ns = meta.get("namespace", "")
+    name = meta.get("name", "")
+    return f"{ns}/{name}" if ns else name
+
+
+def _rfc3339(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+
+
+class ClusterStore:
+    """Single-process cluster state for the seven simulator resource kinds."""
+
+    def __init__(self, clock: Callable[[], float] | None = None, event_log_size: int = 4096):
+        self._lock = threading.RLock()
+        self._objs: dict[str, dict[str, Obj]] = {k: {} for k in KINDS}
+        self._rv = 0
+        self._uid_counter = 0
+        self._clock = clock or time.time
+        self._event_log: dict[str, deque[Event]] = {k: deque(maxlen=event_log_size) for k in KINDS}
+        self._evicted_rv: dict[str, int] = {k: 0 for k in KINDS}
+        self._subscribers: list[tuple[frozenset[str], Callable[[Event], None]]] = []
+        self._update_hooks: dict[str, list[Callable[[Obj, Obj], None]]] = {k: [] for k in KINDS}
+
+    # ------------------------------------------------------------------ infra
+
+    @property
+    def resource_version(self) -> int:
+        with self._lock:
+            return self._rv
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def _next_uid(self) -> str:
+        self._uid_counter += 1
+        c = self._uid_counter
+        return f"{c:08x}-0000-4000-8000-{c:012x}"
+
+    def _emit(self, kind: str, type_: str, obj: Obj, old: Obj | None = None) -> None:
+        ev = Event(kind, type_, copy.deepcopy(obj), int(obj["metadata"]["resourceVersion"]))
+        log = self._event_log[kind]
+        if log.maxlen is not None and len(log) == log.maxlen:
+            self._evicted_rv[kind] = log[0].resource_version
+        log.append(ev)
+        for kinds, cb in list(self._subscribers):
+            if kind in kinds:
+                cb(ev)
+        if type_ == EVENT_MODIFIED and old is not None:
+            for hook in list(self._update_hooks[kind]):
+                hook(copy.deepcopy(old), copy.deepcopy(obj))
+
+    def subscribe(self, kinds: Iterable[str], cb: Callable[[Event], None]) -> Callable[[], None]:
+        """Register a synchronous event callback; returns an unsubscribe fn."""
+        entry = (frozenset(kinds), cb)
+        with self._lock:
+            self._subscribers.append(entry)
+
+        def unsubscribe() -> None:
+            with self._lock:
+                if entry in self._subscribers:
+                    self._subscribers.remove(entry)
+
+        return unsubscribe
+
+    def on_update(self, kind: str, hook: Callable[[Obj, Obj], None]) -> Callable[[], None]:
+        """Register an informer-style UpdateFunc hook (old, new).
+
+        Mirrors the reference's pod-update informer registration used by the
+        store reflector (reference
+        simulator/scheduler/storereflector/storereflector.go:55-72).
+        """
+        with self._lock:
+            self._update_hooks[kind].append(hook)
+
+        def unsubscribe() -> None:
+            with self._lock:
+                if hook in self._update_hooks[kind]:
+                    self._update_hooks[kind].remove(hook)
+
+        return unsubscribe
+
+    def events_since(self, kind: str, rv: int) -> list[Event]:
+        """Events for ``kind`` with resourceVersion > rv (watch resume).
+
+        Raises ResourceExpiredError (410 Gone analog) if events after ``rv``
+        have already been compacted out of the bounded log — the caller must
+        relist instead of silently missing events.
+        """
+        with self._lock:
+            if rv < self._evicted_rv[kind]:
+                raise ResourceExpiredError(
+                    f"{kind}: resourceVersion {rv} expired (oldest retained > {self._evicted_rv[kind]})"
+                )
+            return [e for e in self._event_log[kind] if e.resource_version > rv]
+
+    # ------------------------------------------------------------------- CRUD
+
+    def _bucket(self, kind: str) -> dict[str, Obj]:
+        try:
+            return self._objs[kind]
+        except KeyError:
+            raise NotFoundError(f"unknown resource kind {kind!r}") from None
+
+    def create(self, kind: str, obj: Mapping[str, Any]) -> Obj:
+        with self._lock:
+            bucket = self._bucket(kind)
+            o = copy.deepcopy(dict(obj))
+            meta = o.setdefault("metadata", {})
+            if kind in NAMESPACED_KINDS:
+                meta.setdefault("namespace", "default")
+            k = _key(o)
+            if not meta.get("name"):
+                raise ValueError(f"{kind} object has no metadata.name")
+            if k in bucket:
+                raise AlreadyExistsError(f"{kind} {k!r} already exists")
+            meta["uid"] = self._next_uid()
+            # k8s wire format: resourceVersion is a string.
+            meta["resourceVersion"] = str(self._next_rv())
+            meta.setdefault("creationTimestamp", _rfc3339(self._clock()))
+            if kind == "pods":
+                o.setdefault("status", {}).setdefault("phase", "Pending")
+            bucket[k] = o
+            self._emit(kind, EVENT_ADDED, o)
+            return copy.deepcopy(o)
+
+    def update(self, kind: str, obj: Mapping[str, Any]) -> Obj:
+        with self._lock:
+            bucket = self._bucket(kind)
+            o = copy.deepcopy(dict(obj))
+            meta = o.setdefault("metadata", {})
+            if kind in NAMESPACED_KINDS:
+                meta.setdefault("namespace", "default")
+            k = _key(o)
+            cur = bucket.get(k)
+            if cur is None:
+                raise NotFoundError(f"{kind} {k!r} not found")
+            sent_rv = meta.get("resourceVersion")
+            if sent_rv is not None and int(sent_rv) != int(cur["metadata"]["resourceVersion"]):
+                raise ConflictError(
+                    f"{kind} {k!r}: resourceVersion {sent_rv} != {cur['metadata']['resourceVersion']}"
+                )
+            old = cur
+            meta["uid"] = cur["metadata"]["uid"]
+            meta["creationTimestamp"] = cur["metadata"]["creationTimestamp"]
+            meta["resourceVersion"] = str(self._next_rv())
+            bucket[k] = o
+            self._emit(kind, EVENT_MODIFIED, o, old=old)
+            return copy.deepcopy(o)
+
+    def apply(self, kind: str, obj: Mapping[str, Any]) -> Obj:
+        """Upsert, ignoring any stale uid/resourceVersion on the input.
+
+        This is the role server-side Apply plays in the reference's snapshot
+        load path, where UIDs are nulled before applying (reference
+        simulator/snapshot/snapshot.go:373-536).
+        """
+        with self._lock:
+            o = copy.deepcopy(dict(obj))
+            meta = o.setdefault("metadata", {})
+            if kind in NAMESPACED_KINDS:
+                meta.setdefault("namespace", "default")
+            meta.pop("uid", None)
+            meta.pop("resourceVersion", None)
+            k = _key(o)
+            if k in self._bucket(kind):
+                return self.update(kind, o)
+            return self.create(kind, o)
+
+    def patch(self, kind: str, name: str, patch: Mapping[str, Any], namespace: str | None = None) -> Obj:
+        """Strategic-merge-lite patch: dicts merge recursively, None deletes."""
+        with self._lock:
+            cur = self._get_internal(kind, name, namespace)
+            o = copy.deepcopy(cur)
+            _merge(o, patch)
+            o["metadata"]["resourceVersion"] = cur["metadata"]["resourceVersion"]
+            return self.update(kind, o)
+
+    def get(self, kind: str, name: str, namespace: str | None = None) -> Obj:
+        with self._lock:
+            return copy.deepcopy(self._get_internal(kind, name, namespace))
+
+    def _get_internal(self, kind: str, name: str, namespace: str | None = None) -> Obj:
+        bucket = self._bucket(kind)
+        if kind in NAMESPACED_KINDS:
+            namespace = namespace or "default"
+            k = f"{namespace}/{name}"
+        else:
+            k = name
+        obj = bucket.get(k)
+        if obj is None:
+            raise NotFoundError(f"{kind} {k!r} not found")
+        return obj
+
+    def list(self, kind: str, namespace: str | None = None) -> list[Obj]:
+        """Objects sorted by (namespace, name) — etcd key order."""
+        with self._lock:
+            bucket = self._bucket(kind)
+            return [
+                copy.deepcopy(o)
+                for _, o in sorted(bucket.items())
+                if namespace is None or o["metadata"].get("namespace") == namespace
+            ]
+
+    def delete(self, kind: str, name: str, namespace: str | None = None) -> Obj:
+        with self._lock:
+            obj = self._get_internal(kind, name, namespace)
+            k = _key(obj)
+            del self._bucket(kind)[k]
+            obj = copy.deepcopy(obj)
+            obj["metadata"]["resourceVersion"] = str(self._next_rv())
+            self._emit(kind, EVENT_DELETED, obj)
+            return obj
+
+    # ----------------------------------------------------------- pod helpers
+
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> Obj:
+        """Bind a pod to a node (the Binding-subresource POST of the
+        reference's bind phase, SURVEY.md section 3.2)."""
+        with self._lock:
+            pod = copy.deepcopy(self._get_internal("pods", name, namespace))
+            pod.setdefault("spec", {})["nodeName"] = node_name
+            # The Binding subresource only sets spec.nodeName; with no kubelet
+            # in the simulator, bound pods stay Pending (as in the reference).
+            return self.update("pods", pod)
+
+    # ------------------------------------------------------ snapshot / reset
+
+    def dump(self) -> dict[str, list[Obj]]:
+        with self._lock:
+            return {k: [copy.deepcopy(o) for _, o in sorted(b.items())] for k, b in self._objs.items()}
+
+    def restore(self, data: Mapping[str, list[Obj]]) -> None:
+        """Wholesale state replacement (reset-service restore path,
+        reference simulator/reset/reset.go:57-84)."""
+        with self._lock:
+            for kind in KINDS:
+                # Delete everything not in the target state, then apply.
+                want = {_key(o) for o in data.get(kind, [])}
+                for k in list(self._bucket(kind)):
+                    if k not in want:
+                        obj = self._bucket(kind)[k]
+                        self.delete(kind, obj["metadata"]["name"], obj["metadata"].get("namespace"))
+                for o in data.get(kind, []):
+                    self.apply(kind, o)
+
+
+def _merge(dst: dict[str, Any], patch: Mapping[str, Any]) -> None:
+    for k, v in patch.items():
+        if v is None:
+            dst.pop(k, None)
+        elif isinstance(v, Mapping) and isinstance(dst.get(k), dict):
+            _merge(dst[k], v)
+        else:
+            dst[k] = copy.deepcopy(v)

@@ -1,0 +1,11 @@
+from kube_scheduler_simulator_tpu.utils.gojson import go_marshal
+from kube_scheduler_simulator_tpu.utils.quantity import parse_quantity, milli_value, value
+from kube_scheduler_simulator_tpu.utils.retry import retry_on_conflict
+
+__all__ = [
+    "go_marshal",
+    "parse_quantity",
+    "milli_value",
+    "value",
+    "retry_on_conflict",
+]

@@ -1,0 +1,39 @@
+"""Go-compatible JSON encoding.
+
+The reference serializes every scheduling result map with Go's
+``encoding/json.Marshal`` (reference simulator/scheduler/plugin/resultstore/
+store.go:206,222,241 etc.) before writing it into a Pod annotation, and the
+golden tests (resultstore/store_test.go) pin those exact bytes.  Go's
+encoder differs from ``json.dumps`` in three ways we must reproduce to stay
+byte-identical:
+
+1. map keys are emitted in sorted order,
+2. output is compact (no spaces after ``:`` or ``,``),
+3. ``<``, ``>`` and ``&`` are HTML-escaped to ``\\u003c``/``\\u003e``/
+   ``\\u0026`` by default.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+
+def _escape_html(s: str) -> str:
+    return (
+        s.replace("&", "\\u0026")
+        .replace("<", "\\u003c")
+        .replace(">", "\\u003e")
+        # Go also escapes the JS line separators by default.
+        .replace(" ", "\\u2028")
+        .replace(" ", "\\u2029")
+    )
+
+
+def go_marshal(obj: Any) -> str:
+    """Serialize ``obj`` the way Go's ``json.Marshal`` would."""
+    raw = json.dumps(obj, sort_keys=True, separators=(",", ":"), ensure_ascii=False)
+    # json.dumps never emits raw & < > outside of string literals, so a
+    # post-pass escape over the whole document only touches string contents
+    # (and is what Go's encoder effectively does too).
+    return _escape_html(raw)

@@ -1,0 +1,166 @@
+"""Unit tests for the in-memory cluster store (control-plane replacement)."""
+
+import pytest
+
+from kube_scheduler_simulator_tpu.state import (
+    AlreadyExistsError,
+    ClusterStore,
+    NotFoundError,
+)
+from kube_scheduler_simulator_tpu.utils.retry import ConflictError
+
+
+def pod(name, ns="default", node=None):
+    p = {"metadata": {"name": name, "namespace": ns}, "spec": {}}
+    if node:
+        p["spec"]["nodeName"] = node
+    return p
+
+
+def node(name):
+    return {"metadata": {"name": name}, "status": {"allocatable": {"cpu": "4", "memory": "8Gi"}}}
+
+
+class TestCRUD:
+    def test_create_get(self):
+        s = ClusterStore(clock=lambda: 0.0)
+        s.create("pods", pod("p1"))
+        got = s.get("pods", "p1")
+        assert got["metadata"]["name"] == "p1"
+        # k8s wire format: resourceVersion is a string
+        assert got["metadata"]["resourceVersion"] == "1"
+        assert got["metadata"]["uid"]
+        assert got["metadata"]["creationTimestamp"] == "1970-01-01T00:00:00Z"
+        assert got["status"]["phase"] == "Pending"
+
+    def test_create_duplicate(self):
+        s = ClusterStore()
+        s.create("pods", pod("p1"))
+        with pytest.raises(AlreadyExistsError):
+            s.create("pods", pod("p1"))
+
+    def test_namespace_isolation(self):
+        s = ClusterStore()
+        s.create("pods", pod("p1", ns="a"))
+        s.create("pods", pod("p1", ns="b"))
+        assert len(s.list("pods")) == 2
+        assert len(s.list("pods", namespace="a")) == 1
+
+    def test_update_conflict(self):
+        s = ClusterStore()
+        created = s.create("pods", pod("p1"))
+        created["metadata"]["resourceVersion"] = 999
+        with pytest.raises(ConflictError):
+            s.update("pods", created)
+
+    def test_update_bumps_rv(self):
+        s = ClusterStore()
+        created = s.create("pods", pod("p1"))
+        created["spec"]["priority"] = 5
+        updated = s.update("pods", created)
+        assert int(updated["metadata"]["resourceVersion"]) > int(created["metadata"]["resourceVersion"])
+        assert updated["metadata"]["uid"] == created["metadata"]["uid"]
+
+    def test_apply_upserts_and_ignores_stale_rv(self):
+        s = ClusterStore()
+        s.apply("nodes", node("n1"))
+        o = node("n1")
+        o["metadata"]["resourceVersion"] = 12345
+        o["metadata"]["uid"] = "stale"
+        applied = s.apply("nodes", o)
+        assert applied["metadata"]["uid"] != "stale"
+
+    def test_delete(self):
+        s = ClusterStore()
+        s.create("pods", pod("p1"))
+        s.delete("pods", "p1")
+        with pytest.raises(NotFoundError):
+            s.get("pods", "p1")
+
+    def test_patch_merges(self):
+        s = ClusterStore()
+        s.create("pods", pod("p1"))
+        s.patch("pods", "p1", {"metadata": {"annotations": {"k": "v"}}})
+        s.patch("pods", "p1", {"metadata": {"annotations": {"k2": "v2"}}})
+        got = s.get("pods", "p1")
+        assert got["metadata"]["annotations"] == {"k": "v", "k2": "v2"}
+
+    def test_list_sorted(self):
+        s = ClusterStore()
+        for n in ["c", "a", "b"]:
+            s.create("nodes", node(n))
+        assert [o["metadata"]["name"] for o in s.list("nodes")] == ["a", "b", "c"]
+
+    def test_unknown_kind(self):
+        s = ClusterStore()
+        with pytest.raises(NotFoundError):
+            s.list("widgets")
+
+
+class TestEvents:
+    def test_subscribe(self):
+        s = ClusterStore()
+        events = []
+        s.subscribe(["pods"], events.append)
+        s.create("pods", pod("p1"))
+        s.bind_pod("default", "p1", "n1")
+        s.delete("pods", "p1")
+        assert [e.type for e in events] == ["ADDED", "MODIFIED", "DELETED"]
+        assert events[1].obj["spec"]["nodeName"] == "n1"
+
+    def test_unsubscribe(self):
+        s = ClusterStore()
+        events = []
+        unsub = s.subscribe(["pods"], events.append)
+        unsub()
+        s.create("pods", pod("p1"))
+        assert events == []
+
+    def test_events_since(self):
+        s = ClusterStore()
+        s.create("pods", pod("p1"))
+        rv = s.resource_version
+        s.create("pods", pod("p2"))
+        evs = s.events_since("pods", rv)
+        assert len(evs) == 1
+        assert evs[0].obj["metadata"]["name"] == "p2"
+
+    def test_events_since_expired_raises_gone(self):
+        from kube_scheduler_simulator_tpu.state import ResourceExpiredError
+
+        s = ClusterStore(event_log_size=4)
+        for i in range(10):
+            s.create("pods", pod(f"p{i}"))
+        with pytest.raises(ResourceExpiredError):
+            s.events_since("pods", 1)
+        # Recent enough resourceVersions still resume fine.
+        assert len(s.events_since("pods", 8)) == 2
+
+    def test_update_hook_sees_old_and_new(self):
+        s = ClusterStore()
+        seen = []
+        s.on_update("pods", lambda old, new: seen.append((old["spec"].get("nodeName"), new["spec"].get("nodeName"))))
+        s.create("pods", pod("p1"))
+        s.bind_pod("default", "p1", "n9")
+        assert seen == [(None, "n9")]
+
+
+class TestDumpRestore:
+    def test_roundtrip(self):
+        s = ClusterStore()
+        s.create("nodes", node("n1"))
+        s.create("pods", pod("p1"))
+        snap = s.dump()
+        s.delete("pods", "p1")
+        s.create("pods", pod("p2"))
+        s.restore(snap)
+        names = [o["metadata"]["name"] for o in s.list("pods")]
+        assert names == ["p1"]
+        assert len(s.list("nodes")) == 1
+
+    def test_deterministic_uids(self):
+        s1 = ClusterStore(clock=lambda: 0.0)
+        s2 = ClusterStore(clock=lambda: 0.0)
+        for s in (s1, s2):
+            s.create("pods", pod("p1"))
+        assert s1.get("pods", "p1")["metadata"]["uid"] == s2.get("pods", "p1")["metadata"]["uid"]

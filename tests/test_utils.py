@@ -1,0 +1,185 @@
+"""Unit tests for Go-JSON encoding, quantity parsing, selector matching."""
+
+from fractions import Fraction
+
+import pytest
+
+from kube_scheduler_simulator_tpu.utils.gojson import go_marshal
+from kube_scheduler_simulator_tpu.utils.labels import (
+    find_untolerated_taint,
+    match_label_selector,
+    match_node_selector,
+    match_node_selector_term,
+    toleration_tolerates_taint,
+)
+from kube_scheduler_simulator_tpu.utils.quantity import milli_value, parse_quantity, value
+from kube_scheduler_simulator_tpu.utils.retry import ConflictError, retry_on_conflict
+
+
+class TestGoMarshal:
+    def test_sorted_compact(self):
+        assert go_marshal({"b": "2", "a": "1"}) == '{"a":"1","b":"2"}'
+
+    def test_nested_maps(self):
+        got = go_marshal({"node1": {"PluginB": "passed", "PluginA": "passed"}})
+        assert got == '{"node1":{"PluginA":"passed","PluginB":"passed"}}'
+
+    def test_html_escaping(self):
+        # Go's json.Marshal escapes < > & by default.
+        assert go_marshal({"k": "a<b>&c"}) == '{"k":"a\\u003cb\\u003e\\u0026c"}'
+
+    def test_empty_map(self):
+        assert go_marshal({}) == "{}"
+
+    def test_string_list(self):
+        assert go_marshal({"p": ["n1", "n2"]}) == '{"p":["n1","n2"]}'
+
+
+class TestQuantity:
+    @pytest.mark.parametrize(
+        "q,expected",
+        [
+            ("1", 1),
+            ("100m", Fraction(1, 10)),
+            ("1500m", Fraction(3, 2)),
+            ("128Mi", 128 * 1024**2),
+            ("1Gi", 1024**3),
+            ("1G", 10**9),
+            ("2.5", Fraction(5, 2)),
+            ("1e3", 1000),
+            ("500k", 500_000),
+            ("-2", -2),
+            (2, 2),
+        ],
+    )
+    def test_parse(self, q, expected):
+        assert parse_quantity(q) == expected
+
+    def test_milli_value_ceil(self):
+        assert milli_value("100m") == 100
+        assert milli_value("1") == 1000
+        assert milli_value("0.1") == 100
+        # MilliValue rounds up
+        assert milli_value("1n") == 1
+
+    def test_value_ceil(self):
+        assert value("128Mi") == 134217728
+        assert value("1.5") == 2
+        assert value("100m") == 1
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            parse_quantity("abc")
+        with pytest.raises(ValueError):
+            parse_quantity("1KiB")
+
+
+class TestSelectors:
+    def test_match_labels(self):
+        sel = {"matchLabels": {"app": "web"}}
+        assert match_label_selector(sel, {"app": "web", "x": "y"})
+        assert not match_label_selector(sel, {"app": "db"})
+
+    def test_nil_selector_matches_nothing(self):
+        assert not match_label_selector(None, {"a": "b"})
+
+    def test_empty_selector_matches_everything(self):
+        assert match_label_selector({}, {"a": "b"})
+        assert match_label_selector({}, {})
+
+    def test_expressions(self):
+        sel = {
+            "matchExpressions": [
+                {"key": "zone", "operator": "In", "values": ["us-a", "us-b"]},
+                {"key": "gpu", "operator": "DoesNotExist"},
+            ]
+        }
+        assert match_label_selector(sel, {"zone": "us-a"})
+        assert not match_label_selector(sel, {"zone": "eu-a"})
+        assert not match_label_selector(sel, {"zone": "us-a", "gpu": "yes"})
+        assert not match_label_selector(sel, {})  # In requires presence
+
+    def test_not_in_matches_absent_key(self):
+        # apimachinery semantics: NotIn matches when the key is absent.
+        sel = {"matchExpressions": [{"key": "a", "operator": "NotIn", "values": ["x"]}]}
+        assert match_label_selector(sel, {})
+        assert match_label_selector(sel, {"a": "y"})
+        assert not match_label_selector(sel, {"a": "x"})
+
+    def test_gt_lt(self):
+        term = {"matchExpressions": [{"key": "cores", "operator": "Gt", "values": ["4"]}]}
+        assert match_node_selector_term(term, {"cores": "8"}, "n1")
+        assert not match_node_selector_term(term, {"cores": "2"}, "n1")
+        assert not match_node_selector_term(term, {}, "n1")
+
+    def test_empty_term_matches_nothing(self):
+        assert not match_node_selector_term({}, {"a": "b"}, "n1")
+
+    def test_match_fields(self):
+        term = {
+            "matchFields": [
+                {"key": "metadata.name", "operator": "In", "values": ["node-1"]}
+            ]
+        }
+        assert match_node_selector_term(term, {}, "node-1")
+        assert not match_node_selector_term(term, {}, "node-2")
+
+    def test_node_selector_or_of_terms(self):
+        ns = {
+            "nodeSelectorTerms": [
+                {"matchExpressions": [{"key": "a", "operator": "Exists"}]},
+                {"matchExpressions": [{"key": "b", "operator": "Exists"}]},
+            ]
+        }
+        assert match_node_selector(ns, {"b": "1"}, "n")
+        assert not match_node_selector(ns, {"c": "1"}, "n")
+
+
+class TestTaints:
+    def test_exists_tolerates_everything_with_key(self):
+        tol = {"key": "k", "operator": "Exists"}
+        assert toleration_tolerates_taint(tol, {"key": "k", "value": "v", "effect": "NoSchedule"})
+
+    def test_empty_key_exists_tolerates_all(self):
+        tol = {"operator": "Exists"}
+        assert toleration_tolerates_taint(tol, {"key": "any", "effect": "NoExecute"})
+
+    def test_equal(self):
+        tol = {"key": "k", "operator": "Equal", "value": "v", "effect": "NoSchedule"}
+        assert toleration_tolerates_taint(tol, {"key": "k", "value": "v", "effect": "NoSchedule"})
+        assert not toleration_tolerates_taint(tol, {"key": "k", "value": "w", "effect": "NoSchedule"})
+
+    def test_effect_mismatch(self):
+        tol = {"key": "k", "operator": "Exists", "effect": "NoSchedule"}
+        assert not toleration_tolerates_taint(tol, {"key": "k", "effect": "NoExecute"})
+
+    def test_find_untolerated(self):
+        taints = [
+            {"key": "a", "effect": "PreferNoSchedule"},
+            {"key": "b", "effect": "NoSchedule", "value": "x"},
+        ]
+        t = find_untolerated_taint(taints, [])
+        assert t is not None and t["key"] == "b"
+        t = find_untolerated_taint(taints, [{"key": "b", "operator": "Exists"}])
+        assert t is None
+
+
+class TestRetry:
+    def test_retries_then_succeeds(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConflictError("conflict")
+            return "ok"
+
+        assert retry_on_conflict(fn, sleep=lambda _: None) == "ok"
+        assert len(calls) == 3
+
+    def test_exhausts(self):
+        def fn():
+            raise ConflictError("always")
+
+        with pytest.raises(ConflictError):
+            retry_on_conflict(fn, sleep=lambda _: None)
