@@ -1,0 +1,67 @@
+"""Scheduler-cache snapshot: the per-cycle view of nodes + assigned pods.
+
+Analog of the upstream shared lister snapshot the reference's hot loop
+iterates (SURVEY.md section 3.2).  Plugins that need cluster-wide context
+(PodTopologySpread, InterPodAffinity) read it through the framework handle.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from kube_scheduler_simulator_tpu.models.nodeinfo import NodeInfo, build_node_infos
+
+Obj = dict[str, Any]
+
+
+def _pod_has_affinity(pod: Obj) -> bool:
+    aff = (pod.get("spec") or {}).get("affinity") or {}
+    pa = aff.get("podAffinity") or {}
+    paa = aff.get("podAntiAffinity") or {}
+    return bool(
+        pa.get("requiredDuringSchedulingIgnoredDuringExecution")
+        or pa.get("preferredDuringSchedulingIgnoredDuringExecution")
+        or paa.get("requiredDuringSchedulingIgnoredDuringExecution")
+        or paa.get("preferredDuringSchedulingIgnoredDuringExecution")
+    )
+
+
+def _pod_has_required_anti_affinity(pod: Obj) -> bool:
+    aff = (pod.get("spec") or {}).get("affinity") or {}
+    paa = aff.get("podAntiAffinity") or {}
+    return bool(paa.get("requiredDuringSchedulingIgnoredDuringExecution"))
+
+
+class Snapshot:
+    """NodeInfos plus the two filtered node lists upstream maintains."""
+
+    def __init__(self, nodes: list[Obj], pods: list[Obj], namespaces: "list[Obj] | None" = None):
+        self.node_infos: list[NodeInfo] = build_node_infos(nodes, pods)
+        self._by_name = {ni.name: ni for ni in self.node_infos}
+        self.namespace_labels: dict[str, dict[str, str]] = {
+            ns["metadata"]["name"]: ns["metadata"].get("labels") or {} for ns in namespaces or []
+        }
+
+    def get(self, name: str) -> "NodeInfo | None":
+        return self._by_name.get(name)
+
+    def have_pods_with_affinity(self) -> list[NodeInfo]:
+        return [ni for ni in self.node_infos if any(_pod_has_affinity(p) for p in ni.pods)]
+
+    def have_pods_with_required_anti_affinity(self) -> list[NodeInfo]:
+        return [ni for ni in self.node_infos if any(_pod_has_required_anti_affinity(p) for p in ni.pods)]
+
+    def assume(self, pod: Obj, node_name: str) -> None:
+        """Account a pod onto a node (the cache 'assume' after Reserve)."""
+        ni = self._by_name.get(node_name)
+        if ni is not None:
+            pod = dict(pod)
+            spec = dict(pod.get("spec") or {})
+            spec["nodeName"] = node_name
+            pod["spec"] = spec
+            ni.add_pod(pod)
+
+    def forget(self, pod: Obj, node_name: str) -> None:
+        ni = self._by_name.get(node_name)
+        if ni is not None:
+            ni.remove_pod(pod)

@@ -1,0 +1,15 @@
+"""In-tree plugin implementations (upstream v1.26 semantics).
+
+Each plugin implements the per-pod Python protocol from models.framework
+(exact upstream messages and integer math — the parity oracle), and the hot
+five additionally have vectorized JAX kernels in ``ops`` that the batch
+engine uses (SURVEY.md section 7 north-star five).
+"""
+
+from kube_scheduler_simulator_tpu.plugins.intree.registry import (
+    DEFAULT_PLUGIN_ORDER,
+    DEFAULT_SCORE_WEIGHTS,
+    in_tree_registry,
+)
+
+__all__ = ["in_tree_registry", "DEFAULT_PLUGIN_ORDER", "DEFAULT_SCORE_WEIGHTS"]

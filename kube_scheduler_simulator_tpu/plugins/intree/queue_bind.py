@@ -1,0 +1,120 @@
+"""PrioritySort (QueueSort), DefaultBinder (Bind), DefaultPreemption
+(PostFilter) — upstream v1.26 semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from kube_scheduler_simulator_tpu.models.framework import CycleState, Status
+from kube_scheduler_simulator_tpu.models.nodeinfo import NodeInfo
+
+Obj = dict[str, Any]
+
+
+def pod_priority(pod: Obj) -> int:
+    return int((pod.get("spec") or {}).get("priority") or 0)
+
+
+class PrioritySort:
+    name = "PrioritySort"
+
+    def less(self, pod_info1: Obj, pod_info2: Obj) -> bool:
+        p1 = pod_priority(pod_info1)
+        p2 = pod_priority(pod_info2)
+        if p1 != p2:
+            return p1 > p2
+        t1 = pod_info1["metadata"].get("creationTimestamp") or ""
+        t2 = pod_info2["metadata"].get("creationTimestamp") or ""
+        return t1 < t2
+
+
+class DefaultBinder:
+    name = "DefaultBinder"
+
+    def __init__(self, args: "Obj | None" = None, handle: Any = None):
+        self.handle = handle
+
+    def bind(self, state: CycleState, pod: Obj, node_name: str) -> "Status | None":
+        store = getattr(self.handle, "cluster_store", None) if self.handle else None
+        if store is None:
+            return Status.error("no cluster store to bind against")
+        try:
+            store.bind_pod(pod["metadata"].get("namespace", "default"), pod["metadata"]["name"], node_name)
+        except KeyError as e:
+            # Pod vanished mid-cycle: the binding API call fails, the cycle
+            # reports an error status (upstream binder behavior).
+            return Status.error(f"binding rejected: {e}")
+        return None
+
+
+class DefaultPreemption:
+    """PostFilter: find a node where evicting lower-priority pods makes the
+    pod schedulable; nominate it and delete the victims.
+
+    Candidate selection follows upstream's core rules: only nodes whose
+    filter status was plain Unschedulable are candidates; victims are
+    lower-priority pods removed lowest-priority-first until the pod fits;
+    the node needing the fewest/lowest-priority victims wins.
+    """
+
+    name = "DefaultPreemption"
+
+    def __init__(self, args: "Obj | None" = None, handle: Any = None):
+        self.handle = handle
+
+    def post_filter(
+        self, state: CycleState, pod: Obj, filtered_node_status_map: dict[str, Status]
+    ) -> "tuple[str | None, Status | None]":
+        fwk = getattr(self.handle, "framework", None) if self.handle else None
+        snap = self.handle.snapshot() if self.handle else None
+        if fwk is None or snap is None:
+            return None, Status.unschedulable("preemption not possible")
+        incoming_priority = pod_priority(pod)
+        best: "tuple[int, int, str, list[Obj]] | None" = None  # (len, max prio, name, victims)
+        for node_name, status in filtered_node_status_map.items():
+            if status is not None and status.code.name == "UNSCHEDULABLE_AND_UNRESOLVABLE":
+                continue
+            ni = snap.get(node_name)
+            if ni is None:
+                continue
+            victims = self._find_victims(fwk, state, pod, ni, incoming_priority)
+            if victims is None:
+                continue
+            key = (len(victims), max((pod_priority(v) for v in victims), default=-(10**9)), node_name)
+            if best is None or key < (best[0], best[1], best[2]):
+                best = (key[0], key[1], node_name, victims)
+        if best is None:
+            return None, Status.unschedulable("preemption: 0/%d nodes are available" % len(filtered_node_status_map))
+        node_name, victims = best[2], best[3]
+        store = getattr(self.handle, "cluster_store", None)
+        for v in victims:
+            if store is not None:
+                try:
+                    store.delete("pods", v["metadata"]["name"], v["metadata"].get("namespace"))
+                except KeyError:
+                    pass
+            ni = snap.get(node_name)
+            if ni is not None:
+                ni.remove_pod(v)
+        return node_name, None
+
+    def _find_victims(self, fwk: Any, state: CycleState, pod: Obj, ni: NodeInfo, incoming_priority: int):
+        """Remove lower-priority pods (lowest first) until the pod passes
+        Filter on a scratch copy; None if impossible."""
+        lower = sorted(
+            (p for p in ni.pods if pod_priority(p) < incoming_priority),
+            key=pod_priority,
+        )
+        if not lower:
+            return None
+        scratch = NodeInfo(ni.node)
+        for p in ni.pods:
+            scratch.add_pod(p)
+        victims: list[Obj] = []
+        for victim in lower:
+            scratch.remove_pod(victim)
+            victims.append(victim)
+            if fwk.run_filter_plugins_silently(state, pod, scratch):
+                return victims
+        return None

@@ -1,0 +1,220 @@
+"""Volume-related filter plugins (upstream v1.26 semantics over the
+simulator's resource model: PVs, PVCs, StorageClasses).
+
+- VolumeBinding: pending PVCs must exist; immediate-binding PVCs must be
+  bound; node-affinity of bound PVs must match the node.
+- VolumeZone: zone/region labels of a bound PV must match the node's.
+- VolumeRestrictions: GCE-PD/EBS/AzureDisk single-attach conflicts and
+  ReadWriteOncePod enforcement.
+- NodeVolumeLimits family (EBSLimits/GCEPDLimits/AzureDiskLimits/
+  NodeVolumeLimits=CSI): attachable-volume count limits.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from kube_scheduler_simulator_tpu.models.framework import CycleState, Status
+from kube_scheduler_simulator_tpu.models.nodeinfo import NodeInfo
+
+Obj = dict[str, Any]
+
+ERR_PVC_NOT_FOUND = 'persistentvolumeclaim "%s" not found'
+ERR_VOLUME_NODE_CONFLICT = "node(s) had volume node affinity conflict"
+ERR_VOLUME_ZONE = "node(s) had no available volume zone"
+ERR_DISK_CONFLICT = "node(s) had no available disk"
+ERR_MAX_VOLUME_COUNT = "node(s) exceed max volume count"
+ERR_UNBOUND_IMMEDIATE_PVC = "pod has unbound immediate PersistentVolumeClaims"
+
+ZONE_LABELS = ("topology.kubernetes.io/zone", "failure-domain.beta.kubernetes.io/zone")
+REGION_LABELS = ("topology.kubernetes.io/region", "failure-domain.beta.kubernetes.io/region")
+
+
+def _pod_pvc_names(pod: Obj) -> list[str]:
+    out = []
+    for v in (pod.get("spec") or {}).get("volumes") or []:
+        pvc = v.get("persistentVolumeClaim")
+        if pvc and pvc.get("claimName"):
+            out.append(pvc["claimName"])
+    return out
+
+
+class _VolumeHandleMixin:
+    def __init__(self, args: "Obj | None" = None, handle: Any = None):
+        self.handle = handle
+
+    def _store(self):
+        return getattr(self.handle, "cluster_store", None) if self.handle else None
+
+    def _get(self, kind: str, name: str, namespace: "str | None" = None) -> "Obj | None":
+        store = self._store()
+        if store is None:
+            return None
+        try:
+            return store.get(kind, name, namespace)
+        except KeyError:
+            return None
+
+
+class VolumeBinding(_VolumeHandleMixin):
+    name = "VolumeBinding"
+
+    def pre_filter(self, state: CycleState, pod: Obj):
+        ns = pod["metadata"].get("namespace", "default")
+        missing = []
+        for claim in _pod_pvc_names(pod):
+            if self._store() is not None and self._get("persistentvolumeclaims", claim, ns) is None:
+                missing.append(claim)
+        if missing:
+            return None, Status.unresolvable(ERR_PVC_NOT_FOUND % missing[0])
+        return None, None
+
+    def filter(self, state: CycleState, pod: Obj, node_info: NodeInfo) -> "Status | None":
+        ns = pod["metadata"].get("namespace", "default")
+        node = node_info.node
+        labels = node["metadata"].get("labels") or {}
+        for claim in _pod_pvc_names(pod):
+            pvc = self._get("persistentvolumeclaims", claim, ns)
+            if pvc is None:
+                continue  # pre_filter already rejected the pod
+            vol_name = (pvc.get("spec") or {}).get("volumeName")
+            if not vol_name:
+                # Unbound: WaitForFirstConsumer can bind later; immediate
+                # binding mode means the pod must wait.
+                sc_name = (pvc.get("spec") or {}).get("storageClassName")
+                sc = self._get("storageclasses", sc_name) if sc_name else None
+                mode = (sc or {}).get("volumeBindingMode", "Immediate")
+                if mode != "WaitForFirstConsumer":
+                    return Status.unresolvable(ERR_UNBOUND_IMMEDIATE_PVC)
+                continue
+            pv = self._get("persistentvolumes", vol_name)
+            if pv is None:
+                continue
+            node_affinity = ((pv.get("spec") or {}).get("nodeAffinity") or {}).get("required")
+            if node_affinity is not None:
+                from kube_scheduler_simulator_tpu.utils.labels import match_node_selector
+
+                if not match_node_selector(node_affinity, labels, node_info.name):
+                    return Status.unresolvable(ERR_VOLUME_NODE_CONFLICT)
+        return None
+
+    def reserve(self, state: CycleState, pod: Obj, node_name: str) -> "Status | None":
+        return None
+
+    def unreserve(self, state: CycleState, pod: Obj, node_name: str) -> None:
+        return None
+
+    def pre_bind(self, state: CycleState, pod: Obj, node_name: str) -> "Status | None":
+        return None
+
+
+class VolumeZone(_VolumeHandleMixin):
+    name = "VolumeZone"
+
+    def filter(self, state: CycleState, pod: Obj, node_info: NodeInfo) -> "Status | None":
+        ns = pod["metadata"].get("namespace", "default")
+        node_labels = node_info.node["metadata"].get("labels") or {}
+        for claim in _pod_pvc_names(pod):
+            pvc = self._get("persistentvolumeclaims", claim, ns)
+            if pvc is None:
+                continue
+            vol_name = (pvc.get("spec") or {}).get("volumeName")
+            if not vol_name:
+                continue
+            pv = self._get("persistentvolumes", vol_name)
+            if pv is None:
+                continue
+            pv_labels = pv["metadata"].get("labels") or {}
+            for label_set in (ZONE_LABELS, REGION_LABELS):
+                for label in label_set:
+                    if label in pv_labels and label in node_labels:
+                        pv_vals = set(pv_labels[label].split("__"))
+                        if node_labels[label] not in pv_vals:
+                            return Status.unresolvable(ERR_VOLUME_ZONE)
+        return None
+
+
+def _gce_pd(v: Obj) -> "str | None":
+    pd = v.get("gcePersistentDisk")
+    return pd.get("pdName") if pd else None
+
+
+def _ebs(v: Obj) -> "str | None":
+    ebs = v.get("awsElasticBlockStore")
+    return ebs.get("volumeID") if ebs else None
+
+
+def _azure(v: Obj) -> "str | None":
+    d = v.get("azureDisk")
+    return d.get("diskName") if d else None
+
+
+class VolumeRestrictions(_VolumeHandleMixin):
+    name = "VolumeRestrictions"
+
+    def filter(self, state: CycleState, pod: Obj, node_info: NodeInfo) -> "Status | None":
+        pod_vols = (pod.get("spec") or {}).get("volumes") or []
+        for v in pod_vols:
+            for existing in node_info.pods:
+                for ev in (existing.get("spec") or {}).get("volumes") or []:
+                    for extract, readonly_key in (
+                        (_gce_pd, "gcePersistentDisk"),
+                        (_ebs, "awsElasticBlockStore"),
+                        (_azure, "azureDisk"),
+                    ):
+                        a, b = extract(v), extract(ev)
+                        if a and b and a == b:
+                            ro_a = (v.get(readonly_key) or {}).get("readOnly", False)
+                            ro_b = (ev.get(readonly_key) or {}).get("readOnly", False)
+                            if not (ro_a and ro_b):
+                                return Status.unschedulable(ERR_DISK_CONFLICT)
+        return None
+
+
+class _VolumeLimits(_VolumeHandleMixin):
+    """Shared logic for the four NodeVolumeLimits-family plugins."""
+
+    name = "NodeVolumeLimits"
+    volume_key = ""  # e.g. "awsElasticBlockStore"
+    default_limit = 256
+
+    def filter(self, state: CycleState, pod: Obj, node_info: NodeInfo) -> "Status | None":
+        if not self.volume_key:
+            return None
+
+        def count(p: Obj) -> int:
+            return sum(1 for v in (p.get("spec") or {}).get("volumes") or [] if v.get(self.volume_key))
+
+        want = count(pod)
+        if want == 0:
+            return None
+        used = sum(count(p) for p in node_info.pods)
+        if used + want > self.default_limit:
+            return Status.unschedulable(ERR_MAX_VOLUME_COUNT)
+        return None
+
+
+class EBSLimits(_VolumeLimits):
+    name = "EBSLimits"
+    volume_key = "awsElasticBlockStore"
+    default_limit = 39
+
+
+class GCEPDLimits(_VolumeLimits):
+    name = "GCEPDLimits"
+    volume_key = "gcePersistentDisk"
+    default_limit = 16
+
+
+class AzureDiskLimits(_VolumeLimits):
+    name = "AzureDiskLimits"
+    volume_key = "azureDisk"
+    default_limit = 16
+
+
+class NodeVolumeLimits(_VolumeLimits):
+    """CSI volume limits."""
+
+    name = "NodeVolumeLimits"
+    volume_key = "csi"
+    default_limit = 256

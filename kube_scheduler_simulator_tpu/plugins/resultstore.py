@@ -1,0 +1,224 @@
+"""Per-pod scheduling-result store → annotation formatter.
+
+Python rebuild of the reference's result store (reference
+simulator/scheduler/plugin/resultstore/store.go): holds every plugin's
+filter/score/... outcome per pod and serializes each category to the exact
+annotation JSON the Go golden tests pin (Go json.Marshal: compact, sorted
+keys; scores as decimal strings; weights applied to normalized scores).
+
+Thread-safe like the original (one mutex), though the TPU batch path fills
+it from whole result tensors in one call per pod instead of per
+(pod, node, plugin) callback — that per-call mutex was the reference's
+known hot-loop bottleneck (SURVEY.md section 6 cost shape).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from kube_scheduler_simulator_tpu.plugins import annotations as anno
+from kube_scheduler_simulator_tpu.utils.gojson import go_marshal
+
+Obj = dict[str, Any]
+
+PASSED_FILTER_MESSAGE = "passed"
+SUCCESS_MESSAGE = "success"
+WAIT_MESSAGE = "wait"
+POST_FILTER_NOMINATED_MESSAGE = "preemption victim"
+
+
+def _new_result() -> dict[str, Any]:
+    return {
+        "selectedNode": "",
+        "preScore": {},
+        "score": {},
+        "finalScore": {},
+        "preFilterStatus": {},
+        "preFilterResult": {},
+        "filter": {},
+        "postFilter": {},
+        "permit": {},
+        "permitTimeout": {},
+        "reserve": {},
+        "prebind": {},
+        "bind": {},
+        "custom": {},
+    }
+
+
+class ResultStore:
+    """Mirror of the reference Store (store.go:19-24) keyed by ns/pod."""
+
+    def __init__(self, score_plugin_weight: "dict[str, int] | None" = None):
+        self._mu = threading.Lock()
+        self._results: dict[str, dict[str, Any]] = {}
+        self._weights = dict(score_plugin_weight or {})
+
+    @staticmethod
+    def _key(namespace: str, pod_name: str) -> str:
+        return f"{namespace}/{pod_name}"
+
+    def _entry(self, namespace: str, pod_name: str) -> dict[str, Any]:
+        k = self._key(namespace, pod_name)
+        if k not in self._results:
+            self._results[k] = _new_result()
+        return self._results[k]
+
+    # ------------------------------------------------------------- recorders
+
+    def add_filter_result(self, namespace: str, pod_name: str, node_name: str, plugin: str, reason: str) -> None:
+        with self._mu:
+            self._entry(namespace, pod_name)["filter"].setdefault(node_name, {})[plugin] = reason
+
+    def add_post_filter_result(
+        self, namespace: str, pod_name: str, nominated_node_name: str, plugin: str, node_names: list[str]
+    ) -> None:
+        with self._mu:
+            e = self._entry(namespace, pod_name)
+            for node_name in node_names:
+                e["postFilter"].setdefault(node_name, {})
+                if node_name == nominated_node_name:
+                    e["postFilter"][node_name][plugin] = POST_FILTER_NOMINATED_MESSAGE
+
+    def add_score_result(self, namespace: str, pod_name: str, node_name: str, plugin: str, score: int) -> None:
+        with self._mu:
+            self._entry(namespace, pod_name)["score"].setdefault(node_name, {})[plugin] = str(int(score))
+            self._add_normalized_locked(namespace, pod_name, node_name, plugin, score)
+
+    def add_normalized_score_result(
+        self, namespace: str, pod_name: str, node_name: str, plugin: str, normalized_score: int
+    ) -> None:
+        with self._mu:
+            self._add_normalized_locked(namespace, pod_name, node_name, plugin, normalized_score)
+
+    def _add_normalized_locked(
+        self, namespace: str, pod_name: str, node_name: str, plugin: str, normalized_score: int
+    ) -> None:
+        final = int(normalized_score) * int(self._weights.get(plugin, 0))
+        self._entry(namespace, pod_name)["finalScore"].setdefault(node_name, {})[plugin] = str(final)
+
+    def add_pre_filter_result(
+        self,
+        namespace: str,
+        pod_name: str,
+        plugin: str,
+        reason: str,
+        pre_filter_result: "Any | None" = None,
+    ) -> None:
+        with self._mu:
+            e = self._entry(namespace, pod_name)
+            e["preFilterStatus"][plugin] = reason
+            if pre_filter_result is not None and getattr(pre_filter_result, "node_names", None) is not None:
+                e["preFilterResult"][plugin] = sorted(pre_filter_result.node_names)
+
+    def add_pre_score_result(self, namespace: str, pod_name: str, plugin: str, reason: str) -> None:
+        with self._mu:
+            self._entry(namespace, pod_name)["preScore"][plugin] = reason
+
+    def add_permit_result(
+        self, namespace: str, pod_name: str, plugin: str, status: str, timeout_seconds: float
+    ) -> None:
+        with self._mu:
+            e = self._entry(namespace, pod_name)
+            e["permit"][plugin] = status
+            e["permitTimeout"][plugin] = _go_duration(timeout_seconds)
+
+    def add_selected_node(self, namespace: str, pod_name: str, node_name: str) -> None:
+        with self._mu:
+            self._entry(namespace, pod_name)["selectedNode"] = node_name
+
+    def add_reserve_result(self, namespace: str, pod_name: str, plugin: str, status: str) -> None:
+        with self._mu:
+            self._entry(namespace, pod_name)["reserve"][plugin] = status
+
+    def add_bind_result(self, namespace: str, pod_name: str, plugin: str, status: str) -> None:
+        with self._mu:
+            self._entry(namespace, pod_name)["bind"][plugin] = status
+
+    def add_pre_bind_result(self, namespace: str, pod_name: str, plugin: str, status: str) -> None:
+        with self._mu:
+            self._entry(namespace, pod_name)["prebind"][plugin] = status
+
+    def add_custom_result(self, namespace: str, pod_name: str, annotation_key: str, result: str) -> None:
+        with self._mu:
+            self._entry(namespace, pod_name)["custom"][annotation_key] = result
+
+    # -------------------------------------------------------------- batch fill
+
+    def add_batch_results(self, namespace: str, pod_name: str, **categories: dict) -> None:
+        """Bulk-merge whole category maps (used by the TPU batch engine to
+        avoid per-(node,plugin) lock round-trips)."""
+        with self._mu:
+            e = self._entry(namespace, pod_name)
+            for cat, data in categories.items():
+                if cat not in e:
+                    raise KeyError(f"unknown result category {cat!r}")
+                if isinstance(e[cat], dict):
+                    e[cat].update(data)
+                else:
+                    e[cat] = data
+
+    # ------------------------------------------------------------------ read
+
+    def get_stored_result(self, pod: Obj) -> dict[str, str]:
+        """The annotation map (reference GetStoredResult, store.go:133-198)."""
+        with self._mu:
+            k = self._key(pod["metadata"].get("namespace", "default"), pod["metadata"]["name"])
+            e = self._results.get(k)
+            if e is None:
+                return {}
+            out = {
+                anno.PREFILTER_RESULT: go_marshal(e["preFilterResult"]),
+                anno.PREFILTER_STATUS_RESULT: go_marshal(e["preFilterStatus"]),
+                anno.FILTER_RESULT: go_marshal(e["filter"]),
+                anno.POSTFILTER_RESULT: go_marshal(e["postFilter"]),
+                anno.PRESCORE_RESULT: go_marshal(e["preScore"]),
+                anno.SCORE_RESULT: go_marshal(e["score"]),
+                anno.FINALSCORE_RESULT: go_marshal(e["finalScore"]),
+                anno.RESERVE_RESULT: go_marshal(e["reserve"]),
+                anno.PERMIT_TIMEOUT_RESULT: go_marshal(e["permitTimeout"]),
+                anno.PERMIT_STATUS_RESULT: go_marshal(e["permit"]),
+                anno.PREBIND_RESULT: go_marshal(e["prebind"]),
+                anno.BIND_RESULT: go_marshal(e["bind"]),
+            }
+            for key, val in e["custom"].items():
+                out.setdefault(key, val)
+            out[anno.SELECTED_NODE] = e["selectedNode"]
+            return out
+
+    def has_result(self, pod: Obj) -> bool:
+        with self._mu:
+            return self._key(pod["metadata"].get("namespace", "default"), pod["metadata"]["name"]) in self._results
+
+    def delete_data(self, pod: Obj) -> None:
+        with self._mu:
+            self._results.pop(
+                self._key(pod["metadata"].get("namespace", "default"), pod["metadata"]["name"]), None
+            )
+
+
+def _go_duration(seconds: float) -> str:
+    """Format like Go time.Duration.String() for the common cases."""
+    if seconds == 0:
+        return "0s"
+    ns = int(round(seconds * 1e9))
+    if ns < 1000:
+        return f"{ns}ns"
+    if ns < 10**6:
+        us = ns / 1000
+        return f"{us:g}µs"
+    if ns < 10**9:
+        ms = ns / 10**6
+        return f"{ms:g}ms"
+    out = ""
+    total_seconds = ns / 1e9
+    hours = int(total_seconds // 3600)
+    if hours:
+        out += f"{hours}h"
+    minutes = int((total_seconds - hours * 3600) // 60)
+    if minutes or hours:
+        out += f"{minutes}m"
+    secs = total_seconds - hours * 3600 - minutes * 60
+    out += f"{secs:g}s"
+    return out

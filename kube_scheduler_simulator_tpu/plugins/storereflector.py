@@ -1,0 +1,130 @@
+"""Store reflector: copies scheduling results onto Pod annotations.
+
+Rebuild of the reference's shared reflector (reference
+simulator/scheduler/storereflector/storereflector.go:21-167): it holds N
+ResultStores, hooks pod updates, and when a pod finishes a scheduling
+attempt merges every store's results into the pod's annotations, appends
+the merged map to the ``result-history`` annotation, then deletes the
+stores' entries.  The reference needs informer goroutines + conflict-retry;
+our store delivers update hooks synchronously, but the retry loop is kept
+for the kube-backed adapter.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from kube_scheduler_simulator_tpu.plugins import annotations as anno
+from kube_scheduler_simulator_tpu.plugins.resultstore import ResultStore
+from kube_scheduler_simulator_tpu.utils.gojson import go_marshal
+from kube_scheduler_simulator_tpu.utils.retry import ConflictError, retry_on_conflict
+
+Obj = dict[str, Any]
+
+RESULT_STORE_KEY = "PluginResultStoreKey"
+EXTENDER_STORE_KEY = "ExtenderResultStoreKey"
+
+
+class StoreReflector:
+    def __init__(self) -> None:
+        self._stores: dict[str, Any] = {}
+        self._in_flush: set[str] = set()
+        self._pending: dict[str, Obj] = {}
+
+    def add_result_store(self, store: Any, key: str) -> None:
+        self._stores[key] = store
+
+    def get_result_store(self, key: str) -> "Any | None":
+        return self._stores.get(key)
+
+    def result_stores(self) -> list[Any]:
+        return list(self._stores.values())
+
+    # ------------------------------------------------------------------ hook
+
+    def register_to_cluster_store(self, cluster_store: Any) -> None:
+        """ResisterResultSavingToInformer analog (storereflector.go:55-72).
+
+        The reference's informer handler runs asynchronously, after the
+        scheduling cycle that triggered the update has finished recording
+        (including the Bind result).  We reproduce that ordering by queueing
+        the pod here and flushing from ``flush_all`` at cycle end.
+        """
+        cluster_store.on_update("pods", lambda old, new: self._on_pod_update(new))
+
+    def _on_pod_update(self, pod: Obj) -> None:
+        ns = pod["metadata"].get("namespace", "default")
+        name = pod["metadata"]["name"]
+        self._pending[f"{ns}/{name}"] = pod
+
+    def flush_all(self, cluster_store: Any) -> None:
+        """Flush every queued pod's results to its annotations."""
+        while self._pending:
+            _, pod = self._pending.popitem()
+            self.flush_pod(cluster_store, pod)
+
+    # ----------------------------------------------------------------- flush
+
+    def flush_pod(self, cluster_store: Any, pod: Obj) -> None:
+        """storeAllResultToPodFunc analog (storereflector.go:78-146).
+
+        The annotation write itself fires another pod-update event; in the
+        reference the (async) informer sees it after DeleteData so it
+        no-ops, here the synchronous hook needs an explicit reentrancy
+        guard plus delete-before-write.
+        """
+        ns = pod["metadata"].get("namespace", "default")
+        name = pod["metadata"]["name"]
+        key = f"{ns}/{name}"
+        if key in self._in_flush:
+            return
+
+        merged: dict[str, str] = {}
+        had_any = False
+        for store in self._stores.values():
+            if not store.has_result(pod):
+                continue
+            result = store.get_stored_result(pod)
+            if result:
+                had_any = True
+                merged.update(result)
+        if not had_any:
+            return
+        for store in self._stores.values():
+            store.delete_data(pod)
+
+        def apply() -> None:
+            try:
+                fresh = cluster_store.get("pods", name, ns)
+            except KeyError:
+                return
+            annotations = dict(fresh["metadata"].get("annotations") or {})
+            annotations.update(merged)
+            annotations[anno.RESULT_HISTORY] = _updated_history(
+                (fresh["metadata"].get("annotations") or {}).get(anno.RESULT_HISTORY), merged
+            )
+            fresh["metadata"]["annotations"] = annotations
+            cluster_store.update("pods", fresh)
+
+        self._in_flush.add(key)
+        try:
+            retry_on_conflict(apply, sleep=lambda _: None)
+        except ConflictError:
+            pass
+        finally:
+            self._in_flush.discard(key)
+
+
+def _updated_history(existing: "str | None", new_results: dict[str, str]) -> str:
+    """updateResultHistory analog (storereflector.go:148-167): history is a
+    JSON array of annotation maps, one per scheduling attempt."""
+    history: list[dict[str, str]] = []
+    if existing:
+        try:
+            history = json.loads(existing)
+        except json.JSONDecodeError:
+            history = []
+    entry = {k: v for k, v in new_results.items() if k != anno.RESULT_HISTORY}
+    history.append(entry)
+    return go_marshal(history)

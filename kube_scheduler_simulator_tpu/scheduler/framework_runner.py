@@ -1,0 +1,310 @@
+"""The scheduling framework runner: ScheduleOne over wrapped plugins.
+
+Sequential rebuild of the upstream scheduling cycle the reference traces
+(SURVEY.md section 3.2: PreFilter → Filter → [PostFilter] → PreScore →
+Score → Normalize → selectHost → Reserve → Permit → PreBind → Bind), with
+upstream's feasible-node sampling (percentageOfNodesToScore + rotating
+start index) and the single-feasible-node scoring bypass.
+
+This path produces the full per-plugin annotation trace through the result
+store.  The TPU batch engine (scheduler/batch_engine.py) computes the same
+results as tensors; this runner is the semantic oracle.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any
+
+from kube_scheduler_simulator_tpu.models.framework import Code, CycleState, PreFilterResult, Status
+from kube_scheduler_simulator_tpu.models.nodeinfo import NodeInfo
+from kube_scheduler_simulator_tpu.models.snapshot import Snapshot
+from kube_scheduler_simulator_tpu.models.wrapped import WrappedPlugin
+
+Obj = dict[str, Any]
+
+MIN_FEASIBLE_NODES_TO_FIND = 100
+MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5
+
+
+class FrameworkHandle:
+    """What plugins can reach (upstream framework.Handle analog)."""
+
+    def __init__(self, cluster_store: Any = None):
+        self.cluster_store = cluster_store
+        self.framework: "Framework | None" = None
+        self._snapshot: "Snapshot | None" = None
+
+    def snapshot(self) -> "Snapshot | None":
+        return self._snapshot
+
+    def set_snapshot(self, snap: Snapshot) -> None:
+        self._snapshot = snap
+
+
+class ScheduleResult:
+    __slots__ = ("selected_node", "feasible_nodes", "diagnosis", "status", "nominated_node")
+
+    def __init__(
+        self,
+        selected_node: "str | None" = None,
+        feasible_nodes: "list[str] | None" = None,
+        diagnosis: "dict[str, Status] | None" = None,
+        status: "Status | None" = None,
+        nominated_node: "str | None" = None,
+    ):
+        self.selected_node = selected_node
+        self.feasible_nodes = feasible_nodes or []
+        self.diagnosis = diagnosis or {}
+        self.status = status
+        self.nominated_node = nominated_node
+
+    @property
+    def success(self) -> bool:
+        return self.selected_node is not None
+
+
+class Framework:
+    """One scheduling profile's plugin set, ready to schedule pods."""
+
+    EXTENSION_POINTS = (
+        "queue_sort",
+        "pre_filter",
+        "filter",
+        "post_filter",
+        "pre_score",
+        "score",
+        "reserve",
+        "permit",
+        "pre_bind",
+        "bind",
+        "post_bind",
+    )
+
+    def __init__(
+        self,
+        plugins: dict[str, list[WrappedPlugin]],
+        handle: FrameworkHandle,
+        score_weights: "dict[str, int] | None" = None,
+        percentage_of_nodes_to_score: int = 0,
+        seed: int = 0,
+        profile_name: str = "default-scheduler",
+    ):
+        self.plugins = {p: list(plugins.get(p, [])) for p in self.EXTENSION_POINTS}
+        self.handle = handle
+        handle.framework = self
+        self.score_weights = dict(score_weights or {})
+        self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
+        self.rng = random.Random(seed)
+        self.next_start_node_index = 0
+        self.profile_name = profile_name
+
+    # ------------------------------------------------------------- utilities
+
+    def num_feasible_nodes_to_find(self, num_all_nodes: int) -> int:
+        """Upstream sched.numFeasibleNodesToFind."""
+        pct = self.percentage_of_nodes_to_score
+        if num_all_nodes < MIN_FEASIBLE_NODES_TO_FIND or pct >= 100:
+            return num_all_nodes
+        adaptive = pct
+        if adaptive <= 0:
+            adaptive = 50 - num_all_nodes // 125
+            if adaptive < MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND:
+                adaptive = MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND
+        num_nodes = num_all_nodes * adaptive // 100
+        if num_nodes < MIN_FEASIBLE_NODES_TO_FIND:
+            return MIN_FEASIBLE_NODES_TO_FIND
+        return num_nodes
+
+    def run_filter_plugins_silently(self, state: CycleState, pod: Obj, node_info: NodeInfo) -> bool:
+        """Run the ORIGINAL filter plugins without recording (used by
+        preemption's victim search)."""
+        for wp in self.plugins["filter"]:
+            status = wp.original.filter(state, pod, node_info)
+            if status is not None and not status.is_success():
+                return False
+        return True
+
+    # ---------------------------------------------------------- schedule one
+
+    def schedule_one(self, pod: Obj, snapshot: Snapshot) -> ScheduleResult:
+        self.handle.set_snapshot(snapshot)
+        state = CycleState()
+
+        # PreFilter
+        merged_result = PreFilterResult(None)
+        for wp in self.plugins["pre_filter"]:
+            result, status = wp.pre_filter(state, pod)
+            if status is not None and not status.is_success():
+                if status.is_skip():
+                    continue
+                diagnosis = {ni.name: status for ni in snapshot.node_infos}
+                return ScheduleResult(diagnosis=diagnosis, status=status)
+            if result is not None:
+                merged_result = merged_result.merge(result)
+
+        node_infos = snapshot.node_infos
+        if not merged_result.all_nodes():
+            assert merged_result.node_names is not None
+            node_infos = [ni for ni in node_infos if ni.name in merged_result.node_names]
+            if not node_infos:
+                status = Status.unresolvable("node(s) didn't satisfy plugin(s) prefilter result")
+                return ScheduleResult(status=status)
+
+        # Filter with feasible-node sampling + rotating start index
+        num_all = len(snapshot.node_infos)
+        num_to_find = self.num_feasible_nodes_to_find(num_all)
+        feasible: list[NodeInfo] = []
+        diagnosis: dict[str, Status] = {}
+        processed = 0
+        n = len(node_infos)
+        for i in range(n):
+            ni = node_infos[(self.next_start_node_index + i) % n]
+            processed += 1
+            status = self._run_filters(state, pod, ni)
+            if status is None:
+                feasible.append(ni)
+                if len(feasible) >= num_to_find:
+                    break
+            else:
+                diagnosis[ni.name] = status
+        self.next_start_node_index = (self.next_start_node_index + processed) % n if n else 0
+
+        if not feasible:
+            nominated = self._run_post_filters(state, pod, diagnosis)
+            status = Status.unschedulable(
+                f"0/{num_all} nodes are available"
+            )
+            return ScheduleResult(diagnosis=diagnosis, status=status, nominated_node=nominated)
+
+        # Single feasible node: skip scoring (upstream optimization).
+        if len(feasible) == 1:
+            selected = feasible[0].name
+        else:
+            selected, score_status = self._score_and_select(state, pod, feasible)
+            if selected is None:
+                return ScheduleResult(status=score_status, diagnosis=diagnosis)
+
+        # Reserve
+        for wp in self.plugins["reserve"]:
+            status = wp.reserve(state, pod, selected)
+            if status is not None and not status.is_success():
+                self._unreserve(state, pod, selected)
+                return ScheduleResult(status=status, diagnosis=diagnosis)
+        snapshot.assume(pod, selected)
+
+        # Permit (Wait treated as approved once recorded; there is no async
+        # waiting-pod machinery in the simulator's synchronous cycle).
+        for wp in self.plugins["permit"]:
+            status, _timeout = wp.permit(state, pod, selected)
+            if status is not None and not status.is_success() and not status.is_wait():
+                snapshot.forget(pod, selected)
+                self._unreserve(state, pod, selected)
+                return ScheduleResult(status=status, diagnosis=diagnosis)
+
+        # PreBind
+        for wp in self.plugins["pre_bind"]:
+            status = wp.pre_bind(state, pod, selected)
+            if status is not None and not status.is_success():
+                snapshot.forget(pod, selected)
+                self._unreserve(state, pod, selected)
+                return ScheduleResult(status=status, diagnosis=diagnosis)
+
+        # Bind (first plugin that handles it)
+        for wp in self.plugins["bind"]:
+            status = wp.bind(state, pod, selected)
+            if status is not None and status.is_skip():
+                continue
+            if status is not None and not status.is_success():
+                snapshot.forget(pod, selected)
+                self._unreserve(state, pod, selected)
+                return ScheduleResult(status=status, diagnosis=diagnosis)
+            break
+
+        for wp in self.plugins["post_bind"]:
+            wp.post_bind(state, pod, selected)
+
+        return ScheduleResult(
+            selected_node=selected,
+            feasible_nodes=[ni.name for ni in feasible],
+            diagnosis=diagnosis,
+        )
+
+    # ------------------------------------------------------------- internals
+
+    def _run_filters(self, state: CycleState, pod: Obj, ni: NodeInfo) -> "Status | None":
+        """Run filter plugins in order; stop at first failure (upstream
+        RunFilterPlugins semantics — later plugins don't run, so their
+        entries are absent from the annotation, as in the reference)."""
+        for wp in self.plugins["filter"]:
+            status = wp.filter(state, pod, ni)
+            if status is not None and not status.is_success():
+                return status
+        return None
+
+    def _run_post_filters(self, state: CycleState, pod: Obj, diagnosis: dict[str, Status]) -> "str | None":
+        for wp in self.plugins["post_filter"]:
+            nominated, status = wp.post_filter(state, pod, diagnosis)
+            if status is None or status.is_success():
+                return nominated
+        return None
+
+    def _score_and_select(
+        self, state: CycleState, pod: Obj, feasible: list[NodeInfo]
+    ) -> "tuple[str | None, Status | None]":
+        # PreScore: a non-success status aborts the cycle (upstream
+        # RunPreScorePlugins fails scheduling on the first error).
+        nodes = [ni.node for ni in feasible]
+        for wp in self.plugins["pre_score"]:
+            status = wp.pre_score(state, pod, nodes)
+            if status is not None and not status.is_success():
+                if status.is_skip():
+                    continue
+                return None, status
+
+        totals: dict[str, int] = {ni.name: 0 for ni in feasible}
+        for wp in self.plugins["score"]:
+            raw: dict[str, int] = {}
+            for ni in feasible:
+                score, status = wp.score(state, pod, ni)
+                if status is not None and not status.is_success():
+                    score = 0
+                raw[ni.name] = score
+            wp.normalize_scores(state, pod, raw)
+            weight = self.score_weights.get(wp.original.name, 1)
+            for name, s in raw.items():
+                totals[name] += s * weight
+
+        return self._select_host(totals), None
+
+    def _select_host(self, totals: dict[str, int]) -> str:
+        """Upstream selectHost: max score, reservoir-sampled tie-break
+        (reference mirrors it at scheduler/scheduler.go:323-344) — with a
+        seeded PRNG for reproducibility."""
+        best_score: "int | None" = None
+        selected = ""
+        cnt = 0
+        for name, score in totals.items():
+            if best_score is None or score > best_score:
+                best_score = score
+                selected = name
+                cnt = 1
+            elif score == best_score:
+                cnt += 1
+                if self.rng.randrange(cnt) == 0:
+                    selected = name
+        return selected
+
+    def _unreserve(self, state: CycleState, pod: Obj, node_name: str) -> None:
+        for wp in reversed(self.plugins["reserve"]):
+            wp.unreserve(state, pod, node_name)
+
+    def sort_pods(self, pods: list[Obj]) -> list[Obj]:
+        """Order the activeQ by the QueueSort plugin (PrioritySort default)."""
+        qs = self.plugins["queue_sort"]
+        if not qs:
+            return list(pods)
+        import functools
+
+        less = qs[0].less
+        return sorted(pods, key=functools.cmp_to_key(lambda a, b: -1 if less(a, b) else 1))

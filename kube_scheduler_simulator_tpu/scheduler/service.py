@@ -1,0 +1,346 @@
+"""Scheduler service: lifecycle + config transformation + the run loop.
+
+Rebuild of the reference's scheduler runtime layer (reference
+simulator/scheduler/scheduler.go:30-275): StartScheduler builds the wrapped
+plugin registry from the (defaulted) KubeSchedulerConfiguration, wires the
+result stores into the shared reflector, and runs the scheduling loop;
+RestartScheduler swaps configs with rollback on failure; only
+``profiles`` + ``extenders`` of a user-supplied config are honored
+(reference scheduler.go:258-275 filterOutNonAllowedChangesOnCfg).
+
+The run loop is synchronous-by-default (``schedule_pending`` drains the
+queue deterministically — what scenario replay needs); ``start_background``
+gives the reference's always-on behavior driven by store events.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Any, Callable
+
+from kube_scheduler_simulator_tpu.config import scheduler_config as sc
+from kube_scheduler_simulator_tpu.models.snapshot import Snapshot
+from kube_scheduler_simulator_tpu.models.wrapped import WrappedPlugin, original_name
+from kube_scheduler_simulator_tpu.plugins.intree import in_tree_registry
+from kube_scheduler_simulator_tpu.plugins.resultstore import ResultStore
+from kube_scheduler_simulator_tpu.plugins.storereflector import RESULT_STORE_KEY, StoreReflector
+from kube_scheduler_simulator_tpu.scheduler.framework_runner import (
+    Framework,
+    FrameworkHandle,
+    ScheduleResult,
+)
+
+Obj = dict[str, Any]
+
+
+class SchedulerService:
+    def __init__(self, cluster_store: Any, seed: int = 0):
+        self.cluster_store = cluster_store
+        self.seed = seed
+        self.reflector = StoreReflector()
+        self.reflector.register_to_cluster_store(cluster_store)
+        self._out_of_tree: dict[str, Callable[[Obj | None, Any], Any]] = {}
+        self._plugin_extenders: dict[str, Callable[[ResultStore], Any]] = {}
+        self._current_cfg: "Obj | None" = None
+        self._initial_cfg: "Obj | None" = None
+        self.framework: "Framework | None" = None
+        self.result_store: "ResultStore | None" = None
+        self._bg_thread: "threading.Thread | None" = None
+        self._bg_stop = threading.Event()
+        self._wakeup = threading.Event()
+        self.batch_engine_factory: "Callable[..., Any] | None" = None
+
+    # ----------------------------------------------------------- extension
+
+    def set_out_of_tree_registries(self, registry: dict[str, Callable[[Obj | None, Any], Any]]) -> None:
+        """SetOutOfTreeRegistries analog (reference
+        simulator/scheduler/config/plugin.go:58-63)."""
+        self._out_of_tree.update(registry)
+
+    def set_plugin_extenders(self, extenders: dict[str, Callable[[ResultStore], Any]]) -> None:
+        """WithPluginExtenders analog (reference
+        pkg/debuggablescheduler/command.go:35-46): plugin name →
+        initializer receiving the shared result store."""
+        self._plugin_extenders.update(extenders)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start_scheduler(self, cfg: "Obj | None" = None) -> None:
+        """StartScheduler analog (reference scheduler.go:96-186)."""
+        cfg = self._filter_allowed_changes(cfg)
+        self.framework = self._build_framework(cfg)
+        self._current_cfg = cfg
+        if self._initial_cfg is None:
+            self._initial_cfg = copy.deepcopy(cfg)
+
+    def restart_scheduler(self, cfg: "Obj | None") -> None:
+        """RestartScheduler analog with rollback (reference
+        scheduler.go:70-87)."""
+        old = self._current_cfg
+        try:
+            self.start_scheduler(cfg)
+        except Exception:
+            if old is not None:
+                self.start_scheduler(old)
+            raise
+
+    def reset_scheduler_configuration(self) -> None:
+        self.restart_scheduler(copy.deepcopy(self._initial_cfg))
+
+    def shutdown_scheduler(self) -> None:
+        self.stop_background()
+        self.framework = None
+
+    def get_scheduler_config(self) -> Obj:
+        assert self._current_cfg is not None, "scheduler not started"
+        return copy.deepcopy(self._current_cfg)
+
+    # -------------------------------------------------------------- builder
+
+    def _filter_allowed_changes(self, cfg: "Obj | None") -> Obj:
+        """Only .profiles and .extenders of user configs are honored
+        (reference scheduler.go:258-275)."""
+        base = sc.default_scheduler_config()
+        if cfg is None:
+            return base
+        if cfg.get("profiles"):
+            base["profiles"] = copy.deepcopy(cfg["profiles"])
+        if cfg.get("extenders"):
+            base["extenders"] = copy.deepcopy(cfg["extenders"])
+        if cfg.get("percentageOfNodesToScore") is not None:
+            base["percentageOfNodesToScore"] = cfg["percentageOfNodesToScore"]
+        return base
+
+    def _build_framework(self, cfg: Obj) -> Framework:
+        profile = (cfg.get("profiles") or [{}])[0]
+        registry = in_tree_registry()
+        registry.update(self._out_of_tree)
+
+        # Reject configs naming unknown plugins (reference plugins.go:54
+        # "registry for %s is not found").
+        for point_set in (profile.get("plugins") or {}).values():
+            if not isinstance(point_set, dict):
+                continue
+            for p in point_set.get("enabled") or []:
+                name = original_name(p.get("name", ""))
+                if name and name != "*" and name not in registry:
+                    raise KeyError(f"registry for {name} is not found")
+
+        args_by_name = sc.plugin_args_by_name(profile)
+        handle = FrameworkHandle(cluster_store=self.cluster_store)
+
+        # Instantiate one original per plugin name.
+        instances: dict[str, Any] = {}
+
+        def instance(name: str) -> Any:
+            name = original_name(name)
+            if name not in instances:
+                if name not in registry:
+                    raise KeyError(f"registry for {name} is not found")
+                instances[name] = registry[name](args_by_name.get(name), handle)
+            return instances[name]
+
+        # Capabilities keyed by original name.
+        capabilities: dict[str, set[str]] = {}
+        all_names = set(registry.keys())
+        for p in (profile.get("plugins") or {}).get("multiPoint", {}).get("enabled") or []:
+            all_names.add(original_name(p["name"]))
+        for name in all_names:
+            try:
+                inst = instance(name)
+            except KeyError:
+                continue
+            capabilities[name] = {
+                point for point, method in sc.POINT_METHODS.items() if hasattr(inst, method)
+            }
+
+        norm_profile = copy.deepcopy(profile)
+        _normalize_names(norm_profile)
+        per_point = sc.effective_plugins(norm_profile, capabilities)
+
+        # Weights come from the EFFECTIVE (merged) score plugin set, so
+        # default plugins keep their default weights when a custom profile
+        # only overrides some of them; zero weight → 1 (reference
+        # plugins.go:288-303 semantics over the merged set).
+        score_weights = {
+            original_name(p["name"]): int(p.get("weight") or 0) or 1 for p in per_point["score"]
+        }
+        result_store = ResultStore(score_plugin_weight=score_weights)
+        self.result_store = result_store
+        self.reflector.add_result_store(result_store, RESULT_STORE_KEY)
+
+        wrapped_cache: dict[str, WrappedPlugin] = {}
+
+        def wrapped(name: str) -> WrappedPlugin:
+            name = original_name(name)
+            if name not in wrapped_cache:
+                orig = instance(name)
+                extender = None
+                if name in self._plugin_extenders:
+                    extender = self._plugin_extenders[name](result_store)
+                wrapped_cache[name] = WrappedPlugin(result_store, orig, extender)
+            return wrapped_cache[name]
+
+        plugins = {
+            "queue_sort": [wrapped(p["name"]) for p in per_point["queueSort"]],
+            "pre_filter": [wrapped(p["name"]) for p in per_point["preFilter"]],
+            "filter": [wrapped(p["name"]) for p in per_point["filter"]],
+            "post_filter": [wrapped(p["name"]) for p in per_point["postFilter"]],
+            "pre_score": [wrapped(p["name"]) for p in per_point["preScore"]],
+            "score": [wrapped(p["name"]) for p in per_point["score"]],
+            "reserve": [wrapped(p["name"]) for p in per_point["reserve"]],
+            "permit": [wrapped(p["name"]) for p in per_point["permit"]],
+            "pre_bind": [wrapped(p["name"]) for p in per_point["preBind"]],
+            "bind": [wrapped(p["name"]) for p in per_point["bind"]],
+            "post_bind": [wrapped(p["name"]) for p in per_point["postBind"]],
+        }
+
+        return Framework(
+            plugins,
+            handle,
+            score_weights=score_weights,
+            percentage_of_nodes_to_score=int(cfg.get("percentageOfNodesToScore") or 0),
+            seed=self.seed,
+            profile_name=profile.get("schedulerName") or "default-scheduler",
+        )
+
+    # ------------------------------------------------------------- run loop
+
+    def pending_pods(self) -> list[Obj]:
+        return [
+            p
+            for p in self.cluster_store.list("pods")
+            if not (p.get("spec") or {}).get("nodeName") and not p["metadata"].get("deletionTimestamp")
+        ]
+
+    def build_snapshot(self) -> Snapshot:
+        return Snapshot(
+            self.cluster_store.list("nodes"),
+            self.cluster_store.list("pods"),
+            self.cluster_store.list("namespaces"),
+        )
+
+    def schedule_pending(self, max_rounds: int = 3) -> dict[str, ScheduleResult]:
+        """Drain the pending queue: sort by QueueSort, schedule each pod in
+        order; preemption-nominated pods get retried in later rounds."""
+        assert self.framework is not None, "scheduler not started"
+        results: dict[str, ScheduleResult] = {}
+        for _ in range(max_rounds):
+            pending = self.framework.sort_pods(self.pending_pods())
+            if not pending:
+                break
+            snapshot = self.build_snapshot()
+            progressed = False
+            for pod in pending:
+                result = self.schedule_one(pod, snapshot)
+                key = f"{pod['metadata'].get('namespace', 'default')}/{pod['metadata']['name']}"
+                results[key] = result
+                if result.success or result.nominated_node:
+                    progressed = True
+            if not progressed:
+                break
+        return results
+
+    def schedule_one(self, pod: Obj, snapshot: "Snapshot | None" = None) -> ScheduleResult:
+        assert self.framework is not None, "scheduler not started"
+        if snapshot is None:
+            snapshot = self.build_snapshot()
+        result = self.framework.schedule_one(pod, snapshot)
+        if not result.success:
+            self._record_failure(pod, result)
+        # The reference's informer flushes results asynchronously after the
+        # cycle; flush the queued pods now that all results are recorded.
+        self.reflector.flush_all(self.cluster_store)
+        return result
+
+    def _record_failure(self, pod: Obj, result: ScheduleResult) -> None:
+        """Update pod status like upstream's failure handler: PodScheduled
+        condition + optional nominatedNodeName; the status update event then
+        triggers the reflector's annotation flush."""
+        ns = pod["metadata"].get("namespace", "default")
+        name = pod["metadata"]["name"]
+        message = self._failure_message(result)
+        patch: Obj = {
+            "status": {
+                "phase": "Pending",
+                "conditions": [
+                    {
+                        "type": "PodScheduled",
+                        "status": "False",
+                        "reason": "Unschedulable",
+                        "message": message,
+                    }
+                ],
+            }
+        }
+        if result.nominated_node:
+            patch["status"]["nominatedNodeName"] = result.nominated_node
+        try:
+            self.cluster_store.patch("pods", name, patch, ns)
+        except KeyError:
+            pass
+
+    @staticmethod
+    def _failure_message(result: ScheduleResult) -> str:
+        counts: dict[str, int] = {}
+        for status in result.diagnosis.values():
+            msg = status.message() if status is not None else ""
+            counts[msg] = counts.get(msg, 0) + 1
+        num = len(result.diagnosis)
+        parts = sorted(f"{c} {m}" for m, c in counts.items() if m)
+        if not parts:
+            return result.status.message() if result.status else "no nodes available"
+        return f"0/{num} nodes are available: {', '.join(parts)}."
+
+    # ----------------------------------------------------------- background
+
+    def start_background(self, poll_interval: float = 0.25) -> None:
+        """Always-on mode: schedule whenever pods/nodes change (the
+        reference's ``go sched.Run(ctx)``, scheduler.go:183)."""
+        if self._bg_thread is not None:
+            return
+        self._bg_stop.clear()
+        self._bg_unsubscribe = self.cluster_store.subscribe(["pods", "nodes"], lambda ev: self._wakeup.set())
+
+        def loop() -> None:
+            while not self._bg_stop.is_set():
+                self._wakeup.wait(timeout=poll_interval)
+                self._wakeup.clear()
+                if self._bg_stop.is_set():
+                    break
+                try:
+                    if self.framework is not None and self.pending_pods():
+                        self.schedule_pending(max_rounds=1)
+                except Exception:  # pragma: no cover - keep the loop alive
+                    pass
+
+        self._bg_thread = threading.Thread(target=loop, name="scheduler-loop", daemon=True)
+        self._bg_thread.start()
+
+    def stop_background(self) -> None:
+        if self._bg_thread is None:
+            return
+        self._bg_stop.set()
+        self._wakeup.set()
+        self._bg_thread.join(timeout=5)
+        self._bg_thread = None
+        if getattr(self, "_bg_unsubscribe", None) is not None:
+            self._bg_unsubscribe()
+            self._bg_unsubscribe = None
+
+
+def _normalize_names(profile: Obj) -> None:
+    """Strip the Wrapped suffix from any plugin names in a profile (users may
+    POST back the converted config the GET endpoint serves)."""
+    plugins = profile.get("plugins") or {}
+    for point_set in plugins.values():
+        if not isinstance(point_set, dict):
+            continue
+        for lst in ("enabled", "disabled"):
+            for p in point_set.get(lst) or []:
+                if p.get("name") and p["name"] != "*":
+                    p["name"] = original_name(p["name"])
+    for pc in profile.get("pluginConfig") or []:
+        if pc.get("name"):
+            pc["name"] = original_name(pc["name"])

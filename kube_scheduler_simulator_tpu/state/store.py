@@ -333,7 +333,16 @@ class ClusterStore:
         with self._lock:
             for kind in KINDS:
                 # Delete everything not in the target state, then apply.
-                want = {_key(o) for o in data.get(kind, [])}
+                # Key computation must default the namespace exactly like
+                # create/apply do, or namespaced objects without an explicit
+                # namespace would be deleted+recreated instead of updated.
+                def keyed(o: Mapping[str, Any]) -> str:
+                    meta = dict(o.get("metadata") or {})
+                    if kind in NAMESPACED_KINDS:
+                        meta.setdefault("namespace", "default")
+                    return _key({"metadata": meta})
+
+                want = {keyed(o) for o in data.get(kind, [])}
                 for k in list(self._bucket(kind)):
                     if k not in want:
                         obj = self._bucket(kind)[k]

@@ -1,0 +1,109 @@
+"""Config conversion tests (reference scheduler/scheduler_test.go and
+plugins_test.go pin this behavior)."""
+
+from kube_scheduler_simulator_tpu.config import scheduler_config as sc
+from kube_scheduler_simulator_tpu.models.wrapped import plugin_name, original_name
+
+
+class TestDefaults:
+    def test_default_config_shape(self):
+        cfg = sc.default_scheduler_config()
+        assert cfg["kind"] == "KubeSchedulerConfiguration"
+        profiles = cfg["profiles"]
+        assert len(profiles) == 1
+        assert profiles[0]["schedulerName"] == "default-scheduler"
+        enabled = profiles[0]["plugins"]["multiPoint"]["enabled"]
+        names = [p["name"] for p in enabled]
+        assert names[0] == "PrioritySort"
+        assert names[-1] == "DefaultBinder"
+        weights = {p["name"]: p.get("weight") for p in enabled if "weight" in p}
+        assert weights["TaintToleration"] == 3
+        assert weights["NodeAffinity"] == 2
+        assert weights["NodeResourcesFit"] == 1
+        assert weights["PodTopologySpread"] == 2
+        assert weights["InterPodAffinity"] == 2
+
+
+class TestConvertForSimulator:
+    def test_wraps_names_and_disables_star(self):
+        converted = sc.convert_for_simulator({})
+        mp = converted["multiPoint"]
+        assert mp["disabled"] == [{"name": "*"}]
+        names = [p["name"] for p in mp["enabled"]]
+        assert "TaintTolerationWrapped" in names
+        assert all(n.endswith("Wrapped") for n in names)
+        weights = {p["name"]: p.get("weight") for p in mp["enabled"] if "weight" in p}
+        assert weights["TaintTolerationWrapped"] == 3
+
+    def test_user_enabled_plugin_wrapped(self):
+        converted = sc.convert_for_simulator(
+            {"score": {"enabled": [{"name": "MyPlugin", "weight": 5}]}}
+        )
+        assert converted["score"]["enabled"] == [{"name": "MyPluginWrapped", "weight": 5}]
+
+
+class TestMergePluginSet:
+    def test_disable_star_suppresses_defaults(self):
+        merged = sc.merge_plugin_set(
+            {"enabled": [{"name": "A"}, {"name": "B"}]},
+            {"disabled": [{"name": "*"}], "enabled": [{"name": "C"}]},
+        )
+        assert [p["name"] for p in merged["enabled"]] == ["C"]
+
+    def test_custom_replaces_default_in_place(self):
+        merged = sc.merge_plugin_set(
+            {"enabled": [{"name": "A", "weight": 1}, {"name": "B"}]},
+            {"enabled": [{"name": "A", "weight": 9}]},
+        )
+        assert merged["enabled"][0] == {"name": "A", "weight": 9}
+        assert [p["name"] for p in merged["enabled"]] == ["A", "B"]
+
+    def test_disable_specific(self):
+        merged = sc.merge_plugin_set(
+            {"enabled": [{"name": "A"}, {"name": "B"}]},
+            {"disabled": [{"name": "A"}]},
+        )
+        assert [p["name"] for p in merged["enabled"]] == ["B"]
+
+
+class TestScoreWeights:
+    def test_zero_weight_becomes_one(self):
+        cfg = {
+            "profiles": [
+                {
+                    "plugins": {
+                        "score": {"enabled": [{"name": "Foo"}]},
+                        "multiPoint": {"enabled": [{"name": "Bar", "weight": 4}]},
+                    }
+                }
+            ]
+        }
+        w = sc.get_score_plugin_weight(cfg)
+        assert w["Foo"] == 1
+        assert w["Bar"] == 4
+
+    def test_wrapped_names_unwrapped(self):
+        cfg = {"profiles": [{"plugins": {"score": {"enabled": [{"name": "FooWrapped", "weight": 2}]}}}]}
+        assert sc.get_score_plugin_weight(cfg)["Foo"] == 2
+
+
+class TestNames:
+    def test_roundtrip(self):
+        assert plugin_name("NodeResourcesFit") == "NodeResourcesFitWrapped"
+        assert original_name("NodeResourcesFitWrapped") == "NodeResourcesFit"
+        assert original_name("Plain") == "Plain"
+
+
+class TestPluginArgs:
+    def test_user_args_override_defaults(self):
+        profile = {
+            "pluginConfig": [
+                {"name": "InterPodAffinity", "args": {"hardPodAffinityWeight": 50}},
+                {"name": "MyPlugin", "args": {"x": 1}},
+            ]
+        }
+        args = sc.plugin_args_by_name(profile)
+        assert args["InterPodAffinity"]["hardPodAffinityWeight"] == 50
+        assert args["MyPlugin"] == {"x": 1}
+        # defaults preserved for untouched plugins
+        assert args["NodeResourcesFit"]["scoringStrategy"]["type"] == "LeastAllocated"

@@ -1,0 +1,490 @@
+"""End-to-end tests of the sequential debuggable scheduling path.
+
+These transcribe the reference's parity oracles: annotation keys/shapes from
+the resultstore golden tests (reference
+simulator/scheduler/plugin/resultstore/store_test.go) and plugin semantics
+from upstream v1.26.
+"""
+
+import json
+
+import pytest
+
+from kube_scheduler_simulator_tpu.plugins import annotations as anno
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.state import ClusterStore
+
+
+def make_node(name, cpu="4", mem="8Gi", pods="110", labels=None, taints=None, unschedulable=False):
+    n = {
+        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name, **(labels or {})}},
+        "spec": {},
+        "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": pods}},
+    }
+    if taints:
+        n["spec"]["taints"] = taints
+    if unschedulable:
+        n["spec"]["unschedulable"] = True
+    return n
+
+
+def make_pod(name, cpu="100m", mem="128Mi", labels=None, **spec_extra):
+    return {
+        "metadata": {"name": name, "labels": labels or {}},
+        "spec": {
+            "containers": [
+                {"name": "c", "image": "img:1", "resources": {"requests": {"cpu": cpu, "memory": mem}}}
+            ],
+            **spec_extra,
+        },
+    }
+
+
+@pytest.fixture()
+def store():
+    s = ClusterStore(clock=lambda: 0.0)
+    s.create("namespaces", {"metadata": {"name": "default"}})
+    return s
+
+
+def start_service(store, cfg=None, seed=0):
+    svc = SchedulerService(store, seed=seed)
+    svc.start_scheduler(cfg)
+    return svc
+
+
+def annotations_of(store, pod_name):
+    return store.get("pods", pod_name)["metadata"].get("annotations") or {}
+
+
+class TestBasicScheduling:
+    def test_pods_bound_and_traced(self, store):
+        for i in range(2):
+            store.create("nodes", make_node(f"node-{i}"))
+        store.create("pods", make_pod("p1"))
+        svc = start_service(store)
+        results = svc.schedule_pending()
+        assert results["default/p1"].success
+        pod = store.get("pods", "p1")
+        assert pod["spec"]["nodeName"] in ("node-0", "node-1")
+        annos = pod["metadata"]["annotations"]
+        assert annos[anno.SELECTED_NODE] == pod["spec"]["nodeName"]
+        assert annos[anno.BIND_RESULT] == '{"DefaultBinder":"success"}'
+        assert annos[anno.PREBIND_RESULT] == '{"VolumeBinding":"success"}'
+        assert annos[anno.RESERVE_RESULT] == '{"VolumeBinding":"success"}'
+        # filter-result: every default filter plugin passed on both nodes
+        filt = json.loads(annos[anno.FILTER_RESULT])
+        assert set(filt.keys()) == {"node-0", "node-1"}
+        for per_plugin in filt.values():
+            assert per_plugin["NodeResourcesFit"] == "passed"
+            assert per_plugin["TaintToleration"] == "passed"
+
+    def test_annotation_json_is_go_compact_sorted(self, store):
+        store.create("nodes", make_node("node-0"))
+        store.create("nodes", make_node("node-1"))
+        store.create("pods", make_pod("p1"))
+        svc = start_service(store)
+        svc.schedule_pending()
+        raw = annotations_of(store, "p1")[anno.SCORE_RESULT]
+        # compact (no spaces), keys sorted, scores serialized as strings
+        assert ": " not in raw and ", " not in raw
+        parsed = json.loads(raw)
+        assert list(parsed.keys()) == sorted(parsed.keys())
+        for plugins in parsed.values():
+            for v in plugins.values():
+                assert isinstance(v, str) and v.lstrip("-").isdigit()
+
+    def test_score_weights_applied_in_finalscore(self, store):
+        store.create("nodes", make_node("node-0"))
+        store.create("nodes", make_node("node-1", taints=[{"key": "k", "value": "v", "effect": "PreferNoSchedule"}]))
+        store.create("pods", make_pod("p1"))
+        svc = start_service(store)
+        svc.schedule_pending()
+        annos = annotations_of(store, "p1")
+        score = json.loads(annos[anno.SCORE_RESULT])
+        final = json.loads(annos[anno.FINALSCORE_RESULT])
+        # TaintToleration raw: node-0 -> 0 intolerable, node-1 -> 1;
+        # normalized reversed: node-0=100, node-1=0; weight 3 applied.
+        assert score["node-0"]["TaintToleration"] == "0"
+        assert score["node-1"]["TaintToleration"] == "1"
+        assert final["node-0"]["TaintToleration"] == "300"
+        assert final["node-1"]["TaintToleration"] == "0"
+
+    def test_single_feasible_node_skips_scoring(self, store):
+        store.create("nodes", make_node("node-0"))
+        store.create("pods", make_pod("p1"))
+        svc = start_service(store)
+        results = svc.schedule_pending()
+        assert results["default/p1"].selected_node == "node-0"
+        annos = annotations_of(store, "p1")
+        assert annos[anno.SCORE_RESULT] == "{}"
+        assert annos[anno.FINALSCORE_RESULT] == "{}"
+
+    def test_result_history_accumulates(self, store):
+        store.create("nodes", make_node("node-0", cpu="1"))
+        store.create("pods", make_pod("p1", cpu="2"))
+        svc = start_service(store)
+        svc.schedule_pending(max_rounds=1)
+        history1 = json.loads(annotations_of(store, "p1")[anno.RESULT_HISTORY])
+        assert len(history1) == 1
+        # free resources and reschedule
+        store.create("nodes", make_node("node-1", cpu="4"))
+        svc.schedule_pending(max_rounds=1)
+        history2 = json.loads(annotations_of(store, "p1")[anno.RESULT_HISTORY])
+        assert len(history2) == 2
+        assert history2[1][anno.SELECTED_NODE] == "node-1"
+
+
+class TestUnschedulable:
+    def test_insufficient_resources_message(self, store):
+        for i in range(3):
+            store.create("nodes", make_node(f"node-{i}", cpu="1"))
+        store.create("pods", make_pod("big", cpu="8"))
+        svc = start_service(store)
+        results = svc.schedule_pending(max_rounds=1)
+        assert not results["default/big"].success
+        pod = store.get("pods", "big")
+        cond = pod["status"]["conditions"][0]
+        assert cond["type"] == "PodScheduled" and cond["status"] == "False"
+        assert cond["message"] == "0/3 nodes are available: 3 Insufficient cpu."
+        filt = json.loads(annotations_of(store, "big")[anno.FILTER_RESULT])
+        assert filt["node-0"]["NodeResourcesFit"] == "Insufficient cpu"
+
+    def test_filter_stops_at_first_failure(self, store):
+        # NodeUnschedulable runs before NodeResourcesFit in default order;
+        # later plugin entries must be absent for that node.
+        store.create("nodes", make_node("node-0", unschedulable=True))
+        store.create("pods", make_pod("p1"))
+        svc = start_service(store)
+        svc.schedule_pending(max_rounds=1)
+        filt = json.loads(annotations_of(store, "p1")[anno.FILTER_RESULT])
+        assert filt["node-0"]["NodeUnschedulable"] == "node(s) were unschedulable"
+        assert "NodeResourcesFit" not in filt["node-0"]
+
+
+class TestTaintsAndAffinity:
+    def test_untolerated_taint_message(self, store):
+        store.create(
+            "nodes",
+            make_node("node-0", taints=[{"key": "dedicated", "value": "gpu", "effect": "NoSchedule"}]),
+        )
+        store.create("pods", make_pod("p1"))
+        svc = start_service(store)
+        svc.schedule_pending(max_rounds=1)
+        filt = json.loads(annotations_of(store, "p1")[anno.FILTER_RESULT])
+        assert filt["node-0"]["TaintToleration"] == "node(s) had untolerated taint {dedicated: gpu}"
+
+    def test_toleration_allows(self, store):
+        store.create(
+            "nodes",
+            make_node("node-0", taints=[{"key": "dedicated", "value": "gpu", "effect": "NoSchedule"}]),
+        )
+        store.create(
+            "pods",
+            make_pod(
+                "p1",
+                tolerations=[{"key": "dedicated", "operator": "Equal", "value": "gpu", "effect": "NoSchedule"}],
+            ),
+        )
+        svc = start_service(store)
+        results = svc.schedule_pending()
+        assert results["default/p1"].selected_node == "node-0"
+
+    def test_node_selector(self, store):
+        store.create("nodes", make_node("node-a", labels={"disk": "ssd"}))
+        store.create("nodes", make_node("node-b", labels={"disk": "hdd"}))
+        store.create("pods", make_pod("p1", nodeSelector={"disk": "ssd"}))
+        svc = start_service(store)
+        results = svc.schedule_pending()
+        assert results["default/p1"].selected_node == "node-a"
+        filt = json.loads(annotations_of(store, "p1")[anno.FILTER_RESULT])
+        assert filt["node-b"]["NodeAffinity"] == "node(s) didn't match Pod's node affinity/selector"
+
+    def test_preferred_node_affinity_scoring(self, store):
+        store.create("nodes", make_node("node-a", labels={"zone": "west"}))
+        store.create("nodes", make_node("node-b", labels={"zone": "east"}))
+        affinity = {
+            "nodeAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "weight": 100,
+                        "preference": {
+                            "matchExpressions": [{"key": "zone", "operator": "In", "values": ["west"]}]
+                        },
+                    }
+                ]
+            }
+        }
+        store.create("pods", make_pod("p1", affinity=affinity))
+        svc = start_service(store)
+        results = svc.schedule_pending()
+        assert results["default/p1"].selected_node == "node-a"
+        final = json.loads(annotations_of(store, "p1")[anno.FINALSCORE_RESULT])
+        # normalized 100 * weight 2
+        assert final["node-a"]["NodeAffinity"] == "200"
+        assert final["node-b"]["NodeAffinity"] == "0"
+
+    def test_node_name_pinning(self, store):
+        for i in range(3):
+            store.create("nodes", make_node(f"node-{i}"))
+        store.create("pods", make_pod("p1", nodeName=None) | {})
+        pod = make_pod("pinned")
+        pod["spec"]["nodeName"] = ""  # empty means unpinned
+        store.delete("pods", "p1")
+        store.create("pods", make_pod("p2", **{}))
+        # pin via required nodeName match through NodeName plugin
+        p3 = make_pod("p3")
+        store.create("pods", p3)
+        svc = start_service(store)
+        svc.schedule_pending()
+        assert store.get("pods", "p2")["spec"]["nodeName"]
+
+
+class TestTopologySpread:
+    def test_do_not_schedule_skew(self, store):
+        store.create("nodes", make_node("node-a", labels={"zone": "z1"}))
+        store.create("nodes", make_node("node-b", labels={"zone": "z2"}))
+        # two existing pods in z1, zero in z2
+        for i, node in enumerate(["node-a", "node-a"]):
+            p = make_pod(f"existing-{i}", labels={"app": "web"})
+            p["spec"]["nodeName"] = node
+            store.create("pods", p)
+        constraint = {
+            "maxSkew": 1,
+            "topologyKey": "zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "web"}},
+        }
+        store.create("pods", make_pod("p1", labels={"app": "web"}, topologySpreadConstraints=[constraint]))
+        svc = start_service(store)
+        results = svc.schedule_pending()
+        assert results["default/p1"].selected_node == "node-b"
+        filt = json.loads(annotations_of(store, "p1")[anno.FILTER_RESULT])
+        assert filt["node-a"]["PodTopologySpread"] == "node(s) didn't match pod topology spread constraints"
+
+    def test_missing_topology_label(self, store):
+        store.create("nodes", make_node("node-a", labels={"zone": "z1"}))
+        store.create("nodes", make_node("node-nolabel"))
+        constraint = {
+            "maxSkew": 1,
+            "topologyKey": "zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "web"}},
+        }
+        store.create("pods", make_pod("p1", labels={"app": "web"}, topologySpreadConstraints=[constraint]))
+        svc = start_service(store)
+        results = svc.schedule_pending()
+        assert results["default/p1"].selected_node == "node-a"
+        filt = json.loads(annotations_of(store, "p1")[anno.FILTER_RESULT])
+        assert (
+            filt["node-nolabel"]["PodTopologySpread"]
+            == "node(s) didn't match pod topology spread constraints (missing required label)"
+        )
+
+
+class TestInterPodAffinity:
+    def test_required_anti_affinity_filters(self, store):
+        store.create("nodes", make_node("node-a", labels={"zone": "z1"}))
+        store.create("nodes", make_node("node-b", labels={"zone": "z2"}))
+        existing = make_pod("existing", labels={"app": "db"})
+        existing["spec"]["nodeName"] = "node-a"
+        store.create("pods", existing)
+        affinity = {
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector": {"matchLabels": {"app": "db"}}, "topologyKey": "zone"}
+                ]
+            }
+        }
+        store.create("pods", make_pod("p1", affinity=affinity))
+        svc = start_service(store)
+        results = svc.schedule_pending()
+        assert results["default/p1"].selected_node == "node-b"
+        filt = json.loads(annotations_of(store, "p1")[anno.FILTER_RESULT])
+        assert filt["node-a"]["InterPodAffinity"] == "node(s) didn't match pod anti-affinity rules"
+
+    def test_required_affinity_colocates(self, store):
+        store.create("nodes", make_node("node-a", labels={"zone": "z1"}))
+        store.create("nodes", make_node("node-b", labels={"zone": "z2"}))
+        existing = make_pod("existing", labels={"app": "db"})
+        existing["spec"]["nodeName"] = "node-a"
+        store.create("pods", existing)
+        affinity = {
+            "podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector": {"matchLabels": {"app": "db"}}, "topologyKey": "zone"}
+                ]
+            }
+        }
+        store.create("pods", make_pod("p1", affinity=affinity))
+        svc = start_service(store)
+        results = svc.schedule_pending()
+        assert results["default/p1"].selected_node == "node-a"
+
+    def test_existing_pods_anti_affinity(self, store):
+        store.create("nodes", make_node("node-a", labels={"zone": "z1"}))
+        store.create("nodes", make_node("node-b", labels={"zone": "z2"}))
+        existing = make_pod(
+            "lonely",
+            labels={"app": "db"},
+            affinity={
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {"labelSelector": {"matchLabels": {"app": "web"}}, "topologyKey": "zone"}
+                    ]
+                }
+            },
+        )
+        existing["spec"]["nodeName"] = "node-a"
+        store.create("pods", existing)
+        store.create("pods", make_pod("p1", labels={"app": "web"}))
+        svc = start_service(store)
+        results = svc.schedule_pending()
+        assert results["default/p1"].selected_node == "node-b"
+        filt = json.loads(annotations_of(store, "p1")[anno.FILTER_RESULT])
+        assert filt["node-a"]["InterPodAffinity"] == "node(s) didn't satisfy existing pods' anti-affinity rules"
+
+
+class TestPreemption:
+    def test_high_priority_preempts(self, store):
+        store.create("nodes", make_node("node-0", cpu="1"))
+        victim = make_pod("victim", cpu="800m")
+        victim["spec"]["priority"] = 0
+        victim["spec"]["nodeName"] = "node-0"
+        store.create("pods", victim)
+        vip = make_pod("vip", cpu="800m")
+        vip["spec"]["priority"] = 1000
+        store.create("pods", vip)
+        svc = start_service(store)
+        results = svc.schedule_pending()
+        # victim evicted, vip eventually bound
+        assert results["default/vip"].success
+        assert store.get("pods", "vip")["spec"]["nodeName"] == "node-0"
+        import pytest as _pytest
+
+        with _pytest.raises(KeyError):
+            store.get("pods", "victim")
+
+    def test_postfilter_annotation(self, store):
+        store.create("nodes", make_node("node-0", cpu="1"))
+        victim = make_pod("victim", cpu="800m")
+        victim["spec"]["nodeName"] = "node-0"
+        store.create("pods", victim)
+        vip = make_pod("vip", cpu="800m")
+        vip["spec"]["priority"] = 1000
+        store.create("pods", vip)
+        svc = start_service(store)
+        svc.schedule_pending(max_rounds=1)
+        annos = annotations_of(store, "vip")
+        post = json.loads(annos[anno.POSTFILTER_RESULT])
+        assert post["node-0"]["DefaultPreemption"] == "preemption victim"
+
+
+class TestQueueOrdering:
+    def test_priority_sort(self, store):
+        store.create("nodes", make_node("node-0", cpu="1", pods="1"))
+        low = make_pod("low", cpu="800m")
+        low["spec"]["priority"] = 1
+        high = make_pod("high", cpu="800m")
+        high["spec"]["priority"] = 100
+        store.create("pods", low)
+        store.create("pods", high)
+        svc = start_service(store)
+        svc.schedule_pending(max_rounds=1)
+        # high priority pod scheduled first and takes the only slot
+        assert store.get("pods", "high")["spec"].get("nodeName") == "node-0"
+        assert "nodeName" not in store.get("pods", "low")["spec"]
+
+
+class TestSchedulerConfig:
+    def test_custom_weight_changes_finalscore(self, store):
+        store.create("nodes", make_node("node-0"))
+        store.create(
+            "nodes", make_node("node-1", taints=[{"key": "k", "value": "v", "effect": "PreferNoSchedule"}])
+        )
+        store.create("pods", make_pod("p1"))
+        cfg = {
+            "profiles": [
+                {
+                    "schedulerName": "default-scheduler",
+                    "plugins": {
+                        "multiPoint": {
+                            "enabled": [{"name": "TaintToleration", "weight": 10}],
+                        }
+                    },
+                }
+            ]
+        }
+        svc = start_service(store, cfg)
+        svc.schedule_pending()
+        final = json.loads(annotations_of(store, "p1")[anno.FINALSCORE_RESULT])
+        assert final["node-0"]["TaintToleration"] == "1000"
+
+    def test_default_weights_survive_partial_override(self, store):
+        # Overriding one plugin's weight must not zero the other defaults'
+        # weights (they come from the merged effective set).
+        store.create("nodes", make_node("node-a", labels={"zone": "west"}))
+        store.create("nodes", make_node("node-b", labels={"zone": "east"}))
+        affinity = {
+            "nodeAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "weight": 100,
+                        "preference": {
+                            "matchExpressions": [{"key": "zone", "operator": "In", "values": ["west"]}]
+                        },
+                    }
+                ]
+            }
+        }
+        store.create("pods", make_pod("p1", affinity=affinity))
+        cfg = {
+            "profiles": [
+                {
+                    "schedulerName": "default-scheduler",
+                    "plugins": {"multiPoint": {"enabled": [{"name": "TaintToleration", "weight": 10}]}},
+                }
+            ]
+        }
+        svc = start_service(store, cfg)
+        svc.schedule_pending()
+        final = json.loads(annotations_of(store, "p1")[anno.FINALSCORE_RESULT])
+        # NodeAffinity keeps default weight 2: normalized 100 * 2
+        assert final["node-a"]["NodeAffinity"] == "200"
+        assert final["node-a"]["TaintToleration"] == "1000"
+
+    def test_disable_plugin(self, store):
+        store.create("nodes", make_node("node-0"))
+        store.create("nodes", make_node("node-1"))
+        store.create("pods", make_pod("p1"))
+        cfg = {
+            "profiles": [
+                {
+                    "schedulerName": "default-scheduler",
+                    "plugins": {"multiPoint": {"disabled": [{"name": "ImageLocality"}]}},
+                }
+            ]
+        }
+        svc = start_service(store, cfg)
+        svc.schedule_pending()
+        score = json.loads(annotations_of(store, "p1")[anno.SCORE_RESULT])
+        for node_scores in score.values():
+            assert "ImageLocality" not in node_scores
+            assert "NodeResourcesFit" in node_scores
+
+    def test_restart_rollback_on_bad_config(self, store):
+        store.create("nodes", make_node("node-0"))
+        svc = start_service(store)
+        bad_cfg = {
+            "profiles": [
+                {
+                    "schedulerName": "default-scheduler",
+                    "plugins": {"multiPoint": {"enabled": [{"name": "NoSuchPlugin"}]}},
+                }
+            ]
+        }
+        with pytest.raises(KeyError):
+            svc.restart_scheduler(bad_cfg)
+        # old config still active and scheduling works
+        store.create("pods", make_pod("p1"))
+        assert svc.schedule_pending()["default/p1"].success

@@ -158,6 +158,17 @@ class TestDumpRestore:
         assert names == ["p1"]
         assert len(s.list("nodes")) == 1
 
+    def test_restore_without_namespace_updates_not_recreates(self):
+        s = ClusterStore()
+        s.create("pods", pod("p1"))
+        uid = s.get("pods", "p1")["metadata"]["uid"]
+        events = []
+        s.subscribe(["pods"], events.append)
+        # namespaced object without explicit namespace must match default/p1
+        s.restore({"pods": [{"metadata": {"name": "p1"}, "spec": {}}]})
+        assert s.get("pods", "p1")["metadata"]["uid"] == uid
+        assert all(e.type == "MODIFIED" for e in events)
+
     def test_deterministic_uids(self):
         s1 = ClusterStore(clock=lambda: 0.0)
         s2 = ClusterStore(clock=lambda: 0.0)
