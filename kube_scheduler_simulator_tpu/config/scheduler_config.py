@@ -222,22 +222,15 @@ def effective_plugins(profile: Obj, capabilities: dict[str, set[str]]) -> dict[s
     expansion); point-specific Enabled/Disabled then override.
     """
     plugins = profile.get("plugins") or {}
+    # merge_plugin_set already applies Disabled (incl. "*") to the DEFAULT
+    # set only — custom Enabled entries always survive, per upstream
+    # mergePluginSet semantics (reference plugins.go:229-284).
     multi = merge_plugin_set({"enabled": default_multipoint_enabled()}, plugins.get("multiPoint") or {})
-    multi_disabled = {p["name"] for p in multi["disabled"]}
     out: dict[str, list[Obj]] = {}
     for point in EXTENSION_POINT_KEYS:
-        base: list[Obj] = []
-        if "*" not in multi_disabled:
-            for p in multi["enabled"]:
-                name = p["name"]
-                if name in multi_disabled:
-                    continue
-                if point in capabilities.get(name, set()):
-                    base.append(p)
+        base = [p for p in multi["enabled"] if point in capabilities.get(p["name"], set())]
         point_set = plugins.get(point) or {}
-        merged = merge_plugin_set({"enabled": base}, point_set)
-        disabled_names = {p["name"] for p in merged["disabled"]}
-        out[point] = [p for p in merged["enabled"] if p["name"] not in disabled_names]
+        out[point] = merge_plugin_set({"enabled": base}, point_set)["enabled"]
     return out
 
 

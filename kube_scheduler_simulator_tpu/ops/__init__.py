@@ -1,0 +1,7 @@
+"""Vectorized TPU kernels and the host feature encoder.
+
+The reference evaluates Filter/Score as a Go loop nest per pod × node ×
+plugin (reference scheduler/scheduler.go:174-267 mirrors it); here the same
+semantics are lowered to dense tensors once on the host (ops/encode.py) and
+evaluated on device in a single compiled XLA scan (ops/batch.py).
+"""

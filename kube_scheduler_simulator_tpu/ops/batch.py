@@ -1,0 +1,450 @@
+"""The TPU batch scheduling kernel: one compiled lax.scan over the pod queue.
+
+This lifts the reference's hot loop — per pod × per node × per plugin
+Filter/Score calls serialized through a store mutex (reference
+simulator/scheduler/plugin/wrappedplugin.go:420-445,523-548;
+resultstore/store.go:423-437) — into a single XLA computation
+(BASELINE.json north star).  Scheduling is inherently sequential (each bind
+consumes node resources), so the batch shape is a ``lax.scan`` whose carry
+is the cluster's dynamic state and whose body vectorizes one full
+scheduling cycle over ALL nodes:
+
+    carry = (requested [N,R], nonzero [N,2], pod_count [N],
+             spread_counts [SG,N], ip_sel/ip_own/ip_anti [G,D+1])
+    step  = filters [N] → scores [N] → normalize → argmax → scatter-commit
+
+Every per-plugin semantic (first-failure short circuit, per-plugin
+normalization, weight application, single-feasible-node scoring bypass)
+matches the sequential oracle in scheduler/framework_runner.py, which in
+turn pins the reference's upstream v1.26 behavior.  Static string semantics
+were pre-lowered by ops/encode.py; nothing here touches a string.
+
+All math is in the problem dtype (float64 under x64 for bit-exact parity
+tests on CPU; float32 on TPU, kept exact by the encoder's GCD scaling for
+the filter path and ratio formulations for scores).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kube_scheduler_simulator_tpu.ops.encode import BatchProblem
+
+MAX_NODE_SCORE = 100.0
+NEG = -1e18
+
+
+class BatchConfig(NamedTuple):
+    """Static (compile-time) plugin configuration for the batch kernel."""
+
+    filters: tuple  # subset of FILTER_KERNELS, in profile order
+    scores: tuple   # ((kernel_name, weight), ...) in profile order
+    fit_strategy: str = "LeastAllocated"
+    trace: bool = False
+
+
+FILTER_KERNELS = (
+    "NodeUnschedulable",
+    "NodeName",
+    "TaintToleration",
+    "NodeAffinity",
+    "NodeResourcesFit",
+    "PodTopologySpread",
+    "InterPodAffinity",
+)
+SCORE_KERNELS = (
+    "NodeResourcesFit",
+    "NodeResourcesBalancedAllocation",
+    "TaintToleration",
+    "NodeAffinity",
+    "PodTopologySpread",
+    "InterPodAffinity",
+)
+
+
+class DeviceProblem(NamedTuple):
+    """BatchProblem lowered to device arrays (a pytree, jit-traceable)."""
+
+    alloc: Any            # [N,R]
+    max_pods: Any         # [N]
+    nz_alloc: Any         # [N,2]
+    pod_req: Any          # [P,R]
+    pod_nonzero: Any      # [P,2]
+    fit_checked: Any      # [P,R] bool
+    taint_fail: Any       # [P,N] int16
+    taint_prefer: Any     # [P,N]
+    unsched_ok: Any       # [P,N] bool
+    aff_code: Any         # [P,N] int8
+    aff_pref: Any         # [P,N]
+    name_ok: Any          # [P,N] bool
+    incl: Any             # [P,N] bool
+    node_domain: Any      # [KT,N] int32
+    spf: Any              # spread filter constraints (key,grp,skew,self) [P,KC]
+    sps: Any              # spread score constraints [P,KS]
+    spread_match: Any     # [SG,P] bool
+    gdom: Any             # [G,N] int32 (domain of each group's key per node)
+    term_match: Any       # [G,P]
+    ip_aff_g: Any         # [P,KA]
+    ip_anti_g: Any        # [P,KB]
+    ip_pref_g: Any        # [P,KP]
+    ip_pref_w: Any        # [P,KP]
+    ip_own_g: Any         # [P,KO]
+    ip_own_w: Any         # [P,KO]
+    ip_self_match: Any    # [P] bool
+    pod_active: Any       # [P] bool (False = padding row, never committed)
+    # initial carry
+    requested0: Any       # [N,R]
+    nonzero0: Any         # [N,2]
+    pod_count0: Any       # [N]
+    spread_counts0: Any   # [SG,N]
+    ip_sel0: Any          # [G,D+1]
+    ip_own0: Any          # [G,D+1]
+    ip_anti0: Any         # [G,D+1]
+
+
+def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
+    """Convert host BatchProblem → DeviceProblem (+ static dims dict)."""
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    f = lambda x: jnp.asarray(np.asarray(x), dtype=dtype)
+    i32 = lambda x: jnp.asarray(np.asarray(x), dtype=jnp.int32)
+    b = lambda x: jnp.asarray(np.asarray(x), dtype=bool)
+    D = pr.D
+    group_key = np.asarray(pr.group_key)
+    gdom = np.asarray(pr.node_domain)[np.clip(group_key, 0, None)]  # [G,N]
+    pad = lambda a: np.concatenate([a, np.zeros((a.shape[0], 1), a.dtype)], axis=1)
+    dp = DeviceProblem(
+        alloc=f(pr.alloc),
+        max_pods=f(pr.max_pods),
+        nz_alloc=f(pr.nz_alloc),
+        pod_req=f(pr.pod_req),
+        pod_nonzero=f(pr.pod_nonzero),
+        fit_checked=b(pr.fit_checked),
+        taint_fail=jnp.asarray(pr.taint_fail, dtype=jnp.int16),
+        taint_prefer=f(pr.taint_prefer),
+        unsched_ok=b(pr.unsched_ok),
+        aff_code=jnp.asarray(pr.aff_code, dtype=jnp.int8),
+        aff_pref=f(pr.aff_pref),
+        name_ok=b(pr.name_ok),
+        incl=b(pr.incl),
+        node_domain=i32(pr.node_domain),
+        spf=(i32(pr.spf_key), i32(pr.spf_group), f(pr.spf_skew), f(pr.spf_self)),
+        sps=(i32(pr.sps_key), i32(pr.sps_group), f(pr.sps_skew), f(pr.sps_self)),
+        spread_match=f(pr.spread_match),
+        gdom=i32(gdom),
+        term_match=f(pr.term_match),
+        ip_aff_g=i32(pr.ip_aff_g),
+        ip_anti_g=i32(pr.ip_anti_g),
+        ip_pref_g=i32(pr.ip_pref_g),
+        ip_pref_w=f(pr.ip_pref_w),
+        ip_own_g=i32(pr.ip_own_g),
+        ip_own_w=f(pr.ip_own_w),
+        ip_self_match=b(pr.ip_self_match),
+        pod_active=b(getattr(pr, "pod_active", np.ones(pr.P, dtype=bool))),
+        requested0=f(pr.requested0),
+        nonzero0=f(pr.nonzero0),
+        pod_count0=f(pr.pod_count0),
+        spread_counts0=f(pr.spread_counts0),
+        ip_sel0=f(pad(np.asarray(pr.ip_sel0))),
+        ip_own0=f(pad(np.asarray(pr.ip_own0))),
+        ip_anti0=f(pad(np.asarray(pr.ip_anti0))),
+    )
+    dims = dict(
+        P=pr.P, N=pr.N, R=pr.R, D=D, SG=pr.SG, G=pr.G,
+        KC=pr.KC, KS=pr.KS, KA=pr.KA, KB=pr.KB, KP=pr.KP, KO=pr.KO,
+    )
+    return dp, dims
+
+
+# --------------------------------------------------------------- primitives
+
+def _floordiv(a, b):
+    """Go integer division for non-negative operands, in floats."""
+    return jnp.floor(a / jnp.where(b == 0, 1.0, b)) * (b != 0)
+
+
+def _default_normalize(raw, feasible, reverse: bool):
+    """helper.DefaultNormalizeScore over the feasible set (int semantics)."""
+    mx = jnp.max(jnp.where(feasible, raw, 0.0))
+    scaled = _floordiv(raw * MAX_NODE_SCORE, mx)
+    out = jnp.where(reverse, MAX_NODE_SCORE - scaled, scaled)
+    zero_case = MAX_NODE_SCORE if reverse else 0.0
+    return jnp.where(mx == 0, zero_case, out)
+
+
+def _minmax_normalize(raw, feasible):
+    """InterPodAffinity's ScoreExtensions: MAX*(v-min)/(max-min), trunc."""
+    mn = jnp.min(jnp.where(feasible, raw, jnp.inf))
+    mx = jnp.max(jnp.where(feasible, raw, -jnp.inf))
+    diff = mx - mn
+    return jnp.where(diff > 0, jnp.floor(MAX_NODE_SCORE * (raw - mn) / jnp.where(diff == 0, 1.0, diff)), 0.0)
+
+
+# ------------------------------------------------------------------- kernel
+
+def build_batch_fn(cfg: BatchConfig, dims: dict):
+    """Build the jitted batch scheduling function for a static config/dims.
+
+    Returns fn(dp: DeviceProblem) → dict of result arrays.
+    """
+    P, N, D = dims["P"], dims["N"], dims["D"]
+    KC, KS = dims["KC"], dims["KS"]
+    KA, KB, KP, KO = dims["KA"], dims["KB"], dims["KP"], dims["KO"]
+    G, SG = dims["G"], dims["SG"]
+    use_spread_f = "PodTopologySpread" in cfg.filters and KC > 0
+    use_spread_s = any(k == "PodTopologySpread" for k, _ in cfg.scores) and KS > 0
+    use_ip = G > 0 and (
+        "InterPodAffinity" in cfg.filters or any(k == "InterPodAffinity" for k, _ in cfg.scores)
+    )
+
+    def step(dp: DeviceProblem, carry, xs):
+        requested, nonzero, pod_count, spread_counts, ip_sel, ip_own, ip_anti = carry
+        i = xs
+        dt = requested.dtype
+        pod_req = dp.pod_req[i]
+        codes = {}  # plugin -> [N] reason code (0 = pass)
+
+        # ---------------------------------------------------------- filters
+        feasible = jnp.ones(N, dtype=bool)
+
+        def apply(name, code):
+            nonlocal feasible
+            codes[name] = code
+            feasible = feasible & (code == 0)
+
+        for name in cfg.filters:
+            if name == "NodeUnschedulable":
+                apply(name, jnp.where(dp.unsched_ok[i], 0, 1))
+            elif name == "NodeName":
+                apply(name, jnp.where(dp.name_ok[i], 0, 1))
+            elif name == "TaintToleration":
+                tfail = dp.taint_fail[i].astype(jnp.int32)
+                apply(name, jnp.where(tfail < 0, 0, tfail + 1))
+            elif name == "NodeAffinity":
+                apply(name, dp.aff_code[i].astype(jnp.int32))
+            elif name == "NodeResourcesFit":
+                free = dp.alloc - requested
+                want = pod_req
+                insuff = (want[None, :] > free) & dp.fit_checked[i][None, :]
+                too_many = pod_count + 1.0 > dp.max_pods
+                # bit 0: Too many pods; bit r+1: Insufficient resource r
+                code = too_many.astype(jnp.int32)
+                for r in range(dims["R"]):
+                    code = code | (insuff[:, r].astype(jnp.int32) << (r + 1))
+                apply(name, code)
+            elif name == "PodTopologySpread" and use_spread_f:
+                code = jnp.zeros(N, dtype=jnp.int32)
+                incl_row = dp.incl[i]
+                key_row, grp_row, skew_row, self_row = dp.spf
+                for k in range(KC):
+                    key = key_row[i, k]
+                    active = key >= 0
+                    dom = jnp.take(dp.node_domain, jnp.clip(key, 0), axis=0)  # [N]
+                    m = jnp.take(spread_counts, grp_row[i, k], axis=0)  # [N]
+                    contributing = incl_row & (dom >= 0)
+                    dom_safe = jnp.where(contributing, dom, D)
+                    dcounts = jnp.zeros(D + 1, dtype=dt).at[dom_safe].add(jnp.where(contributing, m, 0.0))
+                    dpresent = jnp.zeros(D + 1, dtype=bool).at[dom_safe].set(contributing)
+                    has_any = jnp.any(dpresent[:D])
+                    min_match = jnp.min(jnp.where(dpresent[:D], dcounts[:D], jnp.inf))
+                    min_match = jnp.where(has_any, min_match, 0.0)
+                    match_num = dcounts[jnp.clip(dom, 0)] * (dom >= 0)
+                    skew = match_num + self_row[i, k] - min_match
+                    k_code = jnp.where(dom < 0, 1, jnp.where(skew > skew_row[i, k], 2, 0))
+                    k_code = jnp.where(active, k_code, 0)
+                    code = jnp.where(code == 0, k_code, code)
+                apply(name, code)
+            elif name == "InterPodAffinity" and use_ip:
+                tm = dp.term_match[:, i]  # [G]
+                gvalid = dp.gdom >= 0  # [G,N]
+                gdom_safe = jnp.where(gvalid, dp.gdom, D)
+                antimat = jnp.take_along_axis(ip_anti, gdom_safe, axis=1) * gvalid  # [G,N]
+                poison = tm @ antimat  # [N]
+                code = jnp.where(poison > 0, 1, 0).astype(jnp.int32)
+                # own required affinity
+                if KA > 0:
+                    sat = jnp.ones(N, dtype=bool)
+                    total_any = jnp.zeros((), dtype=dt)
+                    for k in range(KA):
+                        g = dp.ip_aff_g[i, k]
+                        active = g >= 0
+                        gs = jnp.clip(g, 0)
+                        row = ip_sel[gs]  # [D+1]
+                        dom = dp.gdom[gs]
+                        cnt = row[jnp.where(dom >= 0, dom, D)] * (dom >= 0)
+                        sat = sat & (jnp.where(active, (cnt > 0) & (dom >= 0), True))
+                        total_any = total_any + jnp.where(active, jnp.sum(row[:D]), 0.0)
+                    has_aff = dp.ip_aff_g[i, 0] >= 0
+                    escape = (total_any == 0) & dp.ip_self_match[i]
+                    aff_fail = has_aff & ~sat & ~escape
+                    code = jnp.where((code == 0) & aff_fail, 2, code)
+                if KB > 0:
+                    for k in range(KB):
+                        g = dp.ip_anti_g[i, k]
+                        active = g >= 0
+                        gs = jnp.clip(g, 0)
+                        dom = dp.gdom[gs]
+                        cnt = ip_sel[gs][jnp.where(dom >= 0, dom, D)] * (dom >= 0)
+                        fail = active & (cnt > 0)
+                        code = jnp.where((code == 0) & fail, 3, code)
+                apply(name, code)
+            else:  # kernel inactive for this problem (no constraints)
+                codes[name] = jnp.zeros(N, dtype=jnp.int32)
+
+        count = jnp.sum(feasible.astype(jnp.int32)) * dp.pod_active[i]
+
+        # ----------------------------------------------------------- scores
+        raws = {}
+        norms = {}
+        totals = jnp.zeros(N, dtype=dt)
+        for name, weight in cfg.scores:
+            if name == "NodeResourcesFit":
+                req_nz = nonzero + dp.pod_nonzero[i][None, :]  # [N,2]
+                a = dp.nz_alloc
+                if cfg.fit_strategy == "MostAllocated":
+                    per_r = jnp.where((a > 0) & (req_nz <= a), _floordiv(req_nz * MAX_NODE_SCORE, a), 0.0)
+                else:  # LeastAllocated
+                    per_r = jnp.where((a > 0) & (req_nz <= a), _floordiv((a - req_nz) * MAX_NODE_SCORE, a), 0.0)
+                raw = _floordiv(per_r[:, 0] + per_r[:, 1], 2.0)
+                norm = raw  # no ScoreExtensions
+            elif name == "NodeResourcesBalancedAllocation":
+                req_nz = nonzero + dp.pod_nonzero[i][None, :]
+                a = dp.nz_alloc
+                frac = jnp.where(a > 0, jnp.minimum(req_nz / jnp.where(a == 0, 1.0, a), 1.0), 1.0)
+                std = jnp.abs(frac[:, 0] - frac[:, 1]) / 2.0
+                raw = jnp.floor((1.0 - std) * MAX_NODE_SCORE)
+                norm = raw
+            elif name == "TaintToleration":
+                raw = dp.taint_prefer[i]
+                norm = _default_normalize(raw, feasible, reverse=True)
+            elif name == "NodeAffinity":
+                raw = dp.aff_pref[i]
+                norm = _default_normalize(raw, feasible, reverse=False)
+            elif name == "PodTopologySpread" and use_spread_s:
+                key_row, grp_row, skew_row, self_row = dp.sps
+                has_constraints = key_row[i, 0] >= 0
+                # require-all mask: all active constraint keys present
+                has_all = jnp.ones(N, dtype=bool)
+                for k in range(KS):
+                    key = key_row[i, k]
+                    dom = jnp.take(dp.node_domain, jnp.clip(key, 0), axis=0)
+                    has_all = has_all & jnp.where(key >= 0, dom >= 0, True)
+                raw_f = jnp.zeros(N, dtype=dt)
+                for k in range(KS):
+                    key = key_row[i, k]
+                    active = key >= 0
+                    dom = jnp.take(dp.node_domain, jnp.clip(key, 0), axis=0)
+                    m = jnp.take(spread_counts, grp_row[i, k], axis=0)
+                    contributing = has_all & (dom >= 0)
+                    dom_safe = jnp.where(contributing, dom, D)
+                    dcounts = jnp.zeros(D + 1, dtype=dt).at[dom_safe].add(jnp.where(contributing, m, 0.0))
+                    cnt = dcounts[jnp.clip(dom, 0)] * (dom >= 0)
+                    # topology size among feasible non-ignored nodes
+                    fni = feasible & has_all & (dom >= 0)
+                    dseen = jnp.zeros(D + 1, dtype=bool).at[jnp.where(fni, dom, D)].set(fni)
+                    tsize = jnp.sum(dseen[:D].astype(dt))
+                    w = jnp.log(tsize + 2.0)
+                    raw_f = raw_f + jnp.where(active, cnt * w + (skew_row[i, k] - 1.0), 0.0)
+                raw = jnp.round(raw_f)
+                ignored = ~has_all
+                considered = feasible & ~ignored
+                mn = jnp.min(jnp.where(considered, raw, jnp.inf))
+                mx = jnp.max(jnp.where(considered, raw, -jnp.inf))
+                any_considered = jnp.any(considered)
+                norm = jnp.where(
+                    mx == 0,
+                    MAX_NODE_SCORE,
+                    _floordiv(MAX_NODE_SCORE * (mx + mn - raw), mx),
+                )
+                norm = jnp.where(ignored | ~any_considered, 0.0, norm)
+                norm = jnp.where(has_constraints, norm, 0.0)
+                raw = jnp.where(has_constraints, raw, 0.0)
+            elif name == "InterPodAffinity" and use_ip:
+                gvalid = dp.gdom >= 0
+                gdom_safe = jnp.where(gvalid, dp.gdom, D)
+                selmat = jnp.take_along_axis(ip_sel, gdom_safe, axis=1) * gvalid  # [G,N]
+                ownmat = jnp.take_along_axis(ip_own, gdom_safe, axis=1) * gvalid
+                raw = dp.term_match[:, i] @ ownmat
+                for k in range(KP):
+                    g = dp.ip_pref_g[i, k]
+                    active = g >= 0
+                    w = dp.ip_pref_w[i, k]
+                    raw = raw + jnp.where(active, w * selmat[jnp.clip(g, 0)], 0.0)
+                norm = _minmax_normalize(raw, feasible)
+            else:
+                raw = jnp.zeros(N, dtype=dt)
+                norm = raw
+            if cfg.trace:
+                raws[name] = raw
+                norms[name] = norm
+            totals = totals + norm * float(weight)
+
+        # Single-feasible-node bypass: scores are skipped (annotations omit
+        # them); selection is the lone feasible node either way.
+        masked = jnp.where(feasible, totals, NEG)
+        sel = jnp.argmax(masked).astype(jnp.int32)
+        sel = jnp.where(count > 0, sel, -1)
+
+        # ----------------------------------------------------------- commit
+        commit = count > 0
+        onehot = (jnp.arange(N) == sel) & commit  # [N]
+        oh = onehot.astype(dt)
+        requested = requested + oh[:, None] * pod_req[None, :]
+        nonzero = nonzero + oh[:, None] * dp.pod_nonzero[i][None, :]
+        pod_count = pod_count + oh
+        if SG > 0:
+            spread_counts = spread_counts + dp.spread_match[:, i][:, None] * oh[None, :]
+        if use_ip:
+            sel_safe = jnp.clip(sel, 0)
+            d_g = dp.gdom[:, sel_safe]  # [G]
+            d_g = jnp.where((d_g >= 0) & commit, d_g, D)
+            ip_sel = ip_sel.at[jnp.arange(ip_sel.shape[0]), d_g].add(dp.term_match[:, i] * commit)
+            for k in range(KO):
+                g = dp.ip_own_g[i, k]
+                active = (g >= 0) & commit
+                gs = jnp.clip(g, 0)
+                dd = dp.gdom[gs, sel_safe]
+                dd = jnp.where((dd >= 0) & active, dd, D)
+                ip_own = ip_own.at[gs, dd].add(dp.ip_own_w[i, k] * active)
+            for k in range(KB):
+                g = dp.ip_anti_g[i, k]
+                active = (g >= 0) & commit
+                gs = jnp.clip(g, 0)
+                dd = dp.gdom[gs, sel_safe]
+                dd = jnp.where((dd >= 0) & active, dd, D)
+                ip_anti = ip_anti.at[gs, dd].add(jnp.where(active, 1.0, 0.0))
+
+        carry = (requested, nonzero, pod_count, spread_counts, ip_sel, ip_own, ip_anti)
+        out = {"selected": sel, "feasible_count": count}
+        if cfg.trace:
+            out["feasible"] = feasible
+            out["totals"] = totals
+            for n_, c_ in codes.items():
+                out[f"code:{n_}"] = c_
+            for n_ in raws:
+                out[f"raw:{n_}"] = raws[n_]
+                out[f"norm:{n_}"] = norms[n_]
+        return carry, out
+
+    def run(dp: DeviceProblem):
+        carry0 = (
+            dp.requested0,
+            dp.nonzero0,
+            dp.pod_count0,
+            dp.spread_counts0,
+            dp.ip_sel0,
+            dp.ip_own0,
+            dp.ip_anti0,
+        )
+        carry, ys = lax.scan(functools.partial(step, dp), carry0, jnp.arange(P))
+        ys["final_requested"] = carry[0]
+        ys["final_pod_count"] = carry[2]
+        return ys
+
+    return jax.jit(run)

@@ -1,0 +1,607 @@
+"""Host feature encoder: cluster objects → dense batch-scheduling tensors.
+
+This is the DCN boundary of the TPU build (SURVEY.md §7 step 2): every
+string-semantic the reference evaluates inside its per-node plugin calls
+(label selectors, node-affinity terms, taints/tolerations, topology keys —
+reference simulator/scheduler/plugin/wrappedplugin.go delegates these to the
+upstream in-tree plugins) is evaluated HERE, once, on the host, memoized by
+(spec signature × label signature), and lowered to dense matrices.  The
+device only ever sees numbers.
+
+Encoding layout (P = pending pods in queue order, N = nodes, R = resources):
+
+Static per-(pod,node) matrices — these never change as pods commit:
+- ``taint_fail``   [P,N] int32  index of first untolerated NoSchedule/
+                               NoExecute taint in the node's taint list
+                               (-1 = tolerated) — TaintToleration filter
+- ``taint_prefer`` [P,N] float  count of untolerated PreferNoSchedule taints
+                               — TaintToleration score
+- ``aff_code``     [P,N] int32  0 pass / 1 enforced-affinity fail /
+                               2 pod-affinity fail — NodeAffinity filter
+- ``aff_pref``     [P,N] float  matched preferred-term weight sum
+- ``unsched_ok``   [P,N] bool   NodeUnschedulable filter
+- ``name_ok``      [P,N] bool   NodeName filter
+- ``incl``         [P,N] bool   nodeSelector+requiredAffinity only —
+                               PodTopologySpread NodeInclusionPolicy mask
+
+Dynamic state (the lax.scan carry in ops/batch.py) is seeded with:
+- node ``requested``/``nonzero``/``pod_count`` from already-bound pods
+- ``spread_node_counts`` [SG,N]: per unique (namespace, labelSelector)
+  spread-constraint group, # matching pods per NODE (per-node, so the
+  per-pod NodeInclusionPolicy mask stays exact)
+- inter-pod affinity term-group counts [G,D] over topology DOMAINS
+  (a domain = one (topologyKey, value) pair; hostname keys make one
+  domain per node)
+
+Resource quantities are divided by their per-resource GCD so that float32
+device math stays exact for Mi/milli-granular workloads; all score formulas
+are scale-invariant ratios.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+from kube_scheduler_simulator_tpu.models.nodeinfo import NodeInfo, build_node_infos
+from kube_scheduler_simulator_tpu.plugins.intree.helpers import affinity_term_matches_pod
+from kube_scheduler_simulator_tpu.plugins.intree.noderesources import (
+    DEFAULT_MEMORY_REQUEST,
+    DEFAULT_MILLI_CPU_REQUEST,
+    pod_non_zero_request,
+)
+from kube_scheduler_simulator_tpu.models.podresources import (
+    CPU,
+    EPHEMERAL_STORAGE,
+    MEMORY,
+    PODS,
+    pod_resource_request,
+)
+from kube_scheduler_simulator_tpu.utils.labels import (
+    find_untolerated_taint,
+    match_label_selector,
+    match_node_selector,
+    match_node_selector_term,
+    tolerations_tolerate_taint,
+)
+
+Obj = dict[str, Any]
+
+HOSTNAME_KEY = "kubernetes.io/hostname"
+
+
+def _sig(obj: Any) -> str:
+    """Canonical signature for memoizing selector evaluation."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"), default=str)
+
+
+def _group(items: list[Any], keyfn: Callable[[Any], str]) -> "tuple[list[Any], np.ndarray]":
+    """Unique representatives + index of each item into them."""
+    reps: list[Any] = []
+    index: dict[str, int] = {}
+    idx = np.empty(len(items), dtype=np.int32)
+    for i, it in enumerate(items):
+        k = keyfn(it)
+        j = index.get(k)
+        if j is None:
+            j = len(reps)
+            index[k] = j
+            reps.append(it)
+        idx[i] = j
+    return reps, idx
+
+
+def _fit_resources(pod: Obj) -> dict[str, int]:
+    """Resources NodeResourcesFit actually checks (upstream
+    InsufficientResource: cpu/memory/ephemeral-storage, hugepages-*,
+    extended resources)."""
+    out = {}
+    for r, v in pod_resource_request(pod).items():
+        if v == 0:
+            continue
+        if r in (CPU, MEMORY, EPHEMERAL_STORAGE) or "/" in r or r.startswith("hugepages-"):
+            out[r] = v
+    return out
+
+
+class SpreadConstraint:
+    __slots__ = ("key_idx", "group", "max_skew", "self_match")
+
+    def __init__(self, key_idx: int, group: int, max_skew: int, self_match: bool):
+        self.key_idx = key_idx
+        self.group = group
+        self.max_skew = max_skew
+        self.self_match = self_match
+
+
+class BatchProblem:
+    """All arrays the batch kernel needs, as numpy (host) arrays.
+
+    ``to_device(dtype)`` converts to jnp arrays; ops/batch.py consumes it.
+    """
+
+    def __init__(self) -> None:
+        self.P = 0
+        self.N = 0
+        self.R = 0
+        self.node_names: list[str] = []
+        self.pod_keys: list[str] = []
+        self.resource_names: list[str] = []
+        # filled by encode()
+
+
+def _namespace_of(pod: Obj) -> str:
+    return pod["metadata"].get("namespace", "default")
+
+
+class _Memo:
+    """Memoized selector matchers shared across the encoding pass."""
+
+    def __init__(self, ns_labels: Mapping[str, Mapping[str, str]]):
+        self.ns_labels = ns_labels
+        self._label_sel: dict[tuple[str, str], bool] = {}
+        self._term: dict[tuple[str, str, str], bool] = {}
+
+    def label_selector(self, sel: "Obj | None", labels: Mapping[str, str]) -> bool:
+        k = (_sig(sel), _sig(sorted(labels.items())))
+        v = self._label_sel.get(k)
+        if v is None:
+            v = match_label_selector(sel, labels)
+            self._label_sel[k] = v
+        return v
+
+    def affinity_term(self, term: Obj, owner_ns: str, target: Obj) -> bool:
+        k = (_sig(term) + "|" + owner_ns,
+             _sig(sorted((target["metadata"].get("labels") or {}).items())),
+             _namespace_of(target))
+        v = self._term.get(k)
+        if v is None:
+            v = affinity_term_matches_pod(term, owner_ns, target, self.ns_labels)
+            self._term[k] = v
+        return v
+
+
+def encode(
+    nodes: list[Obj],
+    all_pods: list[Obj],
+    pending: list[Obj],
+    namespaces: "list[Obj] | None" = None,
+    hard_pod_affinity_weight: int = 1,
+    added_affinity: "Obj | None" = None,
+) -> BatchProblem:
+    """Encode a scheduling snapshot.
+
+    ``pending`` must already be in queue (QueueSort) order; ``all_pods`` is
+    the full pod list (bound pods seed the node usage state, mirroring the
+    oracle's build_node_infos snapshot).
+    """
+    pr = BatchProblem()
+    P, N = len(pending), len(nodes)
+    pr.P, pr.N = P, N
+    pr.node_names = [n["metadata"]["name"] for n in nodes]
+    pr.pod_keys = [f"{_namespace_of(p)}/{p['metadata']['name']}" for p in pending]
+    ns_labels = {
+        ns["metadata"]["name"]: ns["metadata"].get("labels") or {} for ns in (namespaces or [])
+    }
+    memo = _Memo(ns_labels)
+    node_infos = build_node_infos(nodes, all_pods)
+
+    # ------------------------------------------------------------- resources
+    res_set: set[str] = {CPU, MEMORY}
+    for p in pending:
+        res_set |= set(_fit_resources(p))
+    pr.resource_names = sorted(res_set)
+    res_idx = {r: i for i, r in enumerate(pr.resource_names)}
+    R = pr.R = len(pr.resource_names)
+
+    alloc = np.zeros((N, R), dtype=np.int64)
+    requested0 = np.zeros((N, R), dtype=np.int64)
+    nonzero0 = np.zeros((N, 2), dtype=np.int64)
+    nz_alloc = np.zeros((N, 2), dtype=np.int64)
+    pod_count0 = np.zeros(N, dtype=np.int64)
+    max_pods = np.zeros(N, dtype=np.int64)
+    for ni_i, ni in enumerate(node_infos):
+        for r, v in ni.allocatable.items():
+            if r in res_idx:
+                alloc[ni_i, res_idx[r]] = v
+        max_pods[ni_i] = ni.allowed_pod_number()
+        pod_count0[ni_i] = len(ni.pods)
+        for r, v in ni.requested.items():
+            if r in res_idx:
+                requested0[ni_i, res_idx[r]] = v
+        cpu = mem = 0
+        for p in ni.pods:
+            nz = pod_non_zero_request(p)
+            cpu += nz[CPU]
+            mem += nz[MEMORY]
+        nonzero0[ni_i] = (cpu, mem)
+        nz_alloc[ni_i] = (ni.allocatable.get(CPU, 0), ni.allocatable.get(MEMORY, 0))
+
+    pod_req = np.zeros((P, R), dtype=np.int64)
+    pod_nonzero = np.zeros((P, 2), dtype=np.int64)
+    for i, p in enumerate(pending):
+        for r, v in pod_resource_request(p).items():
+            if r in res_idx:
+                pod_req[i, res_idx[r]] = v
+        nz = pod_non_zero_request(p)
+        pod_nonzero[i] = (nz[CPU], nz[MEMORY])
+    # fit_checked: which resource columns the Fit filter checks for this pod
+    # (want > 0 and an upstream-checked resource name)
+    fit_checked = np.zeros((P, R), dtype=bool)
+    for i, p in enumerate(pending):
+        for r in _fit_resources(p):
+            fit_checked[i, res_idx[r]] = True
+
+    # GCD-scale each resource column so float32 stays exact on-device (the
+    # score formulas are ratio-based, hence scale-invariant).
+    def _gcd_scale(columns: "list[np.ndarray]") -> None:
+        g = 0
+        for arr in columns:
+            for v in arr:
+                g = math.gcd(g, int(v))
+        g = g or 1
+        for arr in columns:
+            arr //= g
+
+    for r in range(R):
+        _gcd_scale([alloc[:, r], requested0[:, r], pod_req[:, r]])
+    for c in (0, 1):
+        _gcd_scale([nonzero0[:, c], pod_nonzero[:, c], nz_alloc[:, c]])
+
+    pr.alloc, pr.requested0, pr.pod_count0, pr.max_pods = alloc, requested0, pod_count0, max_pods
+    pr.nonzero0, pr.nz_alloc = nonzero0, nz_alloc
+    pr.pod_req, pr.pod_nonzero, pr.fit_checked = pod_req, pod_nonzero, fit_checked
+
+    # --------------------------------------------- static [P,N] matrices
+    node_labels = [n["metadata"].get("labels") or {} for n in nodes]
+    node_taints = [(n.get("spec") or {}).get("taints") or [] for n in nodes]
+    node_unsched = np.array(
+        [bool((n.get("spec") or {}).get("unschedulable")) for n in nodes], dtype=bool
+    )
+
+    # Taints: group pods by toleration signature, nodes by taint signature.
+    tol_reps, tol_idx = _group(
+        [(p.get("spec") or {}).get("tolerations") or [] for p in pending], _sig
+    )
+    taint_reps, taint_idx = _group(node_taints, _sig)
+    tf = np.full((len(tol_reps), len(taint_reps)), -1, dtype=np.int32)
+    tp = np.zeros((len(tol_reps), len(taint_reps)), dtype=np.int64)
+    tu = np.ones((len(tol_reps), len(taint_reps)), dtype=bool)  # unschedulable-toleration
+    for a, tols in enumerate(tol_reps):
+        prefer_tols = [t for t in tols if not t.get("effect") or t.get("effect") == "PreferNoSchedule"]
+        unsched_taint = {"key": "node.kubernetes.io/unschedulable", "effect": "NoSchedule"}
+        tolerates_unsched = tolerations_tolerate_taint(tols, unsched_taint)
+        for b, taints in enumerate(taint_reps):
+            bad = find_untolerated_taint(taints, tols)
+            if bad is not None:
+                tf[a, b] = taints.index(bad)
+            tp[a, b] = sum(
+                1
+                for t in taints
+                if t.get("effect") == "PreferNoSchedule"
+                and not tolerations_tolerate_taint(prefer_tols, t)
+            )
+            tu[a, b] = tolerates_unsched
+    pr.taint_fail = tf[tol_idx][:, taint_idx]
+    pr.taint_prefer = tp[tol_idx][:, taint_idx]
+    # NodeUnschedulable: fails unless the pod tolerates the unschedulable
+    # taint (upstream nodeunschedulable.go).
+    pr.unsched_ok = ~node_unsched[None, :] | tu[tol_idx][:, taint_idx]
+
+    # NodeAffinity + nodeSelector (+ plugin-level addedAffinity), and the
+    # spread inclusion mask (no addedAffinity).
+    def _aff_spec(p: Obj) -> Obj:
+        spec = p.get("spec") or {}
+        aff = ((spec.get("affinity") or {}).get("nodeAffinity") or {}).get(
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        )
+        return {"sel": spec.get("nodeSelector"), "req": aff}
+
+    aff_reps, aff_idx = _group([_aff_spec(p) for p in pending], _sig)
+    nl_reps, nl_idx = _group(
+        [{"labels": node_labels[i], "name": pr.node_names[i]} for i in range(N)],
+        lambda x: _sig(sorted(x["labels"].items())) + "|" + x["name"],
+    )
+    ac = np.zeros((len(aff_reps), len(nl_reps)), dtype=np.int32)
+    inc = np.ones((len(aff_reps), len(nl_reps)), dtype=bool)
+    for a, spec in enumerate(aff_reps):
+        for b, nl in enumerate(nl_reps):
+            labels, name = nl["labels"], nl["name"]
+            ok = True
+            if added_affinity is not None and not match_node_selector(added_affinity, labels, name):
+                ac[a, b] = 1
+                ok = False
+            if ok and spec["sel"]:
+                if any(labels.get(k) != v for k, v in spec["sel"].items()):
+                    ac[a, b] = 2
+                    ok = False
+            if ok and spec["req"] is not None and not match_node_selector(spec["req"], labels, name):
+                ac[a, b] = 2
+            # inclusion ignores addedAffinity
+            iok = True
+            if spec["sel"] and any(labels.get(k) != v for k, v in spec["sel"].items()):
+                iok = False
+            if iok and spec["req"] is not None and not match_node_selector(spec["req"], labels, name):
+                iok = False
+            inc[a, b] = iok
+    pr.aff_code = ac[aff_idx][:, nl_idx]
+    pr.incl = inc[aff_idx][:, nl_idx]
+
+    # Preferred node-affinity weights.
+    pref_reps, pref_idx = _group(
+        [
+            (((p.get("spec") or {}).get("affinity") or {}).get("nodeAffinity") or {}).get(
+                "preferredDuringSchedulingIgnoredDuringExecution"
+            )
+            or []
+            for p in pending
+        ],
+        _sig,
+    )
+    ap = np.zeros((len(pref_reps), len(nl_reps)), dtype=np.int64)
+    for a, prefs in enumerate(pref_reps):
+        for b, nl in enumerate(nl_reps):
+            total = 0
+            for item in prefs:
+                w = int(item.get("weight") or 0)
+                if w and match_node_selector_term(item.get("preference") or {}, nl["labels"], nl["name"]):
+                    total += w
+            ap[a, b] = total
+    pr.aff_pref = ap[pref_idx][:, nl_idx]
+
+    # NodeName
+    name_to_idx = {nm: i for i, nm in enumerate(pr.node_names)}
+    name_ok = np.ones((P, N), dtype=bool)
+    for i, p in enumerate(pending):
+        want = (p.get("spec") or {}).get("nodeName")
+        if want:
+            name_ok[i] = False
+            if want in name_to_idx:
+                name_ok[i, name_to_idx[want]] = True
+    pr.name_ok = name_ok
+
+    # ------------------------------------------------------ topology domains
+    topo_keys: list[str] = []
+
+    def key_id(k: str) -> int:
+        if k not in topo_keys:
+            topo_keys.append(k)
+        return topo_keys.index(k)
+
+    # collect keys used by spread constraints & interpod terms of pending pods
+    for p in pending:
+        for c in (p.get("spec") or {}).get("topologySpreadConstraints") or []:
+            key_id(c["topologyKey"])
+        aff = (p.get("spec") or {}).get("affinity") or {}
+        for kind in ("podAffinity", "podAntiAffinity"):
+            a = aff.get(kind) or {}
+            for t in a.get("requiredDuringSchedulingIgnoredDuringExecution") or []:
+                key_id(t.get("topologyKey", ""))
+            for t in a.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+                key_id((t.get("podAffinityTerm") or {}).get("topologyKey", ""))
+    # ... and by existing pods' terms (they poison/score toward pending pods)
+    for ni in node_infos:
+        for p in ni.pods:
+            aff = (p.get("spec") or {}).get("affinity") or {}
+            for kind in ("podAffinity", "podAntiAffinity"):
+                a = aff.get(kind) or {}
+                for t in a.get("requiredDuringSchedulingIgnoredDuringExecution") or []:
+                    key_id(t.get("topologyKey", ""))
+                for t in a.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+                    key_id((t.get("podAffinityTerm") or {}).get("topologyKey", ""))
+
+    KT = len(topo_keys)
+    domain_table: dict[tuple[int, str], int] = {}
+    node_domain = np.full((max(KT, 1), N), -1, dtype=np.int32)
+    for ki, key in enumerate(topo_keys):
+        for n_i, labels in enumerate(node_labels):
+            if key in labels:
+                pair = (ki, labels[key])
+                d = domain_table.setdefault(pair, len(domain_table))
+                node_domain[ki, n_i] = d
+    D = max(len(domain_table), 1)
+    pr.topo_keys, pr.node_domain, pr.D = topo_keys, node_domain, D
+
+    # --------------------------------------------------- PodTopologySpread
+    sg_table: dict[str, int] = {}
+    sg_specs: list[tuple[str, "Obj | None"]] = []  # (namespace, selector)
+
+    def spread_group(ns: str, sel: "Obj | None") -> int:
+        k = ns + "|" + _sig(sel)
+        if k not in sg_table:
+            sg_table[k] = len(sg_specs)
+            sg_specs.append((ns, sel))
+        return sg_table[k]
+
+    pod_spread_filter: list[list[SpreadConstraint]] = []
+    pod_spread_score: list[list[SpreadConstraint]] = []
+    for i, p in enumerate(pending):
+        ns = _namespace_of(p)
+        labels = p["metadata"].get("labels") or {}
+        fl, sl = [], []
+        for c in (p.get("spec") or {}).get("topologySpreadConstraints") or []:
+            sc = SpreadConstraint(
+                key_id(c["topologyKey"]),
+                spread_group(ns, c.get("labelSelector")),
+                int(c.get("maxSkew") or 1),
+                memo.label_selector(c.get("labelSelector"), labels),
+            )
+            (fl if c.get("whenUnsatisfiable") == "DoNotSchedule" else sl).append(sc)
+        pod_spread_filter.append(fl)
+        pod_spread_score.append(sl)
+
+    SG = len(sg_specs)
+    spread_match = np.zeros((max(SG, 1), P), dtype=bool)
+    spread_counts0 = np.zeros((max(SG, 1), N), dtype=np.int64)
+    for s, (ns, sel) in enumerate(sg_specs):
+        for j, p in enumerate(pending):
+            spread_match[s, j] = (
+                _namespace_of(p) == ns
+                and not p["metadata"].get("deletionTimestamp")
+                and memo.label_selector(sel, p["metadata"].get("labels") or {})
+            )
+        for n_i, ni in enumerate(node_infos):
+            cnt = 0
+            for ep in ni.pods:
+                if (
+                    _namespace_of(ep) == ns
+                    and not ep["metadata"].get("deletionTimestamp")
+                    and memo.label_selector(sel, ep["metadata"].get("labels") or {})
+                ):
+                    cnt += 1
+            spread_counts0[s, n_i] = cnt
+    pr.SG = SG
+    pr.spread_match = spread_match
+    pr.spread_counts0 = spread_counts0
+
+    KC = max((len(x) for x in pod_spread_filter), default=0)
+    KS = max((len(x) for x in pod_spread_score), default=0)
+
+    def pad_constraints(lists: list[list[SpreadConstraint]], K: int):
+        key = np.full((P, max(K, 1)), -1, dtype=np.int32)
+        grp = np.full((P, max(K, 1)), 0, dtype=np.int32)
+        skew = np.ones((P, max(K, 1)), dtype=np.int64)
+        selfm = np.zeros((P, max(K, 1)), dtype=bool)
+        for i, lst in enumerate(lists):
+            for k, c in enumerate(lst):
+                key[i, k] = c.key_idx
+                grp[i, k] = c.group
+                skew[i, k] = c.max_skew
+                selfm[i, k] = c.self_match
+        return key, grp, skew, selfm
+
+    pr.spf_key, pr.spf_group, pr.spf_skew, pr.spf_self = pad_constraints(pod_spread_filter, KC)
+    pr.sps_key, pr.sps_group, pr.sps_skew, pr.sps_self = pad_constraints(pod_spread_score, KS)
+    pr.KC, pr.KS = KC, KS
+
+    # ----------------------------------------------------- InterPodAffinity
+    # Term groups: (topologyKey, namespace-scope, labelSelector).  One group
+    # can be referenced by many pods'/terms' — counts are shared.
+    g_table: dict[str, int] = {}
+    g_terms: list[tuple[Obj, str]] = []  # (term, owner_ns)
+    g_key = []  # key idx per group
+
+    def term_group(term: Obj, owner_ns: str) -> int:
+        namespaces = term.get("namespaces") or []
+        ns_sel = term.get("namespaceSelector")
+        if namespaces or ns_sel is not None:
+            scope = _sig({"ns": sorted(namespaces), "sel": ns_sel})
+        else:
+            scope = "same:" + owner_ns
+        k = _sig({"key": term.get("topologyKey", ""), "sel": term.get("labelSelector")}) + "|" + scope
+        if k not in g_table:
+            g_table[k] = len(g_terms)
+            g_terms.append((term, owner_ns))
+            g_key.append(key_id(term.get("topologyKey", "")))
+        return g_table[k]
+
+    def pod_terms(p: Obj):
+        aff = (p.get("spec") or {}).get("affinity") or {}
+        pa = aff.get("podAffinity") or {}
+        paa = aff.get("podAntiAffinity") or {}
+        return (
+            pa.get("requiredDuringSchedulingIgnoredDuringExecution") or [],
+            paa.get("requiredDuringSchedulingIgnoredDuringExecution") or [],
+            pa.get("preferredDuringSchedulingIgnoredDuringExecution") or [],
+            paa.get("preferredDuringSchedulingIgnoredDuringExecution") or [],
+        )
+
+    # Pending pods' own term lists (padded) + "toward"-update lists.
+    aff_groups: list[list[int]] = []
+    anti_groups: list[list[int]] = []
+    pref_groups: list[list[tuple[int, int]]] = []  # (group, signed weight)
+    own_updates: list[list[tuple[int, int]]] = []  # (group, folded weight)
+    for p in pending:
+        ns = _namespace_of(p)
+        req_aff, req_anti, pref_aff, pref_anti = pod_terms(p)
+        aff_groups.append([term_group(t, ns) for t in req_aff])
+        anti_groups.append([term_group(t, ns) for t in req_anti])
+        prefs = [(term_group((t.get("podAffinityTerm") or {}), ns), int(t.get("weight") or 0)) for t in pref_aff]
+        prefs += [(term_group((t.get("podAffinityTerm") or {}), ns), -int(t.get("weight") or 0)) for t in pref_anti]
+        pref_groups.append([(g, w) for g, w in prefs if w])
+        ups: list[tuple[int, int]] = []
+        if hard_pod_affinity_weight > 0:
+            ups += [(term_group(t, ns), hard_pod_affinity_weight) for t in req_aff]
+        ups += [(g, w) for g, w in prefs if w]
+        own_updates.append(ups)
+
+    # Existing pods' own terms create groups too (they poison/score toward
+    # the pending pods).  Register ALL groups first, then seed the counts.
+    seed_ops: list[tuple[str, int, int, int]] = []  # (which, group, node, weight)
+    for n_i, ni in enumerate(node_infos):
+        for ep in ni.pods:
+            ep_ns = _namespace_of(ep)
+            req_aff, req_anti, pref_aff, pref_anti = pod_terms(ep)
+            for t in req_anti:
+                seed_ops.append(("anti", term_group(t, ep_ns), n_i, 1))
+            if hard_pod_affinity_weight > 0:
+                for t in req_aff:
+                    seed_ops.append(("own", term_group(t, ep_ns), n_i, hard_pod_affinity_weight))
+            for t in pref_aff:
+                w = int(t.get("weight") or 0)
+                if w:
+                    seed_ops.append(("own", term_group((t.get("podAffinityTerm") or {}), ep_ns), n_i, w))
+            for t in pref_anti:
+                w = int(t.get("weight") or 0)
+                if w:
+                    seed_ops.append(("own", term_group((t.get("podAffinityTerm") or {}), ep_ns), n_i, -w))
+
+    G = len(g_terms)
+    ip_sel0 = np.zeros((max(G, 1), D), dtype=np.int64)
+    ip_own0 = np.zeros((max(G, 1), D), dtype=np.int64)
+    ip_anti0 = np.zeros((max(G, 1), D), dtype=np.int64)
+    for which, g, n_i, w in seed_ops:
+        d = node_domain[g_key[g], n_i]
+        if d < 0:
+            continue
+        (ip_anti0 if which == "anti" else ip_own0)[g, d] += w
+    if G:
+        for n_i, ni in enumerate(node_infos):
+            for ep in ni.pods:
+                for g, (term, owner_ns) in enumerate(g_terms):
+                    d = node_domain[g_key[g], n_i]
+                    if d >= 0 and memo.affinity_term(term, owner_ns, ep):
+                        ip_sel0[g, d] += 1
+
+    # term_match[g, j]: group g's term selects pending pod j.
+    term_match = np.zeros((max(G, 1), P), dtype=bool)
+    for g, (term, owner_ns) in enumerate(g_terms):
+        for j, p in enumerate(pending):
+            term_match[g, j] = memo.affinity_term(term, owner_ns, p)
+
+    pr.G = G
+    pr.term_match = term_match
+    pr.ip_sel0, pr.ip_own0, pr.ip_anti0 = ip_sel0, ip_own0, ip_anti0
+    pr.group_key = np.array(g_key, dtype=np.int32) if G else np.zeros(1, dtype=np.int32)
+
+    def pad_groups(lists, K, with_w=False):
+        Kp = max(K, 1)
+        grp = np.full((P, Kp), -1, dtype=np.int32)
+        w = np.zeros((P, Kp), dtype=np.int64)
+        for i, lst in enumerate(lists):
+            for k, item in enumerate(lst):
+                if with_w:
+                    grp[i, k], w[i, k] = item
+                else:
+                    grp[i, k] = item
+        return (grp, w) if with_w else grp
+
+    pr.KA = max((len(x) for x in aff_groups), default=0)
+    pr.KB = max((len(x) for x in anti_groups), default=0)
+    pr.KP = max((len(x) for x in pref_groups), default=0)
+    pr.KO = max((len(x) for x in own_updates), default=0)
+    pr.ip_aff_g = pad_groups(aff_groups, pr.KA)
+    pr.ip_anti_g = pad_groups(anti_groups, pr.KB)
+    pr.ip_pref_g, pr.ip_pref_w = pad_groups(pref_groups, pr.KP, with_w=True)
+    pr.ip_own_g, pr.ip_own_w = pad_groups(own_updates, pr.KO, with_w=True)
+    # self-match escape hatch: pod matches all its own required-affinity terms
+    selfm = np.zeros(P, dtype=bool)
+    for i, p in enumerate(pending):
+        gl = aff_groups[i]
+        selfm[i] = bool(gl) and all(term_match[g, i] for g in gl)
+    pr.ip_self_match = selfm
+
+    return pr

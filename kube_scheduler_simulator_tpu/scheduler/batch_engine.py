@@ -1,0 +1,325 @@
+"""TPUBatchScorer: drive the batch kernel and keep the annotation contract.
+
+This is the component BASELINE.json names the north star: the per-pod
+Filter/Score loop of the reference (SURVEY.md §3.2 hot loop) evaluated as
+one XLA computation (ops/batch.py) over features encoded once on the host
+(ops/encode.py), while the per-plugin annotation trace the reference writes
+onto pods (reference simulator/scheduler/plugin/resultstore/store.go:38-89)
+is reproduced byte-identically from the returned result tensors.
+
+Scope (round 1): kernels for NodeUnschedulable, NodeName, TaintToleration,
+NodeAffinity, NodeResourcesFit (LeastAllocated/MostAllocated over
+cpu+memory), NodeResourcesBalancedAllocation, PodTopologySpread,
+InterPodAffinity.  ``supported()`` reports whether a workload/profile
+combination is fully covered; callers fall back to the sequential oracle
+(scheduler/framework_runner.py) otherwise.  Preemption (PostFilter) stays
+host-side and is not run by the batch pass.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import numpy as np
+
+from kube_scheduler_simulator_tpu.models.framework import Status
+from kube_scheduler_simulator_tpu.ops import batch as B
+from kube_scheduler_simulator_tpu.ops import encode as E
+from kube_scheduler_simulator_tpu.plugins.intree import interpodaffinity as ip
+from kube_scheduler_simulator_tpu.plugins.intree import node_basic as nb
+from kube_scheduler_simulator_tpu.plugins.intree import nodeaffinity as na
+from kube_scheduler_simulator_tpu.plugins.intree import podtopologyspread as pts
+from kube_scheduler_simulator_tpu.plugins.resultstore import PASSED_FILTER_MESSAGE
+
+Obj = dict[str, Any]
+
+KERNEL_FILTERS = set(B.FILTER_KERNELS)
+KERNEL_SCORES = set(B.SCORE_KERNELS)
+# Plugins safely treated as no-ops when the workload doesn't exercise them.
+NOOP_IF_UNUSED = {
+    "NodePorts": lambda pod: not nb._host_ports(pod),
+    "VolumeRestrictions": lambda pod: not _pod_volumes(pod),
+    "EBSLimits": lambda pod: not _pod_volumes(pod),
+    "GCEPDLimits": lambda pod: not _pod_volumes(pod),
+    "NodeVolumeLimits": lambda pod: not _pod_volumes(pod),
+    "AzureDiskLimits": lambda pod: not _pod_volumes(pod),
+    "VolumeBinding": lambda pod: not _pod_volumes(pod),
+    "VolumeZone": lambda pod: not _pod_volumes(pod),
+}
+NOOP_SCORES = {"ImageLocality"}  # zero contribution when no node images
+
+
+def _pod_volumes(pod: Obj) -> list:
+    return [
+        v
+        for v in (pod.get("spec") or {}).get("volumes") or []
+        if "persistentVolumeClaim" in v or "awsElasticBlockStore" in v or "gcePersistentDisk" in v
+    ]
+
+
+FILTER_MESSAGES = {
+    "NodeUnschedulable": {1: nb.NODE_UNSCHEDULABLE_ERR},
+    "NodeName": {1: nb.NODE_NAME_ERR},
+    "NodeAffinity": {1: na.ERR_REASON_ENFORCED, 2: na.ERR_REASON_POD},
+    "PodTopologySpread": {1: pts.ERR_REASON_LABEL, 2: pts.ERR_REASON},
+    "InterPodAffinity": {1: ip.ERR_EXISTING_ANTI, 2: ip.ERR_AFFINITY, 3: ip.ERR_ANTI_AFFINITY},
+}
+
+
+class BatchResult:
+    """Outcome of one batch scheduling pass, with lazy trace formatting."""
+
+    def __init__(
+        self, engine: "BatchEngine", pending: list[Obj], out: dict, pr: E.BatchProblem, nodes: list[Obj]
+    ):
+        self._engine = engine
+        self.pending = pending
+        self.out = out
+        self.problem = pr
+        self.nodes = nodes
+        self.selected = np.asarray(out["selected"])  # node index or -1, per pod
+        self.feasible_count = np.asarray(out["feasible_count"])
+        self.node_names = pr.node_names
+        self.pod_keys = pr.pod_keys
+
+    @property
+    def selected_nodes(self) -> "list[str | None]":
+        return [self.node_names[s] if s >= 0 else None for s in self.selected]
+
+    def assignments(self) -> dict[str, "str | None"]:
+        return dict(zip(self.pod_keys, self.selected_nodes))
+
+    # ------------------------------------------------------------ trace
+
+    def filter_annotation(self, i: int) -> dict:
+        """The scheduler-simulator/filter-result map for pod i: node →
+        plugin → "passed"/failure message, honoring the first-failure
+        short circuit of the sequential cycle."""
+        assert self._engine.cfg.trace, "run with trace=True for annotations"
+        pr, out = self.problem, self.out
+        nodes = self._prefilter_nodes(i)
+        result: dict = {}
+        for n in nodes:
+            nm = pr.node_names[n]
+            entry: dict = {}
+            # Iterate the FULL enabled filter list (profile order): plugins
+            # without a kernel are no-ops for supported workloads and the
+            # oracle still records "passed" for them.
+            for plugin in self._engine.filters:
+                code = (
+                    int(np.asarray(out[f"code:{plugin}"])[i, n])
+                    if f"code:{plugin}" in out
+                    else 0
+                )
+                if code == 0:
+                    entry[plugin] = PASSED_FILTER_MESSAGE
+                else:
+                    entry[plugin] = self._engine.filter_message(self, i, n, plugin, code)
+                    break
+            result[nm] = entry
+        return result
+
+    def score_annotations(self, i: int) -> "tuple[dict, dict]":
+        """(score, finalScore) maps for pod i over feasible nodes."""
+        assert self._engine.cfg.trace
+        pr, out = self.problem, self.out
+        feasible = np.asarray(out["feasible"])[i]
+        score: dict = {}
+        final: dict = {}
+        if int(self.feasible_count[i]) <= 1:
+            return score, final
+        for n in np.nonzero(feasible)[0]:
+            nm = pr.node_names[n]
+            score[nm] = {}
+            final[nm] = {}
+            for plugin, weight in self._engine.cfg.scores:
+                raw = int(np.asarray(out[f"raw:{plugin}"])[i, n])
+                norm = int(np.asarray(out[f"norm:{plugin}"])[i, n])
+                score[nm][plugin] = str(raw)
+                final[nm][plugin] = str(norm * int(weight))
+        return score, final
+
+    def diagnosis(self, i: int) -> dict[str, Status]:
+        """Per-node failure Status map (for failure messages/postfilter)."""
+        assert self._engine.cfg.trace
+        pr, out = self.problem, self.out
+        diag: dict[str, Status] = {}
+        for n in self._prefilter_nodes(i):
+            for plugin in self._engine.cfg.filters:
+                code = int(np.asarray(out[f"code:{plugin}"])[i, n])
+                if code != 0:  # only kernel plugins can fail (others no-op)
+                    msg = self._engine.filter_message(self, i, n, plugin, code)
+                    diag[pr.node_names[n]] = Status.unschedulable(msg)
+                    break
+        return diag
+
+    def _prefilter_nodes(self, i: int) -> list[int]:
+        """Node indices surviving PreFilter narrowing (NodeAffinity
+        matchFields pinning restricts which nodes the cycle visits)."""
+        narrowed = self._engine.prefilter_node_names(self.pending[i])
+        if narrowed is None:
+            return list(range(self.problem.N))
+        idx = {nm: j for j, nm in enumerate(self.problem.node_names)}
+        return sorted(idx[nm] for nm in narrowed if nm in idx)
+
+
+class BatchEngine:
+    """Compile-once, run-per-snapshot driver for the batch kernel."""
+
+    def __init__(
+        self,
+        filters: "list[str] | None" = None,
+        scores: "list[tuple[str, int]] | None" = None,
+        fit_strategy: str = "LeastAllocated",
+        hard_pod_affinity_weight: int = 1,
+        added_affinity: "Obj | None" = None,
+        trace: bool = False,
+        dtype=None,
+    ):
+        self.filters = list(
+            filters
+            if filters is not None
+            else [f for f in B.FILTER_KERNELS]
+        )
+        self.scores = list(scores if scores is not None else [])
+        self.fit_strategy = fit_strategy
+        self.hard_pod_affinity_weight = hard_pod_affinity_weight
+        self.added_affinity = added_affinity
+        self.trace = trace
+        self.dtype = dtype
+        self.cfg = B.BatchConfig(
+            filters=tuple(f for f in self.filters if f in KERNEL_FILTERS),
+            scores=tuple((s, w) for s, w in self.scores),
+            fit_strategy=fit_strategy,
+            trace=trace,
+        )
+        self._fn_cache: dict = {}
+        self.last_timings: dict[str, float] = {}
+
+    # ------------------------------------------------------------ factory
+
+    @classmethod
+    def from_framework(cls, framework: Any, trace: bool = False, dtype=None) -> "BatchEngine":
+        """Build from a scheduler Framework (same plugin set/weights/args
+        the sequential path uses — guarantees config consistency)."""
+        filters = [wp.original.name for wp in framework.plugins["filter"]]
+        scores = [
+            (wp.original.name, framework.score_weights.get(wp.original.name, 1))
+            for wp in framework.plugins["score"]
+        ]
+        fit_strategy = "LeastAllocated"
+        hard_w = 1
+        added = None
+        for wp in framework.plugins["filter"] + framework.plugins["score"]:
+            o = wp.original
+            if o.name == "NodeResourcesFit":
+                fit_strategy = getattr(o, "strategy_type", "LeastAllocated")
+            elif o.name == "InterPodAffinity":
+                hard_w = getattr(o, "hard_pod_affinity_weight", 1)
+            elif o.name == "NodeAffinity":
+                added = getattr(o, "added_affinity", None)
+        eng = cls(
+            filters=filters,
+            scores=scores,
+            fit_strategy=fit_strategy,
+            hard_pod_affinity_weight=hard_w,
+            added_affinity=added,
+            trace=trace,
+            dtype=dtype,
+        )
+        eng._framework = framework
+        return eng
+
+    # ---------------------------------------------------------- supported
+
+    def supported(self, pending: list[Obj], nodes: list[Obj]) -> "tuple[bool, str]":
+        """Can this profile × workload run fully on the batch path?"""
+        for f in self.filters:
+            if f in KERNEL_FILTERS:
+                continue
+            checker = NOOP_IF_UNUSED.get(f)
+            if checker is None:
+                return False, f"filter plugin {f} has no batch kernel"
+            for p in pending:
+                if not checker(p):
+                    return False, f"workload exercises {f} (no batch kernel)"
+        for s, _w in self.scores:
+            if s in KERNEL_SCORES:
+                continue
+            if s in NOOP_SCORES:
+                if s == "ImageLocality" and any((n.get("status") or {}).get("images") for n in nodes):
+                    return False, "workload exercises ImageLocality (no batch kernel)"
+                continue
+            return False, f"score plugin {s} has no batch kernel"
+        return True, ""
+
+    # ------------------------------------------------------------- running
+
+    def schedule(
+        self,
+        nodes: list[Obj],
+        all_pods: list[Obj],
+        pending: list[Obj],
+        namespaces: "list[Obj] | None" = None,
+    ) -> BatchResult:
+        """One batch scheduling pass over ``pending`` (already in queue
+        order).  Returns per-pod selections plus (trace mode) everything
+        needed to format the annotation trail."""
+        t0 = time.perf_counter()
+        pr = E.encode(
+            nodes,
+            all_pods,
+            pending,
+            namespaces,
+            hard_pod_affinity_weight=self.hard_pod_affinity_weight,
+            added_affinity=self.added_affinity,
+        )
+        t1 = time.perf_counter()
+        dp, dims = B.lower(pr, dtype=self.dtype)
+        key = (tuple(sorted(dims.items())), self.cfg)
+        fn = self._fn_cache.get(key)
+        t2 = time.perf_counter()
+        if fn is None:
+            fn = B.build_batch_fn(self.cfg, dims)
+            self._fn_cache[key] = fn
+        out = fn(dp)
+        out = {k: np.asarray(v) for k, v in out.items()}
+        t3 = time.perf_counter()
+        self.last_timings = {
+            "encode_s": t1 - t0,
+            "lower_s": t2 - t1,
+            "device_s": t3 - t2,
+            "total_s": t3 - t0,
+        }
+        return BatchResult(self, pending, out, pr, nodes)
+
+    # ----------------------------------------------------- trace helpers
+
+    def filter_message(self, result: BatchResult, i: int, n: int, plugin: str, code: int) -> str:
+        if plugin == "TaintToleration":
+            node = result.nodes[n]
+            taints = (node.get("spec") or {}).get("taints") or []
+            t = taints[code - 1] if 0 <= code - 1 < len(taints) else {}
+            return f"node(s) had untolerated taint {{{t.get('key', '')}: {t.get('value', '')}}}"
+        if plugin == "NodeResourcesFit":
+            reasons = []
+            if code & 1:
+                reasons.append("Too many pods")
+            for r, name in enumerate(result.problem.resource_names):
+                if code & (1 << (r + 1)):
+                    reasons.append(f"Insufficient {name}")
+            return ", ".join(reasons)
+        return FILTER_MESSAGES.get(plugin, {}).get(code, f"failed ({plugin} code {code})")
+
+    def prefilter_node_names(self, pod: Obj) -> "set[str] | None":
+        """NodeAffinity's matchFields metadata.name pinning (the only
+        node-narrowing PreFilter among the kernelized plugins)."""
+        if "NodeAffinity" not in self.filters:
+            return None
+        from kube_scheduler_simulator_tpu.models.framework import CycleState
+
+        # pre_filter only inspects the pod's own required terms (added
+        # affinity plays no role there).
+        result, _status = na.NodeAffinity(None).pre_filter(CycleState(), pod)
+        return None if result is None else result.node_names

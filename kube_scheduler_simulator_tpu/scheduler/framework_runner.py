@@ -89,6 +89,7 @@ class Framework:
         percentage_of_nodes_to_score: int = 0,
         seed: int = 0,
         profile_name: str = "default-scheduler",
+        tie_break: str = "reservoir",
     ):
         self.plugins = {p: list(plugins.get(p, [])) for p in self.EXTENSION_POINTS}
         self.handle = handle
@@ -98,6 +99,10 @@ class Framework:
         self.rng = random.Random(seed)
         self.next_start_node_index = 0
         self.profile_name = profile_name
+        # "reservoir" = upstream selectHost semantics (seeded PRNG);
+        # "first" = deterministic first-max, matching the batch engine's
+        # argmax — used by parity tests.
+        self.tie_break = tie_break
 
     # ------------------------------------------------------------- utilities
 
@@ -289,7 +294,7 @@ class Framework:
                 best_score = score
                 selected = name
                 cnt = 1
-            elif score == best_score:
+            elif score == best_score and self.tie_break == "reservoir":
                 cnt += 1
                 if self.rng.randrange(cnt) == 0:
                     selected = name

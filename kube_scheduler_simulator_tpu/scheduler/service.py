@@ -35,9 +35,10 @@ Obj = dict[str, Any]
 
 
 class SchedulerService:
-    def __init__(self, cluster_store: Any, seed: int = 0):
+    def __init__(self, cluster_store: Any, seed: int = 0, tie_break: str = "reservoir"):
         self.cluster_store = cluster_store
         self.seed = seed
+        self.tie_break = tie_break
         self.reflector = StoreReflector()
         self.reflector.register_to_cluster_store(cluster_store)
         self._out_of_tree: dict[str, Callable[[Obj | None, Any], Any]] = {}
@@ -203,6 +204,7 @@ class SchedulerService:
             percentage_of_nodes_to_score=int(cfg.get("percentageOfNodesToScore") or 0),
             seed=self.seed,
             profile_name=profile.get("schedulerName") or "default-scheduler",
+            tie_break=self.tie_break,
         )
 
     # ------------------------------------------------------------- run loop

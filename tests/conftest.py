@@ -8,7 +8,14 @@ be set before jax is imported anywhere.
 
 import os
 
+# NOTE: the axon TPU plugin in this image ignores JAX_PLATFORMS but honors
+# JAX_PLATFORM_NAME; set both so tests run on the virtual CPU mesh either way.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+# x64 gives the batch kernels bit-exact integer semantics on CPU, which is
+# what the parity suites assert; the TPU bench path runs float32 (kept
+# near-exact by the encoder's GCD scaling) and reports max |Δscore|.
+os.environ.setdefault("JAX_ENABLE_X64", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,520 @@
+"""Batch (TPU kernel) vs sequential oracle parity.
+
+The sequential framework runner pins the reference's upstream v1.26
+scheduling semantics (it is itself golden-tested); these suites assert the
+batch engine reproduces its decisions — selected node per pod, feasible
+sets, raw/normalized scores — on randomized workloads covering the
+BASELINE.md benchmark configs 1-4 plugin sets.
+
+Tie-break is set to "first" on the oracle (argmax semantics) since the
+upstream reservoir tie-break is intentionally random (BASELINE parity is
+measured on finalscore + selected-node identity modulo score ties).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any
+
+import pytest
+
+from kube_scheduler_simulator_tpu.config import scheduler_config as sc
+from kube_scheduler_simulator_tpu.scheduler.batch_engine import BatchEngine
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+Obj = dict[str, Any]
+
+
+def mk_node(name: str, cpu_m: int, mem_mi: int, pods: int = 110, labels=None, taints=None, unschedulable=False) -> Obj:
+    n: Obj = {
+        "metadata": {"name": name, "labels": labels or {}},
+        "status": {
+            "allocatable": {
+                "cpu": f"{cpu_m}m",
+                "memory": f"{mem_mi}Mi",
+                "pods": str(pods),
+            }
+        },
+        "spec": {},
+    }
+    if taints:
+        n["spec"]["taints"] = taints
+    if unschedulable:
+        n["spec"]["unschedulable"] = True
+    return n
+
+
+def mk_pod(name: str, cpu_m: int = 0, mem_mi: int = 0, labels=None, ns: str = "default", **spec_extra) -> Obj:
+    reqs = {}
+    if cpu_m:
+        reqs["cpu"] = f"{cpu_m}m"
+    if mem_mi:
+        reqs["memory"] = f"{mem_mi}Mi"
+    spec: Obj = {"containers": [{"name": "c", "resources": {"requests": reqs} if reqs else {}}]}
+    spec.update(spec_extra)
+    return {"metadata": {"name": name, "namespace": ns, "labels": labels or {}}, "spec": spec}
+
+
+def profile_with(plugin_names: list[str]) -> Obj:
+    """A profile enabling exactly the given plugins (plus queue/bind infra)."""
+    base = ["PrioritySort", "DefaultBinder"]
+    return {
+        "schedulerName": "default-scheduler",
+        "plugins": {
+            "multiPoint": {
+                "enabled": [{"name": n} for n in base + plugin_names],
+                "disabled": [{"name": "*"}],
+            }
+        },
+    }
+
+
+def run_both(nodes, pods, profile_plugins=None, namespaces=None):
+    """Run the sequential oracle and the batch engine on the same snapshot;
+    return (oracle results dict, BatchResult, service)."""
+    store = ClusterStore()
+    for ns in namespaces or []:
+        store.create("namespaces", ns)
+    for n in nodes:
+        store.create("nodes", n)
+    for p in pods:
+        store.create("pods", p)
+
+    cfg = None
+    if profile_plugins is not None:
+        cfg = {"profiles": [profile_with(profile_plugins)], "percentageOfNodesToScore": 100}
+    else:
+        cfg = {"percentageOfNodesToScore": 100}
+
+    svc = SchedulerService(store, tie_break="first")
+    svc.start_scheduler(cfg)
+    fw = svc.framework
+
+    # Batch engine snapshot BEFORE the oracle mutates the store.
+    eng = BatchEngine.from_framework(fw, trace=True)
+    pending = fw.sort_pods(svc.pending_pods())
+    ok, why = eng.supported(pending, store.list("nodes"))
+    assert ok, why
+    batch = eng.schedule(store.list("nodes"), store.list("pods"), pending, store.list("namespaces"))
+
+    oracle = svc.schedule_pending(max_rounds=1)
+    return oracle, batch, svc
+
+
+def assert_parity(oracle, batch, svc=None, check_scores: bool = True):
+    """Selected-node parity for every pod, plus (when the service is given)
+    score/finalScore parity against the oracle's recorded annotations."""
+    import json
+
+    from kube_scheduler_simulator_tpu.plugins import annotations as anno
+
+    assignments = batch.assignments()
+    for key, res in oracle.items():
+        got = assignments.get(key)
+        assert got == res.selected_node, (
+            f"{key}: oracle={res.selected_node} batch={got}"
+        )
+    if not check_scores or svc is None:
+        return
+    store = svc.cluster_store
+    for i, key in enumerate(batch.pod_keys):
+        ns, name = key.split("/")
+        annos = store.get("pods", name, ns)["metadata"].get("annotations") or {}
+        got_score, got_final = batch.score_annotations(i)
+        want_score = json.loads(annos.get(anno.SCORE_RESULT, "{}"))
+        want_final = json.loads(annos.get(anno.FINALSCORE_RESULT, "{}"))
+        assert got_score == want_score, f"{key} score: {got_score} != {want_score}"
+        assert got_final == want_final, f"{key} finalScore: {got_final} != {want_final}"
+
+
+# --------------------------------------------------------------- config 1
+
+
+def test_fit_only_small():
+    random.seed(0)
+    nodes = [mk_node(f"node-{i}", cpu_m=4000, mem_mi=8192) for i in range(10)]
+    pods = [mk_pod(f"pod-{i}", cpu_m=random.choice([100, 250, 500]), mem_mi=random.choice([128, 256, 512])) for i in range(30)]
+    oracle, batch, svc = run_both(nodes, pods, ["NodeResourcesFit"])
+    assert_parity(oracle, batch, svc)
+
+
+def test_fit_heterogeneous_nodes_and_insufficient():
+    random.seed(1)
+    nodes = [
+        mk_node(f"node-{i}", cpu_m=random.choice([1000, 2000, 4000]), mem_mi=random.choice([1024, 2048, 4096]), pods=random.choice([3, 5, 110]))
+        for i in range(12)
+    ]
+    pods = [mk_pod(f"pod-{i}", cpu_m=random.choice([0, 300, 900, 1500]), mem_mi=random.choice([0, 512, 1500])) for i in range(40)]
+    oracle, batch, svc = run_both(nodes, pods, ["NodeResourcesFit"])
+    assert_parity(oracle, batch, svc)
+
+
+def test_fit_balanced_allocation():
+    random.seed(2)
+    nodes = [mk_node(f"node-{i}", cpu_m=random.choice([2000, 4000]), mem_mi=random.choice([2048, 8192])) for i in range(8)]
+    pods = [mk_pod(f"pod-{i}", cpu_m=random.choice([100, 700]), mem_mi=random.choice([128, 2048])) for i in range(25)]
+    oracle, batch, svc = run_both(
+        nodes, pods, ["NodeResourcesFit", "NodeResourcesBalancedAllocation"]
+    )
+    assert_parity(oracle, batch, svc)
+
+
+# --------------------------------------------------------------- config 2
+
+
+def test_fit_taints_affinity():
+    random.seed(3)
+    zones = ["a", "b", "c"]
+    nodes = []
+    for i in range(15):
+        taints = []
+        if i % 5 == 0:
+            taints = [{"key": "dedicated", "value": "infra", "effect": "NoSchedule"}]
+        if i % 7 == 0:
+            taints.append({"key": "spot", "value": "true", "effect": "PreferNoSchedule"})
+        nodes.append(
+            mk_node(
+                f"node-{i}",
+                cpu_m=4000,
+                mem_mi=8192,
+                labels={"zone": zones[i % 3], "disk": "ssd" if i % 2 else "hdd"},
+                taints=taints or None,
+                unschedulable=(i == 13),
+            )
+        )
+    pods = []
+    for i in range(40):
+        extra = {}
+        if i % 4 == 0:
+            extra["nodeSelector"] = {"disk": "ssd"}
+        if i % 6 == 0:
+            extra["tolerations"] = [{"key": "dedicated", "operator": "Equal", "value": "infra", "effect": "NoSchedule"}]
+        if i % 3 == 0:
+            extra["affinity"] = {
+                "nodeAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {"weight": 10, "preference": {"matchExpressions": [{"key": "zone", "operator": "In", "values": ["a"]}]}},
+                        {"weight": 5, "preference": {"matchExpressions": [{"key": "disk", "operator": "In", "values": ["ssd"]}]}},
+                    ]
+                }
+            }
+        if i % 11 == 0:
+            extra.setdefault("affinity", {})["nodeAffinity"] = {
+                **extra.get("affinity", {}).get("nodeAffinity", {}),
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [
+                        {"matchExpressions": [{"key": "zone", "operator": "NotIn", "values": ["c"]}]}
+                    ]
+                },
+            }
+        pods.append(mk_pod(f"pod-{i}", cpu_m=200, mem_mi=256, **extra))
+    oracle, batch, svc = run_both(
+        nodes,
+        pods,
+        ["NodeUnschedulable", "NodeName", "TaintToleration", "NodeAffinity", "NodeResourcesFit"],
+    )
+    assert_parity(oracle, batch, svc)
+
+
+def test_node_name_pinning():
+    nodes = [mk_node(f"node-{i}", 1000, 1024) for i in range(5)]
+    pods = [
+        mk_pod("pinned", cpu_m=100, nodeName=None),
+    ]
+    pods[0]["spec"]["nodeName"] = None
+    # a pod pinned via required affinity matchFields
+    pods = [
+        mk_pod(
+            "pinned-aff",
+            cpu_m=100,
+            affinity={
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [
+                            {"matchFields": [{"key": "metadata.name", "operator": "In", "values": ["node-3"]}]}
+                        ]
+                    }
+                }
+            },
+        ),
+        mk_pod("free", cpu_m=100),
+    ]
+    oracle, batch, svc = run_both(nodes, pods, ["NodeAffinity", "NodeResourcesFit"])
+    assert_parity(oracle, batch, svc)
+    assert batch.assignments()["default/pinned-aff"] == "node-3"
+
+
+# --------------------------------------------------------------- config 3
+
+
+def test_topology_spread():
+    random.seed(4)
+    zones = ["z1", "z2", "z3"]
+    nodes = [
+        mk_node(
+            f"node-{i}",
+            cpu_m=8000,
+            mem_mi=16384,
+            labels={"topology.kubernetes.io/zone": zones[i % 3], "kubernetes.io/hostname": f"node-{i}"},
+        )
+        for i in range(9)
+    ]
+    constraint = [
+        {
+            "maxSkew": 1,
+            "topologyKey": "topology.kubernetes.io/zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "web"}},
+        },
+        {
+            "maxSkew": 2,
+            "topologyKey": "kubernetes.io/hostname",
+            "whenUnsatisfiable": "ScheduleAnyway",
+            "labelSelector": {"matchLabels": {"app": "web"}},
+        },
+    ]
+    pods = [
+        mk_pod(f"web-{i}", cpu_m=100, mem_mi=128, labels={"app": "web"}, topologySpreadConstraints=constraint)
+        for i in range(18)
+    ]
+    # plus unrelated pods that don't match the selector
+    pods += [mk_pod(f"other-{i}", cpu_m=100, labels={"app": "db"}) for i in range(6)]
+    oracle, batch, svc = run_both(
+        nodes, pods, ["NodeResourcesFit", "PodTopologySpread"]
+    )
+    assert_parity(oracle, batch, svc)
+
+
+def test_topology_spread_missing_label():
+    nodes = [
+        mk_node("node-a", 4000, 8192, labels={"zone": "z1"}),
+        mk_node("node-b", 4000, 8192, labels={"zone": "z2"}),
+        mk_node("node-c", 4000, 8192, labels={}),  # missing key → filtered
+    ]
+    c = [
+        {
+            "maxSkew": 1,
+            "topologyKey": "zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "x"}},
+        }
+    ]
+    pods = [mk_pod(f"x-{i}", cpu_m=100, labels={"app": "x"}, topologySpreadConstraints=c) for i in range(6)]
+    oracle, batch, svc = run_both(nodes, pods, ["NodeResourcesFit", "PodTopologySpread"])
+    assert_parity(oracle, batch, svc)
+    # node-c must never be selected
+    assert "node-c" not in batch.assignments().values()
+
+
+# --------------------------------------------------------------- config 4
+
+
+def test_interpod_affinity_antiaffinity():
+    random.seed(5)
+    nodes = [
+        mk_node(
+            f"node-{i}",
+            cpu_m=8000,
+            mem_mi=16384,
+            labels={"zone": ["z1", "z2", "z3"][i % 3], "kubernetes.io/hostname": f"node-{i}"},
+        )
+        for i in range(9)
+    ]
+    anti = {
+        "podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {
+                    "labelSelector": {"matchLabels": {"app": "db"}},
+                    "topologyKey": "kubernetes.io/hostname",
+                }
+            ]
+        }
+    }
+    aff = {
+        "podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {
+                    "labelSelector": {"matchLabels": {"app": "db"}},
+                    "topologyKey": "zone",
+                }
+            ],
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {
+                    "weight": 50,
+                    "podAffinityTerm": {
+                        "labelSelector": {"matchLabels": {"app": "db"}},
+                        "topologyKey": "zone",
+                    },
+                }
+            ],
+        }
+    }
+    pods = [mk_pod(f"db-{i}", cpu_m=500, mem_mi=512, labels={"app": "db"}, affinity=anti) for i in range(4)]
+    pods += [mk_pod(f"web-{i}", cpu_m=100, mem_mi=128, labels={"app": "web"}, affinity=aff) for i in range(8)]
+    oracle, batch, svc = run_both(
+        nodes, pods, ["NodeResourcesFit", "InterPodAffinity"]
+    )
+    assert_parity(oracle, batch, svc)
+
+
+def test_interpod_with_existing_pods():
+    nodes = [
+        mk_node(f"node-{i}", 8000, 16384, labels={"zone": ["z1", "z2"][i % 2], "kubernetes.io/hostname": f"node-{i}"})
+        for i in range(6)
+    ]
+    # existing bound pod with anti-affinity against app=web
+    existing = mk_pod(
+        "guard",
+        cpu_m=100,
+        labels={"app": "guard"},
+        affinity={
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector": {"matchLabels": {"app": "web"}}, "topologyKey": "zone"}
+                ]
+            }
+        },
+    )
+    existing["spec"]["nodeName"] = "node-0"  # zone z1
+    pods = [existing] + [mk_pod(f"web-{i}", cpu_m=100, labels={"app": "web"}) for i in range(4)]
+    oracle, batch, svc = run_both(nodes, pods, ["NodeResourcesFit", "InterPodAffinity"])
+    assert_parity(oracle, batch, svc)
+    # all web pods must avoid zone z1 (nodes 0, 2, 4)
+    for key, node in batch.assignments().items():
+        if key.startswith("default/web"):
+            assert node in ("node-1", "node-3", "node-5"), (key, node)
+
+
+# ----------------------------------------------------- full default profile
+
+
+def test_default_profile_mixed_workload():
+    """Default KubeSchedulerConfiguration (all default plugins; volume &
+    ports plugins unused by the workload so they're no-ops)."""
+    random.seed(6)
+    zones = ["z1", "z2", "z3"]
+    nodes = [
+        mk_node(
+            f"node-{i}",
+            cpu_m=random.choice([2000, 4000, 8000]),
+            mem_mi=random.choice([4096, 8192]),
+            labels={"topology.kubernetes.io/zone": zones[i % 3], "kubernetes.io/hostname": f"node-{i}"},
+            taints=[{"key": "spot", "value": "true", "effect": "PreferNoSchedule"}] if i % 4 == 0 else None,
+        )
+        for i in range(12)
+    ]
+    pods = []
+    for i in range(30):
+        extra = {}
+        if i % 5 == 0:
+            extra["topologySpreadConstraints"] = [
+                {
+                    "maxSkew": 2,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"tier": "a"}},
+                }
+            ]
+        if i % 7 == 0:
+            extra["affinity"] = {
+                "podAntiAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "weight": 10,
+                            "podAffinityTerm": {
+                                "labelSelector": {"matchLabels": {"tier": "a"}},
+                                "topologyKey": "kubernetes.io/hostname",
+                            },
+                        }
+                    ]
+                }
+            }
+        pods.append(
+            mk_pod(
+                f"pod-{i}",
+                cpu_m=random.choice([100, 300, 600]),
+                mem_mi=random.choice([128, 512]),
+                labels={"tier": "a" if i % 2 == 0 else "b"},
+                **extra,
+            )
+        )
+    oracle, batch, svc = run_both(nodes, pods, profile_plugins=None)  # default config
+    assert_parity(oracle, batch, svc)
+
+
+def test_score_trace_matches_oracle_annotations():
+    """The batch trace's score/finalScore maps must equal the oracle's
+    recorded annotations (the parity oracle for the reference's
+    scheduler-simulator/score-result format)."""
+    random.seed(7)
+    nodes = [mk_node(f"node-{i}", 4000, 8192, labels={"zone": ["a", "b"][i % 2]}) for i in range(6)]
+    pods = [
+        mk_pod(
+            f"pod-{i}",
+            cpu_m=random.choice([100, 400]),
+            mem_mi=random.choice([128, 1024]),
+            affinity={
+                "nodeAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {"weight": 7, "preference": {"matchExpressions": [{"key": "zone", "operator": "In", "values": ["a"]}]}}
+                    ]
+                }
+            },
+        )
+        for i in range(8)
+    ]
+    oracle, batch, svc = run_both(
+        nodes,
+        pods,
+        ["TaintToleration", "NodeAffinity", "NodeResourcesFit", "NodeResourcesBalancedAllocation"],
+    )
+    assert_parity(oracle, batch, svc)
+
+    import json
+
+    from kube_scheduler_simulator_tpu.plugins import annotations as anno
+
+    store = svc.cluster_store
+    for i, key in enumerate(batch.pod_keys):
+        ns, name = key.split("/")
+        pod = store.get("pods", name, ns)
+        annos = pod["metadata"].get("annotations") or {}
+        if int(batch.feasible_count[i]) <= 1:
+            continue
+        got_score, got_final = batch.score_annotations(i)
+        want_score = json.loads(annos[anno.SCORE_RESULT])
+        want_final = json.loads(annos[anno.FINALSCORE_RESULT])
+        assert got_score == want_score, f"{key} score mismatch"
+        assert got_final == want_final, f"{key} finalScore mismatch"
+
+
+def test_filter_trace_matches_oracle_annotations():
+    random.seed(8)
+    nodes = [
+        mk_node(
+            f"node-{i}",
+            cpu_m=1000 if i < 2 else 4000,
+            mem_mi=8192,
+            taints=[{"key": "d", "value": "v", "effect": "NoSchedule"}] if i == 3 else None,
+        )
+        for i in range(6)
+    ]
+    pods = [mk_pod(f"pod-{i}", cpu_m=900, mem_mi=128) for i in range(4)]
+    oracle, batch, svc = run_both(
+        nodes, pods, ["TaintToleration", "NodeResourcesFit"]
+    )
+    assert_parity(oracle, batch, svc)
+
+    import json
+
+    from kube_scheduler_simulator_tpu.plugins import annotations as anno
+
+    store = svc.cluster_store
+    for i, key in enumerate(batch.pod_keys):
+        ns, name = key.split("/")
+        pod = store.get("pods", name, ns)
+        annos = pod["metadata"].get("annotations") or {}
+        want = json.loads(annos[anno.FILTER_RESULT])
+        got = batch.filter_annotation(i)
+        assert got == want, f"{key}: {got} != {want}"
