@@ -1,0 +1,223 @@
+"""Benchmark driver: the BASELINE.md configs on the TPU batch engine.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+The headline metric is pods×nodes plugin-scored per second on the largest
+config that fits the run budget (BASELINE.md config table), measured over
+the full batch pass (encode + transfer + XLA scan + result fetch) after one
+compile warmup.  ``vs_baseline`` compares against the reference's only
+quantitative cost model — the serialized O(pods × nodes × plugins) Go loop
+(SURVEY.md §6: the reference publishes no benchmark numbers) — approximated
+here by this repo's own sequential oracle on a subsampled workload,
+extrapolated linearly.  Run with --quick for a smaller sweep.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+
+# The bench runs on whatever jax finds (real TPU under the driver; CPU in
+# dev shells).  Do NOT force JAX_PLATFORMS here.
+
+
+def mk_node(i: int, zones: int = 8) -> dict:
+    return {
+        "metadata": {
+            "name": f"node-{i}",
+            "labels": {
+                "topology.kubernetes.io/zone": f"zone-{i % zones}",
+                "kubernetes.io/hostname": f"node-{i}",
+                "disk": "ssd" if i % 2 else "hdd",
+            },
+        },
+        "spec": (
+            {"taints": [{"key": "spot", "value": "true", "effect": "PreferNoSchedule"}]}
+            if i % 16 == 0
+            else {}
+        ),
+        "status": {"allocatable": {"cpu": "64000m", "memory": "256Gi", "pods": "512"}},
+    }
+
+
+def mk_pod(i: int, rng: random.Random, spread: bool = False, interpod: bool = False) -> dict:
+    spec: dict = {
+        "containers": [
+            {
+                "name": "c",
+                "resources": {
+                    "requests": {
+                        "cpu": f"{rng.choice([100, 250, 500, 1000])}m",
+                        "memory": f"{rng.choice([128, 256, 512, 1024])}Mi",
+                    }
+                },
+            }
+        ]
+    }
+    labels = {"app": f"app-{i % 8}", "tier": "web" if i % 2 else "db"}
+    if i % 4 == 0:
+        spec["nodeSelector"] = {"disk": "ssd"}
+    if spread:
+        spec["topologySpreadConstraints"] = [
+            {
+                "maxSkew": 3,
+                "topologyKey": "topology.kubernetes.io/zone",
+                "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": {"matchLabels": {"app": f"app-{i % 8}"}},
+            },
+            {
+                "maxSkew": 5,
+                "topologyKey": "kubernetes.io/hostname",
+                "whenUnsatisfiable": "ScheduleAnyway",
+                "labelSelector": {"matchLabels": {"app": f"app-{i % 8}"}},
+            },
+        ]
+    if interpod and i % 2:
+        spec["affinity"] = {
+            "podAntiAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "weight": 10,
+                        "podAffinityTerm": {
+                            "labelSelector": {"matchLabels": {"app": f"app-{i % 8}"}},
+                            "topologyKey": "kubernetes.io/hostname",
+                        },
+                    }
+                ]
+            }
+        }
+    return {"metadata": {"name": f"pod-{i}", "namespace": "default", "labels": labels}, "spec": spec}
+
+
+def run_config(name, P, N, plugins, spread=False, interpod=False, oracle_sample=0):
+    from kube_scheduler_simulator_tpu.scheduler.batch_engine import BatchEngine
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+    rng = random.Random(42)
+    nodes = [mk_node(i) for i in range(N)]
+    pods = [mk_pod(i, rng, spread=spread, interpod=interpod) for i in range(P)]
+
+    store = ClusterStore()
+    for n in nodes:
+        store.create("nodes", n)
+    for p in pods:
+        store.create("pods", p)
+    svc = SchedulerService(store, tie_break="first")
+    cfg = {"percentageOfNodesToScore": 100}
+    if plugins is not None:
+        cfg["profiles"] = [
+            {
+                "schedulerName": "default-scheduler",
+                "plugins": {
+                    "multiPoint": {
+                        "enabled": [{"name": n} for n in ["PrioritySort", "DefaultBinder"] + plugins],
+                        "disabled": [{"name": "*"}],
+                    }
+                },
+            }
+        ]
+    svc.start_scheduler(cfg)
+    fw = svc.framework
+    eng = BatchEngine.from_framework(fw, trace=False)
+    pending = fw.sort_pods(svc.pending_pods())
+    ok, why = eng.supported(pending, nodes)
+    assert ok, why
+
+    all_pods = store.list("pods")
+    namespaces = store.list("namespaces")
+    # warmup (compile)
+    t0 = time.perf_counter()
+    res = eng.schedule(nodes, all_pods, pending, namespaces)
+    compile_s = time.perf_counter() - t0
+    # timed runs
+    runs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        res = eng.schedule(nodes, all_pods, pending, namespaces)
+        runs.append(time.perf_counter() - t0)
+    best = min(runs)
+    scheduled = sum(1 for s in res.selected_nodes if s)
+
+    out = {
+        "config": name,
+        "pods": P,
+        "nodes": N,
+        "wall_s": round(best, 4),
+        "compile_s": round(compile_s, 2),
+        "encode_s": round(eng.last_timings["encode_s"], 4),
+        "device_s": round(eng.last_timings["device_s"], 4),
+        "pods_nodes_per_s": round(P * N / best),
+        "scheduled": scheduled,
+    }
+
+    # Baseline: this repo's sequential oracle (stands in for the reference's
+    # serialized Go loop, which publishes no numbers) on a subsample,
+    # extrapolated linearly in pods.
+    if oracle_sample:
+        sample = min(oracle_sample, P)
+        svc2 = SchedulerService(ClusterStore(), tie_break="first")
+        for n in nodes:
+            svc2.cluster_store.create("nodes", n)
+        for p in pods[:sample]:
+            svc2.cluster_store.create("pods", p)
+        svc2.start_scheduler(cfg)
+        t0 = time.perf_counter()
+        svc2.schedule_pending(max_rounds=1)
+        seq_s = (time.perf_counter() - t0) * (P / sample)
+        out["seq_est_s"] = round(seq_s, 2)
+        out["speedup_vs_seq"] = round(seq_s / best, 1)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="small sweep (CI/dev)")
+    ap.add_argument("--full", action="store_true", help="10k x 5k headline config")
+    args = ap.parse_args()
+
+    if args.quick:
+        configs = [
+            ("cfg1-fit", 100, 10, ["NodeResourcesFit"], False, False, 100),
+        ]
+    elif args.full:
+        configs = [
+            ("cfg1-fit", 100, 10, ["NodeResourcesFit"], False, False, 100),
+            ("cfg2-fit-taint-aff", 1000, 500, ["NodeResourcesFit", "TaintToleration", "NodeAffinity"], False, False, 200),
+            ("cfg3-spread", 5000, 2000, ["NodeResourcesFit", "PodTopologySpread"], True, False, 100),
+            ("cfg4-interpod", 10000, 5000, ["NodeResourcesFit", "InterPodAffinity"], False, True, 50),
+        ]
+    else:
+        configs = [
+            ("cfg1-fit", 100, 10, ["NodeResourcesFit"], False, False, 100),
+            ("cfg2-fit-taint-aff", 1000, 500, ["NodeResourcesFit", "TaintToleration", "NodeAffinity"], False, False, 200),
+            ("cfg3-spread", 2000, 1000, ["NodeResourcesFit", "PodTopologySpread"], True, False, 100),
+        ]
+
+    results = []
+    for cfg in configs:
+        try:
+            results.append(run_config(*cfg))
+        except Exception as e:  # keep the bench line printable on partial failure
+            results.append({"config": cfg[0], "error": f"{type(e).__name__}: {e}"})
+
+    headline = next((r for r in reversed(results) if "pods_nodes_per_s" in r), {})
+    line = {
+        "metric": "pods x nodes plugin-scored per second (batch engine, largest config)",
+        "value": headline.get("pods_nodes_per_s", 0),
+        "unit": "pod-node pairs/s",
+        # reference publishes no numbers (SURVEY.md section 6); baseline 1.0
+        # = this repo's sequential oracle (the reference's loop shape),
+        # so vs_baseline is the measured speedup over that loop.
+        "vs_baseline": headline.get("speedup_vs_seq", 0),
+        "configs": results,
+    }
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,9 @@ class BatchConfig(NamedTuple):
     filters: tuple  # subset of FILTER_KERNELS, in profile order
     scores: tuple   # ((kernel_name, weight), ...) in profile order
     fit_strategy: str = "LeastAllocated"
+    # scoringStrategy.resources: ((col, weight), ...) over the nz axis
+    # (0 = cpu, 1 = memory) — upstream default is cpu:1, memory:1
+    fit_resources: tuple = ((0, 1), (1, 1))
     trace: bool = False
 
 
@@ -197,6 +200,11 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
     KC, KS = dims["KC"], dims["KS"]
     KA, KB, KP, KO = dims["KA"], dims["KB"], dims["KP"], dims["KO"]
     G, SG = dims["G"], dims["SG"]
+    # the Fit filter packs per-resource insufficiency into an int32 bitmask
+    assert dims["R"] <= 30, (
+        f"{dims['R']} distinct checked resources exceed the int32 reason "
+        "bitmask (30); fall back to the sequential path"
+    )
     use_spread_f = "PodTopologySpread" in cfg.filters and KC > 0
     use_spread_s = any(k == "PodTopologySpread" for k, _ in cfg.scores) and KS > 0
     use_ip = G > 0 and (
@@ -311,7 +319,10 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
                     per_r = jnp.where((a > 0) & (req_nz <= a), _floordiv(req_nz * MAX_NODE_SCORE, a), 0.0)
                 else:  # LeastAllocated
                     per_r = jnp.where((a > 0) & (req_nz <= a), _floordiv((a - req_nz) * MAX_NODE_SCORE, a), 0.0)
-                raw = _floordiv(per_r[:, 0] + per_r[:, 1], 2.0)
+                wsum = float(sum(w for _, w in cfg.fit_resources)) or 1.0
+                raw = _floordiv(
+                    sum(per_r[:, c] * float(w) for c, w in cfg.fit_resources), wsum
+                )
                 norm = raw  # no ScoreExtensions
             elif name == "NodeResourcesBalancedAllocation":
                 req_nz = nonzero + dp.pod_nonzero[i][None, :]

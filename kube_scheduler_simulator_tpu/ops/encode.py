@@ -229,11 +229,16 @@ def encode(
         nz = pod_non_zero_request(p)
         pod_nonzero[i] = (nz[CPU], nz[MEMORY])
     # fit_checked: which resource columns the Fit filter checks for this pod
-    # (want > 0 and an upstream-checked resource name)
+    # (want > 0 and an upstream-checked resource name); fit_order keeps the
+    # pod-manifest iteration order for byte-identical failure messages
     fit_checked = np.zeros((P, R), dtype=bool)
+    fit_order: list[list[int]] = []
     for i, p in enumerate(pending):
-        for r in _fit_resources(p):
-            fit_checked[i, res_idx[r]] = True
+        cols = [res_idx[r] for r in _fit_resources(p)]
+        for c in cols:
+            fit_checked[i, c] = True
+        fit_order.append(cols)
+    pr.fit_order = fit_order
 
     # GCD-scale each resource column so float32 stays exact on-device (the
     # score formulas are ratio-based, hence scale-invariant).

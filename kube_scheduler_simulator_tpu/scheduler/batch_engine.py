@@ -172,8 +172,10 @@ class BatchEngine:
         filters: "list[str] | None" = None,
         scores: "list[tuple[str, int]] | None" = None,
         fit_strategy: str = "LeastAllocated",
+        fit_resources: "tuple | None" = None,
         hard_pod_affinity_weight: int = 1,
         added_affinity: "Obj | None" = None,
+        percentage_of_nodes_to_score: int = 100,
         trace: bool = False,
         dtype=None,
     ):
@@ -186,16 +188,21 @@ class BatchEngine:
         self.fit_strategy = fit_strategy
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
         self.added_affinity = added_affinity
+        self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
         self.trace = trace
         self.dtype = dtype
         self.cfg = B.BatchConfig(
             filters=tuple(f for f in self.filters if f in KERNEL_FILTERS),
             scores=tuple((s, w) for s, w in self.scores),
             fit_strategy=fit_strategy,
+            fit_resources=tuple(fit_resources) if fit_resources else ((0, 1), (1, 1)),
             trace=trace,
         )
         self._fn_cache: dict = {}
         self.last_timings: dict[str, float] = {}
+        # Config aspects the kernels cannot honor; set by from_framework,
+        # reported by supported().
+        self._unsupported_config: "str | None" = None
 
     # ------------------------------------------------------------ factory
 
@@ -209,25 +216,57 @@ class BatchEngine:
             for wp in framework.plugins["score"]
         ]
         fit_strategy = "LeastAllocated"
+        fit_resources = None
         hard_w = 1
         added = None
+        unsupported = None
+        nz_col = {"cpu": 0, "memory": 1}
         for wp in framework.plugins["filter"] + framework.plugins["score"]:
             o = wp.original
             if o.name == "NodeResourcesFit":
                 fit_strategy = getattr(o, "strategy_type", "LeastAllocated")
+                res = getattr(o, "score_resources", [("cpu", 1), ("memory", 1)])
+                if all(r in nz_col for r, _w in res):
+                    fit_resources = tuple((nz_col[r], w) for r, w in res)
+                else:
+                    unsupported = f"NodeResourcesFit scoringStrategy over {[r for r, _ in res]}"
+                if fit_strategy == "RequestedToCapacityRatio":
+                    unsupported = "NodeResourcesFit RequestedToCapacityRatio strategy"
+            elif o.name == "NodeResourcesBalancedAllocation":
+                res = getattr(o, "resources", ["cpu", "memory"])
+                if sorted(res) != ["cpu", "memory"]:
+                    unsupported = f"NodeResourcesBalancedAllocation over {res}"
             elif o.name == "InterPodAffinity":
                 hard_w = getattr(o, "hard_pod_affinity_weight", 1)
             elif o.name == "NodeAffinity":
                 added = getattr(o, "added_affinity", None)
+        # The batch pass replicates the default cycle infrastructure:
+        # PrioritySort queue, no permit plugins, DefaultBinder bind, and
+        # reserve/preBind limited to the (no-op without PVCs) VolumeBinding.
+        point_names = {
+            p: [wp.original.name for wp in framework.plugins[p]]
+            for p in ("queue_sort", "reserve", "permit", "pre_bind", "bind", "post_bind")
+        }
+        if point_names["permit"]:
+            unsupported = unsupported or f"permit plugins {point_names['permit']}"
+        if point_names["bind"] != ["DefaultBinder"]:
+            unsupported = unsupported or f"bind plugins {point_names['bind']}"
+        if not set(point_names["reserve"]) <= {"VolumeBinding"}:
+            unsupported = unsupported or f"reserve plugins {point_names['reserve']}"
+        if not set(point_names["pre_bind"]) <= {"VolumeBinding"}:
+            unsupported = unsupported or f"preBind plugins {point_names['pre_bind']}"
         eng = cls(
             filters=filters,
             scores=scores,
             fit_strategy=fit_strategy,
+            fit_resources=fit_resources,
             hard_pod_affinity_weight=hard_w,
             added_affinity=added,
+            percentage_of_nodes_to_score=framework.percentage_of_nodes_to_score,
             trace=trace,
             dtype=dtype,
         )
+        eng._unsupported_config = unsupported
         eng._framework = framework
         return eng
 
@@ -235,6 +274,17 @@ class BatchEngine:
 
     def supported(self, pending: list[Obj], nodes: list[Obj]) -> "tuple[bool, str]":
         """Can this profile × workload run fully on the batch path?"""
+        if self._unsupported_config:
+            return False, self._unsupported_config
+        # Upstream feasible-node sampling (numFeasibleNodesToFind) kicks in
+        # at >= 100 nodes unless percentageOfNodesToScore >= 100; the batch
+        # kernel always scores every node, so fall back when sampling would
+        # change the oracle's behavior.
+        if len(nodes) >= 100 and not (self.percentage_of_nodes_to_score >= 100):
+            return False, (
+                f"percentageOfNodesToScore={self.percentage_of_nodes_to_score} "
+                f"samples feasible nodes at {len(nodes)} nodes"
+            )
         for f in self.filters:
             if f in KERNEL_FILTERS:
                 continue
@@ -306,9 +356,10 @@ class BatchEngine:
             reasons = []
             if code & 1:
                 reasons.append("Too many pods")
-            for r, name in enumerate(result.problem.resource_names):
+            # pod-manifest resource order, matching the oracle's req.items()
+            for r in result.problem.fit_order[i]:
                 if code & (1 << (r + 1)):
-                    reasons.append(f"Insufficient {name}")
+                    reasons.append(f"Insufficient {result.problem.resource_names[r]}")
             return ", ".join(reasons)
         return FILTER_MESSAGES.get(plugin, {}).get(code, f"failed ({plugin} code {code})")
 

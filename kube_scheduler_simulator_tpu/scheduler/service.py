@@ -35,10 +35,23 @@ Obj = dict[str, Any]
 
 
 class SchedulerService:
-    def __init__(self, cluster_store: Any, seed: int = 0, tie_break: str = "reservoir"):
+    def __init__(
+        self,
+        cluster_store: Any,
+        seed: int = 0,
+        tie_break: str = "reservoir",
+        use_batch: str = "off",
+    ):
+        """``use_batch``: "off" = sequential cycle only; "auto" = run whole
+        pending rounds through the TPU batch engine when the profile ×
+        workload is fully supported AND every pod finds a node (falling back
+        to the sequential cycle otherwise, so preemption and unsupported
+        plugins keep exact semantics); "force" = always batch (failures are
+        recorded without preemption)."""
         self.cluster_store = cluster_store
         self.seed = seed
         self.tie_break = tie_break
+        self.use_batch = use_batch
         self.reflector = StoreReflector()
         self.reflector.register_to_cluster_store(cluster_store)
         self._out_of_tree: dict[str, Callable[[Obj | None, Any], Any]] = {}
@@ -50,7 +63,7 @@ class SchedulerService:
         self._bg_thread: "threading.Thread | None" = None
         self._bg_stop = threading.Event()
         self._wakeup = threading.Event()
-        self.batch_engine_factory: "Callable[..., Any] | None" = None
+        self._batch_engine: Any = None
 
     # ----------------------------------------------------------- extension
 
@@ -71,6 +84,7 @@ class SchedulerService:
         """StartScheduler analog (reference scheduler.go:96-186)."""
         cfg = self._filter_allowed_changes(cfg)
         self.framework = self._build_framework(cfg)
+        self._batch_engine = None  # rebuilt lazily for the new profile
         self._current_cfg = cfg
         if self._initial_cfg is None:
             self._initial_cfg = copy.deepcopy(cfg)
@@ -225,8 +239,17 @@ class SchedulerService:
 
     def schedule_pending(self, max_rounds: int = 3) -> dict[str, ScheduleResult]:
         """Drain the pending queue: sort by QueueSort, schedule each pod in
-        order; preemption-nominated pods get retried in later rounds."""
+        order; preemption-nominated pods get retried in later rounds.
+
+        With use_batch enabled, whole rounds run through the TPU batch
+        engine when possible (identical outcomes: batch results are only
+        committed when every pod found a node, so the sequential-only
+        preemption path never diverges)."""
         assert self.framework is not None, "scheduler not started"
+        if self.use_batch in ("auto", "force"):
+            batch_results = self._schedule_pending_batch()
+            if batch_results is not None:
+                return batch_results
         results: dict[str, ScheduleResult] = {}
         for _ in range(max_rounds):
             pending = self.framework.sort_pods(self.pending_pods())
@@ -243,6 +266,102 @@ class SchedulerService:
             if not progressed:
                 break
         return results
+
+    # ------------------------------------------------------------ batch path
+
+    def _schedule_pending_batch(self) -> "dict[str, ScheduleResult] | None":
+        """One whole round on the TPU batch engine (scheduler/batch_engine).
+
+        Returns None when the sequential path must run instead: profile or
+        workload unsupported, or (auto mode) some pod found no node — the
+        sequential cycle owns preemption.  Nothing is committed in that
+        case, so falling back is exact."""
+        from kube_scheduler_simulator_tpu.scheduler.batch_engine import BatchEngine
+
+        fw = self.framework
+        assert fw is not None
+        pending = fw.sort_pods(self.pending_pods())
+        if not pending:
+            return {}
+        nodes = self.cluster_store.list("nodes")
+        if self._batch_engine is None:
+            self._batch_engine = BatchEngine.from_framework(fw, trace=True)
+        eng = self._batch_engine
+        ok, _why = eng.supported(pending, nodes)
+        if not ok:
+            return None
+        result = eng.schedule(
+            nodes, self.cluster_store.list("pods"), pending, self.cluster_store.list("namespaces")
+        )
+        failed = [i for i, s in enumerate(result.selected) if s < 0]
+        if failed and self.use_batch != "force":
+            has_preemption = bool(fw.plugins["post_filter"])
+            if has_preemption:
+                return None  # preemption is host-side; run the exact cycle
+        return self._commit_batch_round(result)
+
+    def _commit_batch_round(self, result: Any) -> dict[str, ScheduleResult]:
+        """Write the batch trace into the result store (the same categories
+        the wrapped plugins record, models/wrapped.py), bind the pods, and
+        flush annotations."""
+        from kube_scheduler_simulator_tpu.plugins.resultstore import SUCCESS_MESSAGE
+
+        fw = self.framework
+        assert fw is not None and self.result_store is not None
+        rs = self.result_store
+        out: dict[str, ScheduleResult] = {}
+        point_names = {
+            p: [wp.original.name for wp in fw.plugins[p]]
+            for p in ("pre_filter", "pre_score", "reserve", "pre_bind", "bind")
+        }
+        for i, pod in enumerate(result.pending):
+            ns = pod["metadata"].get("namespace", "default")
+            name = pod["metadata"]["name"]
+            sel = int(result.selected[i])
+            feasible_count = int(result.feasible_count[i])
+
+            for pn in point_names["pre_filter"]:
+                narrowed = None
+                if pn == "NodeAffinity":
+                    names = result._engine.prefilter_node_names(pod)
+                    if names is not None:
+                        from kube_scheduler_simulator_tpu.models.framework import PreFilterResult
+
+                        narrowed = PreFilterResult(names)
+                rs.add_pre_filter_result(ns, name, pn, SUCCESS_MESSAGE, narrowed)
+            rs.add_batch_results(ns, name, filter=result.filter_annotation(i))
+            if feasible_count > 1:
+                for pn in point_names["pre_score"]:
+                    rs.add_pre_score_result(ns, name, pn, SUCCESS_MESSAGE)
+                score, final = result.score_annotations(i)
+                rs.add_batch_results(ns, name, score=score, finalScore=final)
+
+            key = f"{ns}/{name}"
+            if sel >= 0:
+                node_name = result.node_names[sel]
+                rs.add_selected_node(ns, name, node_name)
+                for pn in point_names["reserve"]:
+                    rs.add_reserve_result(ns, name, pn, SUCCESS_MESSAGE)
+                for pn in point_names["pre_bind"]:
+                    rs.add_pre_bind_result(ns, name, pn, SUCCESS_MESSAGE)
+                if point_names["bind"]:
+                    rs.add_bind_result(ns, name, point_names["bind"][0], SUCCESS_MESSAGE)
+                self.cluster_store.bind_pod(ns, name, node_name)
+                out[key] = ScheduleResult(selected_node=node_name)
+            else:
+                diagnosis = result.diagnosis(i)
+                from kube_scheduler_simulator_tpu.models.framework import Status
+
+                res = ScheduleResult(
+                    diagnosis=diagnosis,
+                    status=Status.unschedulable(
+                        f"0/{result.problem.N} nodes are available"
+                    ),
+                )
+                self._record_failure(pod, res)
+                out[key] = res
+        self.reflector.flush_all(self.cluster_store)
+        return out
 
     def schedule_one(self, pod: Obj, snapshot: "Snapshot | None" = None) -> ScheduleResult:
         assert self.framework is not None, "scheduler not started"

@@ -489,6 +489,75 @@ def test_score_trace_matches_oracle_annotations():
         assert got_final == want_final, f"{key} finalScore mismatch"
 
 
+def test_service_batch_mode_byte_identical_annotations():
+    """SchedulerService(use_batch='auto') must produce byte-identical pod
+    annotations to the sequential path — the reference's core contract."""
+    random.seed(9)
+
+    def build_store():
+        store = ClusterStore()
+        for i in range(8):
+            store.create(
+                "nodes",
+                mk_node(
+                    f"node-{i}",
+                    cpu_m=4000,
+                    mem_mi=8192,
+                    labels={"topology.kubernetes.io/zone": f"z{i % 2}", "kubernetes.io/hostname": f"node-{i}"},
+                    taints=[{"key": "spot", "value": "t", "effect": "PreferNoSchedule"}] if i == 0 else None,
+                ),
+            )
+        rng = random.Random(99)
+        for i in range(20):
+            store.create(
+                "pods",
+                mk_pod(
+                    f"pod-{i}",
+                    cpu_m=rng.choice([100, 400]),
+                    mem_mi=rng.choice([128, 512]),
+                    labels={"app": "a" if i % 2 else "b"},
+                    topologySpreadConstraints=[
+                        {
+                            "maxSkew": 2,
+                            "topologyKey": "topology.kubernetes.io/zone",
+                            "whenUnsatisfiable": "DoNotSchedule",
+                            "labelSelector": {"matchLabels": {"app": "a"}},
+                        }
+                    ]
+                    if i % 3 == 0
+                    else [],
+                ),
+            )
+        return store
+
+    cfg = {"percentageOfNodesToScore": 100}
+    store_seq = build_store()
+    svc_seq = SchedulerService(store_seq, tie_break="first", use_batch="off")
+    svc_seq.start_scheduler(cfg)
+    svc_seq.schedule_pending(max_rounds=1)
+
+    store_bat = build_store()
+    svc_bat = SchedulerService(store_bat, tie_break="first", use_batch="auto")
+    svc_bat.start_scheduler(cfg)
+    results = svc_bat.schedule_pending(max_rounds=1)
+    assert all(r.success for r in results.values())
+
+    for i in range(20):
+        seq_pod = store_seq.get("pods", f"pod-{i}")
+        bat_pod = store_bat.get("pods", f"pod-{i}")
+        seq_annos = seq_pod["metadata"].get("annotations") or {}
+        bat_annos = bat_pod["metadata"].get("annotations") or {}
+        assert seq_annos == bat_annos, (
+            f"pod-{i} annotation divergence:\n"
+            + "\n".join(
+                f"  {k}:\n   seq={seq_annos.get(k)}\n   bat={bat_annos.get(k)}"
+                for k in sorted(set(seq_annos) | set(bat_annos))
+                if seq_annos.get(k) != bat_annos.get(k)
+            )
+        )
+        assert seq_pod["spec"].get("nodeName") == bat_pod["spec"].get("nodeName")
+
+
 def test_filter_trace_matches_oracle_annotations():
     random.seed(8)
     nodes = [
