@@ -1,0 +1,3 @@
+from kube_scheduler_simulator_tpu.simulator import main
+
+main()

@@ -201,10 +201,12 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
     KA, KB, KP, KO = dims["KA"], dims["KB"], dims["KP"], dims["KO"]
     G, SG = dims["G"], dims["SG"]
     # the Fit filter packs per-resource insufficiency into an int32 bitmask
-    assert dims["R"] <= 30, (
-        f"{dims['R']} distinct checked resources exceed the int32 reason "
-        "bitmask (30); fall back to the sequential path"
-    )
+    # (BatchEngine.supported() pre-rejects such workloads; this is the
+    # backstop for direct kernel users)
+    if dims["R"] > 30:
+        raise ValueError(
+            f"{dims['R']} distinct checked resources exceed the int32 reason bitmask (30)"
+        )
     use_spread_f = "PodTopologySpread" in cfg.filters and KC > 0
     use_spread_s = any(k == "PodTopologySpread" for k, _ in cfg.scores) and KS > 0
     use_ip = G > 0 and (

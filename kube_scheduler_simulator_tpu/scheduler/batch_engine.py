@@ -255,6 +255,9 @@ class BatchEngine:
             unsupported = unsupported or f"reserve plugins {point_names['reserve']}"
         if not set(point_names["pre_bind"]) <= {"VolumeBinding"}:
             unsupported = unsupported or f"preBind plugins {point_names['pre_bind']}"
+        ext = getattr(framework, "extender_service", None)
+        if ext is not None and ext.extenders:
+            unsupported = unsupported or "extender webhooks configured"
         eng = cls(
             filters=filters,
             scores=scores,
@@ -277,14 +280,26 @@ class BatchEngine:
         if self._unsupported_config:
             return False, self._unsupported_config
         # Upstream feasible-node sampling (numFeasibleNodesToFind) kicks in
-        # at >= 100 nodes unless percentageOfNodesToScore >= 100; the batch
-        # kernel always scores every node, so fall back when sampling would
-        # change the oracle's behavior.
-        if len(nodes) >= 100 and not (self.percentage_of_nodes_to_score >= 100):
+        # at >= MIN_FEASIBLE_NODES_TO_FIND nodes unless
+        # percentageOfNodesToScore >= 100; the batch kernel always scores
+        # every node, so fall back when sampling would change the oracle.
+        from kube_scheduler_simulator_tpu.scheduler.framework_runner import (
+            MIN_FEASIBLE_NODES_TO_FIND,
+        )
+
+        if len(nodes) >= MIN_FEASIBLE_NODES_TO_FIND and not (self.percentage_of_nodes_to_score >= 100):
             return False, (
                 f"percentageOfNodesToScore={self.percentage_of_nodes_to_score} "
                 f"samples feasible nodes at {len(nodes)} nodes"
             )
+        # the Fit filter's reason bitmask covers at most 30 resource columns
+        from kube_scheduler_simulator_tpu.ops.encode import _fit_resources
+
+        distinct: set = {"cpu", "memory"}
+        for p in pending:
+            distinct |= set(_fit_resources(p))
+        if len(distinct) > 30:
+            return False, f"{len(distinct)} distinct requested resources exceed the batch kernel's bitmask"
         for f in self.filters:
             if f in KERNEL_FILTERS:
                 continue

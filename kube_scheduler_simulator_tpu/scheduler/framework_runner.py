@@ -103,6 +103,11 @@ class Framework:
         # "first" = deterministic first-max, matching the batch engine's
         # argmax — used by parity tests.
         self.tie_break = tie_break
+        # ExtenderService (scheduler/extender.py); None = no extenders.
+        # Hooks mirror upstream: filter narrowing after plugin filters,
+        # additive prioritize scores, extender binder preferred over bind
+        # plugins.
+        self.extender_service = None
 
     # ------------------------------------------------------------- utilities
 
@@ -175,6 +180,18 @@ class Framework:
                 diagnosis[ni.name] = status
         self.next_start_node_index = (self.next_start_node_index + processed) % n if n else 0
 
+        # Extender filter pass (upstream findNodesThatPassExtenders).  A
+        # non-ignorable extender failure fails this scheduling attempt.
+        if feasible and self.extender_service is not None and self.extender_service.extenders:
+            try:
+                passed, failed = self.extender_service.run_filter(pod, [ni.node for ni in feasible])
+            except Exception as e:
+                return ScheduleResult(status=Status.error(str(e)), diagnosis=diagnosis)
+            passed_names = {nd["metadata"]["name"] for nd in passed}
+            for nm, reason in failed.items():
+                diagnosis[nm] = Status.unschedulable(reason)
+            feasible = [ni for ni in feasible if ni.name in passed_names]
+
         if not feasible:
             nominated = self._run_post_filters(state, pod, diagnosis)
             status = Status.unschedulable(
@@ -215,16 +232,52 @@ class Framework:
                 self._unreserve(state, pod, selected)
                 return ScheduleResult(status=status, diagnosis=diagnosis)
 
-        # Bind (first plugin that handles it)
-        for wp in self.plugins["bind"]:
-            status = wp.bind(state, pod, selected)
-            if status is not None and status.is_skip():
-                continue
-            if status is not None and not status.is_success():
+        # Bind: an interested extender binder takes precedence over bind
+        # plugins (upstream sched.extendersBinding).
+        binder = (
+            self.extender_service.find_binder(pod)
+            if self.extender_service is not None and self.extender_service.extenders
+            else None
+        )
+        if binder is not None:
+            idx, _ext = binder
+            meta = pod["metadata"]
+            try:
+                result = self.extender_service.bind(
+                    idx,
+                    {
+                        "podName": meta["name"],
+                        "podNamespace": meta.get("namespace", "default"),
+                        "podUID": meta.get("uid", ""),
+                        "node": selected,
+                    },
+                )
+            except Exception as e:  # webhook down/timeout: clean up state
                 snapshot.forget(pod, selected)
                 self._unreserve(state, pod, selected)
-                return ScheduleResult(status=status, diagnosis=diagnosis)
-            break
+                return ScheduleResult(status=Status.error(str(e)), diagnosis=diagnosis)
+            if result and result.get("error"):
+                snapshot.forget(pod, selected)
+                self._unreserve(state, pod, selected)
+                return ScheduleResult(status=Status.error(result["error"]), diagnosis=diagnosis)
+            # Upstream: the extender webhook binds against the apiserver
+            # itself.  Our extender can't reach the in-memory store, so the
+            # simulator performs the store bind on its behalf after a
+            # successful response.
+            store = getattr(self.handle, "cluster_store", None)
+            if store is not None:
+                meta = pod["metadata"]
+                store.bind_pod(meta.get("namespace", "default"), meta["name"], selected)
+        else:
+            for wp in self.plugins["bind"]:
+                status = wp.bind(state, pod, selected)
+                if status is not None and status.is_skip():
+                    continue
+                if status is not None and not status.is_success():
+                    snapshot.forget(pod, selected)
+                    self._unreserve(state, pod, selected)
+                    return ScheduleResult(status=status, diagnosis=diagnosis)
+                break
 
         for wp in self.plugins["post_bind"]:
             wp.post_bind(state, pod, selected)
@@ -279,6 +332,13 @@ class Framework:
             weight = self.score_weights.get(wp.original.name, 1)
             for name, s in raw.items():
                 totals[name] += s * weight
+
+        # Extender prioritize pass (additive weighted scores).
+        if self.extender_service is not None and self.extender_service.extenders:
+            ext_totals = self.extender_service.run_prioritize(pod, nodes)
+            for name, s in ext_totals.items():
+                if name in totals:
+                    totals[name] += s
 
         return self._select_host(totals), None
 

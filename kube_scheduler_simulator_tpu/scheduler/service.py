@@ -41,17 +41,23 @@ class SchedulerService:
         seed: int = 0,
         tie_break: str = "reservoir",
         use_batch: str = "off",
+        batch_min_work: int = 2048,
     ):
         """``use_batch``: "off" = sequential cycle only; "auto" = run whole
         pending rounds through the TPU batch engine when the profile ×
         workload is fully supported AND every pod finds a node (falling back
         to the sequential cycle otherwise, so preemption and unsupported
         plugins keep exact semantics); "force" = always batch (failures are
-        recorded without preemption)."""
+        recorded without preemption).
+
+        ``batch_min_work``: in auto mode, rounds with pods×nodes below this
+        skip the batch path — XLA compile + dispatch overhead dwarfs tiny
+        interactive rounds; the sequential cycle answers instantly."""
         self.cluster_store = cluster_store
         self.seed = seed
         self.tie_break = tie_break
         self.use_batch = use_batch
+        self.batch_min_work = batch_min_work
         self.reflector = StoreReflector()
         self.reflector.register_to_cluster_store(cluster_store)
         self._out_of_tree: dict[str, Callable[[Obj | None, Any], Any]] = {}
@@ -64,6 +70,7 @@ class SchedulerService:
         self._bg_stop = threading.Event()
         self._wakeup = threading.Event()
         self._batch_engine: Any = None
+        self.extender_service: Any = None  # set by _build_framework
 
     # ----------------------------------------------------------- extension
 
@@ -211,7 +218,7 @@ class SchedulerService:
             "post_bind": [wrapped(p["name"]) for p in per_point["postBind"]],
         }
 
-        return Framework(
+        fw = Framework(
             plugins,
             handle,
             score_weights=score_weights,
@@ -220,6 +227,13 @@ class SchedulerService:
             profile_name=profile.get("schedulerName") or "default-scheduler",
             tie_break=self.tie_break,
         )
+        # Extender webhook proxy (reference scheduler.go:120-126 wires the
+        # extender service + its result store before the scheduler starts).
+        from kube_scheduler_simulator_tpu.scheduler.extender import ExtenderService
+
+        self.extender_service = ExtenderService(cfg.get("extenders"), self.reflector)
+        fw.extender_service = self.extender_service
+        return fw
 
     # ------------------------------------------------------------- run loop
 
@@ -284,6 +298,8 @@ class SchedulerService:
         if not pending:
             return {}
         nodes = self.cluster_store.list("nodes")
+        if self.use_batch == "auto" and len(pending) * max(len(nodes), 1) < self.batch_min_work:
+            return None
         if self._batch_engine is None:
             self._batch_engine = BatchEngine.from_framework(fw, trace=True)
         eng = self._batch_engine

@@ -1,0 +1,6 @@
+"""HTTP API layer (reference simulator/server + handler + di)."""
+
+from kube_scheduler_simulator_tpu.server.di import DIContainer
+from kube_scheduler_simulator_tpu.server.server import SimulatorServer
+
+__all__ = ["DIContainer", "SimulatorServer"]

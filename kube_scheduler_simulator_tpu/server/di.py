@@ -1,0 +1,60 @@
+"""DI container: constructor-injection of every simulator service.
+
+Rebuild of the reference's DI layer (reference simulator/server/di/di.go:
+21-91): one place that wires the cluster store (our control plane), the
+scheduler service, and the snapshot/reset/watcher/importer services, so
+the HTTP server only sees interfaces.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.services.importer import ClusterResourceImporter
+from kube_scheduler_simulator_tpu.services.reset import ResetService
+from kube_scheduler_simulator_tpu.services.resourcewatcher import ResourceWatcherService
+from kube_scheduler_simulator_tpu.services.snapshot import SnapshotService
+from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+
+class DIContainer:
+    def __init__(
+        self,
+        cluster_store: "ClusterStore | None" = None,
+        initial_scheduler_cfg: "dict | None" = None,
+        use_batch: str = "auto",
+        external_snap_source: Any = None,
+        seed: int = 0,
+    ):
+        self.cluster_store = cluster_store or ClusterStore()
+        self._scheduler_service = SchedulerService(self.cluster_store, seed=seed, use_batch=use_batch)
+        self._scheduler_service.start_scheduler(initial_scheduler_cfg)
+        self._snapshot_service = SnapshotService(self.cluster_store, self._scheduler_service)
+        # Reset captures the post-boot state (reference NewDIContainer order:
+        # reset service is built at boot, capturing the initial keyspace).
+        self._reset_service = ResetService(self.cluster_store, self._scheduler_service)
+        self._watcher_service = ResourceWatcherService(self.cluster_store)
+        self._importer = (
+            ClusterResourceImporter(external_snap_source, self._snapshot_service)
+            if external_snap_source is not None
+            else None
+        )
+
+    def scheduler_service(self) -> SchedulerService:
+        return self._scheduler_service
+
+    def extender_service(self):
+        return self._scheduler_service.extender_service
+
+    def snapshot_service(self) -> SnapshotService:
+        return self._snapshot_service
+
+    def reset_service(self) -> ResetService:
+        return self._reset_service
+
+    def resource_watcher_service(self) -> ResourceWatcherService:
+        return self._watcher_service
+
+    def import_cluster_resource_service(self) -> "ClusterResourceImporter | None":
+        return self._importer

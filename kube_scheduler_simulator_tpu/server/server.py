@@ -1,0 +1,265 @@
+"""The simulator HTTP server: the reference's exact REST surface.
+
+Routes (reference simulator/server/server.go:42-57):
+
+    GET  /api/v1/schedulerconfiguration      → 200 current config
+    POST /api/v1/schedulerconfiguration      → 202 (only .profiles honored)
+    PUT  /api/v1/reset                       → 202
+    GET  /api/v1/export                      → 200 ResourcesForSnap
+    POST /api/v1/import                      → 200
+    GET  /api/v1/listwatchresources          → JSON-lines server push (SSE analog)
+    POST /api/v1/extender/filter/:id | prioritize/:id | preempt/:id | bind/:id
+
+Because this build replaces the in-process kube-apiserver with the
+in-memory cluster store (SURVEY.md §7 step 1), the direct kube-API CRUD
+the reference's web UI performs is exposed here too:
+
+    GET    /api/v1/resources/{kind}?namespace=        → list
+    POST   /api/v1/resources/{kind}                   → create
+    GET    /api/v1/resources/{kind}/{name}?namespace= → get
+    PUT    /api/v1/resources/{kind}/{name}            → apply (upsert)
+    DELETE /api/v1/resources/{kind}/{name}?namespace= → delete
+
+Implementation: stdlib ThreadingHTTPServer — one thread per connection,
+matching the store's synchronous, lock-guarded semantics; the watch
+endpoint writes newline-delimited WatchEvent JSON with per-event flush
+(what the reference's echo ResponseStream does, streamwriter.go:41-50).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+from urllib.parse import parse_qs, urlparse
+
+from kube_scheduler_simulator_tpu.server.di import DIContainer
+from kube_scheduler_simulator_tpu.services.resourcewatcher import PARAM_KINDS
+from kube_scheduler_simulator_tpu.state.store import KINDS, AlreadyExistsError, NotFoundError
+
+Obj = dict[str, Any]
+
+_EXTENDER_RE = re.compile(r"^/api/v1/extender/(filter|prioritize|preempt|bind)/(\d+)$")
+_RESOURCE_RE = re.compile(r"^/api/v1/resources/([a-z]+)(?:/([^/]+))?$")
+
+
+class SimulatorServer:
+    """NewSimulatorServer analog (reference server/server.go:26-66)."""
+
+    def __init__(self, di: DIContainer, port: int = 1212, cors_allowed_origins: "list[str] | None" = None):
+        self.di = di
+        self.port = port
+        self.cors = cors_allowed_origins or []
+        self._httpd: "ThreadingHTTPServer | None" = None
+        self._thread: "threading.Thread | None" = None
+        self._stop = threading.Event()  # ends open watch streams on shutdown
+
+    # --------------------------------------------------------------- serve
+
+    def start(self, background: bool = True) -> int:
+        """Start serving; returns the bound port (0 requests an ephemeral
+        port, handy for tests)."""
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        # The scheduler runs continuously like the reference's
+        # `go sched.Run(ctx)` (scheduler.go:183).
+        self.di.scheduler_service().start_background()
+        if background:
+            self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+            self._thread.start()
+        else:
+            self._httpd.serve_forever()
+        return self.port
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+        self.di.scheduler_service().stop_background()
+
+
+def _make_handler(server: SimulatorServer):
+    di = server.di
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # silence default request logging (echo's logger is opt-in)
+        def log_message(self, fmt: str, *args: Any) -> None:
+            pass
+
+        # ----------------------------------------------------------- utils
+
+        def _send_json(self, code: int, obj: Any) -> None:
+            data = json.dumps(obj).encode()
+            self.send_response(code)
+            self._cors_headers()
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _send_empty(self, code: int) -> None:
+            self.send_response(code)
+            self._cors_headers()
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def _cors_headers(self) -> None:
+            origin = self.headers.get("Origin")
+            if origin and (origin in server.cors or "*" in server.cors):
+                self.send_header("Access-Control-Allow-Origin", origin)
+                self.send_header("Access-Control-Allow-Methods", "GET, POST, PUT, DELETE, OPTIONS")
+                self.send_header("Access-Control-Allow-Headers", "Content-Type")
+
+        def _body(self) -> Any:
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b""
+            return json.loads(raw.decode()) if raw else None
+
+        # --------------------------------------------------------- methods
+
+        def do_OPTIONS(self) -> None:  # CORS preflight
+            self._send_empty(204)
+
+        def do_GET(self) -> None:
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
+            try:
+                if url.path == "/api/v1/schedulerconfiguration":
+                    self._send_json(200, di.scheduler_service().get_scheduler_config())
+                elif url.path == "/api/v1/export":
+                    self._send_json(200, di.snapshot_service().snap())
+                elif url.path == "/api/v1/listwatchresources":
+                    self._list_watch(q)
+                elif m := _RESOURCE_RE.match(url.path):
+                    kind, name = m.group(1), m.group(2)
+                    ns = (q.get("namespace") or [None])[0]
+                    if kind not in KINDS:
+                        self._send_json(404, {"message": f"unknown resource kind {kind}"})
+                    elif name is None:
+                        self._send_json(200, {"items": di.cluster_store.list(kind, ns)})
+                    else:
+                        self._send_json(200, di.cluster_store.get(kind, name, ns))
+                else:
+                    self._send_json(404, {"message": "not found"})
+            except NotFoundError as e:
+                self._send_json(404, {"message": str(e)})
+            except Exception as e:  # pragma: no cover - defensive 500
+                self._send_json(500, {"message": f"{type(e).__name__}: {e}"})
+
+        def do_POST(self) -> None:
+            url = urlparse(self.path)
+            try:
+                if url.path == "/api/v1/schedulerconfiguration":
+                    body = self._body() or {}
+                    # only .Profiles is honored (reference
+                    # handler/schedulerconfig.go:39-60)
+                    svc = di.scheduler_service()
+                    cfg = svc.get_scheduler_config()
+                    cfg["profiles"] = copy.deepcopy(body.get("profiles") or [])
+                    svc.restart_scheduler(cfg)
+                    self._send_empty(202)
+                elif url.path == "/api/v1/import":
+                    di.snapshot_service().load(self._body() or {})
+                    self._send_empty(200)
+                elif m := _EXTENDER_RE.match(url.path):
+                    verb, id_ = m.group(1), int(m.group(2))
+                    ext = di.extender_service()
+                    result = getattr(ext, verb)(id_, self._body() or {})
+                    self._send_json(200, result)
+                elif m := _RESOURCE_RE.match(url.path):
+                    kind = m.group(1)
+                    if kind not in KINDS:
+                        self._send_json(404, {"message": f"unknown resource kind {kind}"})
+                    else:
+                        self._send_json(201, di.cluster_store.create(kind, self._body() or {}))
+                else:
+                    self._send_json(404, {"message": "not found"})
+            except AlreadyExistsError as e:
+                self._send_json(409, {"message": str(e)})
+            except NotFoundError as e:
+                self._send_json(404, {"message": str(e)})
+            except IndexError:
+                self._send_json(400, {"message": "unknown extender id"})
+            except Exception as e:
+                self._send_json(500, {"message": f"{type(e).__name__}: {e}"})
+
+        def do_PUT(self) -> None:
+            url = urlparse(self.path)
+            try:
+                if url.path == "/api/v1/reset":
+                    di.reset_service().reset()
+                    self._send_empty(202)
+                elif m := _RESOURCE_RE.match(url.path):
+                    kind, name = m.group(1), m.group(2)
+                    if kind not in KINDS or name is None:
+                        self._send_json(404, {"message": "not found"})
+                    else:
+                        body = self._body() or {}
+                        body.setdefault("metadata", {}).setdefault("name", name)
+                        self._send_json(200, di.cluster_store.apply(kind, body))
+                else:
+                    self._send_json(404, {"message": "not found"})
+            except Exception as e:
+                self._send_json(500, {"message": f"{type(e).__name__}: {e}"})
+
+        def do_DELETE(self) -> None:
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
+            try:
+                if m := _RESOURCE_RE.match(url.path):
+                    kind, name = m.group(1), m.group(2)
+                    ns = (q.get("namespace") or [None])[0]
+                    if kind not in KINDS or name is None:
+                        self._send_json(404, {"message": "not found"})
+                    else:
+                        di.cluster_store.delete(kind, name, ns)
+                        self._send_empty(200)
+                else:
+                    self._send_json(404, {"message": "not found"})
+            except NotFoundError as e:
+                self._send_json(404, {"message": str(e)})
+            except Exception as e:
+                self._send_json(500, {"message": f"{type(e).__name__}: {e}"})
+
+        # ----------------------------------------------------------- watch
+
+        def _list_watch(self, q: dict) -> None:
+            lrv = {}
+            for param, kind in PARAM_KINDS:
+                v = (q.get(f"{param}LastResourceVersion") or [""])[0]
+                # docs also show the all-lowercase variant (api.md:118-130)
+                v = v or (q.get(f"{param}lastResourceVersion") or [""])[0]
+                if v:
+                    lrv[kind] = v
+            self.send_response(200)
+            self._cors_headers()
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            handler = self
+
+            class ChunkedStream:
+                def write(self, data: bytes) -> None:
+                    handler.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+
+                def flush(self) -> None:
+                    handler.wfile.flush()
+
+            try:
+                di.resource_watcher_service().list_watch(ChunkedStream(), lrv, stop=server._stop)
+            finally:
+                try:
+                    handler.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
+
+    return Handler

@@ -1,0 +1,51 @@
+"""Cluster-resource importer: one-shot import of an external cluster.
+
+Rebuild of the reference's clusterresourceimporter (reference
+simulator/clusterresourceimporter/importer.go:17-60): Snap the external
+cluster, convert, and Load into the simulator with errors ignored and the
+scheduler configuration left untouched.
+
+The external source is injected as any object with a ``snap()`` method
+returning the ResourcesForSnap shape: another SnapshotService (simulator →
+simulator), a kubeconfig-backed client adapter, or a file loader.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol
+
+
+class SnapSource(Protocol):
+    def snap(self) -> dict: ...
+
+
+class ClusterResourceImporter:
+    def __init__(self, export_service: SnapSource, import_service: Any):
+        """``export_service``: where resources come from (external cluster);
+        ``import_service``: the simulator's SnapshotService."""
+        self.export_service = export_service
+        self.import_service = import_service
+
+    def import_cluster_resources(self) -> None:
+        resources = self.export_service.snap()
+        # IgnoreErr + IgnoreSchedulerConfiguration (reference importer.go:44-60)
+        self.import_service.load(resources, ignore_err=True, ignore_scheduler_configuration=True)
+
+
+class FileSnapSource:
+    """Load a ResourcesForSnap JSON/YAML file as an import source."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def snap(self) -> dict:
+        import json
+
+        with open(self.path) as f:
+            text = f.read()
+        try:
+            return json.loads(text)
+        except json.JSONDecodeError:
+            import yaml  # type: ignore[import-untyped]
+
+            return yaml.safe_load(text)

@@ -1,0 +1,76 @@
+"""Simulator entry point: boot order mirroring the reference.
+
+Reference ``startSimulator`` (simulator/simulator.go:32-106): config →
+control plane → DI container → scheduler → optional cluster import → HTTP
+server → wait for SIGTERM.  Here the control plane is the in-memory
+ClusterStore (no external etcd / in-process kube-apiserver needed), and
+the scheduler can run its TPU batch path.
+
+Run:  python -m kube_scheduler_simulator_tpu  [--config config.yaml]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from kube_scheduler_simulator_tpu.config.simulator_config import new_config
+from kube_scheduler_simulator_tpu.server import DIContainer, SimulatorServer
+from kube_scheduler_simulator_tpu.services.importer import FileSnapSource
+
+logger = logging.getLogger("simulator")
+
+
+def start_simulator(config_path: "str | None" = None, use_batch: str = "auto", block: bool = True):
+    cfg = new_config(config_path)
+
+    external_source = None
+    if cfg.external_import_enabled and cfg.kubeconfig:
+        # The reference imports via client-go against a real cluster
+        # (importer.go:44-60); this build accepts a ResourcesForSnap file
+        # exported from any cluster (kubectl-based exporters produce it).
+        external_source = FileSnapSource(cfg.kubeconfig)
+
+    di = DIContainer(
+        initial_scheduler_cfg=cfg.initial_scheduler_cfg,
+        use_batch=use_batch,
+        external_snap_source=external_source,
+    )
+    if di.import_cluster_resource_service() is not None:
+        di.import_cluster_resource_service().import_cluster_resources()
+
+    server = SimulatorServer(di, port=cfg.port, cors_allowed_origins=cfg.cors_allowed_origin_list)
+    port = server.start(background=True)
+    logger.info("simulator server started on :%d", port)
+
+    if not block:
+        return server
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    try:
+        stop.wait()
+    finally:
+        server.shutdown()
+    return server
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
+    ap = argparse.ArgumentParser(description="TPU-native kube-scheduler-simulator")
+    ap.add_argument("--config", default=None, help="SimulatorConfiguration YAML path")
+    ap.add_argument(
+        "--use-batch",
+        default="auto",
+        choices=["off", "auto", "force"],
+        help="TPU batch scheduling mode (default: auto)",
+    )
+    args = ap.parse_args()
+    start_simulator(args.config, use_batch=args.use_batch)
+
+
+if __name__ == "__main__":
+    main()

@@ -537,7 +537,7 @@ def test_service_batch_mode_byte_identical_annotations():
     svc_seq.schedule_pending(max_rounds=1)
 
     store_bat = build_store()
-    svc_bat = SchedulerService(store_bat, tie_break="first", use_batch="auto")
+    svc_bat = SchedulerService(store_bat, tie_break="first", use_batch="auto", batch_min_work=0)
     svc_bat.start_scheduler(cfg)
     results = svc_bat.schedule_pending(max_rounds=1)
     assert all(r.success for r in results.values())

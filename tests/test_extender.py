@@ -1,0 +1,185 @@
+"""Extender webhook proxy tests: a real user-extender HTTP server, the
+scheduling cycle calling through the recording proxy, and the
+scheduler-simulator/extender-* annotations (reference
+extender/{extender,service}.go + extender/resultstore)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+import pytest
+
+from kube_scheduler_simulator_tpu.scheduler.extender import (
+    override_extenders_cfg_to_simulator,
+)
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+Obj = dict[str, Any]
+
+
+class FakeExtender(BaseHTTPRequestHandler):
+    """A user extender webhook: filters out nodes named *-banned and
+    prioritizes nodes ending in the preferred suffix."""
+
+    requests_seen: list = []
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        args = json.loads(self.rfile.read(length))
+        type(self).requests_seen.append((self.path, args))
+        if self.path.endswith("/filter"):
+            items = (args.get("nodes") or {}).get("items") or []
+            keep = [n for n in items if not n["metadata"]["name"].endswith("-banned")]
+            failed = {
+                n["metadata"]["name"]: "banned by extender"
+                for n in items
+                if n["metadata"]["name"].endswith("-banned")
+            }
+            out = {"nodes": {"items": keep}, "failedNodes": failed}
+        elif self.path.endswith("/prioritize"):
+            items = (args.get("nodes") or {}).get("items") or []
+            out = [
+                {"host": n["metadata"]["name"], "score": 10 if n["metadata"]["name"] == "node-preferred" else 0}
+                for n in items
+            ]
+        elif self.path.endswith("/bind"):
+            out = {}
+        else:
+            out = {}
+        data = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+@pytest.fixture()
+def fake_extender():
+    FakeExtender.requests_seen = []
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), FakeExtender)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def _node(name: str) -> Obj:
+    return {"metadata": {"name": name}, "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "110"}}}
+
+
+def _pod(name: str) -> Obj:
+    return {
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "100m"}}}]},
+    }
+
+
+def test_extender_filter_and_prioritize_in_cycle(fake_extender):
+    store = ClusterStore()
+    store.create("nodes", _node("node-banned"))
+    store.create("nodes", _node("node-ok"))
+    store.create("nodes", _node("node-preferred"))
+    store.create("pods", _pod("p1"))
+
+    svc = SchedulerService(store, tie_break="first")
+    svc.start_scheduler(
+        {
+            "extenders": [
+                {
+                    "urlPrefix": fake_extender,
+                    "filterVerb": "filter",
+                    "prioritizeVerb": "prioritize",
+                    "weight": 1,
+                }
+            ]
+        }
+    )
+    results = svc.schedule_pending(max_rounds=1)
+    res = results["default/p1"]
+    # extender score dominates: 10 * weight 1 * (100/10) = 100 extra
+    assert res.selected_node == "node-preferred"
+
+    pod = store.get("pods", "p1")
+    annos = pod["metadata"]["annotations"]
+    filter_result = json.loads(annos["scheduler-simulator/extender-filter-result"])
+    assert fake_extender in filter_result
+    assert filter_result[fake_extender]["failedNodes"] == {"node-banned": "banned by extender"}
+    prioritize_result = json.loads(annos["scheduler-simulator/extender-prioritize-result"])
+    scores = {e["host"]: e["score"] for e in prioritize_result[fake_extender]}
+    # the annotation records the webhook's RAW response (reference
+    # "returns the response as is"); scaling happens at combination time
+    assert scores["node-preferred"] == 10
+
+    # the scheduler's own diagnosis recorded the extender failure reason
+    assert "node-banned" not in (res.feasible_nodes or [])
+
+
+def test_extender_bind_verb(fake_extender):
+    store = ClusterStore()
+    store.create("nodes", _node("node-ok"))
+    store.create("pods", _pod("p1"))
+    svc = SchedulerService(store, tie_break="first")
+    svc.start_scheduler(
+        {"extenders": [{"urlPrefix": fake_extender, "bindVerb": "bind"}]}
+    )
+    results = svc.schedule_pending(max_rounds=1)
+    assert results["default/p1"].selected_node == "node-ok"
+    # the bind webhook was called and the pod is bound in the store
+    assert any(p.endswith("/bind") for p, _ in FakeExtender.requests_seen)
+    assert store.get("pods", "p1")["spec"]["nodeName"] == "node-ok"
+    annos = store.get("pods", "p1")["metadata"]["annotations"]
+    assert fake_extender in json.loads(annos["scheduler-simulator/extender-bind-result"])
+
+
+def test_extender_down_fails_attempt_unless_ignorable():
+    store = ClusterStore()
+    store.create("nodes", _node("node-ok"))
+    store.create("pods", _pod("p1"))
+    svc = SchedulerService(store, tie_break="first")
+    # port 1 refuses connections — the webhook is down
+    svc.start_scheduler(
+        {"extenders": [{"urlPrefix": "http://127.0.0.1:1", "filterVerb": "filter"}]}
+    )
+    results = svc.schedule_pending(max_rounds=1)
+    res = results["default/p1"]
+    assert not res.success
+    assert res.status is not None and res.status.code.name == "ERROR"
+
+    # ignorable: the same failure is skipped and scheduling proceeds
+    store2 = ClusterStore()
+    store2.create("nodes", _node("node-ok"))
+    store2.create("pods", _pod("p1"))
+    svc2 = SchedulerService(store2, tie_break="first")
+    svc2.start_scheduler(
+        {
+            "extenders": [
+                {"urlPrefix": "http://127.0.0.1:1", "filterVerb": "filter", "ignorable": True}
+            ]
+        }
+    )
+    results2 = svc2.schedule_pending(max_rounds=1)
+    assert results2["default/p1"].selected_node == "node-ok"
+
+
+def test_override_extenders_cfg():
+    cfg = {
+        "extenders": [
+            {"urlPrefix": "https://user-ext:8443/scheduler", "filterVerb": "filter", "bindVerb": "bind", "enableHTTPS": True},
+            {"urlPrefix": "http://other/x", "prioritizeVerb": "prio"},
+        ]
+    }
+    override_extenders_cfg_to_simulator(cfg, 1212)
+    e0, e1 = cfg["extenders"]
+    assert e0["urlPrefix"] == "http://localhost:1212/api/v1/extender/"
+    assert e0["filterVerb"] == "filter/0"
+    assert e0["bindVerb"] == "bind/0"
+    assert e0["enableHTTPS"] is False
+    assert e1["prioritizeVerb"] == "prioritize/1"

@@ -1,0 +1,153 @@
+"""HTTP API tests: the reference's REST surface end-to-end over a real
+socket (reference routes server/server.go:42-57)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from typing import Any
+
+import pytest
+
+from kube_scheduler_simulator_tpu.server import DIContainer, SimulatorServer
+
+Obj = dict[str, Any]
+
+
+@pytest.fixture()
+def server():
+    di = DIContainer(use_batch="off")
+    srv = SimulatorServer(di, port=0)
+    srv.start(background=True)
+    yield srv
+    srv.shutdown()
+
+
+def _req(srv, method: str, path: str, body: "Obj | None" = None) -> "tuple[int, Any]":
+    url = f"http://127.0.0.1:{srv.port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method, headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            raw = resp.read()
+            return resp.status, (json.loads(raw) if raw else None)
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, (json.loads(raw) if raw else None)
+
+
+def test_scheduler_configuration_get_post(server):
+    code, cfg = _req(server, "GET", "/api/v1/schedulerconfiguration")
+    assert code == 200
+    assert cfg["kind"] == "KubeSchedulerConfiguration"
+    assert cfg["profiles"][0]["schedulerName"] == "default-scheduler"
+
+    # POST: only .profiles honored, returns 202 (handler/schedulerconfig.go)
+    new_cfg = {
+        "profiles": [
+            {
+                "schedulerName": "my-scheduler",
+                "plugins": {
+                    "multiPoint": {
+                        "enabled": [{"name": "NodeResourcesFit"}],
+                        "disabled": [{"name": "*"}],
+                    }
+                },
+            }
+        ],
+        "parallelism": 9999,  # must be ignored
+    }
+    code, _ = _req(server, "POST", "/api/v1/schedulerconfiguration", new_cfg)
+    assert code == 202
+    code, cfg = _req(server, "GET", "/api/v1/schedulerconfiguration")
+    assert cfg["profiles"][0]["schedulerName"] == "my-scheduler"
+    assert cfg["parallelism"] == 16  # default kept
+
+
+def test_resource_crud_and_export_import_reset(server):
+    node = {"metadata": {"name": "n1"}, "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "110"}}}
+    code, created = _req(server, "POST", "/api/v1/resources/nodes", node)
+    assert code == 201 and created["metadata"]["uid"]
+
+    code, lst = _req(server, "GET", "/api/v1/resources/nodes")
+    assert code == 200 and [n["metadata"]["name"] for n in lst["items"]] == ["n1"]
+
+    code, exported = _req(server, "GET", "/api/v1/export")
+    assert code == 200
+    assert [n["metadata"]["name"] for n in exported["nodes"]] == ["n1"]
+    assert exported["schedulerConfig"]["kind"] == "KubeSchedulerConfiguration"
+
+    # reset: the DI container captured the boot (empty) state
+    code, _ = _req(server, "PUT", "/api/v1/reset")
+    assert code == 202
+    code, lst = _req(server, "GET", "/api/v1/resources/nodes")
+    assert lst["items"] == []
+
+    # import the export back
+    code, _ = _req(server, "POST", "/api/v1/import", exported)
+    assert code == 200
+    code, lst = _req(server, "GET", "/api/v1/resources/nodes")
+    assert [n["metadata"]["name"] for n in lst["items"]] == ["n1"]
+
+    code, got = _req(server, "GET", "/api/v1/resources/nodes/n1")
+    assert code == 200 and got["metadata"]["name"] == "n1"
+    code, _ = _req(server, "DELETE", "/api/v1/resources/nodes/n1")
+    assert code == 200
+    code, _ = _req(server, "GET", "/api/v1/resources/nodes/n1")
+    assert code == 404
+
+
+def test_schedules_created_pods_and_writes_annotations(server):
+    node = {"metadata": {"name": "n1"}, "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "110"}}}
+    pod = {
+        "metadata": {"name": "p1", "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "100m"}}}]},
+    }
+    _req(server, "POST", "/api/v1/resources/nodes", node)
+    _req(server, "POST", "/api/v1/resources/pods", pod)
+
+    import time
+
+    deadline = time.time() + 10
+    scheduled = None
+    while time.time() < deadline:
+        code, got = _req(server, "GET", "/api/v1/resources/pods/p1?namespace=default")
+        if code == 200 and (got.get("spec") or {}).get("nodeName"):
+            scheduled = got
+            break
+        time.sleep(0.1)
+    assert scheduled is not None, "background scheduler did not bind the pod"
+    assert scheduled["spec"]["nodeName"] == "n1"
+    annos = scheduled["metadata"]["annotations"]
+    assert annos["scheduler-simulator/selected-node"] == "n1"
+    assert "scheduler-simulator/filter-result" in annos
+    assert "scheduler-simulator/result-history" in annos
+
+
+def test_listwatchresources_streams_events(server):
+    node = {"metadata": {"name": "n1"}, "status": {"allocatable": {"cpu": "4"}}}
+    _req(server, "POST", "/api/v1/resources/nodes", node)
+
+    url = f"http://127.0.0.1:{server.port}/api/v1/listwatchresources"
+    resp = urllib.request.urlopen(url, timeout=10)
+    first = json.loads(resp.readline())
+    assert first["Kind"] == "nodes" and first["EventType"] == "ADDED"
+    assert first["Obj"]["metadata"]["name"] == "n1"
+
+    # a live event arrives on the open stream
+    def create_later():
+        _req(server, "POST", "/api/v1/resources/namespaces", {"metadata": {"name": "team-b"}})
+
+    t = threading.Thread(target=create_later, daemon=True)
+    t.start()
+    ev = json.loads(resp.readline())
+    assert ev["Kind"] == "namespaces" and ev["Obj"]["metadata"]["name"] == "team-b"
+    resp.close()
+
+
+def test_unknown_routes_404(server):
+    code, _ = _req(server, "GET", "/api/v1/nope")
+    assert code == 404
+    code, _ = _req(server, "GET", "/api/v1/resources/gadgets")
+    assert code == 404

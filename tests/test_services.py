@@ -1,0 +1,202 @@
+"""Snapshot / reset / watcher / importer service tests (reference test
+strategy: snapshot_test.go shapes + apply ordering, SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.services.importer import ClusterResourceImporter
+from kube_scheduler_simulator_tpu.services.reset import ResetService
+from kube_scheduler_simulator_tpu.services.resourcewatcher import ResourceWatcherService
+from kube_scheduler_simulator_tpu.services.snapshot import SnapshotService
+from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+Obj = dict[str, Any]
+
+
+def _node(name: str) -> Obj:
+    return {"metadata": {"name": name}, "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "110"}}}
+
+
+def _pod(name: str, ns: str = "default") -> Obj:
+    return {"metadata": {"name": name, "namespace": ns}, "spec": {"containers": [{"name": "c"}]}}
+
+
+def build() -> "tuple[ClusterStore, SchedulerService, SnapshotService]":
+    store = ClusterStore()
+    svc = SchedulerService(store)
+    svc.start_scheduler(None)
+    return store, svc, SnapshotService(store, svc)
+
+
+# ------------------------------------------------------------------ snapshot
+
+
+def test_snap_shape_and_filters():
+    store, svc, snap = build()
+    store.create("nodes", _node("n1"))
+    store.create("pods", _pod("p1"))
+    store.create("priorityclasses", {"metadata": {"name": "user-pc"}, "value": 100})
+    store.create("priorityclasses", {"metadata": {"name": "system-node-critical"}, "value": 2000001000})
+    store.create("namespaces", {"metadata": {"name": "team-a"}})
+    store.create("namespaces", {"metadata": {"name": "kube-system"}})
+    store.create("namespaces", {"metadata": {"name": "default"}})
+
+    out = snap.snap()
+    assert set(out) == {
+        "pods", "nodes", "pvs", "pvcs", "storageClasses", "priorityClasses", "namespaces", "schedulerConfig",
+    }
+    assert [p["metadata"]["name"] for p in out["pods"]] == ["p1"]
+    assert [n["metadata"]["name"] for n in out["nodes"]] == ["n1"]
+    # system- PCs and kube-/default namespaces excluded (snapshot.go:538-560)
+    assert [p["metadata"]["name"] for p in out["priorityClasses"]] == ["user-pc"]
+    assert [n["metadata"]["name"] for n in out["namespaces"]] == ["team-a"]
+    assert out["schedulerConfig"]["kind"] == "KubeSchedulerConfiguration"
+
+
+def test_load_applies_and_rebinds_pv_claimrefs():
+    store, svc, snap = build()
+    resources = {
+        "namespaces": [{"metadata": {"name": "team-a"}}],
+        "nodes": [_node("n1")],
+        "pods": [_pod("p1", "team-a")],
+        "pvcs": [{"metadata": {"name": "claim", "namespace": "team-a", "uid": "stale-uid"}, "spec": {}}],
+        "pvs": [
+            {
+                "metadata": {"name": "pv1", "uid": "stale-pv-uid"},
+                "spec": {"claimRef": {"name": "claim", "namespace": "team-a", "uid": "stale-uid"}},
+                "status": {"phase": "Bound"},
+            }
+        ],
+        "storageClasses": [{"metadata": {"name": "fast"}, "provisioner": "x"}],
+        "priorityClasses": [{"metadata": {"name": "high"}, "value": 999}],
+        "schedulerConfig": None,
+    }
+    snap.load(resources, ignore_scheduler_configuration=True)
+    pvc = store.get("persistentvolumeclaims", "claim", "team-a")
+    pv = store.get("persistentvolumes", "pv1")
+    # ClaimRef re-resolved to the NEW pvc uid (snapshot.go:439-470)
+    assert pv["spec"]["claimRef"]["uid"] == pvc["metadata"]["uid"]
+    assert pv["spec"]["claimRef"]["uid"] != "stale-uid"
+    assert store.get("pods", "p1", "team-a")
+
+
+def test_snap_load_round_trip():
+    store, svc, snap = build()
+    store.create("nodes", _node("n1"))
+    store.create("pods", _pod("p1"))
+    exported = snap.snap()
+
+    store2 = ClusterStore()
+    svc2 = SchedulerService(store2)
+    svc2.start_scheduler(None)
+    snap2 = SnapshotService(store2, svc2)
+    snap2.load(exported)
+    assert [n["metadata"]["name"] for n in store2.list("nodes")] == ["n1"]
+    assert [p["metadata"]["name"] for p in store2.list("pods")] == ["p1"]
+    # the scheduler restarted from the exported config
+    assert svc2.get_scheduler_config()["kind"] == "KubeSchedulerConfiguration"
+
+
+# --------------------------------------------------------------------- reset
+
+
+def test_reset_restores_boot_state_and_config():
+    store, svc, _ = build()
+    store.create("nodes", _node("boot-node"))
+    reset = ResetService(store, svc)  # captures state incl. boot-node
+
+    store.create("nodes", _node("later-node"))
+    store.create("pods", _pod("later-pod"))
+    svc.restart_scheduler(
+        {"profiles": [{"schedulerName": "custom", "plugins": {"multiPoint": {"enabled": [{"name": "NodeResourcesFit"}], "disabled": [{"name": "*"}]}}}]}
+    )
+    assert svc.get_scheduler_config()["profiles"][0]["schedulerName"] == "custom"
+
+    reset.reset()
+    assert [n["metadata"]["name"] for n in store.list("nodes")] == ["boot-node"]
+    assert store.list("pods") == []
+    assert svc.get_scheduler_config()["profiles"][0]["schedulerName"] == "default-scheduler"
+
+
+# ------------------------------------------------------------------- watcher
+
+
+class _MemStream:
+    def __init__(self):
+        self.chunks: list[bytes] = []
+        self.closed = False
+
+    def write(self, data: bytes) -> None:
+        if self.closed:
+            raise BrokenPipeError
+        self.chunks.append(data)
+
+    def lines(self) -> list[dict]:
+        import json
+
+        return [json.loads(l) for l in b"".join(self.chunks).splitlines() if l]
+
+
+def test_watcher_lists_then_watches():
+    store = ClusterStore()
+    store.create("nodes", _node("n1"))
+    watcher = ResourceWatcherService(store)
+    stream = _MemStream()
+    stop = threading.Event()
+    t = threading.Thread(target=watcher.list_watch, args=(stream, {}, stop), daemon=True)
+    t.start()
+    time.sleep(0.3)
+    store.create("pods", _pod("p1"))
+    deadline = time.time() + 3
+    while time.time() < deadline:
+        if any(e["EventType"] == "ADDED" and e["Kind"] == "pods" for e in stream.lines()):
+            break
+        time.sleep(0.05)
+    stop.set()
+    t.join(timeout=3)
+    events = stream.lines()
+    # initial list emitted as ADDED (resourcewatcher.go:108-114)
+    assert events[0] == {"Kind": "nodes", "EventType": "ADDED", "Obj": events[0]["Obj"]}
+    assert events[0]["Obj"]["metadata"]["name"] == "n1"
+    assert any(e["Kind"] == "pods" and e["Obj"]["metadata"]["name"] == "p1" for e in events)
+
+
+def test_watcher_resumes_from_resource_version():
+    store = ClusterStore()
+    n = store.create("nodes", _node("n1"))
+    rv = n["metadata"]["resourceVersion"]
+    store.create("nodes", _node("n2"))
+
+    watcher = ResourceWatcherService(store)
+    stream = _MemStream()
+    stop = threading.Event()
+    t = threading.Thread(
+        target=watcher.list_watch, args=(stream, {"nodes": rv}, stop), daemon=True
+    )
+    t.start()
+    time.sleep(0.3)
+    stop.set()
+    t.join(timeout=3)
+    events = stream.lines()
+    names = [e["Obj"]["metadata"]["name"] for e in events if e["Kind"] == "nodes"]
+    # resumed after rv: only n2 (no re-list of n1)
+    assert names == ["n2"]
+
+
+# ------------------------------------------------------------------ importer
+
+
+def test_import_cluster_resources():
+    src_store, src_svc, src_snap = build()
+    src_store.create("nodes", _node("external-node"))
+    src_store.create("pods", _pod("external-pod"))
+
+    dst_store, dst_svc, dst_snap = build()
+    importer = ClusterResourceImporter(src_snap, dst_snap)
+    importer.import_cluster_resources()
+    assert [n["metadata"]["name"] for n in dst_store.list("nodes")] == ["external-node"]
+    assert [p["metadata"]["name"] for p in dst_store.list("pods")] == ["external-pod"]
