@@ -1,0 +1,81 @@
+"""Sample out-of-tree plugin: NodeNumber.
+
+Python rebuild of the reference's sample custom plugin (reference
+simulator/docs/sample/nodenumber/plugin.go:24-149): scores 10 for nodes
+whose name's last digit matches the pod name's last digit (reversed by the
+``reverse`` arg).  Shows the out-of-tree plugin surface: a plain class with
+pre_score/score methods registered via
+SchedulerService.set_out_of_tree_registries (the reference's
+debuggablescheduler.WithPlugin).
+
+Run the demo:  PYTHONPATH=. python examples/nodenumber.py
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+Obj = dict[str, Any]
+
+PRE_SCORE_STATE_KEY = "PreScoreNodeNumber"
+
+
+class NodeNumber:
+    name = "NodeNumber"
+
+    def __init__(self, args: "Obj | None" = None):
+        self.reverse = bool((args or {}).get("reverse"))
+
+    def pre_score(self, state, pod: Obj, nodes: list[Obj]):
+        last = pod["metadata"]["name"][-1:]
+        if last.isdigit():
+            state.write(PRE_SCORE_STATE_KEY, int(last))
+        return None
+
+    def score(self, state, pod: Obj, node_info) -> "tuple[int, Any]":
+        podnum = state.read(PRE_SCORE_STATE_KEY)
+        if podnum is None:
+            return 0, None
+        last = node_info.name[-1:]
+        if not last.isdigit():
+            return 0, None
+        match_score, non_match_score = (0, 10) if self.reverse else (10, 0)
+        return (match_score if int(last) == podnum else non_match_score), None
+
+
+def node_number_factory(args: "Obj | None", handle: Any) -> NodeNumber:
+    return NodeNumber(args)
+
+
+def main() -> None:
+    from kube_scheduler_simulator_tpu.pkg import debuggablescheduler
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+    store = ClusterStore()
+    for i in range(10):
+        store.create(
+            "nodes",
+            {"metadata": {"name": f"node-{i}"}, "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "110"}}},
+        )
+    store.create(
+        "pods",
+        {"metadata": {"name": "pod-7"}, "spec": {"containers": [{"name": "c"}]}},
+    )
+    config = {
+        "profiles": [
+            {
+                "schedulerName": "default-scheduler",
+                "plugins": {"multiPoint": {"enabled": [{"name": "NodeNumber", "weight": 10}]}},
+                "pluginConfig": [{"name": "NodeNumber", "args": {"reverse": False}}],
+            }
+        ]
+    }
+    scheduler, _rs = debuggablescheduler.new_scheduler(store, plugins={"NodeNumber": node_number_factory}, config=config)
+    scheduler.schedule_pending()
+    pod = store.get("pods", "pod-7")
+    print("pod-7 landed on:", pod["spec"].get("nodeName"))
+    print("score annotation:", pod["metadata"]["annotations"]["scheduler-simulator/score-result"])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,309 @@
+"""Deployment / ReplicaSet / PersistentVolume controllers.
+
+Reconcile semantics (upstream, simplified to the simulator's needs — the
+reference runs the real upstream controllers but only ever exercises the
+basic create/scale/bind paths, reference simulator/controller/*.go):
+
+- **deployment**: ensure one ReplicaSet per Deployment carrying the pod
+  template and replica count (no rollout/versioned RS history — the
+  simulator never updates images).
+- **replicaset**: ensure ``spec.replicas`` pods exist matching the
+  selector, created from the template with ``<rs-name>-<n>`` names and an
+  ownerReference; surplus pods are deleted (highest ordinal first).
+- **persistentvolume**: bind Pending PVCs to the smallest compatible
+  Available PV (storageClass + accessModes + capacity), setting
+  ``claimRef``/``status.phase`` both ways.
+
+All reconciles are idempotent and run until quiescent via
+``reconcile_all()``; ``start()`` also wires them to store events so the
+manager behaves like the reference's always-on controllers.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from kube_scheduler_simulator_tpu.utils.quantity import value as quantity_value
+
+Obj = dict[str, Any]
+
+
+def _ns(obj: Obj) -> str:
+    return obj["metadata"].get("namespace", "default")
+
+
+def _owned_by(obj: Obj, owner: Obj) -> bool:
+    for ref in obj["metadata"].get("ownerReferences") or []:
+        if ref.get("uid") == owner["metadata"]["uid"]:
+            return True
+    return False
+
+
+def _owner_ref(owner: Obj, kind: str) -> Obj:
+    return {
+        "apiVersion": "apps/v1",
+        "kind": kind,
+        "name": owner["metadata"]["name"],
+        "uid": owner["metadata"]["uid"],
+        "controller": True,
+    }
+
+
+class ControllerManager:
+    def __init__(self, cluster_store: Any):
+        self.store = cluster_store
+        self._unsubscribe = None
+        # Synchronization uses the STORE's reentrant lock (store.lock): the
+        # synchronous event bus already holds it when it calls us, so a
+        # private lock here would create a store→manager / manager→store
+        # lock-order inversion between the scheduler and HTTP threads.
+        # The store's event bus is synchronous: our own mutations re-enter
+        # reconcile_all via the subscription.  A depth guard turns that
+        # recursion into a "dirty → one more pass" loop.
+        self._reconciling = False
+        self._dirty = False
+
+    # ---------------------------------------------------------------- wiring
+
+    def start(self) -> None:
+        """RunController analog: reconcile now and on every relevant event."""
+        self.reconcile_all()
+        if self._unsubscribe is None:
+            self._unsubscribe = self.store.subscribe(
+                ["deployments", "replicasets", "pods", "persistentvolumes", "persistentvolumeclaims"],
+                self._on_event,
+            )
+
+    def _on_event(self, ev: Any) -> None:
+        # Pod churn only concerns the replicaset controller when owned pods
+        # disappear — skip the (deepcopying) reconcile sweep for the
+        # scheduler's bind updates on the hot path.
+        if ev.kind == "pods":
+            refs = (ev.obj.get("metadata") or {}).get("ownerReferences") or []
+            if ev.type != "DELETED" or not refs:
+                return
+        self.reconcile_all()
+
+    def stop(self) -> None:
+        if self._unsubscribe is not None:
+            self._unsubscribe()
+            self._unsubscribe = None
+
+    def reconcile_all(self, max_passes: int = 25) -> None:
+        """Run all controllers to quiescence (each pass is idempotent; a
+        pass that changes nothing ends the loop)."""
+        with self.store.lock:
+            if self._reconciling:
+                self._dirty = True
+                return
+            # fast path: nothing for any controller to do (no workload
+            # objects, no unbound claims) — avoids full-cluster deepcopies
+            # on every pod event
+            if (
+                self.store.count("deployments") == 0
+                and self.store.count("replicasets") == 0
+                and not self._has_unbound_pvcs()
+            ):
+                return
+            self._reconciling = True
+            try:
+                for _ in range(max_passes):
+                    self._dirty = False
+                    changed = self._reconcile_deployments()
+                    changed = self._reconcile_replicasets() or changed
+                    changed = self._gc_orphans() or changed
+                    changed = self._reconcile_volumes() or changed
+                    if not changed and not self._dirty:
+                        return
+            finally:
+                self._reconciling = False
+
+    def _has_unbound_pvcs(self) -> bool:
+        if self.store.count("persistentvolumeclaims") == 0:
+            return False
+        return any(
+            (pvc.get("status") or {}).get("phase", "Pending") != "Bound"
+            for pvc in self.store.list("persistentvolumeclaims")
+        )
+
+    # ------------------------------------------------------------------- gc
+
+    def _gc_orphans(self) -> bool:
+        """Cascade deletion (the kube GC role): ReplicaSets whose owning
+        Deployment is gone, and pods whose owning ReplicaSet is gone."""
+        changed = False
+        dep_uids = {d["metadata"]["uid"] for d in self.store.list("deployments")}
+        rs_uids = set()
+        for rs in self.store.list("replicasets"):
+            owner = next(
+                (r for r in rs["metadata"].get("ownerReferences") or [] if r.get("controller")), None
+            )
+            if owner and owner.get("kind") == "Deployment" and owner.get("uid") not in dep_uids:
+                self.store.delete("replicasets", rs["metadata"]["name"], _ns(rs))
+                changed = True
+            else:
+                rs_uids.add(rs["metadata"]["uid"])
+        for p in self.store.list("pods"):
+            owner = next(
+                (r for r in p["metadata"].get("ownerReferences") or [] if r.get("controller")), None
+            )
+            if owner and owner.get("kind") == "ReplicaSet" and owner.get("uid") not in rs_uids:
+                self.store.delete("pods", p["metadata"]["name"], _ns(p))
+                changed = True
+        return changed
+
+    # ----------------------------------------------------------- deployment
+
+    def _reconcile_deployments(self) -> bool:
+        changed = False
+        replicasets = self.store.list("replicasets")
+        for dep in self.store.list("deployments"):
+            spec = dep.get("spec") or {}
+            want_replicas = int(spec.get("replicas", 1))
+            owned = [rs for rs in replicasets if _ns(rs) == _ns(dep) and _owned_by(rs, dep)]
+            if not owned:
+                rs = {
+                    "metadata": {
+                        "name": dep["metadata"]["name"] + "-rs",
+                        "namespace": _ns(dep),
+                        "labels": dict((spec.get("selector") or {}).get("matchLabels") or {}),
+                        "ownerReferences": [_owner_ref(dep, "Deployment")],
+                    },
+                    "spec": {
+                        "replicas": want_replicas,
+                        "selector": (spec.get("selector") or {}),
+                        "template": (spec.get("template") or {}),
+                    },
+                }
+                try:
+                    self.store.create("replicasets", rs)
+                except Exception:
+                    continue  # name taken by an unowned RS: leave it alone
+                changed = True
+            else:
+                rs = owned[0]
+                if int((rs.get("spec") or {}).get("replicas", 1)) != want_replicas:
+                    self.store.patch(
+                        "replicasets", rs["metadata"]["name"], {"spec": {"replicas": want_replicas}}, _ns(rs)
+                    )
+                    changed = True
+            status = dep.get("status") or {}
+            ready = sum(
+                int((rs.get("status") or {}).get("replicas") or 0)
+                for rs in self.store.list("replicasets")
+                if _ns(rs) == _ns(dep) and _owned_by(rs, dep)
+            )
+            if status.get("replicas") != ready:
+                self.store.patch("deployments", dep["metadata"]["name"], {"status": {"replicas": ready}}, _ns(dep))
+                changed = True
+        return changed
+
+    # ----------------------------------------------------------- replicaset
+
+    def _reconcile_replicasets(self) -> bool:
+        changed = False
+        pods = self.store.list("pods")
+        for rs in self.store.list("replicasets"):
+            want = int((rs.get("spec") or {}).get("replicas", 1))
+            owned = sorted(
+                (p for p in pods if _ns(p) == _ns(rs) and _owned_by(p, rs)),
+                key=lambda p: p["metadata"]["name"],
+            )
+            if len(owned) < want:
+                # Skip any taken pod name (owned or not — a user pod may
+                # collide with an ordinal name).
+                taken = {p["metadata"]["name"] for p in pods if _ns(p) == _ns(rs)}
+                template = (rs.get("spec") or {}).get("template") or {}
+                i = 0
+                while len(owned) < want and i < want + len(taken) + 1:
+                    name = f"{rs['metadata']['name']}-{i}"
+                    i += 1
+                    if name in taken:
+                        continue
+                    pod = {
+                        "metadata": {
+                            "name": name,
+                            "namespace": _ns(rs),
+                            "labels": dict((template.get("metadata") or {}).get("labels") or {}),
+                            "ownerReferences": [_owner_ref(rs, "ReplicaSet")],
+                        },
+                        "spec": dict(template.get("spec") or {}),
+                    }
+                    try:
+                        self.store.create("pods", pod)
+                    except Exception:
+                        continue
+                    owned.append(pod)
+                    changed = True
+            elif len(owned) > want:
+                for p in owned[want:]:
+                    self.store.delete("pods", p["metadata"]["name"], _ns(p))
+                    changed = True
+            status_replicas = int((rs.get("status") or {}).get("replicas") or 0)
+            if status_replicas != min(len(owned), want) or status_replicas != len(owned):
+                self.store.patch(
+                    "replicasets", rs["metadata"]["name"], {"status": {"replicas": len(owned[:want])}}, _ns(rs)
+                )
+                changed = True
+        return changed
+
+    # -------------------------------------------------------------- volumes
+
+    @staticmethod
+    def _pv_matches(pv: Obj, pvc: Obj) -> bool:
+        pv_spec = pv.get("spec") or {}
+        pvc_spec = pvc.get("spec") or {}
+        if pv_spec.get("storageClassName", "") != pvc_spec.get("storageClassName", ""):
+            return False
+        want_modes = set(pvc_spec.get("accessModes") or [])
+        have_modes = set(pv_spec.get("accessModes") or [])
+        if not want_modes <= have_modes:
+            return False
+        want = (pvc_spec.get("resources") or {}).get("requests", {}).get("storage")
+        have = (pv_spec.get("capacity") or {}).get("storage")
+        if want is not None:
+            if have is None or quantity_value(have) < quantity_value(want):
+                return False
+        return True
+
+    def _reconcile_volumes(self) -> bool:
+        changed = False
+        pvs = self.store.list("persistentvolumes")
+        available = [
+            pv for pv in pvs if (pv.get("status") or {}).get("phase", "Available") in ("Available", "")
+            and not (pv.get("spec") or {}).get("claimRef")
+        ]
+        available.sort(
+            key=lambda pv: quantity_value(((pv.get("spec") or {}).get("capacity") or {}).get("storage", "0"))
+        )
+        for pvc in self.store.list("persistentvolumeclaims"):
+            phase = (pvc.get("status") or {}).get("phase", "Pending")
+            if phase == "Bound":
+                continue
+            match = next((pv for pv in available if self._pv_matches(pv, pvc)), None)
+            if match is None:
+                continue
+            available.remove(match)
+            self.store.patch(
+                "persistentvolumes",
+                match["metadata"]["name"],
+                {
+                    "spec": {
+                        "claimRef": {
+                            "kind": "PersistentVolumeClaim",
+                            "namespace": _ns(pvc),
+                            "name": pvc["metadata"]["name"],
+                            "uid": pvc["metadata"]["uid"],
+                        }
+                    },
+                    "status": {"phase": "Bound"},
+                },
+            )
+            self.store.patch(
+                "persistentvolumeclaims",
+                pvc["metadata"]["name"],
+                {"spec": {"volumeName": match["metadata"]["name"]}, "status": {"phase": "Bound"}},
+                _ns(pvc),
+            )
+            changed = True
+        return changed

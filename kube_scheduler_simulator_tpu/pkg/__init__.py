@@ -1,0 +1,2 @@
+"""Library surfaces for embedding the debuggable scheduler
+(reference simulator/pkg/debuggablescheduler)."""

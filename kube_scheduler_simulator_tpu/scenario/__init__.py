@@ -1,0 +1,12 @@
+"""Scenario replay engine (KEP-140 scenario-based simulation).
+
+The reference only scaffolds this (scenario/ kubebuilder project with an
+empty Reconcile, reference scenario/controllers/scenario_controller.go:48-55);
+the full design lives in keps/140-scenario-based-simulation/README.md and
+is implemented here as a first-class engine over the in-memory store.
+"""
+
+from kube_scheduler_simulator_tpu.scenario.engine import ScenarioEngine
+from kube_scheduler_simulator_tpu.scenario.result import allocation_rate, node_utilization
+
+__all__ = ["ScenarioEngine", "allocation_rate", "node_utilization"]

@@ -1,0 +1,230 @@
+"""The Scenario engine: deterministic step-driven replay.
+
+Implements KEP-140's semantics (reference
+keps/140-scenario-based-simulation/README.md):
+
+- ``ScenarioOperation`` with ``id`` + ``step`` (MajorStep) and exactly one
+  of ``createOperation`` / ``patchOperation`` / ``deleteOperation`` /
+  ``doneOperation`` (README.md:117-174).
+- Simulated time ``ScenarioStep {major, minor}``: Major advances when the
+  controllers can no longer do anything with the current cluster state;
+  Minor advances on every resource operation (README.md:176-183).
+- Phases ``Pending/Running/Paused/Succeeded/Failed`` and per-step
+  ``StepPhase`` transitions (README.md:214-256).
+- ``ScenarioResult.Timeline``: map of MajorStep(string) → events, the
+  user-defined operations plus generated PodScheduled / pod Delete events
+  from the scheduler's work (README.md:261-313).
+- Determinism rules: all resources are deleted at scenario start, and the
+  run is driven synchronously — same Scenario, same result
+  (README.md:600-610).
+
+The "SimulationController" of the KEP maps to the scheduler service's
+synchronous ``schedule_pending`` (TPU batch path included) plus the
+controller manager's ``reconcile_all``; ControllerWaiter convergence is
+detected when a full pass makes no progress (README.md:371-381).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any
+
+from kube_scheduler_simulator_tpu.scenario.result import allocation_rate, node_utilization
+from kube_scheduler_simulator_tpu.state.store import KIND_NAMES
+
+Obj = dict[str, Any]
+
+VERSION = "kube-scheduler-simulator-tpu/0.1.0"
+
+_KIND_TO_STORE = {v: k for k, v in KIND_NAMES.items()}
+
+
+class ScenarioError(Exception):
+    pass
+
+
+def _store_kind(type_meta: "Obj | str") -> str:
+    """Map a TypeMeta kind ("Pod") or store kind ("pods") to a store kind."""
+    kind = type_meta.get("kind") if isinstance(type_meta, dict) else type_meta
+    if kind in _KIND_TO_STORE:
+        return _KIND_TO_STORE[kind]
+    if kind in KIND_NAMES:
+        return str(kind)
+    raise ScenarioError(f"unknown resource kind {kind!r}")
+
+
+class ScenarioEngine:
+    def __init__(self, cluster_store: Any, scheduler_service: Any, controller_manager: Any = None):
+        self.store = cluster_store
+        self.scheduler = scheduler_service
+        self.controllers = controller_manager
+
+    # ------------------------------------------------------------------ run
+
+    def run(self, scenario: Obj) -> Obj:
+        """Run a Scenario to completion; returns it with status filled."""
+        scenario = copy.deepcopy(scenario)
+        status: Obj = {
+            "phase": "Running",
+            "stepStatus": {"step": {"major": 0, "minor": 0}, "phase": "Operating"},
+            "scenarioResult": {"simulatorVersion": VERSION, "timeline": {}},
+        }
+        scenario["status"] = status
+        timeline: dict[str, list[Obj]] = status["scenarioResult"]["timeline"]
+
+        # Determinism (README.md:600-610): the scenario owns the cluster —
+        # pause the always-on scheduler loop (manual/concurrent operations
+        # are forbidden during a scenario) and start from an empty state.
+        was_background = getattr(self.scheduler, "is_background_running", lambda: False)()
+        if was_background:
+            self.scheduler.stop_background()
+        try:
+            return self._run_steps(scenario, status, timeline)
+        finally:
+            if was_background:
+                self.scheduler.start_background()
+
+    def _run_steps(self, scenario: Obj, status: Obj, timeline: dict) -> Obj:
+        spec = scenario.get("spec") or {}
+        self.store.restore({})
+
+        ops = list(spec.get("operations") or [])
+        for op in ops:
+            n_set = sum(
+                1
+                for f in ("createOperation", "patchOperation", "deleteOperation", "doneOperation")
+                if op.get(f) is not None
+            )
+            if n_set != 1:
+                status["phase"] = "Failed"
+                status["message"] = f"operation {op.get('id')!r}: exactly one operation field must be set"
+                return scenario
+
+        by_major: dict[int, list[Obj]] = {}
+        for op in ops:
+            by_major.setdefault(int(op.get("step", 0)), []).append(op)
+
+        minor = 0
+        done = False
+        auto_id = 0
+        for major in sorted(by_major):
+            minor = 0
+            events: list[Obj] = []
+            timeline[str(major)] = events
+            status["stepStatus"]["step"] = {"major": major, "minor": minor}
+            status["stepStatus"]["phase"] = "Operating"
+            for op in by_major[major]:
+                try:
+                    event, is_done = self._apply(op, major, minor)
+                except Exception as e:
+                    status["phase"] = "Failed"
+                    status["message"] = f"operation {op.get('id')!r}: {e}"
+                    return scenario
+                if event is not None:
+                    events.append(event)
+                    minor += 1  # Minor advances on every resource operation
+                    status["stepStatus"]["step"]["minor"] = minor
+                done = done or is_done
+            status["stepStatus"]["phase"] = "OperatingCompleted"
+
+            # SimulationController runs until nothing changes
+            # (ControllerWaiter convergence, README.md:371-381).
+            status["stepStatus"]["phase"] = "ControllerRunning"
+            generated = self._run_controllers_to_convergence(major, minor)
+            for ev in generated:
+                auto_id += 1
+                ev["id"] = f"auto-{major}-{auto_id}"
+                events.append(ev)
+                minor += 1
+            status["stepStatus"]["step"]["minor"] = minor
+            status["stepStatus"]["phase"] = "Finished"
+            if done:
+                break
+
+        status["phase"] = "Succeeded" if done else "Paused"
+        # Result-calc summary (the KEP's result packages: allocation rate,
+        # per-node utilization — README.md:553-565).
+        status["scenarioResult"]["summary"] = {
+            "allocationRate": allocation_rate(self.store),
+            "nodeUtilization": node_utilization(self.store),
+        }
+        return scenario
+
+    # ------------------------------------------------------------ internals
+
+    def _apply(self, op: Obj, major: int, minor: int) -> "tuple[Obj | None, bool]":
+        step = {"major": major, "minor": minor}
+        oid = op.get("id", "")
+        if op.get("doneOperation") is not None:
+            return {"id": oid, "step": step, "done": {"operation": op["doneOperation"]}}, True
+        if op.get("createOperation") is not None:
+            create = op["createOperation"]
+            obj = create.get("object") or {}
+            kind = _store_kind(obj)
+            result = self.store.create(kind, obj)
+            return {"id": oid, "step": step, "create": {"operation": create, "result": result}}, False
+        if op.get("patchOperation") is not None:
+            patch = op["patchOperation"]
+            kind = _store_kind(patch.get("typeMeta") or {})
+            meta = patch.get("objectMeta") or {}
+            body = patch.get("patch")
+            if isinstance(body, str):
+                import json
+
+                body = json.loads(body)
+            result = self.store.patch(kind, meta.get("name", ""), body, meta.get("namespace"))
+            return {"id": oid, "step": step, "patch": {"operation": patch, "result": result}}, False
+        delete = op["deleteOperation"]
+        kind = _store_kind(delete.get("typeMeta") or {})
+        meta = delete.get("objectMeta") or {}
+        self.store.delete(kind, meta.get("name", ""), meta.get("namespace"))
+        return {"id": oid, "step": step, "delete": {"operation": delete}}, False
+
+    def _run_controllers_to_convergence(self, major: int, minor: int) -> list[Obj]:
+        """Run controllers + scheduler until quiescent; emit generated
+        timeline events (PodScheduled, preemption-victim Delete)."""
+        events: list[Obj] = []
+        before = {
+            f"{p['metadata'].get('namespace', 'default')}/{p['metadata']['name']}": (p.get("spec") or {}).get("nodeName")
+            for p in self.store.list("pods")
+        }
+        for _ in range(50):
+            if self.controllers is not None:
+                self.controllers.reconcile_all()
+            results = self.scheduler.schedule_pending(max_rounds=1) if self.scheduler.framework else {}
+            progressed = any(r.success or r.nominated_node for r in results.values())
+            if self.controllers is not None:
+                self.controllers.reconcile_all()
+            if not progressed:
+                break
+        after_pods = self.store.list("pods")
+        after = {
+            f"{p['metadata'].get('namespace', 'default')}/{p['metadata']['name']}": p for p in after_pods
+        }
+        m = minor
+        for key, pod in after.items():
+            node = (pod.get("spec") or {}).get("nodeName")
+            if node and before.get(key) != node:
+                events.append(
+                    {
+                        "step": {"major": major, "minor": m},
+                        "podScheduled": {"result": pod},
+                    }
+                )
+                m += 1
+        for key, old_node in before.items():
+            if key not in after:  # deleted during the step (preemption victim)
+                ns, name = key.split("/", 1)
+                events.append(
+                    {
+                        "step": {"major": major, "minor": m},
+                        "delete": {
+                            "operation": {
+                                "typeMeta": {"kind": "Pod", "apiVersion": "v1"},
+                                "objectMeta": {"name": name, "namespace": ns},
+                            }
+                        },
+                    }
+                )
+                m += 1
+        return events

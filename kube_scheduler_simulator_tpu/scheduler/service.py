@@ -455,6 +455,9 @@ class SchedulerService:
         self._bg_thread = threading.Thread(target=loop, name="scheduler-loop", daemon=True)
         self._bg_thread.start()
 
+    def is_background_running(self) -> bool:
+        return self._bg_thread is not None
+
     def stop_background(self) -> None:
         if self._bg_thread is None:
             return

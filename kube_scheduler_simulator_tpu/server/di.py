@@ -28,6 +28,12 @@ class DIContainer:
         seed: int = 0,
     ):
         self.cluster_store = cluster_store or ClusterStore()
+        # Controllers start before the scheduler (reference boot order,
+        # simulator.go:32-106: apiserver → controllers → … → scheduler).
+        from kube_scheduler_simulator_tpu.controllers import ControllerManager
+
+        self._controller_manager = ControllerManager(self.cluster_store)
+        self._controller_manager.start()
         self._scheduler_service = SchedulerService(self.cluster_store, seed=seed, use_batch=use_batch)
         self._scheduler_service.start_scheduler(initial_scheduler_cfg)
         self._snapshot_service = SnapshotService(self.cluster_store, self._scheduler_service)
@@ -43,6 +49,9 @@ class DIContainer:
 
     def scheduler_service(self) -> SchedulerService:
         return self._scheduler_service
+
+    def controller_manager(self):
+        return self._controller_manager
 
     def extender_service(self):
         return self._scheduler_service.extender_service

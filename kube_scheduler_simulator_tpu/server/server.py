@@ -9,6 +9,9 @@ Routes (reference simulator/server/server.go:42-57):
     POST /api/v1/import                      → 200
     GET  /api/v1/listwatchresources          → JSON-lines server push (SSE analog)
     POST /api/v1/extender/filter/:id | prioritize/:id | preempt/:id | bind/:id
+    POST /api/v1/scenarios                   → run a KEP-140 Scenario, return it
+                                               with status/timeline (the
+                                               reference only scaffolds this)
 
 Because this build replaces the in-process kube-apiserver with the
 in-memory cluster store (SURVEY.md §7 step 1), the direct kube-API CRUD
@@ -132,7 +135,16 @@ def _make_handler(server: SimulatorServer):
             url = urlparse(self.path)
             q = parse_qs(url.query)
             try:
-                if url.path == "/api/v1/schedulerconfiguration":
+                if url.path in ("/", "/index.html"):
+                    from kube_scheduler_simulator_tpu.server.webui import HTML
+
+                    data = HTML.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                elif url.path == "/api/v1/schedulerconfiguration":
                     self._send_json(200, di.scheduler_service().get_scheduler_config())
                 elif url.path == "/api/v1/export":
                     self._send_json(200, di.snapshot_service().snap())
@@ -169,6 +181,13 @@ def _make_handler(server: SimulatorServer):
                 elif url.path == "/api/v1/import":
                     di.snapshot_service().load(self._body() or {})
                     self._send_empty(200)
+                elif url.path == "/api/v1/scenarios":
+                    from kube_scheduler_simulator_tpu.scenario import ScenarioEngine
+
+                    engine = ScenarioEngine(
+                        di.cluster_store, di.scheduler_service(), di.controller_manager()
+                    )
+                    self._send_json(200, engine.run(self._body() or {}))
                 elif m := _EXTENDER_RE.match(url.path):
                     verb, id_ = m.group(1), int(m.group(2))
                     ext = di.extender_service()

@@ -1,0 +1,296 @@
+"""The web UI, served at GET / by the simulator server.
+
+Functional rebuild of the reference's Nuxt2/Vuetify SPA (reference web/,
+SURVEY.md §2.2) as a single static page (no build step, no node_modules):
+
+- per-resource views with pods bucketed under their node (or
+  "unscheduled"), mirroring web/store/pod.ts:12-50
+- create resources from editable YAML-ish JSON templates
+  (web/components/lib/templates/*)
+- per-pod scheduling-result dialog rendering every
+  scheduler-simulator/* annotation (the reference's result dialog)
+- scheduler configuration editor (GET/POST /api/v1/schedulerconfiguration)
+- export / import / reset buttons
+- live updates over the /api/v1/listwatchresources stream
+"""
+
+HTML = r"""<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>kube-scheduler-simulator (TPU)</title>
+<style>
+  :root { --bg:#fafafa; --panel:#fff; --line:#e0e0e0; --accent:#326ce5; --mono:ui-monospace,Menlo,Consolas,monospace; }
+  * { box-sizing:border-box; }
+  body { margin:0; font:14px/1.45 system-ui,sans-serif; background:var(--bg); color:#222; }
+  header { background:var(--accent); color:#fff; padding:10px 16px; display:flex; gap:12px; align-items:center; }
+  header h1 { font-size:16px; margin:0 auto 0 0; font-weight:600; }
+  button { background:#fff; color:var(--accent); border:1px solid #fff3; border-radius:4px; padding:5px 10px; cursor:pointer; font-weight:600; }
+  main button { border-color:var(--accent); }
+  main { display:grid; grid-template-columns: 2fr 1fr; gap:12px; padding:12px; }
+  .panel { background:var(--panel); border:1px solid var(--line); border-radius:6px; padding:10px 12px; overflow:auto; }
+  .node { border:1px solid var(--line); border-radius:6px; margin:8px 0; }
+  .node>h3 { margin:0; padding:6px 10px; background:#f0f4ff; font-size:13px; border-bottom:1px solid var(--line); }
+  .pod { display:inline-block; margin:6px; padding:4px 10px; background:#e8f0fe; border:1px solid #c6d7fb; border-radius:12px; cursor:pointer; font-size:12px; }
+  .pod.unsched { background:#fdecea; border-color:#f6c8c4; }
+  .kindrow { margin:4px 0; } .kindrow b { display:inline-block; width:160px; }
+  .item { display:inline-block; margin:2px; padding:2px 8px; border:1px solid var(--line); border-radius:10px; font-size:12px; cursor:pointer; }
+  dialog { width:min(900px,90vw); border:1px solid var(--line); border-radius:8px; }
+  pre, textarea { font-family:var(--mono); font-size:12px; }
+  textarea { width:100%; min-height:220px; }
+  table.kv { border-collapse:collapse; width:100%; } .kv td { border-bottom:1px solid var(--line); padding:4px 6px; vertical-align:top; }
+  .kv td:first-child { white-space:nowrap; color:#555; }
+  .muted { color:#777; font-size:12px; }
+  h2 { font-size:14px; margin:4px 0 8px; }
+</style>
+</head>
+<body>
+<header>
+  <h1>kube-scheduler-simulator <span class="muted" style="color:#cfe0ff">TPU-native</span></h1>
+  <button onclick="newResource()">+ Create</button>
+  <button onclick="openSchedConfig()">Scheduler&nbsp;Config</button>
+  <button onclick="doExport()">Export</button>
+  <button onclick="doImport()">Import</button>
+  <button onclick="doReset()">Reset</button>
+</header>
+<main>
+  <div class="panel">
+    <h2>Nodes &amp; Pods</h2>
+    <div id="nodes"></div>
+  </div>
+  <div class="panel">
+    <h2>Other resources</h2>
+    <div id="others"></div>
+  </div>
+</main>
+<dialog id="dlg"><div id="dlgbody"></div><p style="text-align:right"><button onclick="dlg.close()">Close</button></p></dialog>
+<script>
+const KINDS = ["pods","nodes","persistentvolumes","persistentvolumeclaims","storageclasses","priorityclasses","namespaces","deployments","replicasets"];
+const state = Object.fromEntries(KINDS.map(k=>[k,{}]));
+const dlg = document.getElementById("dlg");
+const key = o => (o.metadata.namespace? o.metadata.namespace+"/" : "") + o.metadata.name;
+
+async function api(method, path, body) {
+  const r = await fetch(path, {method, headers:{"Content-Type":"application/json"},
+                               body: body===undefined? undefined : JSON.stringify(body)});
+  const text = await r.text();
+  if (!r.ok) throw new Error(text || r.status);
+  return text ? JSON.parse(text) : null;
+}
+
+async function refreshAll() {
+  for (const k of KINDS) {
+    const lst = await api("GET", `/api/v1/resources/${k}`);
+    state[k] = {};
+    for (const o of lst.items) state[k][key(o)] = o;
+  }
+  render();
+}
+
+function render() {
+  const nodesDiv = document.getElementById("nodes");
+  nodesDiv.innerHTML = "";
+  const buckets = {"(unscheduled)": []};
+  for (const n of Object.values(state.nodes)) buckets[n.metadata.name] = [];
+  for (const p of Object.values(state.pods)) {
+    const nn = (p.spec||{}).nodeName;
+    (buckets[nn] || buckets["(unscheduled)"]).push(p);
+  }
+  for (const [nodeName, pods] of Object.entries(buckets)) {
+    if (nodeName === "(unscheduled)" && !pods.length) continue;
+    const div = document.createElement("div");
+    div.className = "node";
+    const node = state.nodes[nodeName];
+    const h = document.createElement("h3");
+    h.textContent = nodeName + (node ? `  —  cpu ${((node.status||{}).allocatable||{}).cpu||"?"} / mem ${((node.status||{}).allocatable||{}).memory||"?"}` : "");
+    if (node) { h.style.cursor = "pointer"; h.onclick = () => showObject("nodes", node); }
+    div.appendChild(h);
+    for (const p of pods) {
+      const s = document.createElement("span");
+      s.className = "pod" + (nodeName === "(unscheduled)" ? " unsched" : "");
+      s.textContent = key(p);
+      s.onclick = () => showPod(p);
+      div.appendChild(s);
+    }
+    nodesDiv.appendChild(div);
+  }
+  const others = document.getElementById("others");
+  others.innerHTML = "";
+  for (const k of KINDS) {
+    if (k === "pods" || k === "nodes") continue;
+    const row = document.createElement("div");
+    row.className = "kindrow";
+    row.innerHTML = `<b>${k}</b>`;
+    for (const o of Object.values(state[k])) {
+      const s = document.createElement("span");
+      s.className = "item";
+      s.textContent = key(o);
+      s.onclick = () => showObject(k, o);
+      row.appendChild(s);
+    }
+    others.appendChild(row);
+  }
+}
+
+function esc(s){ return String(s).replace(/&/g,"&amp;").replace(/</g,"&lt;"); }
+
+function deleteButton(kind, k) {
+  // built via DOM (not inline onclick) so stored object names can't inject
+  // script through attribute strings
+  const b = document.createElement("button");
+  b.textContent = "Delete";
+  b.addEventListener("click", () => del(kind, k));
+  const p = document.createElement("p");
+  p.appendChild(b);
+  return p;
+}
+
+function showPod(p) {
+  const annos = (p.metadata||{}).annotations || {};
+  let rows = "";
+  for (const [k,v] of Object.entries(annos)) {
+    if (!k.startsWith("scheduler-simulator/")) continue;
+    let pretty = v;
+    try { pretty = JSON.stringify(JSON.parse(v), null, 1); } catch (e) {}
+    rows += `<tr><td>${esc(k.replace("scheduler-simulator/",""))}</td><td><pre style="margin:0;white-space:pre-wrap">${esc(pretty)}</pre></td></tr>`;
+  }
+  const body = document.getElementById("dlgbody");
+  body.innerHTML =
+    `<h2>Pod ${esc(key(p))} — scheduling results</h2>
+     <p class="muted">node: ${esc((p.spec||{}).nodeName||"(unscheduled)")}</p>
+     <table class="kv">${rows || "<tr><td>no scheduler-simulator/* annotations yet</td></tr>"}</table>
+     <details><summary>manifest</summary><pre>${esc(JSON.stringify(p,null,2))}</pre></details>`;
+  body.appendChild(deleteButton("pods", key(p)));
+  dlg.showModal();
+}
+
+function showObject(kind, o) {
+  const body = document.getElementById("dlgbody");
+  body.innerHTML =
+    `<h2>${esc(kind)} / ${esc(key(o))}</h2>
+     <pre>${esc(JSON.stringify(o,null,2))}</pre>`;
+  body.appendChild(deleteButton(kind, key(o)));
+  dlg.showModal();
+}
+
+async function del(kind, k) {
+  const [ns, name] = k.includes("/") ? k.split("/") : [null, k];
+  await api("DELETE", `/api/v1/resources/${kind}/${name}` + (ns?`?namespace=${ns}`:""));
+  dlg.close();
+}
+
+const TEMPLATES = {
+  pods: {kind:"Pod", metadata:{name:"pod-1", namespace:"default"}, spec:{containers:[{name:"c", resources:{requests:{cpu:"100m", memory:"128Mi"}}}]}},
+  nodes: {kind:"Node", metadata:{name:"node-1", labels:{"kubernetes.io/hostname":"node-1","topology.kubernetes.io/zone":"zone-a"}}, status:{allocatable:{cpu:"4", memory:"8Gi", pods:"110"}}},
+  deployments: {kind:"Deployment", metadata:{name:"dep-1", namespace:"default"}, spec:{replicas:3, selector:{matchLabels:{app:"dep-1"}}, template:{metadata:{labels:{app:"dep-1"}}, spec:{containers:[{name:"c", resources:{requests:{cpu:"100m"}}}]}}}},
+  persistentvolumes: {kind:"PersistentVolume", metadata:{name:"pv-1"}, spec:{capacity:{storage:"10Gi"}, accessModes:["ReadWriteOnce"], storageClassName:"standard"}},
+  persistentvolumeclaims: {kind:"PersistentVolumeClaim", metadata:{name:"pvc-1", namespace:"default"}, spec:{accessModes:["ReadWriteOnce"], storageClassName:"standard", resources:{requests:{storage:"1Gi"}}}},
+  storageclasses: {kind:"StorageClass", metadata:{name:"standard"}, provisioner:"kubernetes.io/no-provisioner"},
+  priorityclasses: {kind:"PriorityClass", metadata:{name:"high-priority"}, value:1000},
+  namespaces: {kind:"Namespace", metadata:{name:"team-a"}},
+};
+
+function newResource() {
+  const opts = Object.keys(TEMPLATES).map(k=>`<option>${k}</option>`).join("");
+  document.getElementById("dlgbody").innerHTML =
+    `<h2>Create resource</h2>
+     <p><select id="newkind" onchange="document.getElementById('newbody').value=JSON.stringify(TEMPLATES[this.value],null,2)">${opts}</select></p>
+     <textarea id="newbody">${esc(JSON.stringify(TEMPLATES.pods,null,2))}</textarea>
+     <p><button onclick="createResource()">Create</button></p>`;
+  dlg.showModal();
+}
+
+async function createResource() {
+  const kind = document.getElementById("newkind").value;
+  try {
+    await api("POST", `/api/v1/resources/${kind}`, JSON.parse(document.getElementById("newbody").value));
+    dlg.close();
+  } catch (e) { alert(e.message); }
+}
+
+async function openSchedConfig() {
+  const cfg = await api("GET", "/api/v1/schedulerconfiguration");
+  document.getElementById("dlgbody").innerHTML =
+    `<h2>KubeSchedulerConfiguration</h2>
+     <p class="muted">POST honors only .profiles (reference behavior)</p>
+     <textarea id="schedcfg">${esc(JSON.stringify(cfg,null,2))}</textarea>
+     <p><button onclick="applySchedConfig()">Apply</button></p>`;
+  dlg.showModal();
+}
+
+async function applySchedConfig() {
+  try {
+    await api("POST", "/api/v1/schedulerconfiguration", JSON.parse(document.getElementById("schedcfg").value));
+    dlg.close();
+  } catch (e) { alert(e.message); }
+}
+
+async function doExport() {
+  const snap = await api("GET", "/api/v1/export");
+  const blob = new Blob([JSON.stringify(snap, null, 2)], {type: "application/json"});
+  const a = Object.assign(document.createElement("a"), {href: URL.createObjectURL(blob), download: "snapshot.json"});
+  a.click();
+}
+
+function doImport() {
+  const inp = Object.assign(document.createElement("input"), {type: "file", accept: ".json"});
+  inp.onchange = async () => {
+    const text = await inp.files[0].text();
+    await api("POST", "/api/v1/import", JSON.parse(text));
+  };
+  inp.click();
+}
+
+async function doReset() { if (confirm("Reset the simulator?")) await api("PUT", "/api/v1/reset"); }
+
+async function watchLoop() {
+  while (true) {
+    try {
+      const resp = await fetch("/api/v1/listwatchresources");
+      const reader = resp.body.getReader();
+      const decoder = new TextDecoder();
+      let buf = "";
+      for (;;) {
+        const {done, value} = await reader.read();
+        if (done) break;
+        buf += decoder.decode(value, {stream: true});
+        const lines = buf.split("\n");
+        buf = lines.pop();
+        let dirty = false;
+        for (const line of lines) {
+          if (!line.trim()) continue;
+          const ev = JSON.parse(line);
+          const k = key(ev.Obj);
+          if (!(ev.Kind in state)) continue;
+          if (ev.EventType === "DELETED") delete state[ev.Kind][k];
+          else state[ev.Kind][k] = ev.Obj;
+          dirty = true;
+        }
+        if (dirty) render();
+      }
+    } catch (e) { /* server restart — retry */ }
+    await new Promise(r => setTimeout(r, 1000));
+  }
+}
+
+// deployments/replicasets are controller-internal kinds the watch stream
+// doesn't carry (it mirrors the reference's 7 kinds) — poll them instead.
+async function pollWorkloads() {
+  for (;;) {
+    try {
+      for (const k of ["deployments", "replicasets"]) {
+        const lst = await api("GET", `/api/v1/resources/${k}`);
+        state[k] = {};
+        for (const o of lst.items) state[k][key(o)] = o;
+      }
+      render();
+    } catch (e) {}
+    await new Promise(r => setTimeout(r, 3000));
+  }
+}
+
+refreshAll().then(() => { watchLoop(); pollWorkloads(); });
+</script>
+</body>
+</html>
+"""

@@ -22,7 +22,7 @@ import queue
 import threading
 from typing import Any, Mapping
 
-from kube_scheduler_simulator_tpu.state.store import KINDS, ResourceExpiredError
+from kube_scheduler_simulator_tpu.state.store import ResourceExpiredError
 
 Obj = dict[str, Any]
 
@@ -37,6 +37,10 @@ PARAM_KINDS: tuple[tuple[str, str], ...] = (
     ("pcs", "priorityclasses"),
     ("namespace", "namespaces"),
 )
+
+# The watcher covers exactly the reference's 7 kinds (resourcewatcher.go:
+# 23-29) — workload kinds reconciled by the controllers are not streamed.
+WATCH_KINDS: tuple[str, ...] = tuple(kind for _param, kind in PARAM_KINDS)
 
 
 class StreamWriter:
@@ -102,9 +106,9 @@ class ResourceWatcherService:
                 # (the same contract as an expired watch resourceVersion).
                 pass
 
-        unsubscribe = self.cluster_store.subscribe(list(KINDS), on_event)
+        unsubscribe = self.cluster_store.subscribe(list(WATCH_KINDS), on_event)
         try:
-            for kind in KINDS:
+            for kind in WATCH_KINDS:
                 rv = lrv.get(kind, "")
                 if not str(rv).isdigit():
                     rv = ""  # non-numeric (opaque-token misuse) → relist

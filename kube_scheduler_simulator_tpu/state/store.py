@@ -37,6 +37,10 @@ from kube_scheduler_simulator_tpu.utils.retry import ConflictError
 
 Obj = dict[str, Any]
 
+# The 7 simulator-managed kinds (reference snapshot/watcher surface,
+# SURVEY.md §2.1 #13-15) + the workload kinds the reference's mini
+# controller-manager reconciles (deployment/replicaset controllers,
+# reference simulator/controller/controller.go:77-83).
 KINDS: tuple[str, ...] = (
     "pods",
     "nodes",
@@ -45,8 +49,12 @@ KINDS: tuple[str, ...] = (
     "storageclasses",
     "priorityclasses",
     "namespaces",
+    "deployments",
+    "replicasets",
 )
-NAMESPACED_KINDS: frozenset[str] = frozenset({"pods", "persistentvolumeclaims"})
+NAMESPACED_KINDS: frozenset[str] = frozenset(
+    {"pods", "persistentvolumeclaims", "deployments", "replicasets"}
+)
 
 KIND_NAMES: dict[str, str] = {
     "pods": "Pod",
@@ -56,6 +64,8 @@ KIND_NAMES: dict[str, str] = {
     "storageclasses": "StorageClass",
     "priorityclasses": "PriorityClass",
     "namespaces": "Namespace",
+    "deployments": "Deployment",
+    "replicasets": "ReplicaSet",
 }
 
 EVENT_ADDED = "ADDED"
@@ -121,9 +131,21 @@ class ClusterStore:
     # ------------------------------------------------------------------ infra
 
     @property
+    def lock(self) -> threading.RLock:
+        """The store's reentrant lock — components that must act atomically
+        with store state (e.g. the controller manager) synchronize on THIS
+        lock instead of a private one, so there is a single lock order."""
+        return self._lock
+
+    @property
     def resource_version(self) -> int:
         with self._lock:
             return self._rv
+
+    def count(self, kind: str) -> int:
+        """Object count without the deepcopy cost of list()."""
+        with self._lock:
+            return len(self._bucket(kind))
 
     def _next_rv(self) -> int:
         self._rv += 1
@@ -329,9 +351,16 @@ class ClusterStore:
 
     def restore(self, data: Mapping[str, list[Obj]]) -> None:
         """Wholesale state replacement (reset-service restore path,
-        reference simulator/reset/reset.go:57-84)."""
+        reference simulator/reset/reset.go:57-84).
+
+        Deletion runs owners-first (deployments → replicasets → pods …) so
+        the synchronous controller manager can't resurrect owned objects
+        mid-teardown."""
+        delete_order = ("deployments", "replicasets") + tuple(
+            k for k in KINDS if k not in ("deployments", "replicasets")
+        )
         with self._lock:
-            for kind in KINDS:
+            for kind in delete_order:
                 # Delete everything not in the target state, then apply.
                 # Key computation must default the namespace exactly like
                 # create/apply do, or namespaced objects without an explicit

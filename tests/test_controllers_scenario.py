@@ -1,0 +1,333 @@
+"""Controller manager + scenario engine + debuggablescheduler library tests."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from kube_scheduler_simulator_tpu.controllers import ControllerManager
+from kube_scheduler_simulator_tpu.scenario import ScenarioEngine, allocation_rate
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+Obj = dict[str, Any]
+
+
+def _node(name: str, cpu: str = "8") -> Obj:
+    return {
+        "kind": "Node",
+        "metadata": {"name": name},
+        "status": {"allocatable": {"cpu": cpu, "memory": "16Gi", "pods": "110"}},
+    }
+
+
+# ----------------------------------------------------------------- controllers
+
+
+def test_deployment_creates_replicaset_and_pods():
+    store = ClusterStore()
+    cm = ControllerManager(store)
+    cm.start()
+    store.create(
+        "deployments",
+        {
+            "metadata": {"name": "web"},
+            "spec": {
+                "replicas": 3,
+                "selector": {"matchLabels": {"app": "web"}},
+                "template": {
+                    "metadata": {"labels": {"app": "web"}},
+                    "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "100m"}}}]},
+                },
+            },
+        },
+    )
+    rs = store.list("replicasets")
+    assert len(rs) == 1 and rs[0]["spec"]["replicas"] == 3
+    pods = store.list("pods")
+    assert len(pods) == 3
+    assert all(p["metadata"]["labels"] == {"app": "web"} for p in pods)
+    assert all(p["metadata"]["ownerReferences"][0]["kind"] == "ReplicaSet" for p in pods)
+
+    # scale down
+    store.patch("deployments", "web", {"spec": {"replicas": 1}})
+    assert len(store.list("pods")) == 1
+    # scale up
+    store.patch("deployments", "web", {"spec": {"replicas": 2}})
+    assert len(store.list("pods")) == 2
+    cm.stop()
+
+
+def test_pv_controller_binds_claims():
+    store = ClusterStore()
+    cm = ControllerManager(store)
+    store.create(
+        "persistentvolumes",
+        {"metadata": {"name": "pv-big"}, "spec": {"capacity": {"storage": "100Gi"}, "accessModes": ["ReadWriteOnce"], "storageClassName": "fast"}},
+    )
+    store.create(
+        "persistentvolumes",
+        {"metadata": {"name": "pv-small"}, "spec": {"capacity": {"storage": "10Gi"}, "accessModes": ["ReadWriteOnce"], "storageClassName": "fast"}},
+    )
+    store.create(
+        "persistentvolumeclaims",
+        {
+            "metadata": {"name": "claim"},
+            "spec": {"storageClassName": "fast", "accessModes": ["ReadWriteOnce"], "resources": {"requests": {"storage": "5Gi"}}},
+        },
+    )
+    cm.reconcile_all()
+    pvc = store.get("persistentvolumeclaims", "claim")
+    # smallest compatible PV wins
+    assert pvc["spec"]["volumeName"] == "pv-small"
+    assert pvc["status"]["phase"] == "Bound"
+    pv = store.get("persistentvolumes", "pv-small")
+    assert pv["status"]["phase"] == "Bound"
+    assert pv["spec"]["claimRef"]["name"] == "claim"
+    assert pv["spec"]["claimRef"]["uid"] == pvc["metadata"]["uid"]
+
+
+def test_restore_empties_cluster_despite_controllers():
+    """restore({}) must not let the controller resurrect owned pods
+    (owners-first delete order + orphan GC)."""
+    store = ClusterStore()
+    cm = ControllerManager(store)
+    cm.start()
+    store.create(
+        "deployments",
+        {
+            "metadata": {"name": "web"},
+            "spec": {
+                "replicas": 3,
+                "selector": {"matchLabels": {"app": "web"}},
+                "template": {"metadata": {"labels": {"app": "web"}}, "spec": {"containers": [{"name": "c"}]}},
+            },
+        },
+    )
+    assert len(store.list("pods")) == 3
+    store.restore({})
+    assert store.list("pods") == []
+    assert store.list("replicasets") == []
+    assert store.list("deployments") == []
+
+    # deleting a deployment directly cascades (GC)
+    store.create(
+        "deployments",
+        {
+            "metadata": {"name": "web2"},
+            "spec": {
+                "replicas": 2,
+                "selector": {"matchLabels": {"app": "w2"}},
+                "template": {"metadata": {"labels": {"app": "w2"}}, "spec": {"containers": [{"name": "c"}]}},
+            },
+        },
+    )
+    assert len(store.list("pods")) == 2
+    store.delete("deployments", "web2")
+    assert store.list("pods") == []
+    assert store.list("replicasets") == []
+    cm.stop()
+
+
+def test_controller_tolerates_specless_deployment_and_name_collisions():
+    store = ClusterStore()
+    cm = ControllerManager(store)
+    cm.start()
+    # spec-less deployment must not poison the event bus
+    store.create("deployments", {"metadata": {"name": "bare"}})
+    # name collision with a user pod
+    store.create("pods", {"metadata": {"name": "web-rs-0"}, "spec": {}})
+    store.create(
+        "deployments",
+        {
+            "metadata": {"name": "web"},
+            "spec": {
+                "replicas": 2,
+                "selector": {"matchLabels": {"app": "web"}},
+                "template": {"metadata": {"labels": {"app": "web"}}, "spec": {"containers": [{"name": "c"}]}},
+            },
+        },
+    )
+    owned = [
+        p
+        for p in store.list("pods")
+        if p["metadata"].get("ownerReferences") and p["metadata"]["name"].startswith("web-rs-")
+    ]
+    assert len(owned) == 2  # collided name skipped, later ordinals used
+    assert store.get("pods", "web-rs-0")["metadata"].get("ownerReferences") is None
+    # the spec-less deployment defaulted to one replica without erroring
+    assert store.get("pods", "bare-rs-0")
+    cm.stop()
+
+
+# -------------------------------------------------------------------- scenario
+
+
+def _scenario_ops(ops: list[Obj]) -> Obj:
+    return {"metadata": {"name": "s1"}, "spec": {"operations": ops}}
+
+
+def build_engine():
+    store = ClusterStore()
+    svc = SchedulerService(store, tie_break="first")
+    svc.start_scheduler(None)
+    cm = ControllerManager(store)
+    return store, ScenarioEngine(store, svc, cm)
+
+
+def test_scenario_steps_schedule_and_timeline():
+    store, engine = build_engine()
+    # pre-existing junk must be wiped (determinism rule)
+    store.create("nodes", _node("stale-node"))
+
+    scenario = _scenario_ops(
+        [
+            {"id": "op1", "step": 1, "createOperation": {"object": _node("node-1")}},
+            {
+                "id": "op2",
+                "step": 2,
+                "createOperation": {
+                    "object": {
+                        "metadata": {"name": "p1", "namespace": "default"},
+                        "kind": "Pod",
+                        "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "1"}}}]},
+                    }
+                },
+            },
+            {"id": "op3", "step": 3, "doneOperation": {}},
+        ]
+    )
+    out = engine.run(scenario)
+    status = out["status"]
+    assert status["phase"] == "Succeeded"
+    timeline = status["scenarioResult"]["timeline"]
+    assert set(timeline) == {"1", "2", "3"}
+    # the stale node was wiped before step 1
+    assert [n["metadata"]["name"] for n in store.list("nodes")] == ["node-1"]
+    # step 2 recorded the create + the generated PodScheduled event
+    kinds = [next(k for k in ev if k not in ("id", "step")) for ev in timeline["2"]]
+    assert kinds == ["create", "podScheduled"]
+    assert timeline["2"][1]["podScheduled"]["result"]["spec"]["nodeName"] == "node-1"
+    assert status["scenarioResult"]["summary"]["allocationRate"] == 1.0
+    assert "node-1" in status["scenarioResult"]["summary"]["nodeUtilization"]
+
+
+def test_scenario_with_deployment_and_patch():
+    store, engine = build_engine()
+    scenario = _scenario_ops(
+        [
+            {"id": "n", "step": 1, "createOperation": {"object": _node("node-1")}},
+            {
+                "id": "d",
+                "step": 1,
+                "createOperation": {
+                    "object": {
+                        "kind": "Deployment",
+                        "metadata": {"name": "web", "namespace": "default"},
+                        "spec": {
+                            "replicas": 2,
+                            "selector": {"matchLabels": {"app": "w"}},
+                            "template": {
+                                "metadata": {"labels": {"app": "w"}},
+                                "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "100m"}}}]},
+                            },
+                        },
+                    }
+                },
+            },
+            {
+                "id": "scale",
+                "step": 2,
+                "patchOperation": {
+                    "typeMeta": {"kind": "Deployment"},
+                    "objectMeta": {"name": "web", "namespace": "default"},
+                    "patch": '{"spec": {"replicas": 4}}',
+                },
+            },
+            {"id": "done", "step": 3, "doneOperation": {}},
+        ]
+    )
+    out = engine.run(scenario)
+    assert out["status"]["phase"] == "Succeeded", out["status"]
+    pods = store.list("pods")
+    assert len(pods) == 4
+    assert all(p["spec"].get("nodeName") == "node-1" for p in pods)
+    assert allocation_rate(store) == 1.0
+    # step 1 generated 2 PodScheduled events, step 2 two more
+    t = out["status"]["scenarioResult"]["timeline"]
+    assert sum(1 for ev in t["1"] if "podScheduled" in ev) == 2
+    assert sum(1 for ev in t["2"] if "podScheduled" in ev) == 2
+
+
+def test_scenario_invalid_operation_fails():
+    _store, engine = build_engine()
+    out = engine.run(_scenario_ops([{"id": "bad", "step": 1}]))
+    assert out["status"]["phase"] == "Failed"
+    assert "exactly one" in out["status"]["message"]
+
+
+def test_scenario_without_done_pauses():
+    _store, engine = build_engine()
+    out = engine.run(_scenario_ops([{"id": "n", "step": 1, "createOperation": {"object": _node("n1")}}]))
+    assert out["status"]["phase"] == "Paused"
+
+
+# ------------------------------------------------------- debuggablescheduler
+
+
+def test_debuggablescheduler_with_custom_plugin_and_extender():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "examples"))
+    from nodenumber import node_number_factory
+
+    from kube_scheduler_simulator_tpu.pkg import debuggablescheduler
+
+    calls: list[str] = []
+
+    class FitExtender:
+        """Plugin extender exporting state (reference
+        docs/sample/plugin-extender/extender.go)."""
+
+        def __init__(self, store):
+            self.store = store
+
+        def after_pre_filter(self, state, pod, result, status):
+            calls.append("after_pre_filter")
+            self.store.add_custom_result(
+                pod["metadata"].get("namespace", "default"),
+                pod["metadata"]["name"],
+                "scheduler-simulator/customresult",
+                "fit-prefilter-ran",
+            )
+            return result, status
+
+    store = ClusterStore()
+    for i in range(4):
+        store.create("nodes", _node(f"node-{i}"))
+    store.create("pods", {"metadata": {"name": "pod-2"}, "spec": {"containers": [{"name": "c"}]}})
+
+    config = {
+        "profiles": [
+            {
+                "schedulerName": "default-scheduler",
+                "plugins": {"multiPoint": {"enabled": [{"name": "NodeNumber", "weight": 10}]}},
+            }
+        ]
+    }
+    scheduler, result_store = debuggablescheduler.new_scheduler(
+        store,
+        plugins={"NodeNumber": node_number_factory},
+        plugin_extenders={"NodeResourcesFit": lambda rs: FitExtender(rs)},
+        config=config,
+    )
+    results = scheduler.schedule_pending()
+    assert results["default/pod-2"].selected_node == "node-2"  # suffix match wins
+    assert "after_pre_filter" in calls
+    pod = store.get("pods", "pod-2")
+    annos = pod["metadata"]["annotations"]
+    assert annos["scheduler-simulator/customresult"] == "fit-prefilter-ran"
+    import json
+
+    scores = json.loads(annos["scheduler-simulator/score-result"])
+    assert scores["node-2"]["NodeNumber"] == "10"
