@@ -101,6 +101,19 @@ class DeviceProblem(NamedTuple):
     ip_own_w: Any         # [P,KO]
     ip_self_match: Any    # [P] bool
     pod_active: Any       # [P] bool (False = padding row, never committed)
+    # Per-used-topology-key expansion data.  Domain-level [D+1] vectors are
+    # expanded to node vectors WITHOUT per-element gathers of the mutable
+    # carry (XLA serializes those inside the scan, ~10x slower):
+    # - "identity" keys (hostname-like bijections, dom = base + n): a
+    #   dynamic_slice + valid mask — free;
+    # - interned keys (zones): a small [size, N] one-hot matmul.
+    # The static structure (kind, base, size per key) lives in
+    # dims["key_struct"]; the arrays here are traced inputs.
+    key_valid: Any        # tuple of [N] bool, per used key
+    key_oh: Any           # tuple of [size,N] one-hots ([0,N] for identity keys)
+    g_ku: Any             # [G] local key index per term group
+    spf_ku: Any           # [P, KC] local key per filter constraint
+    sps_ku: Any           # [P, KS] local key per score constraint
     # initial carry
     requested0: Any       # [N,R]
     nonzero0: Any         # [N,2]
@@ -122,6 +135,52 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
     group_key = np.asarray(pr.group_key)
     gdom = np.asarray(pr.node_domain)[np.clip(group_key, 0, None)]  # [G,N]
     pad = lambda a: np.concatenate([a, np.zeros((a.shape[0], 1), a.dtype)], axis=1)
+
+    # Used topology keys → local index + static expansion structure
+    # (see DeviceProblem.key_valid/key_oh and dims["key_struct"]).
+    node_domain = np.asarray(pr.node_domain)
+    used_keys: list[int] = sorted(
+        {int(k) for k in group_key.tolist() if pr.G}
+        | {int(k) for k in np.asarray(pr.spf_key).ravel().tolist() if k >= 0}
+        | {int(k) for k in np.asarray(pr.sps_key).ravel().tolist() if k >= 0}
+    )
+    ku_of = {k: u for u, k in enumerate(used_keys)}
+    N = pr.N
+    key_base = list(getattr(pr, "key_base", []))
+    key_identity = list(getattr(pr, "key_identity", []))
+    key_struct: list[tuple] = []
+    key_valid: list[np.ndarray] = []
+    key_oh: list[np.ndarray] = []
+    for k in used_keys:
+        dom = node_domain[k]
+        valid = dom >= 0
+        base = key_base[k] if k < len(key_base) else 0
+        if key_identity[k] if k < len(key_identity) else False:
+            key_struct.append(("identity", base, N))
+            key_valid.append(valid)
+            key_oh.append(np.zeros((0, N), dtype=np.float32))
+        else:
+            size = int(dom[valid].max() - base + 1) if valid.any() else 1
+            oh = np.zeros((size, N), dtype=np.float32)
+            oh[dom[valid] - base, np.nonzero(valid)[0]] = 1.0
+            key_struct.append(("onehot", base, size))
+            key_valid.append(valid)
+            key_oh.append(oh)
+    if not used_keys:
+        key_struct.append(("identity", 0, N))
+        key_valid.append(np.zeros(N, dtype=bool))
+        key_oh.append(np.zeros((0, N), dtype=np.float32))
+
+    def remap(keys: np.ndarray) -> np.ndarray:
+        out = np.zeros_like(keys)
+        flat = out.ravel()
+        for i, k in enumerate(np.asarray(keys).ravel()):
+            flat[i] = ku_of.get(int(k), 0)
+        return out
+
+    g_ku = remap(group_key) if pr.G else np.zeros(1, dtype=np.int32)
+    spf_ku = remap(np.asarray(pr.spf_key))
+    sps_ku = remap(np.asarray(pr.sps_key))
     dp = DeviceProblem(
         alloc=f(pr.alloc),
         max_pods=f(pr.max_pods),
@@ -150,6 +209,11 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
         ip_own_w=f(pr.ip_own_w),
         ip_self_match=b(pr.ip_self_match),
         pod_active=b(getattr(pr, "pod_active", np.ones(pr.P, dtype=bool))),
+        key_valid=tuple(b(v) for v in key_valid),
+        key_oh=tuple(f(o) for o in key_oh),
+        g_ku=i32(g_ku),
+        spf_ku=i32(spf_ku),
+        sps_ku=i32(sps_ku),
         requested0=f(pr.requested0),
         nonzero0=f(pr.nonzero0),
         pod_count0=f(pr.pod_count0),
@@ -161,11 +225,18 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
     dims = dict(
         P=pr.P, N=pr.N, R=pr.R, D=D, SG=pr.SG, G=pr.G,
         KC=pr.KC, KS=pr.KS, KA=pr.KA, KB=pr.KB, KP=pr.KP, KO=pr.KO,
+        key_struct=tuple(key_struct),
     )
     return dp, dims
 
 
 # --------------------------------------------------------------- primitives
+
+def _mv(a, b):
+    """Matvec at HIGHEST precision: the one-hot expansions must stay exact
+    integer arithmetic on TPU (default f32 matmul precision is bf16-based)."""
+    return jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
+
 
 def _floordiv(a, b):
     """Go integer division for non-negative operands, in floats."""
@@ -212,6 +283,21 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
     use_ip = G > 0 and (
         "InterPodAffinity" in cfg.filters or any(k == "InterPodAffinity" for k, _ in cfg.scores)
     )
+    key_struct = dims["key_struct"]
+    KU = len(key_struct)
+
+    def expand_u(u: int, vec, dp):
+        """Domain vector [D+1] → per-node values [N] for static key u."""
+        kind, base, size = key_struct[u]
+        if kind == "identity":
+            return lax.dynamic_slice(vec, (base,), (N,)) * dp.key_valid[u]
+        return _mv(vec[base : base + size], dp.key_oh[u])
+
+    def expand_switch(u, vec, dp):
+        """Same, for a TRACED key index (lax.switch over the static set)."""
+        if KU == 1:
+            return expand_u(0, vec, dp)
+        return lax.switch(u, [lambda v, uu=uu: expand_u(uu, v, dp) for uu in range(KU)], vec)
 
     def step(dp: DeviceProblem, carry, xs):
         requested, nonzero, pod_count, spread_counts, ip_sel, ip_own, ip_anti = carry
@@ -258,13 +344,34 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
                     dom = jnp.take(dp.node_domain, jnp.clip(key, 0), axis=0)  # [N]
                     m = jnp.take(spread_counts, grp_row[i, k], axis=0)  # [N]
                     contributing = incl_row & (dom >= 0)
-                    dom_safe = jnp.where(contributing, dom, D)
-                    dcounts = jnp.zeros(D + 1, dtype=dt).at[dom_safe].add(jnp.where(contributing, m, 0.0))
-                    dpresent = jnp.zeros(D + 1, dtype=bool).at[dom_safe].set(contributing)
-                    has_any = jnp.any(dpresent[:D])
-                    min_match = jnp.min(jnp.where(dpresent[:D], dcounts[:D], jnp.inf))
-                    min_match = jnp.where(has_any, min_match, 0.0)
-                    match_num = dcounts[jnp.clip(dom, 0)] * (dom >= 0)
+                    mc = jnp.where(contributing, m, 0.0)
+
+                    def spread_branch(u):
+                        def br(operands):
+                            mc_, contributing_ = operands
+                            kind, base, size = key_struct[u]
+                            if kind == "identity":
+                                # each node is its own domain
+                                present = contributing_
+                                mn = jnp.min(jnp.where(present, mc_, jnp.inf))
+                                match = mc_ * dp.key_valid[u]
+                            else:
+                                oh = dp.key_oh[u]
+                                dc = _mv(oh, mc_)  # [size]
+                                present = _mv(oh, contributing_.astype(dt)) > 0
+                                mn = jnp.min(jnp.where(present, dc, jnp.inf))
+                                match = _mv(dc, oh)
+                            has_any = jnp.any(present)
+                            return match, jnp.where(has_any, mn, 0.0)
+                        return br
+
+                    u = dp.spf_ku[i, k]
+                    if KU == 1:
+                        match_num, min_match = spread_branch(0)((mc, contributing))
+                    else:
+                        match_num, min_match = lax.switch(
+                            u, [spread_branch(uu) for uu in range(KU)], (mc, contributing)
+                        )
                     skew = match_num + self_row[i, k] - min_match
                     k_code = jnp.where(dom < 0, 1, jnp.where(skew > skew_row[i, k], 2, 0))
                     k_code = jnp.where(active, k_code, 0)
@@ -272,10 +379,12 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
                 apply(name, code)
             elif name == "InterPodAffinity" and use_ip:
                 tm = dp.term_match[:, i]  # [G]
-                gvalid = dp.gdom >= 0  # [G,N]
-                gdom_safe = jnp.where(gvalid, dp.gdom, D)
-                antimat = jnp.take_along_axis(ip_anti, gdom_safe, axis=1) * gvalid  # [G,N]
-                poison = tm @ antimat  # [N]
+                # collapse over groups per used key, then expand to nodes
+                # through the static one-hot (exact: one-hot entries are 0/1)
+                poison = jnp.zeros(N, dtype=dt)
+                for u in range(KU):
+                    vec = _mv(tm * (dp.g_ku == u), ip_anti)  # [D+1]
+                    poison = poison + expand_u(u, vec, dp)
                 code = jnp.where(poison > 0, 1, 0).astype(jnp.int32)
                 # own required affinity
                 if KA > 0:
@@ -287,7 +396,7 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
                         gs = jnp.clip(g, 0)
                         row = ip_sel[gs]  # [D+1]
                         dom = dp.gdom[gs]
-                        cnt = row[jnp.where(dom >= 0, dom, D)] * (dom >= 0)
+                        cnt = expand_switch(dp.g_ku[gs], row, dp)  # [N]
                         sat = sat & (jnp.where(active, (cnt > 0) & (dom >= 0), True))
                         total_any = total_any + jnp.where(active, jnp.sum(row[:D]), 0.0)
                     has_aff = dp.ip_aff_g[i, 0] >= 0
@@ -299,8 +408,7 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
                         g = dp.ip_anti_g[i, k]
                         active = g >= 0
                         gs = jnp.clip(g, 0)
-                        dom = dp.gdom[gs]
-                        cnt = ip_sel[gs][jnp.where(dom >= 0, dom, D)] * (dom >= 0)
+                        cnt = expand_switch(dp.g_ku[gs], ip_sel[gs], dp)
                         fail = active & (cnt > 0)
                         code = jnp.where((code == 0) & fail, 3, code)
                 apply(name, code)
@@ -355,13 +463,29 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
                     dom = jnp.take(dp.node_domain, jnp.clip(key, 0), axis=0)
                     m = jnp.take(spread_counts, grp_row[i, k], axis=0)
                     contributing = has_all & (dom >= 0)
-                    dom_safe = jnp.where(contributing, dom, D)
-                    dcounts = jnp.zeros(D + 1, dtype=dt).at[dom_safe].add(jnp.where(contributing, m, 0.0))
-                    cnt = dcounts[jnp.clip(dom, 0)] * (dom >= 0)
-                    # topology size among feasible non-ignored nodes
+                    mc = jnp.where(contributing, m, 0.0)
                     fni = feasible & has_all & (dom >= 0)
-                    dseen = jnp.zeros(D + 1, dtype=bool).at[jnp.where(fni, dom, D)].set(fni)
-                    tsize = jnp.sum(dseen[:D].astype(dt))
+
+                    def score_branch(u):
+                        def br(operands):
+                            mc_, fni_ = operands
+                            kind, base, size = key_struct[u]
+                            if kind == "identity":
+                                cnt_ = mc_ * dp.key_valid[u]
+                                tsize_ = jnp.sum(fni_.astype(dt))
+                            else:
+                                oh = dp.key_oh[u]
+                                dc = _mv(oh, mc_)
+                                cnt_ = _mv(dc, oh)
+                                tsize_ = jnp.sum((_mv(oh, fni_.astype(dt)) > 0).astype(dt))
+                            return cnt_, tsize_
+                        return br
+
+                    u = dp.sps_ku[i, k]
+                    if KU == 1:
+                        cnt, tsize = score_branch(0)((mc, fni))
+                    else:
+                        cnt, tsize = lax.switch(u, [score_branch(uu) for uu in range(KU)], (mc, fni))
                     w = jnp.log(tsize + 2.0)
                     raw_f = raw_f + jnp.where(active, cnt * w + (skew_row[i, k] - 1.0), 0.0)
                 raw = jnp.round(raw_f)
@@ -379,16 +503,18 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
                 norm = jnp.where(has_constraints, norm, 0.0)
                 raw = jnp.where(has_constraints, raw, 0.0)
             elif name == "InterPodAffinity" and use_ip:
-                gvalid = dp.gdom >= 0
-                gdom_safe = jnp.where(gvalid, dp.gdom, D)
-                selmat = jnp.take_along_axis(ip_sel, gdom_safe, axis=1) * gvalid  # [G,N]
-                ownmat = jnp.take_along_axis(ip_own, gdom_safe, axis=1) * gvalid
-                raw = dp.term_match[:, i] @ ownmat
+                tm = dp.term_match[:, i]
+                raw = jnp.zeros(N, dtype=dt)
+                for u in range(KU):
+                    vec = _mv(tm * (dp.g_ku == u), ip_own)  # [D+1]
+                    raw = raw + expand_u(u, vec, dp)
                 for k in range(KP):
                     g = dp.ip_pref_g[i, k]
                     active = g >= 0
+                    gs = jnp.clip(g, 0)
                     w = dp.ip_pref_w[i, k]
-                    raw = raw + jnp.where(active, w * selmat[jnp.clip(g, 0)], 0.0)
+                    cnt = expand_switch(dp.g_ku[gs], ip_sel[gs], dp)
+                    raw = raw + jnp.where(active, w * cnt, 0.0)
                 norm = _minmax_normalize(raw, feasible)
             else:
                 raw = jnp.zeros(N, dtype=dt)

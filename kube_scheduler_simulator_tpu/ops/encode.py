@@ -272,8 +272,8 @@ def encode(
         [(p.get("spec") or {}).get("tolerations") or [] for p in pending], _sig
     )
     taint_reps, taint_idx = _group(node_taints, _sig)
-    tf = np.full((len(tol_reps), len(taint_reps)), -1, dtype=np.int32)
-    tp = np.zeros((len(tol_reps), len(taint_reps)), dtype=np.int64)
+    tf = np.full((len(tol_reps), len(taint_reps)), -1, dtype=np.int16)
+    tp = np.zeros((len(tol_reps), len(taint_reps)), dtype=np.int16)
     tu = np.ones((len(tol_reps), len(taint_reps)), dtype=bool)  # unschedulable-toleration
     for a, tols in enumerate(tol_reps):
         prefer_tols = [t for t in tols if not t.get("effect") or t.get("effect") == "PreferNoSchedule"]
@@ -310,7 +310,7 @@ def encode(
         [{"labels": node_labels[i], "name": pr.node_names[i]} for i in range(N)],
         lambda x: _sig(sorted(x["labels"].items())) + "|" + x["name"],
     )
-    ac = np.zeros((len(aff_reps), len(nl_reps)), dtype=np.int32)
+    ac = np.zeros((len(aff_reps), len(nl_reps)), dtype=np.int8)
     inc = np.ones((len(aff_reps), len(nl_reps)), dtype=bool)
     for a, spec in enumerate(aff_reps):
         for b, nl in enumerate(nl_reps):
@@ -346,7 +346,7 @@ def encode(
         ],
         _sig,
     )
-    ap = np.zeros((len(pref_reps), len(nl_reps)), dtype=np.int64)
+    ap = np.zeros((len(pref_reps), len(nl_reps)), dtype=np.int32)
     for a, prefs in enumerate(pref_reps):
         for b, nl in enumerate(nl_reps):
             total = 0
@@ -398,17 +398,38 @@ def encode(
                 for t in a.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
                     key_id((t.get("podAffinityTerm") or {}).get("topologyKey", ""))
 
+    # Global domain numbering, contiguous per key.  Keys whose values are
+    # UNIQUE per node (hostname-like bijections) get the identity layout
+    # dom[n] = base + n, which lets the batch kernel expand/collapse
+    # domain vectors with array slices instead of [D,N] one-hot streams
+    # (ops/batch.py key_info).
     KT = len(topo_keys)
-    domain_table: dict[tuple[int, str], int] = {}
     node_domain = np.full((max(KT, 1), N), -1, dtype=np.int32)
+    key_base: list[int] = []
+    key_identity: list[bool] = []
+    next_id = 0
     for ki, key in enumerate(topo_keys):
-        for n_i, labels in enumerate(node_labels):
-            if key in labels:
-                pair = (ki, labels[key])
-                d = domain_table.setdefault(pair, len(domain_table))
-                node_domain[ki, n_i] = d
-    D = max(len(domain_table), 1)
+        values = [labels.get(key) for labels in node_labels]
+        present = [v for v in values if v is not None]
+        bijective = len(present) > 0 and len(set(present)) == len(present)
+        key_base.append(next_id)
+        key_identity.append(bijective)
+        if bijective:
+            for n_i, v in enumerate(values):
+                if v is not None:
+                    node_domain[ki, n_i] = next_id + n_i
+            next_id += N  # reserve the full range to keep the identity map
+        else:
+            interned: dict[str, int] = {}
+            for n_i, v in enumerate(values):
+                if v is not None:
+                    if v not in interned:
+                        interned[v] = next_id
+                        next_id += 1
+                    node_domain[ki, n_i] = interned[v]
+    D = max(next_id, 1)
     pr.topo_keys, pr.node_domain, pr.D = topo_keys, node_domain, D
+    pr.key_base, pr.key_identity = key_base, key_identity
 
     # --------------------------------------------------- PodTopologySpread
     sg_table: dict[str, int] = {}

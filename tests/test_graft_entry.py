@@ -1,0 +1,38 @@
+"""Driver entry points: compile check + multi-chip sharding dryrun.
+
+These mirror what the round driver runs (__graft_entry__.entry on one
+chip, dryrun_multichip on a virtual CPU mesh), so sharding regressions
+fail in CI, not at judging time.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def test_entry_compiles_and_schedules():
+    import jax
+
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = fn(*args)
+    sel = np.asarray(out["selected"])
+    assert (sel >= 0).all()
+    # jit of the unwrapped computation also works (driver compile check)
+    out2 = jax.jit(fn.__wrapped__)(*args)
+    assert (np.asarray(out2["selected"]) == sel).all()
+
+
+def test_dryrun_multichip_8_devices():
+    import jax
+
+    import __graft_entry__ as ge
+
+    n = min(8, len(jax.local_devices(backend="cpu")))
+    ge.dryrun_multichip(n)
