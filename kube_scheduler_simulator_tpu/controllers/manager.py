@@ -62,6 +62,19 @@ class ControllerManager:
         # recursion into a "dirty → one more pass" loop.
         self._reconciling = False
         self._dirty = False
+        # RS uid → number of pods carrying that controller ownerReference;
+        # maintained incrementally by _on_event, rebuilt authoritatively by
+        # every _reconcile_replicasets sweep (drift self-heals).
+        self._owned_counts: dict[str, int] = {}
+        # RS uid → spec.replicas: lets the ADDED-pod hot path decide
+        # "surplus or not" without deepcopying the replicasets kind.
+        self._rs_replicas: dict[str, int] = {}
+        # Owner uids whose DELETION this manager observed.  Cascade GC
+        # fires only for these: a dangling ownerReference whose owner was
+        # NEVER seen (snapshot import applies pods but snapshots don't
+        # carry replicasets) must survive, matching the reference where no
+        # kube GC controller runs at all (controller/controller.go:77-83).
+        self._deleted_owner_uids: set[str] = set()
 
     # ---------------------------------------------------------------- wiring
 
@@ -75,12 +88,47 @@ class ControllerManager:
             )
 
     def _on_event(self, ev: Any) -> None:
-        # Pod churn only concerns the replicaset controller when owned pods
-        # disappear — skip the (deepcopying) reconcile sweep for the
-        # scheduler's bind updates on the hot path.
+        # Pod churn concerns the replicaset controller when owned pods
+        # appear (user-created pod adopted by / surplus to an existing RS)
+        # or disappear — but NOT for the scheduler's bind updates
+        # (MODIFIED without ownership change), the hot path, which would
+        # otherwise pay a full-cluster deepcopy sweep per bind.  ADDED
+        # events are filtered through an incrementally-tracked per-RS pod
+        # count so a bulk import of N owned pods coalesces to zero sweeps
+        # instead of N full-cluster ones (the reference's informer
+        # workqueues coalesce such bursts the same way).
+        if ev.kind in ("deployments", "replicasets") and ev.type == "DELETED":
+            self._deleted_owner_uids.add((ev.obj.get("metadata") or {}).get("uid", ""))
+        if ev.kind == "replicasets":
+            uid = (ev.obj.get("metadata") or {}).get("uid", "")
+            if ev.type == "DELETED":
+                self._rs_replicas.pop(uid, None)
+            else:
+                self._rs_replicas[uid] = int((ev.obj.get("spec") or {}).get("replicas", 1))
         if ev.kind == "pods":
             refs = (ev.obj.get("metadata") or {}).get("ownerReferences") or []
-            if ev.type != "DELETED" or not refs:
+            ctrl = next((r for r in refs if r.get("controller")), None)
+            if ev.type == "DELETED":
+                if not refs:
+                    return
+                if ctrl is not None and ctrl.get("kind") == "ReplicaSet":
+                    uid = ctrl.get("uid", "")
+                    self._owned_counts[uid] = max(0, self._owned_counts.get(uid, 0) - 1)
+            elif ev.type == "ADDED":
+                if ctrl is None or ctrl.get("kind") != "ReplicaSet":
+                    return  # the RS controller only reacts to RS-owned pods
+                uid = ctrl.get("uid", "")
+                cnt = self._owned_counts[uid] = self._owned_counts.get(uid, 0) + 1
+                want = self._rs_replicas.get(uid)
+                if want is not None and cnt <= want:
+                    return  # owner exists, no surplus: nothing to reconcile
+                if want is None and uid not in self._deleted_owner_uids:
+                    # Owner never seen by this manager (e.g. snapshot import
+                    # applies pods without their replicasets): not an
+                    # orphan, nothing to scale — skip the sweep.
+                    return
+                # surplus (scale-down) or observed-deleted owner (GC): sweep
+            else:
                 return
         self.reconcile_all()
 
@@ -130,7 +178,11 @@ class ControllerManager:
 
     def _gc_orphans(self) -> bool:
         """Cascade deletion (the kube GC role): ReplicaSets whose owning
-        Deployment is gone, and pods whose owning ReplicaSet is gone."""
+        Deployment was OBSERVED deleted, and pods whose owning ReplicaSet
+        was observed deleted.  A dangling ownerReference to an owner this
+        manager never saw (snapshot import carries pods but not their
+        replicasets) is left alone — the reference runs no GC controller
+        at all, so imported pods must never be collected."""
         changed = False
         dep_uids = {d["metadata"]["uid"] for d in self.store.list("deployments")}
         rs_uids = set()
@@ -138,7 +190,12 @@ class ControllerManager:
             owner = next(
                 (r for r in rs["metadata"].get("ownerReferences") or [] if r.get("controller")), None
             )
-            if owner and owner.get("kind") == "Deployment" and owner.get("uid") not in dep_uids:
+            if (
+                owner
+                and owner.get("kind") == "Deployment"
+                and owner.get("uid") not in dep_uids
+                and owner.get("uid") in self._deleted_owner_uids
+            ):
                 self.store.delete("replicasets", rs["metadata"]["name"], _ns(rs))
                 changed = True
             else:
@@ -147,7 +204,12 @@ class ControllerManager:
             owner = next(
                 (r for r in p["metadata"].get("ownerReferences") or [] if r.get("controller")), None
             )
-            if owner and owner.get("kind") == "ReplicaSet" and owner.get("uid") not in rs_uids:
+            if (
+                owner
+                and owner.get("kind") == "ReplicaSet"
+                and owner.get("uid") not in rs_uids
+                and owner.get("uid") in self._deleted_owner_uids
+            ):
                 self.store.delete("pods", p["metadata"]["name"], _ns(p))
                 changed = True
         return changed
@@ -203,6 +265,20 @@ class ControllerManager:
     def _reconcile_replicasets(self) -> bool:
         changed = False
         pods = self.store.list("pods")
+        counts: dict[str, int] = {}
+        for p in pods:
+            ref = next(
+                (r for r in p["metadata"].get("ownerReferences") or [] if r.get("controller")),
+                None,
+            )
+            if ref is not None and ref.get("kind") == "ReplicaSet":
+                uid = ref.get("uid", "")
+                counts[uid] = counts.get(uid, 0) + 1
+        self._owned_counts = counts
+        self._rs_replicas = {
+            rs["metadata"]["uid"]: int((rs.get("spec") or {}).get("replicas", 1))
+            for rs in self.store.list("replicasets")
+        }
         for rs in self.store.list("replicasets"):
             want = int((rs.get("spec") or {}).get("replicas", 1))
             owned = sorted(

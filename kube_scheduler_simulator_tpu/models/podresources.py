@@ -26,6 +26,21 @@ EPHEMERAL_STORAGE = "ephemeral-storage"
 PODS = "pods"
 
 
+def is_fit_resource(r: str) -> bool:
+    """Whether NodeResourcesFit checks resource ``r`` (upstream
+    InsufficientResource: cpu/memory/ephemeral-storage, hugepages-*,
+    attachable-volumes-*, extended "<domain>/<name>" resources).  The
+    single source of truth for BOTH the sequential Fit plugin
+    (plugins/intree/noderesources.py) and the batch encoder
+    (ops/encode.py) — they must never diverge."""
+    return (
+        r in (CPU, MEMORY, EPHEMERAL_STORAGE)
+        or "/" in r
+        or r.startswith("hugepages-")
+        or r.startswith("attachable-volumes-")
+    )
+
+
 def _to_internal(resource: str, q: Any) -> int:
     if resource == CPU:
         return milli_value(q)

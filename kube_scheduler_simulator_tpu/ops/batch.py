@@ -50,6 +50,11 @@ class BatchConfig(NamedTuple):
     # (0 = cpu, 1 = memory) — upstream default is cpu:1, memory:1
     fit_resources: tuple = ((0, 1), (1, 1))
     trace: bool = False
+    # selectHost tie handling: "first" = first tied max in visit order;
+    # "reservoir" = k-th tied max with k from the counter-keyed hash draw
+    # (utils/hashing.py) — bit-identical to the sequential _select_host.
+    tie_break: str = "first"
+    seed: int = 0
 
 
 FILTER_KERNELS = (
@@ -101,6 +106,7 @@ class DeviceProblem(NamedTuple):
     ip_own_w: Any         # [P,KO]
     ip_self_match: Any    # [P] bool
     pod_active: Any       # [P] bool (False = padding row, never committed)
+    tb_base: Any          # [] uint32: attempt counter of the round's first pod
     # Per-used-topology-key expansion data.  Domain-level [D+1] vectors are
     # expanded to node vectors WITHOUT per-element gathers of the mutable
     # carry (XLA serializes those inside the scan, ~10x slower):
@@ -209,6 +215,7 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
         ip_own_w=f(pr.ip_own_w),
         ip_self_match=b(pr.ip_self_match),
         pod_active=b(getattr(pr, "pod_active", np.ones(pr.P, dtype=bool))),
+        tb_base=jnp.asarray(0, dtype=jnp.uint32),
         key_valid=tuple(b(v) for v in key_valid),
         key_oh=tuple(f(o) for o in key_oh),
         g_ku=i32(g_ku),
@@ -236,6 +243,17 @@ def _mv(a, b):
     """Matvec at HIGHEST precision: the one-hot expansions must stay exact
     integer arithmetic on TPU (default f32 matmul precision is bf16-based)."""
     return jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
+
+
+def _mix32(x):
+    """murmur3 32-bit finalizer — constants MUST match utils/hashing.py."""
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
 
 
 def _floordiv(a, b):
@@ -527,7 +545,21 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
         # Single-feasible-node bypass: scores are skipped (annotations omit
         # them); selection is the lone feasible node either way.
         masked = jnp.where(feasible, totals, NEG)
-        sel = jnp.argmax(masked).astype(jnp.int32)
+        if cfg.tie_break == "reservoir":
+            # k-th tied max in visit order, k from the counter-keyed draw —
+            # the same pick the sequential _select_host makes for attempt
+            # tb_base + i (utils/hashing.py).
+            mx = jnp.max(masked)
+            tied = feasible & (masked == mx)
+            ties = jnp.cumsum(tied.astype(jnp.int32))
+            t_count = ties[-1]
+            counter = dp.tb_base + i.astype(jnp.uint32)
+            seed_mix = _mix32(jnp.uint32((cfg.seed ^ 0x9E3779B9) & 0xFFFFFFFF))
+            draw = _mix32(seed_mix ^ _mix32(counter))
+            k = (draw % jnp.maximum(t_count, 1).astype(jnp.uint32)).astype(jnp.int32)
+            sel = jnp.argmax(tied & (ties == k + 1)).astype(jnp.int32)
+        else:
+            sel = jnp.argmax(masked).astype(jnp.int32)
         sel = jnp.where(count > 0, sel, -1)
 
         # ----------------------------------------------------------- commit

@@ -58,6 +58,7 @@ from kube_scheduler_simulator_tpu.models.podresources import (
     EPHEMERAL_STORAGE,
     MEMORY,
     PODS,
+    is_fit_resource,
     pod_resource_request,
 )
 from kube_scheduler_simulator_tpu.utils.labels import (
@@ -95,16 +96,12 @@ def _group(items: list[Any], keyfn: Callable[[Any], str]) -> "tuple[list[Any], n
 
 
 def _fit_resources(pod: Obj) -> dict[str, int]:
-    """Resources NodeResourcesFit actually checks (upstream
-    InsufficientResource: cpu/memory/ephemeral-storage, hugepages-*,
-    extended resources)."""
-    out = {}
-    for r, v in pod_resource_request(pod).items():
-        if v == 0:
-            continue
-        if r in (CPU, MEMORY, EPHEMERAL_STORAGE) or "/" in r or r.startswith("hugepages-"):
-            out[r] = v
-    return out
+    """Nonzero requests for the resources NodeResourcesFit checks
+    (models/podresources.is_fit_resource — shared with the sequential
+    plugin)."""
+    return {
+        r: v for r, v in pod_resource_request(pod).items() if v != 0 and is_fit_resource(r)
+    }
 
 
 class SpreadConstraint:

@@ -28,6 +28,7 @@ from kube_scheduler_simulator_tpu.models.podresources import (
     EPHEMERAL_STORAGE,
     MEMORY,
     PODS,
+    is_fit_resource,
     pod_resource_request,
 )
 from kube_scheduler_simulator_tpu.utils.quantity import milli_value, value
@@ -103,9 +104,7 @@ class NodeResourcesFit:
         if len(node_info.pods) + 1 > node_info.allowed_pod_number():
             reasons.append("Too many pods")
         for r, want in req.items():
-            if want == 0:
-                continue
-            if r not in (CPU, MEMORY, EPHEMERAL_STORAGE) and "/" not in r and not r.startswith("hugepages-"):
+            if want == 0 or not is_fit_resource(r):
                 continue
             have = node_info.allocatable.get(r, 0) - node_info.requested.get(r, 0)
             if want > have:

@@ -71,7 +71,7 @@ class DefaultPreemption:
         if fwk is None or snap is None:
             return None, Status.unschedulable("preemption not possible")
         incoming_priority = pod_priority(pod)
-        best: "tuple[int, int, str, list[Obj]] | None" = None  # (len, max prio, name, victims)
+        candidates: dict[str, list[Obj]] = {}
         for node_name, status in filtered_node_status_map.items():
             if status is not None and status.code.name == "UNSCHEDULABLE_AND_UNRESOLVABLE":
                 continue
@@ -79,14 +79,28 @@ class DefaultPreemption:
             if ni is None:
                 continue
             victims = self._find_victims(fwk, state, pod, ni, incoming_priority)
-            if victims is None:
-                continue
+            if victims is not None:
+                candidates[node_name] = victims
+
+        # Extender preempt pass (upstream Evaluator.callExtenders): preempt-
+        # verb extenders narrow the candidate map before the best candidate
+        # is picked; a non-ignorable extender failure aborts preemption.
+        ext = getattr(fwk, "extender_service", None)
+        if candidates and ext is not None and any(e.preempt_verb for e in ext.extenders):
+            try:
+                candidates = ext.run_preempt(pod, candidates)
+            except Exception as e:
+                return None, Status.error(f"preemption extender: {e}")
+
+        best: "tuple[int, int, str] | None" = None  # (len, max prio, name)
+        for node_name, victims in candidates.items():
             key = (len(victims), max((pod_priority(v) for v in victims), default=-(10**9)), node_name)
-            if best is None or key < (best[0], best[1], best[2]):
-                best = (key[0], key[1], node_name, victims)
+            if best is None or key < best:
+                best = key
         if best is None:
             return None, Status.unschedulable("preemption: 0/%d nodes are available" % len(filtered_node_status_map))
-        node_name, victims = best[2], best[3]
+        node_name = best[2]
+        victims = candidates[node_name]
         store = getattr(self.handle, "cluster_store", None)
         for v in victims:
             if store is not None:

@@ -178,6 +178,8 @@ class BatchEngine:
         percentage_of_nodes_to_score: int = 100,
         trace: bool = False,
         dtype=None,
+        tie_break: str = "first",
+        seed: int = 0,
     ):
         self.filters = list(
             filters
@@ -197,6 +199,8 @@ class BatchEngine:
             fit_strategy=fit_strategy,
             fit_resources=tuple(fit_resources) if fit_resources else ((0, 1), (1, 1)),
             trace=trace,
+            tie_break=tie_break,
+            seed=seed,
         )
         self._fn_cache: dict = {}
         self.last_timings: dict[str, float] = {}
@@ -268,6 +272,8 @@ class BatchEngine:
             percentage_of_nodes_to_score=framework.percentage_of_nodes_to_score,
             trace=trace,
             dtype=dtype,
+            tie_break=framework.tie_break,
+            seed=framework.seed,
         )
         eng._unsupported_config = unsupported
         eng._framework = framework
@@ -327,10 +333,13 @@ class BatchEngine:
         all_pods: list[Obj],
         pending: list[Obj],
         namespaces: "list[Obj] | None" = None,
+        base_counter: int = 0,
     ) -> BatchResult:
         """One batch scheduling pass over ``pending`` (already in queue
         order).  Returns per-pod selections plus (trace mode) everything
-        needed to format the annotation trail."""
+        needed to format the annotation trail.  ``base_counter`` is the
+        framework's attempt counter for the round's first pod (keys the
+        reservoir tie-break draws)."""
         t0 = time.perf_counter()
         pr = E.encode(
             nodes,
@@ -342,6 +351,10 @@ class BatchEngine:
         )
         t1 = time.perf_counter()
         dp, dims = B.lower(pr, dtype=self.dtype)
+        if base_counter:
+            import jax.numpy as jnp
+
+            dp = dp._replace(tb_base=jnp.asarray(base_counter & 0xFFFFFFFF, dtype=jnp.uint32))
         key = (tuple(sorted(dims.items())), self.cfg)
         fn = self._fn_cache.get(key)
         t2 = time.perf_counter()

@@ -280,6 +280,82 @@ class ExtenderService:
                 totals[item["host"]] = totals.get(item["host"], 0) + int(item["score"]) * scale
         return totals
 
+    def run_preempt(
+        self, pod: Obj, node_to_victims: dict[str, list[Obj]]
+    ) -> dict[str, list[Obj]]:
+        """Upstream Evaluator.callExtenders: each preempt-verb extender
+        narrows the candidate node→victims map.  A failing extender is
+        skipped when ignorable, otherwise the error propagates
+        (ExtenderError) and the preemption attempt fails."""
+        candidates = node_to_victims
+
+        def _uid(v: Obj) -> str:
+            return (
+                v["metadata"].get("uid")
+                or f"{v['metadata'].get('namespace', 'default')}/{v['metadata']['name']}"
+            )
+
+        for i, ext in enumerate(self.extenders):
+            if not ext.preempt_verb or not candidates:
+                continue
+            if not ext.is_interested(pod):
+                continue
+            if ext.node_cache_capable:
+                # upstream ProcessPreemption sends uid-only meta victims to
+                # nodeCacheCapable extenders
+                args: Obj = {
+                    "pod": pod,
+                    "nodeNameToMetaVictims": {
+                        nm: {"pods": [{"uid": _uid(v)} for v in victims], "numPDBViolations": 0}
+                        for nm, victims in candidates.items()
+                    },
+                }
+            else:
+                args = {
+                    "pod": pod,
+                    "nodeNameToVictims": {
+                        nm: {"pods": victims, "numPDBViolations": 0}
+                        for nm, victims in candidates.items()
+                    },
+                }
+            try:
+                result = self.preempt(i, args) or {}
+            except Exception as e:
+                if ext.ignorable:
+                    continue
+                raise ExtenderError(f"extender {ext.name} preempt: {e}") from e
+            narrowed = result.get("nodeNameToVictims")
+            if narrowed is None:
+                narrowed = result.get("nodeNameToMetaVictims")
+            if narrowed is None:
+                continue  # extender expressed no opinion
+            # an empty map is an explicit all-veto, not "no opinion"
+            by_uid = {_uid(v): v for victims in candidates.values() for v in victims}
+
+            def resolve(entry: Any) -> list[Obj]:
+                pods = (entry or {}).get("pods") or []
+                out: list[Obj] = []
+                for p in pods:
+                    if "metadata" in p:  # full victims response
+                        out.append(p)
+                    else:  # meta victims: {"uid": ...}
+                        v = by_uid.get(p.get("uid", ""))
+                        if v is not None:
+                            out.append(v)
+                return out
+
+            # A node whose returned victims are empty/unresolvable is
+            # dropped (upstream errors "expected at least one victim pod on
+            # node"); victims the extender didn't approve are never used.
+            candidates = {
+                nm: victims
+                for nm, entry in narrowed.items()
+                if nm in candidates
+                for victims in [resolve(entry)]
+                if victims
+            }
+        return candidates
+
     def find_binder(self, pod: Obj) -> "tuple[int, HTTPExtender] | None":
         for i, ext in enumerate(self.extenders):
             if ext.is_binder() and ext.is_interested(pod):

@@ -13,7 +13,6 @@ results as tensors; this runner is the semantic oracle.
 
 from __future__ import annotations
 
-import random
 from typing import Any
 
 from kube_scheduler_simulator_tpu.models.framework import Code, CycleState, PreFilterResult, Status
@@ -96,12 +95,17 @@ class Framework:
         handle.framework = self
         self.score_weights = dict(score_weights or {})
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
-        self.rng = random.Random(seed)
+        self.seed = seed
         self.next_start_node_index = 0
+        # Number of schedule_one attempts so far; keys the tie-break draw
+        # (utils/hashing.py) so the batch kernel — which processes pod i of
+        # a round as attempt sched_counter+i — makes the identical pick.
+        self.sched_counter = 0
         self.profile_name = profile_name
-        # "reservoir" = upstream selectHost semantics (seeded PRNG);
-        # "first" = deterministic first-max, matching the batch engine's
-        # argmax — used by parity tests.
+        # "reservoir" = upstream selectHost semantics (uniform over tied
+        # maxima), made deterministic via a counter-keyed hash draw shared
+        # with the batch kernel; "first" = first-max in visit order,
+        # matching the batch engine's argmax — used by parity tests.
         self.tie_break = tie_break
         # ExtenderService (scheduler/extender.py); None = no extenders.
         # Hooks mirror upstream: filter narrowing after plugin filters,
@@ -140,6 +144,10 @@ class Framework:
     def schedule_one(self, pod: Obj, snapshot: Snapshot) -> ScheduleResult:
         self.handle.set_snapshot(snapshot)
         state = CycleState()
+        # One attempt = one tie-break counter tick, consumed or not (the
+        # batch kernel ticks once per scan step the same way).
+        self._attempt = self.sched_counter
+        self.sched_counter += 1
 
         # PreFilter
         merged_result = PreFilterResult(None)
@@ -343,22 +351,26 @@ class Framework:
         return self._select_host(totals), None
 
     def _select_host(self, totals: dict[str, int]) -> str:
-        """Upstream selectHost: max score, reservoir-sampled tie-break
-        (reference mirrors it at scheduler/scheduler.go:323-344) — with a
-        seeded PRNG for reproducibility."""
+        """Upstream selectHost: max score, uniform tie-break over tied
+        maxima (reference mirrors the reservoir form at
+        scheduler/scheduler.go:323-344).  The pick is the k-th tied
+        candidate in visit order with k from the counter-keyed hash draw —
+        bit-identical to the batch kernel's selection (ops/batch.py)."""
         best_score: "int | None" = None
-        selected = ""
-        cnt = 0
+        tied: list[str] = []
         for name, score in totals.items():
             if best_score is None or score > best_score:
                 best_score = score
-                selected = name
-                cnt = 1
-            elif score == best_score and self.tie_break == "reservoir":
-                cnt += 1
-                if self.rng.randrange(cnt) == 0:
-                    selected = name
-        return selected
+                tied = [name]
+            elif score == best_score:
+                tied.append(name)
+        if not tied:
+            return ""
+        if self.tie_break != "reservoir" or len(tied) == 1:
+            return tied[0]
+        from kube_scheduler_simulator_tpu.utils.hashing import tie_break_draw
+
+        return tied[tie_break_draw(self.seed, self._attempt) % len(tied)]
 
     def _unreserve(self, state: CycleState, pod: Obj, node_name: str) -> None:
         for wp in reversed(self.plugins["reserve"]):

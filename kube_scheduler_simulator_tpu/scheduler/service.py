@@ -258,7 +258,9 @@ class SchedulerService:
         With use_batch enabled, whole rounds run through the TPU batch
         engine when possible (identical outcomes: batch results are only
         committed when every pod found a node, so the sequential-only
-        preemption path never diverges)."""
+        preemption path never diverges; tie-breaks use the counter-keyed
+        draw both paths share, so the same workload/seed places pods on
+        the same nodes whichever path a round takes)."""
         assert self.framework is not None, "scheduler not started"
         if self.use_batch in ("auto", "force"):
             batch_results = self._schedule_pending_batch()
@@ -307,13 +309,20 @@ class SchedulerService:
         if not ok:
             return None
         result = eng.schedule(
-            nodes, self.cluster_store.list("pods"), pending, self.cluster_store.list("namespaces")
+            nodes,
+            self.cluster_store.list("pods"),
+            pending,
+            self.cluster_store.list("namespaces"),
+            base_counter=fw.sched_counter,
         )
         failed = [i for i, s in enumerate(result.selected) if s < 0]
         if failed and self.use_batch != "force":
             has_preemption = bool(fw.plugins["post_filter"])
             if has_preemption:
                 return None  # preemption is host-side; run the exact cycle
+        # The batch round consumed one attempt per pending pod; keep the
+        # sequential path's tie-break counters in sync for later rounds.
+        fw.sched_counter += len(pending)
         return self._commit_batch_round(result)
 
     def _commit_batch_round(self, result: Any) -> dict[str, ScheduleResult]:

@@ -274,6 +274,11 @@ def _make_handler(server: SimulatorServer):
                     handler.wfile.flush()
 
             try:
+                # No heartbeat: this endpoint carries the reference's exact
+                # wire format (WatchEvent JSON lines only, streamwriter.go:
+                # 41-50), so probe bytes must not be injected.  Like the
+                # reference, a dead idle client is only detected at the
+                # next event write (or at server stop).
                 di.resource_watcher_service().list_watch(ChunkedStream(), lrv, stop=server._stop)
             finally:
                 try:

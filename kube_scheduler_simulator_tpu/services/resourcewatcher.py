@@ -74,16 +74,19 @@ class ResourceWatcherService:
         last_resource_versions: "Mapping[str, str] | None" = None,
         stop: "threading.Event | None" = None,
         dumps=None,
-        heartbeat_s: float = 15.0,
+        heartbeat_s: "float | None" = None,
     ) -> None:
         """Stream events until the client disconnects (write raises) or
         ``stop`` is set.  ``last_resource_versions`` maps store kind →
         resourceVersion string (empty/absent/non-numeric = list first).
 
-        Idle connections get a blank-line heartbeat every ``heartbeat_s``
-        so dead sockets are detected (and the subscription released) even
-        when no events flow; the per-client queue is bounded, so a stuck
-        client can't hold unbounded event copies."""
+        ``heartbeat_s`` is opt-in (default off): the reference's stream
+        carries only WatchEvent JSON lines (streamwriter.go:41-50), so a
+        probe must not be injected into streams strict clients parse.  When
+        enabled, idle connections get a blank-line probe every
+        ``heartbeat_s`` so dead sockets are detected (and the subscription
+        released) even when no events flow; the per-client queue is
+        bounded, so a stuck client can't hold unbounded event copies."""
         import json as _json
 
         lrv = dict(last_resource_versions or {})
@@ -101,9 +104,10 @@ class ResourceWatcherService:
             try:
                 events.put_nowait({"Kind": ev.kind, "EventType": ev.type, "Obj": ev.obj})
             except queue.Full:
-                # Stuck/dead client: drop; the heartbeat will detect a dead
-                # socket and a live-but-lagging client must reconnect+relist
-                # (the same contract as an expired watch resourceVersion).
+                # Stuck/dead client: drop.  A live-but-lagging client must
+                # reconnect+relist (the same contract as an expired watch
+                # resourceVersion); a dead socket is detected at the next
+                # write — or by the opt-in heartbeat probe on idle streams.
                 pass
 
         unsubscribe = self.cluster_store.subscribe(list(WATCH_KINDS), on_event)
@@ -146,7 +150,7 @@ class ResourceWatcherService:
                 try:
                     ev = events.get(timeout=0.25)
                 except queue.Empty:
-                    if _time.monotonic() - last_write >= heartbeat_s:
+                    if heartbeat_s is not None and _time.monotonic() - last_write >= heartbeat_s:
                         writer.write_raw(b"\n")  # probes for a dead socket
                         last_write = _time.monotonic()
                     continue

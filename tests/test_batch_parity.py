@@ -70,7 +70,7 @@ def profile_with(plugin_names: list[str]) -> Obj:
     }
 
 
-def run_both(nodes, pods, profile_plugins=None, namespaces=None):
+def run_both(nodes, pods, profile_plugins=None, namespaces=None, tie_break="first", seed=0):
     """Run the sequential oracle and the batch engine on the same snapshot;
     return (oracle results dict, BatchResult, service)."""
     store = ClusterStore()
@@ -87,7 +87,7 @@ def run_both(nodes, pods, profile_plugins=None, namespaces=None):
     else:
         cfg = {"percentageOfNodesToScore": 100}
 
-    svc = SchedulerService(store, tie_break="first")
+    svc = SchedulerService(store, tie_break=tie_break, seed=seed)
     svc.start_scheduler(cfg)
     fw = svc.framework
 
@@ -129,6 +129,53 @@ def assert_parity(oracle, batch, svc=None, check_scores: bool = True):
 
 
 # --------------------------------------------------------------- config 1
+
+
+def test_reservoir_tie_break_parity():
+    """Default tie handling ("reservoir" = counter-keyed uniform draw over
+    tied maxima) must pick the same node in the batch kernel and the
+    sequential cycle — identical nodes maximize ties."""
+    random.seed(7)
+    for seed in (0, 1, 12345):
+        nodes = [mk_node(f"node-{i}", cpu_m=64000, mem_mi=65536) for i in range(9)]
+        pods = [mk_pod(f"pod-{i}", cpu_m=100, mem_mi=128) for i in range(24)]
+        oracle, batch, svc = run_both(
+            nodes, pods, ["NodeResourcesFit"], tie_break="reservoir", seed=seed
+        )
+        assert_parity(oracle, batch, svc)
+        # the draw must actually spread pods (not degenerate to first-max)
+        picked = {r.selected_node for r in oracle.values()}
+        assert len(picked) > 2, f"seed {seed} placed everything on {picked}"
+
+
+def test_reservoir_batch_vs_sequential_service_paths():
+    """The same SchedulerService workload/seed must yield identical
+    placements whether a round runs via the batch engine or sequentially
+    (the round-1 advisor finding: path choice must not change outcomes)."""
+
+    def build() -> ClusterStore:
+        store = ClusterStore()
+        for i in range(8):
+            store.create("nodes", mk_node(f"node-{i}", cpu_m=32000, mem_mi=32768))
+        for i in range(20):
+            store.create("pods", mk_pod(f"pod-{i}", cpu_m=100, mem_mi=128))
+        return store
+
+    cfg = {"profiles": [profile_with(["NodeResourcesFit"])], "percentageOfNodesToScore": 100}
+    store_seq = build()
+    svc_seq = SchedulerService(store_seq, seed=3, use_batch="off")
+    svc_seq.start_scheduler(cfg)
+    svc_seq.schedule_pending(max_rounds=1)
+
+    store_bat = build()
+    svc_bat = SchedulerService(store_bat, seed=3, use_batch="auto", batch_min_work=0)
+    svc_bat.start_scheduler(cfg)
+    svc_bat.schedule_pending(max_rounds=1)
+
+    for i in range(20):
+        seq = store_seq.get("pods", f"pod-{i}")["spec"].get("nodeName")
+        bat = store_bat.get("pods", f"pod-{i}")["spec"].get("nodeName")
+        assert seq == bat, f"pod-{i}: sequential={seq} batch={bat}"
 
 
 def test_fit_only_small():

@@ -57,6 +57,101 @@ def test_deployment_creates_replicaset_and_pods():
     cm.stop()
 
 
+def test_cascade_gc_on_observed_deletion_only():
+    """Deleting a Deployment cascades to its RS and pods (observed
+    deletions), but pods whose ownerReference points at a never-seen owner
+    — the snapshot-import case, where pods are applied without their
+    replicasets — must survive every reconcile."""
+    store = ClusterStore()
+    cm = ControllerManager(store)
+    cm.start()
+
+    # An imported pod with a dangling RS ownerReference, plus an unbound
+    # PVC so the reconcile fast path doesn't mask the GC behavior.
+    store.create(
+        "persistentvolumeclaims",
+        {"metadata": {"name": "claim"}, "spec": {"storageClassName": "none"}},
+    )
+    store.create(
+        "pods",
+        {
+            "metadata": {
+                "name": "imported",
+                "ownerReferences": [
+                    {"kind": "ReplicaSet", "uid": "never-seen-uid", "controller": True}
+                ],
+            },
+            "spec": {"containers": [{"name": "c"}]},
+        },
+    )
+    assert store.get("pods", "imported") is not None
+
+    store.create(
+        "deployments",
+        {
+            "metadata": {"name": "web"},
+            "spec": {
+                "replicas": 2,
+                "selector": {"matchLabels": {"app": "web"}},
+                "template": {
+                    "metadata": {"labels": {"app": "web"}},
+                    "spec": {"containers": [{"name": "c"}]},
+                },
+            },
+        },
+    )
+    assert len(store.list("replicasets")) == 1
+    owned = [
+        p for p in store.list("pods") if p["metadata"].get("ownerReferences", [{}])[0].get("name")
+    ]
+    assert len(owned) == 2
+
+    # observed deletion → full cascade; the imported pod still survives
+    store.delete("deployments", "web")
+    cm.reconcile_all()
+    assert store.list("replicasets") == []
+    remaining = [p["metadata"]["name"] for p in store.list("pods")]
+    assert remaining == ["imported"]
+    cm.stop()
+
+
+def test_surplus_owned_pod_triggers_scale_down():
+    """A user-created pod carrying an existing RS's controller ref makes
+    the RS over-replicated; the ADDED event must trigger reconcile."""
+    store = ClusterStore()
+    cm = ControllerManager(store)
+    cm.start()
+    store.create(
+        "replicasets",
+        {
+            "metadata": {"name": "rs", "labels": {"app": "a"}},
+            "spec": {
+                "replicas": 2,
+                "selector": {"matchLabels": {"app": "a"}},
+                "template": {"metadata": {"labels": {"app": "a"}}, "spec": {"containers": [{"name": "c"}]}},
+            },
+        },
+    )
+    assert len(store.list("pods")) == 2
+    rs_uid = store.list("replicasets")[0]["metadata"]["uid"]
+    store.create(
+        "pods",
+        {
+            "metadata": {
+                "name": "extra",
+                "labels": {"app": "a"},
+                "ownerReferences": [
+                    {"kind": "ReplicaSet", "name": "rs", "uid": rs_uid, "controller": True}
+                ],
+            },
+            "spec": {"containers": [{"name": "c"}]},
+        },
+    )
+    # surplus detected on the ADDED event: back to 2 owned pods
+    assert len(store.list("pods")) == 2
+    cm.stop()
+
+
 def test_pv_controller_binds_claims():
     store = ClusterStore()
     cm = ControllerManager(store)

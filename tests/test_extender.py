@@ -49,6 +49,14 @@ class FakeExtender(BaseHTTPRequestHandler):
                 {"host": n["metadata"]["name"], "score": 10 if n["metadata"]["name"] == "node-preferred" else 0}
                 for n in items
             ]
+        elif self.path.endswith("/preempt"):
+            # keep only candidate nodes NOT ending in -vetoed, victims as-is
+            narrowed = {
+                nm: entry
+                for nm, entry in (args.get("nodeNameToVictims") or {}).items()
+                if not nm.endswith("-vetoed")
+            }
+            out = {"nodeNameToVictims": narrowed}
         elif self.path.endswith("/bind"):
             out = {}
         else:
@@ -167,6 +175,72 @@ def test_extender_down_fails_attempt_unless_ignorable():
     )
     results2 = svc2.schedule_pending(max_rounds=1)
     assert results2["default/p1"].selected_node == "node-ok"
+
+
+def test_extender_preempt_narrows_candidates(fake_extender):
+    """In-process preemption must round-trip through preempt-verb extenders
+    (upstream Evaluator.callExtenders): the extender vetoes one candidate
+    node, so the victim on the other node is evicted instead."""
+    store = ClusterStore()
+    for nm in ("node-a-vetoed", "node-b"):
+        n = _node(nm)
+        n["status"]["allocatable"] = {"cpu": "1000m", "memory": "8Gi", "pods": "110"}
+        store.create("nodes", n)
+        victim = _pod(f"victim-{nm}")
+        victim["spec"]["containers"][0]["resources"]["requests"] = {"cpu": "900m"}
+        victim["spec"]["priority"] = 0
+        victim["spec"]["nodeName"] = nm
+        store.create("pods", victim)
+    urgent = _pod("urgent")
+    urgent["spec"]["containers"][0]["resources"]["requests"] = {"cpu": "900m"}
+    urgent["spec"]["priority"] = 100
+    store.create("pods", urgent)
+
+    svc = SchedulerService(store, tie_break="first")
+    svc.start_scheduler(
+        {"extenders": [{"urlPrefix": fake_extender, "preemptVerb": "preempt"}]}
+    )
+    results = svc.schedule_pending(max_rounds=1)
+    res = results["default/urgent"]
+    # without the extender the name tie-break would evict on node-a-vetoed
+    assert res.nominated_node == "node-b"
+    assert store.get("pods", "victim-node-a-vetoed") is not None
+    with pytest.raises(KeyError):
+        store.get("pods", "victim-node-b")
+    # the preempt round-trip was recorded on the pod's annotations
+    annos = store.get("pods", "urgent")["metadata"].get("annotations") or {}
+    preempt_result = json.loads(annos["scheduler-simulator/extender-preempt-result"])
+    assert "node-b" in preempt_result[fake_extender]["nodeNameToVictims"]
+    assert "node-a-vetoed" not in preempt_result[fake_extender]["nodeNameToVictims"]
+
+
+def test_extender_preempt_all_veto_aborts(fake_extender):
+    """An extender returning an EMPTY victims map is an explicit all-veto:
+    preemption finds no candidate and nothing is evicted."""
+    store = ClusterStore()
+    for nm in ("node-x-vetoed", "node-y-vetoed"):
+        n = _node(nm)
+        n["status"]["allocatable"] = {"cpu": "1000m", "memory": "8Gi", "pods": "110"}
+        store.create("nodes", n)
+        victim = _pod(f"victim-{nm}")
+        victim["spec"]["containers"][0]["resources"]["requests"] = {"cpu": "900m"}
+        victim["spec"]["priority"] = 0
+        victim["spec"]["nodeName"] = nm
+        store.create("pods", victim)
+    urgent = _pod("urgent")
+    urgent["spec"]["containers"][0]["resources"]["requests"] = {"cpu": "900m"}
+    urgent["spec"]["priority"] = 100
+    store.create("pods", urgent)
+
+    svc = SchedulerService(store, tie_break="first")
+    svc.start_scheduler(
+        {"extenders": [{"urlPrefix": fake_extender, "preemptVerb": "preempt"}]}
+    )
+    results = svc.schedule_pending(max_rounds=1)
+    res = results["default/urgent"]
+    assert not res.success and res.nominated_node is None
+    assert store.get("pods", "victim-node-x-vetoed") is not None
+    assert store.get("pods", "victim-node-y-vetoed") is not None
 
 
 def test_override_extenders_cfg():
