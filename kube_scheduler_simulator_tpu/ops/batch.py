@@ -107,6 +107,12 @@ class DeviceProblem(NamedTuple):
     ip_self_match: Any    # [P] bool
     pod_active: Any       # [P] bool (False = padding row, never committed)
     tb_base: Any          # [] uint32: attempt counter of the round's first pod
+    # Feasible-node sampling (upstream numFeasibleNodesToFind + rotating
+    # start index, mirrored from framework_runner.schedule_one's filter
+    # loop).  All three are traced scalars: value changes don't recompile.
+    sample_k: Any         # [] int32: stop after this many feasible nodes
+    start0: Any           # [] int32: rotation start index for the first pod
+    n_true: Any           # [] int32: real node count (modulus; N minus padding)
     # Per-used-topology-key expansion data.  Domain-level [D+1] vectors are
     # expanded to node vectors WITHOUT per-element gathers of the mutable
     # carry (XLA serializes those inside the scan, ~10x slower):
@@ -216,6 +222,9 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
         ip_self_match=b(pr.ip_self_match),
         pod_active=b(getattr(pr, "pod_active", np.ones(pr.P, dtype=bool))),
         tb_base=jnp.asarray(0, dtype=jnp.uint32),
+        sample_k=jnp.asarray(pr.N, dtype=jnp.int32),
+        start0=jnp.asarray(0, dtype=jnp.int32),
+        n_true=jnp.asarray(pr.N, dtype=jnp.int32),
         key_valid=tuple(b(v) for v in key_valid),
         key_oh=tuple(f(o) for o in key_oh),
         g_ku=i32(g_ku),
@@ -318,7 +327,7 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
         return lax.switch(u, [lambda v, uu=uu: expand_u(uu, v, dp) for uu in range(KU)], vec)
 
     def step(dp: DeviceProblem, carry, xs):
-        requested, nonzero, pod_count, spread_counts, ip_sel, ip_own, ip_anti = carry
+        requested, nonzero, pod_count, spread_counts, ip_sel, ip_own, ip_anti, start = carry
         i = xs
         dt = requested.dtype
         pod_req = dp.pod_req[i]
@@ -433,7 +442,32 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
             else:  # kernel inactive for this problem (no constraints)
                 codes[name] = jnp.zeros(N, dtype=jnp.int32)
 
-        count = jnp.sum(feasible.astype(jnp.int32)) * dp.pod_active[i]
+        # ------------------------------------------- feasible-node sampling
+        # Upstream visits nodes from a rotating start index and stops after
+        # sample_k feasible ones (framework_runner.schedule_one); here the
+        # visit order is expressed as a per-node rank r = (n - start) mod
+        # n_true, and "the first K feasible in visit order" falls out of a
+        # windowed prefix sum — no gathers, everything elementwise.
+        nt = dp.n_true
+        K = dp.sample_k
+        idx = jnp.arange(N, dtype=jnp.int32)
+        r = jnp.where(idx >= start, idx - start, idx - start + nt)  # visit rank
+
+        def rot_cumsum(mask):
+            """c[n] = number of True entries with visit rank <= r[n] (a
+            cumsum in rotation order), plus the total count."""
+            pref = jnp.cumsum(mask.astype(jnp.int32))
+            tot = pref[N - 1]
+            ps = jnp.where(start == 0, 0, jnp.take(pref, jnp.maximum(start - 1, 0)))
+            return jnp.where(idx >= start, pref - ps, pref + (tot - ps)), tot
+
+        c, total = rot_cumsum(feasible)
+        sampled = feasible & (c <= K)
+        # nodes actually visited: up to and including the K-th feasible one
+        processed = jnp.where(
+            total >= K, jnp.sum(jnp.where(feasible & (c == K), r + 1, 0)), nt
+        )
+        count = jnp.minimum(total, K) * dp.pod_active[i]
 
         # ----------------------------------------------------------- scores
         raws = {}
@@ -461,10 +495,10 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
                 norm = raw
             elif name == "TaintToleration":
                 raw = dp.taint_prefer[i]
-                norm = _default_normalize(raw, feasible, reverse=True)
+                norm = _default_normalize(raw, sampled, reverse=True)
             elif name == "NodeAffinity":
                 raw = dp.aff_pref[i]
-                norm = _default_normalize(raw, feasible, reverse=False)
+                norm = _default_normalize(raw, sampled, reverse=False)
             elif name == "PodTopologySpread" and use_spread_s:
                 key_row, grp_row, skew_row, self_row = dp.sps
                 has_constraints = key_row[i, 0] >= 0
@@ -482,7 +516,7 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
                     m = jnp.take(spread_counts, grp_row[i, k], axis=0)
                     contributing = has_all & (dom >= 0)
                     mc = jnp.where(contributing, m, 0.0)
-                    fni = feasible & has_all & (dom >= 0)
+                    fni = sampled & has_all & (dom >= 0)
 
                     def score_branch(u):
                         def br(operands):
@@ -508,7 +542,7 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
                     raw_f = raw_f + jnp.where(active, cnt * w + (skew_row[i, k] - 1.0), 0.0)
                 raw = jnp.round(raw_f)
                 ignored = ~has_all
-                considered = feasible & ~ignored
+                considered = sampled & ~ignored
                 mn = jnp.min(jnp.where(considered, raw, jnp.inf))
                 mx = jnp.max(jnp.where(considered, raw, -jnp.inf))
                 any_considered = jnp.any(considered)
@@ -533,7 +567,7 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
                     w = dp.ip_pref_w[i, k]
                     cnt = expand_switch(dp.g_ku[gs], ip_sel[gs], dp)
                     raw = raw + jnp.where(active, w * cnt, 0.0)
-                norm = _minmax_normalize(raw, feasible)
+                norm = _minmax_normalize(raw, sampled)
             else:
                 raw = jnp.zeros(N, dtype=dt)
                 norm = raw
@@ -543,23 +577,25 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
             totals = totals + norm * float(weight)
 
         # Single-feasible-node bypass: scores are skipped (annotations omit
-        # them); selection is the lone feasible node either way.
-        masked = jnp.where(feasible, totals, NEG)
+        # them); selection is the lone feasible node either way.  Ties are
+        # ordered by VISIT rank (the sequential cycle iterates feasible
+        # nodes in rotation order), not node index.
+        masked = jnp.where(sampled, totals, NEG)
+        mx = jnp.max(masked)
+        tied = sampled & (masked == mx)
         if cfg.tie_break == "reservoir":
             # k-th tied max in visit order, k from the counter-keyed draw —
             # the same pick the sequential _select_host makes for attempt
             # tb_base + i (utils/hashing.py).
-            mx = jnp.max(masked)
-            tied = feasible & (masked == mx)
-            ties = jnp.cumsum(tied.astype(jnp.int32))
-            t_count = ties[-1]
+            ct, t_count = rot_cumsum(tied)
             counter = dp.tb_base + i.astype(jnp.uint32)
             seed_mix = _mix32(jnp.uint32((cfg.seed ^ 0x9E3779B9) & 0xFFFFFFFF))
             draw = _mix32(seed_mix ^ _mix32(counter))
             k = (draw % jnp.maximum(t_count, 1).astype(jnp.uint32)).astype(jnp.int32)
-            sel = jnp.argmax(tied & (ties == k + 1)).astype(jnp.int32)
+            sel = jnp.argmax(tied & (ct == k + 1)).astype(jnp.int32)
         else:
-            sel = jnp.argmax(masked).astype(jnp.int32)
+            # first tied max in visit order = minimal visit rank
+            sel = jnp.argmin(jnp.where(tied, r, jnp.int32(2) * nt + N)).astype(jnp.int32)
         sel = jnp.where(count > 0, sel, -1)
 
         # ----------------------------------------------------------- commit
@@ -591,10 +627,19 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
                 dd = jnp.where((dd >= 0) & active, dd, D)
                 ip_anti = ip_anti.at[gs, dd].add(jnp.where(active, 1.0, 0.0))
 
-        carry = (requested, nonzero, pod_count, spread_counts, ip_sel, ip_own, ip_anti)
-        out = {"selected": sel, "feasible_count": count}
+        # the rotating start advances by the number of visited nodes
+        # (upstream: next_start_node_index = (start + processed) % n)
+        next_start = jnp.where(nt > 0, (start + processed) % jnp.maximum(nt, 1), 0)
+        next_start = jnp.where(dp.pod_active[i], next_start, start)
+        carry = (requested, nonzero, pod_count, spread_counts, ip_sel, ip_own, ip_anti, next_start)
+        out = {
+            "selected": sel,
+            "feasible_count": count,
+            "sample_start": start,
+            "sample_processed": processed,
+        }
         if cfg.trace:
-            out["feasible"] = feasible
+            out["feasible"] = sampled
             out["totals"] = totals
             for n_, c_ in codes.items():
                 out[f"code:{n_}"] = c_
@@ -612,10 +657,12 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
             dp.ip_sel0,
             dp.ip_own0,
             dp.ip_anti0,
+            dp.start0,
         )
         carry, ys = lax.scan(functools.partial(step, dp), carry0, jnp.arange(P))
         ys["final_requested"] = carry[0]
         ys["final_pod_count"] = carry[2]
+        ys["final_start"] = carry[-1]
         return ys
 
     return jax.jit(run)

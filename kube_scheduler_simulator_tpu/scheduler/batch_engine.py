@@ -87,10 +87,25 @@ class BatchResult:
     def selected_nodes(self) -> "list[str | None]":
         return [self.node_names[s] if s >= 0 else None for s in self.selected]
 
+    @property
+    def final_start(self) -> int:
+        """next_start_node_index after this round (rotating sample start)."""
+        return int(np.asarray(self.out["final_start"]))
+
     def assignments(self) -> dict[str, "str | None"]:
         return dict(zip(self.pod_keys, self.selected_nodes))
 
     # ------------------------------------------------------------ trace
+
+    def visited(self, i: int) -> "np.ndarray":
+        """[N] bool: nodes the sampled filter pass actually visited for pod
+        i (upstream stops at numFeasibleNodesToFind; unvisited nodes never
+        appear in diagnosis or the filter annotation)."""
+        start = int(np.asarray(self.out["sample_start"])[i])
+        processed = int(np.asarray(self.out["sample_processed"])[i])
+        nt = self.problem.N
+        rank = (np.arange(nt) - start) % max(nt, 1)
+        return rank < processed
 
     def filter_annotation(self, i: int) -> dict:
         """The scheduler-simulator/filter-result map for pod i: node →
@@ -98,7 +113,8 @@ class BatchResult:
         short circuit of the sequential cycle."""
         assert self._engine.cfg.trace, "run with trace=True for annotations"
         pr, out = self.problem, self.out
-        nodes = self._prefilter_nodes(i)
+        visited = self.visited(i)
+        nodes = [n for n in self._prefilter_nodes(i) if visited[n]]
         result: dict = {}
         for n in nodes:
             nm = pr.node_names[n]
@@ -145,7 +161,8 @@ class BatchResult:
         assert self._engine.cfg.trace
         pr, out = self.problem, self.out
         diag: dict[str, Status] = {}
-        for n in self._prefilter_nodes(i):
+        visited = self.visited(i)
+        for n in (n for n in self._prefilter_nodes(i) if visited[n]):
             for plugin in self._engine.cfg.filters:
                 code = int(np.asarray(out[f"code:{plugin}"])[i, n])
                 if code != 0:  # only kernel plugins can fail (others no-op)
@@ -285,18 +302,29 @@ class BatchEngine:
         """Can this profile × workload run fully on the batch path?"""
         if self._unsupported_config:
             return False, self._unsupported_config
-        # Upstream feasible-node sampling (numFeasibleNodesToFind) kicks in
-        # at >= MIN_FEASIBLE_NODES_TO_FIND nodes unless
-        # percentageOfNodesToScore >= 100; the batch kernel always scores
-        # every node, so fall back when sampling would change the oracle.
+        # Feasible-node sampling (numFeasibleNodesToFind + rotating start)
+        # runs IN the kernel.  The one case it can't express is a PreFilter
+        # that narrows the node list while sampling is active: upstream
+        # rotates over the narrowed list, desynchronizing the shared start
+        # index from the kernel's all-nodes rotation.
         from kube_scheduler_simulator_tpu.scheduler.framework_runner import (
             MIN_FEASIBLE_NODES_TO_FIND,
         )
 
-        if len(nodes) >= MIN_FEASIBLE_NODES_TO_FIND and not (self.percentage_of_nodes_to_score >= 100):
+        sampling = (
+            len(nodes) >= MIN_FEASIBLE_NODES_TO_FIND
+            and self.percentage_of_nodes_to_score < 100
+        )
+        # A nonzero rotating start (left by earlier sampled rounds) rotates
+        # the sequential oracle over the NARROWED list modulus, which the
+        # kernel's all-nodes rotation can't express either.
+        start = getattr(getattr(self, "_framework", None), "next_start_node_index", 0)
+        if (sampling or start != 0) and any(
+            self.prefilter_node_names(p) is not None for p in pending
+        ):
             return False, (
-                f"percentageOfNodesToScore={self.percentage_of_nodes_to_score} "
-                f"samples feasible nodes at {len(nodes)} nodes"
+                "PreFilter node narrowing while feasible-node sampling (or a "
+                "rotated start index) is active"
             )
         # the Fit filter's reason bitmask covers at most 30 resource columns
         from kube_scheduler_simulator_tpu.ops.encode import _fit_resources
@@ -334,12 +362,18 @@ class BatchEngine:
         pending: list[Obj],
         namespaces: "list[Obj] | None" = None,
         base_counter: int = 0,
+        start_index: int = 0,
     ) -> BatchResult:
         """One batch scheduling pass over ``pending`` (already in queue
         order).  Returns per-pod selections plus (trace mode) everything
         needed to format the annotation trail.  ``base_counter`` is the
         framework's attempt counter for the round's first pod (keys the
-        reservoir tie-break draws)."""
+        reservoir tie-break draws); ``start_index`` is the framework's
+        rotating next_start_node_index at round start."""
+        from kube_scheduler_simulator_tpu.scheduler.framework_runner import (
+            num_feasible_nodes_to_find,
+        )
+
         t0 = time.perf_counter()
         pr = E.encode(
             nodes,
@@ -351,10 +385,16 @@ class BatchEngine:
         )
         t1 = time.perf_counter()
         dp, dims = B.lower(pr, dtype=self.dtype)
-        if base_counter:
-            import jax.numpy as jnp
+        import jax.numpy as jnp
 
-            dp = dp._replace(tb_base=jnp.asarray(base_counter & 0xFFFFFFFF, dtype=jnp.uint32))
+        dp = dp._replace(
+            tb_base=jnp.asarray(base_counter & 0xFFFFFFFF, dtype=jnp.uint32),
+            sample_k=jnp.asarray(
+                num_feasible_nodes_to_find(len(nodes), self.percentage_of_nodes_to_score),
+                dtype=jnp.int32,
+            ),
+            start0=jnp.asarray(start_index % max(len(nodes), 1), dtype=jnp.int32),
+        )
         key = (tuple(sorted(dims.items())), self.cfg)
         fn = self._fn_cache.get(key)
         t2 = time.perf_counter()

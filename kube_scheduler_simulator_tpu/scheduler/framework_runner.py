@@ -26,6 +26,22 @@ MIN_FEASIBLE_NODES_TO_FIND = 100
 MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5
 
 
+def num_feasible_nodes_to_find(num_all_nodes: int, percentage: int) -> int:
+    """Upstream sched.numFeasibleNodesToFind (module-level so the batch
+    engine computes the identical sample cap, scheduler/batch_engine.py)."""
+    if num_all_nodes < MIN_FEASIBLE_NODES_TO_FIND or percentage >= 100:
+        return num_all_nodes
+    adaptive = percentage
+    if adaptive <= 0:
+        adaptive = 50 - num_all_nodes // 125
+        if adaptive < MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND:
+            adaptive = MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND
+    num_nodes = num_all_nodes * adaptive // 100
+    if num_nodes < MIN_FEASIBLE_NODES_TO_FIND:
+        return MIN_FEASIBLE_NODES_TO_FIND
+    return num_nodes
+
+
 class FrameworkHandle:
     """What plugins can reach (upstream framework.Handle analog)."""
 
@@ -117,18 +133,7 @@ class Framework:
 
     def num_feasible_nodes_to_find(self, num_all_nodes: int) -> int:
         """Upstream sched.numFeasibleNodesToFind."""
-        pct = self.percentage_of_nodes_to_score
-        if num_all_nodes < MIN_FEASIBLE_NODES_TO_FIND or pct >= 100:
-            return num_all_nodes
-        adaptive = pct
-        if adaptive <= 0:
-            adaptive = 50 - num_all_nodes // 125
-            if adaptive < MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND:
-                adaptive = MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND
-        num_nodes = num_all_nodes * adaptive // 100
-        if num_nodes < MIN_FEASIBLE_NODES_TO_FIND:
-            return MIN_FEASIBLE_NODES_TO_FIND
-        return num_nodes
+        return num_feasible_nodes_to_find(num_all_nodes, self.percentage_of_nodes_to_score)
 
     def run_filter_plugins_silently(self, state: CycleState, pod: Obj, node_info: NodeInfo) -> bool:
         """Run the ORIGINAL filter plugins without recording (used by

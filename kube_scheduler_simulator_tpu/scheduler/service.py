@@ -314,6 +314,7 @@ class SchedulerService:
             pending,
             self.cluster_store.list("namespaces"),
             base_counter=fw.sched_counter,
+            start_index=fw.next_start_node_index,
         )
         failed = [i for i, s in enumerate(result.selected) if s < 0]
         if failed and self.use_batch != "force":
@@ -321,8 +322,10 @@ class SchedulerService:
             if has_preemption:
                 return None  # preemption is host-side; run the exact cycle
         # The batch round consumed one attempt per pending pod; keep the
-        # sequential path's tie-break counters in sync for later rounds.
+        # sequential path's tie-break counter and rotating sample start in
+        # sync for later rounds.
         fw.sched_counter += len(pending)
+        fw.next_start_node_index = result.final_start
         return self._commit_batch_round(result)
 
     def _commit_batch_round(self, result: Any) -> dict[str, ScheduleResult]:
