@@ -106,6 +106,7 @@ class DeviceProblem(NamedTuple):
     ip_own_w: Any         # [P,KO]
     ip_self_match: Any    # [P] bool
     pod_active: Any       # [P] bool (False = padding row, never committed)
+    node_active: Any      # [N] bool (False = padding column, never feasible)
     tb_base: Any          # [] uint32: attempt counter of the round's first pod
     # Feasible-node sampling (upstream numFeasibleNodesToFind + rotating
     # start index, mirrored from framework_runner.schedule_one's filter
@@ -220,11 +221,12 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
         ip_own_g=i32(pr.ip_own_g),
         ip_own_w=f(pr.ip_own_w),
         ip_self_match=b(pr.ip_self_match),
-        pod_active=b(getattr(pr, "pod_active", np.ones(pr.P, dtype=bool))),
+        pod_active=b(pr.pod_active),
+        node_active=b(pr.node_active),
         tb_base=jnp.asarray(0, dtype=jnp.uint32),
-        sample_k=jnp.asarray(pr.N, dtype=jnp.int32),
+        sample_k=jnp.asarray(pr.N_true, dtype=jnp.int32),
         start0=jnp.asarray(0, dtype=jnp.int32),
-        n_true=jnp.asarray(pr.N, dtype=jnp.int32),
+        n_true=jnp.asarray(pr.N_true, dtype=jnp.int32),
         key_valid=tuple(b(v) for v in key_valid),
         key_oh=tuple(f(o) for o in key_oh),
         g_ku=i32(g_ku),
@@ -289,11 +291,14 @@ def _minmax_normalize(raw, feasible):
 
 # ------------------------------------------------------------------- kernel
 
-def build_batch_fn(cfg: BatchConfig, dims: dict):
+def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
     """Build the jitted batch scheduling function for a static config/dims.
 
-    Returns fn(dp: DeviceProblem) → dict of result arrays.
-    """
+    Returns fn(dp: DeviceProblem) → dict of result arrays.  With
+    ``donate``, the DeviceProblem's buffers are donated — the initial
+    carry aliases into the scan carry instead of being copied; callers
+    must not reuse ``dp`` after the call (BatchEngine builds a fresh one
+    per round)."""
     P, N, D = dims["P"], dims["N"], dims["D"]
     KC, KS = dims["KC"], dims["KS"]
     KA, KB, KP, KO = dims["KA"], dims["KB"], dims["KP"], dims["KO"]
@@ -334,7 +339,7 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
         codes = {}  # plugin -> [N] reason code (0 = pass)
 
         # ---------------------------------------------------------- filters
-        feasible = jnp.ones(N, dtype=bool)
+        feasible = dp.node_active  # padding columns are never feasible
 
         def apply(name, code):
             nonlocal feasible
@@ -648,21 +653,41 @@ def build_batch_fn(cfg: BatchConfig, dims: dict):
                 out[f"norm:{n_}"] = norms[n_]
         return carry, out
 
-    def run(dp: DeviceProblem):
-        carry0 = (
-            dp.requested0,
-            dp.nonzero0,
-            dp.pod_count0,
-            dp.spread_counts0,
-            dp.ip_sel0,
-            dp.ip_own0,
-            dp.ip_anti0,
-            dp.start0,
-        )
+    def _scan(carry0, dp: DeviceProblem):
         carry, ys = lax.scan(functools.partial(step, dp), carry0, jnp.arange(P))
         ys["final_requested"] = carry[0]
         ys["final_pod_count"] = carry[2]
         ys["final_start"] = carry[-1]
+        return carry, ys
+
+    CARRY0_FIELDS = (
+        "requested0", "nonzero0", "pod_count0", "spread_counts0",
+        "ip_sel0", "ip_own0", "ip_anti0", "start0",
+    )
+
+    def run(dp: DeviceProblem):
+        carry0 = tuple(getattr(dp, f) for f in CARRY0_FIELDS)
+        _carry, ys = _scan(carry0, dp)
         return ys
 
-    return jax.jit(run)
+    if not donate:
+        return jax.jit(run)
+
+    # Donate ONLY the initial carry (as its own jit argument) and return
+    # the final carry so every donated buffer has an output to alias into
+    # — donating the whole DeviceProblem would warn about the feature
+    # matrices, which are pure inputs with nothing to alias.
+    def run_donate(carry0, dp: DeviceProblem):
+        carry, ys = _scan(carry0, dp)
+        ys["_final_carry"] = carry
+        return ys
+
+    jitted = jax.jit(run_donate, donate_argnums=(0,))
+
+    def fn(dp: DeviceProblem):
+        carry0 = tuple(getattr(dp, f) for f in CARRY0_FIELDS)
+        # the donated buffers must not also arrive through dp
+        slim = dp._replace(**{f: jnp.int32(0) for f in CARRY0_FIELDS})
+        return jitted(carry0, slim)
+
+    return fn

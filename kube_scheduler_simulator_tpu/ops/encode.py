@@ -627,4 +627,105 @@ def encode(
         selfm[i] = bool(gl) and all(term_match[g, i] for g in gl)
     pr.ip_self_match = selfm
 
+    # True (unpadded) sizes + all-active masks; pad_problem overwrites
+    # these, so every consumer can read them unconditionally.
+    pr.P_true, pr.N_true = P, N
+    pr.pod_active = np.ones(P, dtype=bool)
+    pr.node_active = np.ones(N, dtype=bool)
+
+    return pr
+
+
+# --------------------------------------------------------- shape bucketing
+
+def _bucket(x: int) -> int:
+    """Next size in the {2^k, 1.5·2^k} series (≤33% padding waste) — the
+    jit cache then sees O(log) distinct shapes as pods/nodes churn instead
+    of one compile per exact dimension (SURVEY §7 hard part (b))."""
+    if x <= 0:
+        return 0
+    if x <= 8:
+        return 8
+    k = math.ceil(math.log2(x))
+    mid = 3 * 2 ** (k - 2)
+    return mid if mid >= x else 2 ** k
+
+
+def _pad_axis(a: np.ndarray, axis: int, target: int, fill) -> np.ndarray:
+    a = np.asarray(a)
+    if a.shape[axis] >= target:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, target - a.shape[axis])
+    return np.pad(a, widths, constant_values=fill)
+
+
+def pad_problem(pr: BatchProblem) -> BatchProblem:
+    """Pad the pod/node/group axes of an encoded problem to bucket
+    boundaries, with ``pod_active``/``node_active`` masks so padding rows
+    never schedule and padded nodes are never feasible.  The unrolled
+    per-constraint dims (KC/KS/KA/KB/KP/KO) stay exact — padding them
+    would multiply kernel work, and they are workload-type-stable.  Host
+    metadata (node_names/pod_keys, P_true/N_true) keeps the true sizes."""
+    P, N = pr.P, pr.N
+    P_pad, N_pad = _bucket(P), _bucket(N)
+    SG_pad = _bucket(pr.SG) if pr.SG else pr.SG
+    G_pad = _bucket(pr.G) if pr.G else pr.G
+
+    pr.P_true, pr.N_true = P, N
+    pr.pod_active = _pad_axis(np.ones(P, dtype=bool), 0, P_pad, False)
+    pr.node_active = _pad_axis(np.ones(N, dtype=bool), 0, N_pad, False)
+
+    # pod axis (rows)
+    for name, fill in (
+        ("pod_req", 0), ("pod_nonzero", 0), ("fit_checked", False),
+        ("taint_fail", -1), ("taint_prefer", 0), ("unsched_ok", True),
+        ("aff_code", 0), ("aff_pref", 0), ("name_ok", True), ("incl", False),
+        ("spf_key", -1), ("spf_group", 0), ("spf_skew", 1), ("spf_self", 0),
+        ("sps_key", -1), ("sps_group", 0), ("sps_skew", 1), ("sps_self", 0),
+        ("ip_aff_g", -1), ("ip_anti_g", -1), ("ip_pref_g", -1), ("ip_pref_w", 0),
+        ("ip_own_g", -1), ("ip_own_w", 0), ("ip_self_match", False),
+    ):
+        setattr(pr, name, _pad_axis(getattr(pr, name), 0, P_pad, fill))
+    # pod axis as columns
+    pr.spread_match = _pad_axis(pr.spread_match, 1, P_pad, False)
+    pr.term_match = _pad_axis(pr.term_match, 1, P_pad, False)
+
+    # node axis
+    for name, fill in (
+        ("alloc", 0), ("max_pods", 0), ("nz_alloc", 0), ("requested0", 0),
+        ("nonzero0", 0), ("pod_count0", 0),
+    ):
+        setattr(pr, name, _pad_axis(getattr(pr, name), 0, N_pad, fill))
+    for name, fill in (
+        ("taint_fail", -1), ("taint_prefer", 0), ("unsched_ok", True),
+        ("aff_code", 0), ("aff_pref", 0), ("name_ok", True), ("incl", False),
+        ("node_domain", -1), ("spread_counts0", 0),
+    ):
+        setattr(pr, name, _pad_axis(getattr(pr, name), 1, N_pad, fill))
+
+    # group axes (rows of [SG,*] / [G,*] arrays; indices into them are
+    # unaffected, padding rows are simply never referenced)
+    if pr.SG and SG_pad > pr.SG:
+        pr.spread_match = _pad_axis(pr.spread_match, 0, SG_pad, False)
+        pr.spread_counts0 = _pad_axis(pr.spread_counts0, 0, SG_pad, 0)
+        pr.SG = SG_pad
+    if pr.G and G_pad > pr.G:
+        pr.term_match = _pad_axis(pr.term_match, 0, G_pad, False)
+        # fill with an already-used key so lower()'s used_keys set (hence
+        # KU/key_struct and per-step expansion work) doesn't grow
+        pr.group_key = _pad_axis(pr.group_key, 0, G_pad, int(pr.group_key[0]))
+        for name in ("ip_sel0", "ip_own0", "ip_anti0"):
+            setattr(pr, name, _pad_axis(getattr(pr, name), 0, G_pad, 0))
+        pr.G = G_pad
+
+    # Identity-key expansions dynamic_slice [base, base+N) out of the
+    # domain axis; with N padded the axis must extend past the last base.
+    if N_pad > N and any(pr.key_identity):
+        d_pad = pr.D + (N_pad - N)
+        for name in ("ip_sel0", "ip_own0", "ip_anti0"):
+            setattr(pr, name, _pad_axis(getattr(pr, name), 1, d_pad, 0))
+        pr.D = d_pad
+
+    pr.P, pr.N = P_pad, N_pad
     return pr

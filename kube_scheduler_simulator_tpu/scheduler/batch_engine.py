@@ -103,7 +103,7 @@ class BatchResult:
         appear in diagnosis or the filter annotation)."""
         start = int(np.asarray(self.out["sample_start"])[i])
         processed = int(np.asarray(self.out["sample_processed"])[i])
-        nt = self.problem.N
+        nt = self.problem.N_true
         rank = (np.arange(nt) - start) % max(nt, 1)
         return rank < processed
 
@@ -176,7 +176,7 @@ class BatchResult:
         matchFields pinning restricts which nodes the cycle visits)."""
         narrowed = self._engine.prefilter_node_names(self.pending[i])
         if narrowed is None:
-            return list(range(self.problem.N))
+            return list(range(self.problem.N_true))
         idx = {nm: j for j, nm in enumerate(self.problem.node_names)}
         return sorted(idx[nm] for nm in narrowed if nm in idx)
 
@@ -197,6 +197,7 @@ class BatchEngine:
         dtype=None,
         tie_break: str = "first",
         seed: int = 0,
+        bucket: bool = True,
     ):
         self.filters = list(
             filters
@@ -210,6 +211,9 @@ class BatchEngine:
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
         self.trace = trace
         self.dtype = dtype
+        # Pad P/N/group dims to bucket boundaries so churning workloads
+        # reuse compiled executables (SURVEY §7 hard part (b)).
+        self.bucket = bucket
         self.cfg = B.BatchConfig(
             filters=tuple(f for f in self.filters if f in KERNEL_FILTERS),
             scores=tuple((s, w) for s, w in self.scores),
@@ -383,6 +387,8 @@ class BatchEngine:
             hard_pod_affinity_weight=self.hard_pod_affinity_weight,
             added_affinity=self.added_affinity,
         )
+        if self.bucket:
+            pr = E.pad_problem(pr)
         t1 = time.perf_counter()
         dp, dims = B.lower(pr, dtype=self.dtype)
         import jax.numpy as jnp
@@ -399,10 +405,14 @@ class BatchEngine:
         fn = self._fn_cache.get(key)
         t2 = time.perf_counter()
         if fn is None:
-            fn = B.build_batch_fn(self.cfg, dims)
+            # donate: dp is rebuilt per round, so its buffers can alias
+            # into the scan carry instead of being copied
+            fn = B.build_batch_fn(self.cfg, dims, donate=True)
             self._fn_cache[key] = fn
         out = fn(dp)
-        out = {k: np.asarray(v) for k, v in out.items()}
+        # "_"-prefixed entries (the donation-aliased final carry) stay on
+        # device and are not part of the result contract
+        out = {k: np.asarray(v) for k, v in out.items() if not k.startswith("_")}
         t3 = time.perf_counter()
         self.last_timings = {
             "encode_s": t1 - t0,

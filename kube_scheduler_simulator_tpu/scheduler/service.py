@@ -71,6 +71,16 @@ class SchedulerService:
         self._wakeup = threading.Event()
         self._batch_engine: Any = None
         self.extender_service: Any = None  # set by _build_framework
+        # Observability counters (exposed by the metrics endpoint):
+        # batch_commits = rounds committed via the TPU batch engine;
+        # batch_fallbacks = rounds that fell back to the sequential cycle
+        # (reason → count); sequential_pods = pods scheduled sequentially.
+        self.stats: dict[str, Any] = {
+            "batch_commits": 0,
+            "batch_pods": 0,
+            "batch_fallbacks": {},
+            "sequential_pods": 0,
+        }
 
     # ----------------------------------------------------------- extension
 
@@ -301,12 +311,14 @@ class SchedulerService:
             return {}
         nodes = self.cluster_store.list("nodes")
         if self.use_batch == "auto" and len(pending) * max(len(nodes), 1) < self.batch_min_work:
+            self._count_fallback("below batch_min_work")
             return None
         if self._batch_engine is None:
             self._batch_engine = BatchEngine.from_framework(fw, trace=True)
         eng = self._batch_engine
-        ok, _why = eng.supported(pending, nodes)
+        ok, why = eng.supported(pending, nodes)
         if not ok:
+            self._count_fallback(why)
             return None
         result = eng.schedule(
             nodes,
@@ -316,17 +328,25 @@ class SchedulerService:
             base_counter=fw.sched_counter,
             start_index=fw.next_start_node_index,
         )
-        failed = [i for i, s in enumerate(result.selected) if s < 0]
+        # only real pods count — bucketing pads result.selected with -1 rows
+        failed = [i for i, s in enumerate(result.selected[: len(result.pending)]) if s < 0]
         if failed and self.use_batch != "force":
             has_preemption = bool(fw.plugins["post_filter"])
             if has_preemption:
+                self._count_fallback("unschedulable pods need preemption")
                 return None  # preemption is host-side; run the exact cycle
         # The batch round consumed one attempt per pending pod; keep the
         # sequential path's tie-break counter and rotating sample start in
         # sync for later rounds.
         fw.sched_counter += len(pending)
         fw.next_start_node_index = result.final_start
+        self.stats["batch_commits"] += 1
+        self.stats["batch_pods"] += len(pending)
         return self._commit_batch_round(result)
+
+    def _count_fallback(self, reason: str) -> None:
+        fb = self.stats["batch_fallbacks"]
+        fb[reason] = fb.get(reason, 0) + 1
 
     def _commit_batch_round(self, result: Any) -> dict[str, ScheduleResult]:
         """Write the batch trace into the result store (the same categories
@@ -383,7 +403,7 @@ class SchedulerService:
                 res = ScheduleResult(
                     diagnosis=diagnosis,
                     status=Status.unschedulable(
-                        f"0/{result.problem.N} nodes are available"
+                        f"0/{result.problem.N_true} nodes are available"
                     ),
                 )
                 self._record_failure(pod, res)
@@ -396,6 +416,7 @@ class SchedulerService:
         if snapshot is None:
             snapshot = self.build_snapshot()
         result = self.framework.schedule_one(pod, snapshot)
+        self.stats["sequential_pods"] += 1
         if not result.success:
             self._record_failure(pod, result)
         # The reference's informer flushes results asynchronously after the
