@@ -225,6 +225,12 @@ class BatchEngine:
         )
         self._fn_cache: dict = {}
         self.last_timings: dict[str, float] = {}
+        # Cumulative observability counters (surfaced by /api/v1/metrics):
+        # rounds = schedule() calls, compiles = jit-cache misses,
+        # cum_timings = per-phase seconds summed over rounds.
+        self.rounds = 0
+        self.compiles = 0
+        self.cum_timings: dict[str, float] = {}
         # Config aspects the kernels cannot honor; set by from_framework,
         # reported by supported().
         self._unsupported_config: "str | None" = None
@@ -409,6 +415,7 @@ class BatchEngine:
             # into the scan carry instead of being copied
             fn = B.build_batch_fn(self.cfg, dims, donate=True)
             self._fn_cache[key] = fn
+            self.compiles += 1
         out = fn(dp)
         # "_"-prefixed entries (the donation-aliased final carry) stay on
         # device and are not part of the result contract
@@ -420,6 +427,9 @@ class BatchEngine:
             "device_s": t3 - t2,
             "total_s": t3 - t0,
         }
+        self.rounds += 1
+        for k, v in self.last_timings.items():
+            self.cum_timings[k] = self.cum_timings.get(k, 0.0) + v
         return BatchResult(self, pending, out, pr, nodes)
 
     # ----------------------------------------------------- trace helpers

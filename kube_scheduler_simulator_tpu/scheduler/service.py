@@ -348,6 +348,24 @@ class SchedulerService:
         fb = self.stats["batch_fallbacks"]
         fb[reason] = fb.get(reason, 0) + 1
 
+    def metrics(self) -> dict[str, Any]:
+        """Observability snapshot for the metrics endpoint (the reference
+        exposes upstream Prometheus metrics via blank imports, reference
+        pkg/debuggablescheduler/debuggable_scheduler.go:13-15; here the
+        simulator's own counters are first-class)."""
+        eng = self._batch_engine
+        return {
+            "batch_commits": self.stats["batch_commits"],
+            "batch_pods": self.stats["batch_pods"],
+            "sequential_pods": self.stats["sequential_pods"],
+            "batch_fallbacks": dict(self.stats["batch_fallbacks"]),
+            "engine_rounds": eng.rounds if eng else 0,
+            "engine_compiles": eng.compiles if eng else 0,
+            "engine_cache_entries": len(eng._fn_cache) if eng else 0,
+            "engine_last_timings": dict(eng.last_timings) if eng else {},
+            "engine_cum_timings": dict(eng.cum_timings) if eng else {},
+        }
+
     def _commit_batch_round(self, result: Any) -> dict[str, ScheduleResult]:
         """Write the batch trace into the result store (the same categories
         the wrapped plugins record, models/wrapped.py), bind the pods, and

@@ -1,0 +1,87 @@
+"""Prometheus-text-format metrics for the simulator.
+
+The reference pulls in the upstream scheduler's Prometheus registration via
+blank imports (reference pkg/debuggablescheduler/debuggable_scheduler.go:
+13-15) and component-base metrics; this build exposes the simulator's own
+counters natively: scheduling-round counts per path, batch-engine fallback
+reasons, jit compile counts/cache size, and per-phase timings
+(encode/lower/device), plus cluster-store object counts.
+
+Served at ``GET /api/v1/metrics`` (and ``/metrics``, the conventional
+scrape path) in Prometheus text exposition format v0.0.4.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+_PREFIX = "simulator"
+
+
+def _esc(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def render_metrics(di: Any) -> str:
+    """Render the whole registry from the DI container's live services."""
+    svc = di.scheduler_service()
+    m = svc.metrics()
+    lines: list[str] = []
+
+    def counter(name: str, help_: str, value: float, labels: "dict[str, str] | None" = None, typ: str = "counter"):
+        full = f"{_PREFIX}_{name}"
+        if not any(ln.startswith(f"# HELP {full} ") for ln in lines):
+            lines.append(f"# HELP {full} {help_}")
+            lines.append(f"# TYPE {full} {typ}")
+        lab = ""
+        if labels:
+            lab = "{" + ",".join(f'{k}="{_esc(str(v))}"' for k, v in sorted(labels.items())) + "}"
+        lines.append(f"{full}{lab} {value}")
+
+    counter("scheduled_pods_total", "Pods scheduled, by path.", m["batch_pods"], {"path": "batch"})
+    counter("scheduled_pods_total", "Pods scheduled, by path.", m["sequential_pods"], {"path": "sequential"})
+    counter("batch_rounds_total", "Rounds committed via the TPU batch engine.", m["batch_commits"])
+    for reason, n in sorted(m["batch_fallbacks"].items()):
+        counter(
+            "batch_fallbacks_total",
+            "Rounds that fell back to the sequential cycle, by reason.",
+            n,
+            {"reason": reason},
+        )
+    if not m["batch_fallbacks"]:
+        counter(
+            "batch_fallbacks_total",
+            "Rounds that fell back to the sequential cycle, by reason.",
+            0,
+            {"reason": "none"},
+        )
+    counter("batch_compiles_total", "XLA compilations of the batch kernel (jit cache misses).", m["engine_compiles"])
+    counter("batch_executable_cache_entries", "Compiled batch executables held in the jit cache.", m["engine_cache_entries"], typ="gauge")
+    for phase, secs in sorted(m["engine_cum_timings"].items()):
+        counter(
+            "batch_phase_seconds_total",
+            "Cumulative batch-engine time by phase (encode/lower/device/total).",
+            round(secs, 6),
+            {"phase": phase.removesuffix("_s")},
+        )
+    for phase, secs in sorted(m["engine_last_timings"].items()):
+        counter(
+            "batch_phase_seconds_last",
+            "Last-round batch-engine time by phase.",
+            round(secs, 6),
+            {"phase": phase.removesuffix("_s")},
+            typ="gauge",
+        )
+
+    store = di.cluster_store
+    from kube_scheduler_simulator_tpu.state.store import KINDS
+
+    for kind in sorted(KINDS):
+        counter(
+            "cluster_objects",
+            "Objects in the cluster store, by kind.",
+            len(store.list(kind)),
+            {"kind": kind},
+            typ="gauge",
+        )
+    return "\n".join(lines) + "\n"

@@ -12,6 +12,10 @@ Routes (reference simulator/server/server.go:42-57):
     POST /api/v1/scenarios                   → run a KEP-140 Scenario, return it
                                                with status/timeline (the
                                                reference only scaffolds this)
+    GET  /api/v1/metrics (also /metrics)     → Prometheus text metrics (the
+                                               reference exposes upstream
+                                               Prometheus metrics via blank
+                                               imports)
 
 Because this build replaces the in-process kube-apiserver with the
 in-memory cluster store (SURVEY.md §7 step 1), the direct kube-API CRUD
@@ -146,6 +150,16 @@ def _make_handler(server: SimulatorServer):
                     self.wfile.write(data)
                 elif url.path == "/api/v1/schedulerconfiguration":
                     self._send_json(200, di.scheduler_service().get_scheduler_config())
+                elif url.path in ("/api/v1/metrics", "/metrics"):
+                    from kube_scheduler_simulator_tpu.server.metrics import render_metrics
+
+                    data = render_metrics(di).encode()
+                    self.send_response(200)
+                    self._cors_headers()
+                    self.send_header("Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
                 elif url.path == "/api/v1/export":
                     self._send_json(200, di.snapshot_service().snap())
                 elif url.path == "/api/v1/listwatchresources":

@@ -151,3 +151,36 @@ def test_unknown_routes_404(server):
     assert code == 404
     code, _ = _req(server, "GET", "/api/v1/resources/gadgets")
     assert code == 404
+
+
+def test_metrics_endpoint(server):
+    import time
+
+    node = {"metadata": {"name": "n1"}, "status": {"allocatable": {"cpu": "4", "pods": "10"}}}
+    _req(server, "POST", "/api/v1/resources/nodes", node)
+    pod = {
+        "metadata": {"name": "pm", "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "1"}}}]},
+    }
+    _req(server, "POST", "/api/v1/resources/pods", pod)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        code, got = _req(server, "GET", "/api/v1/resources/pods/pm?namespace=default")
+        if code == 200 and (got.get("spec") or {}).get("nodeName"):
+            break
+        time.sleep(0.1)
+
+    url = f"http://127.0.0.1:{server.port}/api/v1/metrics"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+    # Prometheus text exposition: HELP/TYPE headers + the core series
+    assert "# HELP simulator_scheduled_pods_total" in text
+    assert "# TYPE simulator_scheduled_pods_total counter" in text
+    assert 'simulator_scheduled_pods_total{path="sequential"} 1' in text
+    assert 'simulator_cluster_objects{kind="nodes"} 1' in text
+    assert "simulator_batch_compiles_total 0" in text
+    # /metrics is an alias
+    with urllib.request.urlopen(f"http://127.0.0.1:{server.port}/metrics", timeout=10) as resp:
+        assert resp.status == 200
