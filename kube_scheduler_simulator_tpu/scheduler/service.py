@@ -34,6 +34,21 @@ from kube_scheduler_simulator_tpu.scheduler.framework_runner import (
 Obj = dict[str, Any]
 
 
+def _pod_key(pod: Obj) -> str:
+    return f"{pod['metadata'].get('namespace', 'default')}/{pod['metadata']['name']}"
+
+
+def _safe_copy(d: dict) -> dict:
+    """Copy a dict that another thread (the background scheduler loop) may
+    be inserting into; retries the rare mid-iteration resize."""
+    for _ in range(5):
+        try:
+            return dict(d)
+        except RuntimeError:
+            continue
+    return {}
+
+
 class SchedulerService:
     def __init__(
         self,
@@ -42,6 +57,7 @@ class SchedulerService:
         tie_break: str = "reservoir",
         use_batch: str = "off",
         batch_min_work: int = 2048,
+        batch_max_restarts: int = 8,
     ):
         """``use_batch``: "off" = sequential cycle only; "auto" = run whole
         pending rounds through the TPU batch engine when the profile ×
@@ -58,6 +74,10 @@ class SchedulerService:
         self.tie_break = tie_break
         self.use_batch = use_batch
         self.batch_min_work = batch_min_work
+        # Successful preemptions free resources mid-round, forcing a kernel
+        # re-run on the remaining tail; past this many re-runs the round
+        # finishes on the (equally exact) sequential cycle.
+        self.batch_max_restarts = batch_max_restarts
         self.reflector = StoreReflector()
         self.reflector.register_to_cluster_store(cluster_store)
         self._out_of_tree: dict[str, Callable[[Obj | None, Any], Any]] = {}
@@ -79,6 +99,7 @@ class SchedulerService:
             "batch_commits": 0,
             "batch_pods": 0,
             "batch_fallbacks": {},
+            "batch_restarts": 0,
             "sequential_pods": 0,
         }
 
@@ -265,43 +286,52 @@ class SchedulerService:
         """Drain the pending queue: sort by QueueSort, schedule each pod in
         order; preemption-nominated pods get retried in later rounds.
 
-        With use_batch enabled, whole rounds run through the TPU batch
-        engine when possible (identical outcomes: batch results are only
-        committed when every pod found a node, so the sequential-only
-        preemption path never diverges; tie-breaks use the counter-keyed
-        draw both paths share, so the same workload/seed places pods on
-        the same nodes whichever path a round takes)."""
+        With use_batch enabled, each round runs through the TPU batch
+        engine when possible, with identical outcomes to the sequential
+        cycle: successes are committed from the kernel trace in queue
+        order, kernel-failed pods run the exact sequential cycle (which
+        owns preemption), and a successful preemption — which frees
+        resources later pods in the round must see, exactly as the shared
+        round snapshot exposes them sequentially — re-runs the kernel on
+        the remaining tail.  Tie-breaks use the counter-keyed draw both
+        paths share, so the same workload/seed places pods on the same
+        nodes whichever path a round takes."""
         assert self.framework is not None, "scheduler not started"
-        if self.use_batch in ("auto", "force"):
-            batch_results = self._schedule_pending_batch()
-            if batch_results is not None:
-                return batch_results
         results: dict[str, ScheduleResult] = {}
         for _ in range(max_rounds):
-            pending = self.framework.sort_pods(self.pending_pods())
-            if not pending:
+            round_results: "dict[str, ScheduleResult] | None" = None
+            if self.use_batch in ("auto", "force"):
+                round_results = self._schedule_pending_batch()
+            if round_results is None:
+                pending = self.framework.sort_pods(self.pending_pods())
+                if not pending:
+                    break
+                snapshot = self.build_snapshot()
+                round_results = {}
+                for pod in pending:
+                    round_results[_pod_key(pod)] = self.schedule_one(pod, snapshot)
+            if not round_results:
                 break
-            snapshot = self.build_snapshot()
-            progressed = False
-            for pod in pending:
-                result = self.schedule_one(pod, snapshot)
-                key = f"{pod['metadata'].get('namespace', 'default')}/{pod['metadata']['name']}"
-                results[key] = result
-                if result.success or result.nominated_node:
-                    progressed = True
-            if not progressed:
+            results.update(round_results)
+            if not any(r.success or r.nominated_node for r in round_results.values()):
                 break
         return results
 
     # ------------------------------------------------------------ batch path
 
     def _schedule_pending_batch(self) -> "dict[str, ScheduleResult] | None":
-        """One whole round on the TPU batch engine (scheduler/batch_engine).
+        """One round on the TPU batch engine (scheduler/batch_engine).
 
-        Returns None when the sequential path must run instead: profile or
-        workload unsupported, or (auto mode) some pod found no node — the
-        sequential cycle owns preemption.  Nothing is committed in that
-        case, so falling back is exact."""
+        Returns None when the whole round must run sequentially instead
+        (profile or workload unsupported — nothing is committed, so falling
+        back is exact).  Otherwise the kernel's decisions are replayed in
+        queue order: successes commit from the trace, kernel-failed pods
+        run the exact sequential cycle (which owns preemption).  A
+        SUCCESSFUL preemption mutates the shared round snapshot — later
+        pods must see the freed resources — so the kernel re-runs on the
+        remaining tail from the updated cluster state; failed pods whose
+        preemption found no candidates (or profiles with no PostFilter at
+        all) leave the state untouched and the replay continues."""
         from kube_scheduler_simulator_tpu.scheduler.batch_engine import BatchEngine
 
         fw = self.framework
@@ -320,29 +350,61 @@ class SchedulerService:
         if not ok:
             self._count_fallback(why)
             return None
-        result = eng.schedule(
-            nodes,
-            self.cluster_store.list("pods"),
-            pending,
-            self.cluster_store.list("namespaces"),
-            base_counter=fw.sched_counter,
-            start_index=fw.next_start_node_index,
-        )
-        # only real pods count — bucketing pads result.selected with -1 rows
-        failed = [i for i, s in enumerate(result.selected[: len(result.pending)]) if s < 0]
-        if failed and self.use_batch != "force":
-            has_preemption = bool(fw.plugins["post_filter"])
-            if has_preemption:
-                self._count_fallback("unschedulable pods need preemption")
-                return None  # preemption is host-side; run the exact cycle
-        # The batch round consumed one attempt per pending pod; keep the
-        # sequential path's tie-break counter and rotating sample start in
-        # sync for later rounds.
-        fw.sched_counter += len(pending)
-        fw.next_start_node_index = result.final_start
+
+        seq_failures = bool(fw.plugins["post_filter"]) and self.use_batch != "force"
+        point_names = {
+            p: [wp.original.name for wp in fw.plugins[p]]
+            for p in ("pre_filter", "pre_score", "reserve", "pre_bind", "bind")
+        }
+        results: dict[str, ScheduleResult] = {}
+        i = 0  # index of the tail's first pod within `pending`
+        restarts = 0
+        while i < len(pending):
+            tail = pending[i:]
+            result = eng.schedule(
+                nodes,
+                self.cluster_store.list("pods"),
+                tail,
+                self.cluster_store.list("namespaces"),
+                base_counter=fw.sched_counter,
+                start_index=fw.next_start_node_index,
+            )
+            snapshot = self.build_snapshot()
+            sample_start = result.out["sample_start"]
+            restart_at = None
+            for j, pod in enumerate(tail):
+                key = _pod_key(pod)
+                if int(result.selected[j]) >= 0 or not seq_failures:
+                    results[key] = self._commit_batch_pod(result, j, pod, snapshot, point_names)
+                    fw.sched_counter += 1
+                    self.stats["batch_pods"] += 1
+                else:
+                    # Exact sequential cycle for this pod: same snapshot
+                    # state (earlier commits assumed), same attempt counter
+                    # and rotation start as the all-sequential round.
+                    fw.next_start_node_index = int(sample_start[j])
+                    res = self.schedule_one(pod, snapshot)
+                    results[key] = res
+                    if res.nominated_node:
+                        restart_at = i + j + 1
+                        break
+            if restart_at is None:
+                fw.next_start_node_index = result.final_start
+                break
+            i = restart_at
+            restarts += 1
+            if i >= len(pending):
+                break
+            self.stats["batch_restarts"] += 1
+            if restarts >= self.batch_max_restarts:
+                # Preemption-heavy round: finish it sequentially (exact).
+                snapshot = self.build_snapshot()
+                for pod in pending[i:]:
+                    results[_pod_key(pod)] = self.schedule_one(pod, snapshot)
+                break
         self.stats["batch_commits"] += 1
-        self.stats["batch_pods"] += len(pending)
-        return self._commit_batch_round(result)
+        self.reflector.flush_all(self.cluster_store)
+        return results
 
     def _count_fallback(self, reason: str) -> None:
         fb = self.stats["batch_fallbacks"]
@@ -357,77 +419,82 @@ class SchedulerService:
         return {
             "batch_commits": self.stats["batch_commits"],
             "batch_pods": self.stats["batch_pods"],
+            "batch_restarts": self.stats["batch_restarts"],
             "sequential_pods": self.stats["sequential_pods"],
-            "batch_fallbacks": dict(self.stats["batch_fallbacks"]),
+            "batch_fallbacks": _safe_copy(self.stats["batch_fallbacks"]),
             "engine_rounds": eng.rounds if eng else 0,
             "engine_compiles": eng.compiles if eng else 0,
             "engine_cache_entries": len(eng._fn_cache) if eng else 0,
-            "engine_last_timings": dict(eng.last_timings) if eng else {},
-            "engine_cum_timings": dict(eng.cum_timings) if eng else {},
+            "engine_last_timings": _safe_copy(eng.last_timings) if eng else {},
+            "engine_cum_timings": _safe_copy(eng.cum_timings) if eng else {},
         }
 
-    def _commit_batch_round(self, result: Any) -> dict[str, ScheduleResult]:
-        """Write the batch trace into the result store (the same categories
-        the wrapped plugins record, models/wrapped.py), bind the pods, and
-        flush annotations."""
+    def _commit_batch_pod(
+        self,
+        result: Any,
+        i: int,
+        pod: Obj,
+        snapshot: "Snapshot | None" = None,
+        point_names: "dict[str, list[str]] | None" = None,
+    ) -> ScheduleResult:
+        """Write one pod's batch trace into the result store (the same
+        categories the wrapped plugins record, models/wrapped.py) and bind
+        it; with ``snapshot``, assume the bind so later sequential cycles
+        in the same round see it (exactly as the shared round snapshot
+        does in the all-sequential path)."""
         from kube_scheduler_simulator_tpu.plugins.resultstore import SUCCESS_MESSAGE
 
         fw = self.framework
         assert fw is not None and self.result_store is not None
         rs = self.result_store
-        out: dict[str, ScheduleResult] = {}
-        point_names = {
-            p: [wp.original.name for wp in fw.plugins[p]]
-            for p in ("pre_filter", "pre_score", "reserve", "pre_bind", "bind")
-        }
-        for i, pod in enumerate(result.pending):
-            ns = pod["metadata"].get("namespace", "default")
-            name = pod["metadata"]["name"]
-            sel = int(result.selected[i])
-            feasible_count = int(result.feasible_count[i])
+        if point_names is None:
+            point_names = {
+                p: [wp.original.name for wp in fw.plugins[p]]
+                for p in ("pre_filter", "pre_score", "reserve", "pre_bind", "bind")
+            }
+        ns = pod["metadata"].get("namespace", "default")
+        name = pod["metadata"]["name"]
+        sel = int(result.selected[i])
+        feasible_count = int(result.feasible_count[i])
 
-            for pn in point_names["pre_filter"]:
-                narrowed = None
-                if pn == "NodeAffinity":
-                    names = result._engine.prefilter_node_names(pod)
-                    if names is not None:
-                        from kube_scheduler_simulator_tpu.models.framework import PreFilterResult
+        for pn in point_names["pre_filter"]:
+            narrowed = None
+            if pn == "NodeAffinity":
+                names = result._engine.prefilter_node_names(pod)
+                if names is not None:
+                    from kube_scheduler_simulator_tpu.models.framework import PreFilterResult
 
-                        narrowed = PreFilterResult(names)
-                rs.add_pre_filter_result(ns, name, pn, SUCCESS_MESSAGE, narrowed)
-            rs.add_batch_results(ns, name, filter=result.filter_annotation(i))
-            if feasible_count > 1:
-                for pn in point_names["pre_score"]:
-                    rs.add_pre_score_result(ns, name, pn, SUCCESS_MESSAGE)
-                score, final = result.score_annotations(i)
-                rs.add_batch_results(ns, name, score=score, finalScore=final)
+                    narrowed = PreFilterResult(names)
+            rs.add_pre_filter_result(ns, name, pn, SUCCESS_MESSAGE, narrowed)
+        rs.add_batch_results(ns, name, filter=result.filter_annotation(i))
+        if feasible_count > 1:
+            for pn in point_names["pre_score"]:
+                rs.add_pre_score_result(ns, name, pn, SUCCESS_MESSAGE)
+            score, final = result.score_annotations(i)
+            rs.add_batch_results(ns, name, score=score, finalScore=final)
 
-            key = f"{ns}/{name}"
-            if sel >= 0:
-                node_name = result.node_names[sel]
-                rs.add_selected_node(ns, name, node_name)
-                for pn in point_names["reserve"]:
-                    rs.add_reserve_result(ns, name, pn, SUCCESS_MESSAGE)
-                for pn in point_names["pre_bind"]:
-                    rs.add_pre_bind_result(ns, name, pn, SUCCESS_MESSAGE)
-                if point_names["bind"]:
-                    rs.add_bind_result(ns, name, point_names["bind"][0], SUCCESS_MESSAGE)
-                self.cluster_store.bind_pod(ns, name, node_name)
-                out[key] = ScheduleResult(selected_node=node_name)
-            else:
-                diagnosis = result.diagnosis(i)
-                from kube_scheduler_simulator_tpu.models.framework import Status
+        if sel >= 0:
+            node_name = result.node_names[sel]
+            rs.add_selected_node(ns, name, node_name)
+            for pn in point_names["reserve"]:
+                rs.add_reserve_result(ns, name, pn, SUCCESS_MESSAGE)
+            for pn in point_names["pre_bind"]:
+                rs.add_pre_bind_result(ns, name, pn, SUCCESS_MESSAGE)
+            if point_names["bind"]:
+                rs.add_bind_result(ns, name, point_names["bind"][0], SUCCESS_MESSAGE)
+            self.cluster_store.bind_pod(ns, name, node_name)
+            if snapshot is not None:
+                snapshot.assume(pod, node_name)
+            return ScheduleResult(selected_node=node_name)
+        diagnosis = result.diagnosis(i)
+        from kube_scheduler_simulator_tpu.models.framework import Status
 
-                res = ScheduleResult(
-                    diagnosis=diagnosis,
-                    status=Status.unschedulable(
-                        f"0/{result.problem.N_true} nodes are available"
-                    ),
-                )
-                self._record_failure(pod, res)
-                out[key] = res
-        self.reflector.flush_all(self.cluster_store)
-        return out
+        res = ScheduleResult(
+            diagnosis=diagnosis,
+            status=Status.unschedulable(f"0/{result.problem.N_true} nodes are available"),
+        )
+        self._record_failure(pod, res)
+        return res
 
     def schedule_one(self, pod: Obj, snapshot: "Snapshot | None" = None) -> ScheduleResult:
         assert self.framework is not None, "scheduler not started"

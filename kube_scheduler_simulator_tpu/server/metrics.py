@@ -40,7 +40,9 @@ def render_metrics(di: Any) -> str:
 
     counter("scheduled_pods_total", "Pods scheduled, by path.", m["batch_pods"], {"path": "batch"})
     counter("scheduled_pods_total", "Pods scheduled, by path.", m["sequential_pods"], {"path": "sequential"})
-    counter("batch_rounds_total", "Rounds committed via the TPU batch engine.", m["batch_commits"])
+    counter("batch_rounds_total", "Scheduling rounds that ran on the TPU batch engine.", m["batch_commits"])
+    counter("batch_kernel_runs_total", "Batch-kernel invocations (>= rounds: mid-round preemptions re-run the kernel on the tail).", m["engine_rounds"])
+    counter("batch_restarts_total", "Mid-round kernel re-runs forced by successful preemptions.", m["batch_restarts"])
     for reason, n in sorted(m["batch_fallbacks"].items()):
         counter(
             "batch_fallbacks_total",
