@@ -174,28 +174,73 @@ def run_config(name, P, N, plugins, spread=False, interpod=False, oracle_sample=
     return out
 
 
+def run_churn(P_total=10000, N=5000, waves=5, delete_frac=0.1):
+    """BASELINE cfg5: scenario-replay churn — the FULL default-plugins
+    profile (percentageOfNodesToScore=0, so feasible-node sampling engages
+    at this node count), pods arriving in waves with 10% of bound pods
+    deleted between waves (keps/140 churn semantics).  Measures end-to-end
+    service throughput: encode + kernel + commit + annotation flush every
+    wave, compiled executables reused across waves via shape bucketing."""
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+    rng = random.Random(7)
+    store = ClusterStore()
+    for i in range(N):
+        store.create("nodes", mk_node(i))
+    svc = SchedulerService(store, tie_break="first", use_batch="auto")
+    svc.start_scheduler(None)  # full default KubeSchedulerConfiguration
+
+    per_wave = P_total // waves
+    created = 0
+    scheduled = 0
+    waves_done = 0
+    budget_s = 480.0  # soft cap so a driver bench run always completes
+    t0 = time.perf_counter()
+    for w in range(waves):
+        for _ in range(per_wave):
+            store.create("pods", mk_pod(created, rng, spread=created % 3 == 0))
+            created += 1
+        results = svc.schedule_pending(max_rounds=1)
+        scheduled += sum(1 for r in results.values() if r.success)
+        waves_done += 1
+        if time.perf_counter() - t0 > budget_s and w + 1 < waves:
+            break
+        bound = [p for p in store.list("pods") if (p.get("spec") or {}).get("nodeName")]
+        for p in rng.sample(bound, int(len(bound) * delete_frac)):
+            store.delete("pods", p["metadata"]["name"], p["metadata"].get("namespace"))
+    wall = time.perf_counter() - t0
+    eng = svc._batch_engine
+    return {
+        "config": "cfg5-churn-default-profile",
+        "pods": scheduled,
+        "nodes": N,
+        "waves": waves_done,
+        "wall_s": round(wall, 4),
+        "scheduled": scheduled,
+        "pods_per_s": round(scheduled / wall),
+        "pods_nodes_per_s": round(scheduled * N / wall),
+        "compiles": eng.compiles if eng else 0,
+        "batch_fallbacks": svc.stats["batch_fallbacks"],
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="small sweep (CI/dev)")
-    ap.add_argument("--full", action="store_true", help="10k x 5k headline config")
     args = ap.parse_args()
 
     if args.quick:
         configs = [
             ("cfg1-fit", 100, 10, ["NodeResourcesFit"], False, False, 100),
         ]
-    elif args.full:
+    else:
+        # The BASELINE.md config table — the default sweep IS the mandate.
         configs = [
             ("cfg1-fit", 100, 10, ["NodeResourcesFit"], False, False, 100),
             ("cfg2-fit-taint-aff", 1000, 500, ["NodeResourcesFit", "TaintToleration", "NodeAffinity"], False, False, 200),
             ("cfg3-spread", 5000, 2000, ["NodeResourcesFit", "PodTopologySpread"], True, False, 100),
             ("cfg4-interpod", 10000, 5000, ["NodeResourcesFit", "InterPodAffinity"], False, True, 50),
-        ]
-    else:
-        configs = [
-            ("cfg1-fit", 100, 10, ["NodeResourcesFit"], False, False, 100),
-            ("cfg2-fit-taint-aff", 1000, 500, ["NodeResourcesFit", "TaintToleration", "NodeAffinity"], False, False, 200),
-            ("cfg3-spread", 2000, 1000, ["NodeResourcesFit", "PodTopologySpread"], True, False, 100),
         ]
 
     results = []
@@ -204,16 +249,28 @@ def main() -> None:
             results.append(run_config(*cfg))
         except Exception as e:  # keep the bench line printable on partial failure
             results.append({"config": cfg[0], "error": f"{type(e).__name__}: {e}"})
+    if not args.quick:
+        try:
+            results.append(run_churn())
+        except Exception as e:
+            results.append({"config": "cfg5-churn-default-profile", "error": f"{type(e).__name__}: {e}"})
 
-    headline = next((r for r in reversed(results) if "pods_nodes_per_s" in r), {})
+    headline = next((r for r in results if r.get("config") == "cfg4-interpod" and "wall_s" in r), None)
+    if headline is None:
+        headline = next((r for r in reversed(results) if "pods_nodes_per_s" in r), {})
     line = {
-        "metric": "pods x nodes plugin-scored per second (batch engine, largest config)",
+        "metric": "pods x nodes plugin-scored per second (batch engine, 10k pods x 5k nodes)",
         "value": headline.get("pods_nodes_per_s", 0),
         "unit": "pod-node pairs/s",
         # reference publishes no numbers (SURVEY.md section 6); baseline 1.0
         # = this repo's sequential oracle (the reference's loop shape),
         # so vs_baseline is the measured speedup over that loop.
         "vs_baseline": headline.get("speedup_vs_seq", 0),
+        "north_star": {
+            "target": "10k pods x 5k nodes scored in <1 s on one TPU chip",
+            "wall_s": headline.get("wall_s"),
+            "met": bool(headline.get("wall_s") and headline["wall_s"] < 1.0),
+        },
         "configs": results,
     }
     print(json.dumps(line))

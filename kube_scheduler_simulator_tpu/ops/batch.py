@@ -55,6 +55,11 @@ class BatchConfig(NamedTuple):
     # (utils/hashing.py) — bit-identical to the sequential _select_host.
     tie_break: str = "first"
     seed: int = 0
+    # False compiles out the feasible-node sampling machinery (rotation
+    # ranks + rotated prefix sums) — valid only when sample_k covers every
+    # node AND the start index is 0, where visit order == index order.
+    # BatchEngine picks the variant per round; both share the jit cache.
+    sampling: bool = True
 
 
 FILTER_KERNELS = (
@@ -85,13 +90,32 @@ class DeviceProblem(NamedTuple):
     pod_req: Any          # [P,R]
     pod_nonzero: Any      # [P,2]
     fit_checked: Any      # [P,R] bool
-    taint_fail: Any       # [P,N] int16
-    taint_prefer: Any     # [P,N]
-    unsched_ok: Any       # [P,N] bool
-    aff_code: Any         # [P,N] int8
-    aff_pref: Any         # [P,N]
-    name_ok: Any          # [P,N] bool
-    incl: Any             # [P,N] bool
+    # Pairwise features, factored through (pod-class × node-class) matrices
+    # — a few MB of transfer instead of ~700 MB of dense [P,N] at 10k×5k;
+    # the kernel expands them on-device (_expand_features) into the
+    # taint_fail / taint_prefer / unsched_ok / aff_code / aff_pref /
+    # name_ok / incl [P,N] fields below, which lower() leaves as scalar
+    # placeholders.
+    taint_cls: Any        # [L,T] int16: first untolerated taint idx or -1
+    taint_prefer_cls: Any # [L,T] int16
+    taint_unsched_cls: Any# [L,T] bool
+    pod_tol_idx: Any      # [P] int32
+    node_taint_idx: Any   # [N] int32
+    node_unsched: Any     # [N] bool
+    aff_code_cls: Any     # [A,M] int8
+    incl_cls: Any         # [A,M] bool
+    aff_pref_cls: Any     # [B,M] int32
+    pod_aff_idx: Any      # [P] int32
+    pod_pref_idx: Any     # [P] int32
+    node_label_idx: Any   # [N] int32
+    name_target: Any      # [P] int32: -1 free, node idx, -2 absent node
+    taint_fail: Any       # [P,N] int16 (expanded on-device)
+    taint_prefer: Any     # [P,N] (expanded on-device)
+    unsched_ok: Any       # [P,N] bool (expanded on-device)
+    aff_code: Any         # [P,N] int8 (expanded on-device)
+    aff_pref: Any         # [P,N] (expanded on-device)
+    name_ok: Any          # [P,N] bool (expanded on-device)
+    incl: Any             # [P,N] bool (expanded on-device)
     node_domain: Any      # [KT,N] int32
     spf: Any              # spread filter constraints (key,grp,skew,self) [P,KC]
     sps: Any              # spread score constraints [P,KS]
@@ -185,11 +209,11 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
         key_oh.append(np.zeros((0, N), dtype=np.float32))
 
     def remap(keys: np.ndarray) -> np.ndarray:
-        out = np.zeros_like(keys)
-        flat = out.ravel()
-        for i, k in enumerate(np.asarray(keys).ravel()):
-            flat[i] = ku_of.get(int(k), 0)
-        return out
+        keys = np.asarray(keys)
+        lut = np.zeros(max((max(ku_of, default=0) + 1, 1)), dtype=keys.dtype)
+        for k, u in ku_of.items():
+            lut[k] = u
+        return lut[np.clip(keys, 0, len(lut) - 1)]
 
     g_ku = remap(group_key) if pr.G else np.zeros(1, dtype=np.int32)
     spf_ku = remap(np.asarray(pr.spf_key))
@@ -201,13 +225,27 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
         pod_req=f(pr.pod_req),
         pod_nonzero=f(pr.pod_nonzero),
         fit_checked=b(pr.fit_checked),
-        taint_fail=jnp.asarray(pr.taint_fail, dtype=jnp.int16),
-        taint_prefer=f(pr.taint_prefer),
-        unsched_ok=b(pr.unsched_ok),
-        aff_code=jnp.asarray(pr.aff_code, dtype=jnp.int8),
-        aff_pref=f(pr.aff_pref),
-        name_ok=b(pr.name_ok),
-        incl=b(pr.incl),
+        taint_cls=jnp.asarray(pr.taint_cls, dtype=jnp.int16),
+        taint_prefer_cls=jnp.asarray(pr.taint_prefer_cls, dtype=jnp.int16),
+        taint_unsched_cls=b(pr.taint_unsched_cls),
+        pod_tol_idx=i32(pr.pod_tol_idx),
+        node_taint_idx=i32(pr.node_taint_idx),
+        node_unsched=b(pr.node_unsched),
+        aff_code_cls=jnp.asarray(pr.aff_code_cls, dtype=jnp.int8),
+        incl_cls=b(pr.incl_cls),
+        aff_pref_cls=i32(pr.aff_pref_cls),
+        pod_aff_idx=i32(pr.pod_aff_idx),
+        pod_pref_idx=i32(pr.pod_pref_idx),
+        node_label_idx=i32(pr.node_label_idx),
+        name_target=i32(pr.name_target),
+        # expanded on-device inside the jitted kernel (_expand_features)
+        taint_fail=jnp.int32(0),
+        taint_prefer=jnp.int32(0),
+        unsched_ok=jnp.int32(0),
+        aff_code=jnp.int32(0),
+        aff_pref=jnp.int32(0),
+        name_ok=jnp.int32(0),
+        incl=jnp.int32(0),
         node_domain=i32(pr.node_domain),
         spf=(i32(pr.spf_key), i32(pr.spf_group), f(pr.spf_skew), f(pr.spf_self)),
         sps=(i32(pr.sps_key), i32(pr.sps_group), f(pr.sps_skew), f(pr.sps_self)),
@@ -290,6 +328,61 @@ def _minmax_normalize(raw, feasible):
 
 
 # ------------------------------------------------------------------- kernel
+
+def build_compact_fn(cfg: BatchConfig, dims: dict, W: int):
+    """Build the trace-compaction function: gather each pod's VISITED
+    nodes (the only ones the annotation trail mentions — upstream stops
+    filtering at numFeasibleNodesToFind) out of the [P,N] trace arrays
+    into [*,P,W] stacks, where W is a bucket over the round's max visited
+    count.  Two outputs → two device→host fetches instead of ~20 [P,N]
+    ones; through a tunneled TPU (~10 MB/s D2H) this is the difference
+    between milliseconds and minutes per round.
+
+    Outputs, dtype-packed to minimize fetch volume (values are all exact
+    integers by kernel construction, so the casts are lossless):
+      ids   [P,W]   int32  visited node ids (-1 pad)
+      codes [F,P,W] int16  filter reason codes (int32 when the Fit
+                           bitmask needs >15 bits)
+      feas  [P,W]   int8   feasible mask
+      raw   [S,P,W] int32  raw scores (InterPodAffinity sums can be large)
+      norm  [S,P,W] int8   normalized scores (0..MAX_NODE_SCORE)
+    """
+    P, N = dims["P"], dims["N"]
+    code_dtype = jnp.int16 if dims["R"] + 1 <= 15 else jnp.int32
+
+    def run(out: dict, n_true):
+        idx = jnp.arange(N, dtype=jnp.int32)[None, :]
+        d = idx - out["sample_start"][:, None]
+        rank = jnp.where(d >= 0, d, d + n_true)
+        # padded node columns can alias into the rank window when the
+        # rotation start is nonzero — they were never really visited
+        visited = (rank < out["sample_processed"][:, None]) & (idx < n_true)
+        order = jnp.argsort(jnp.where(visited, idx, N + idx), axis=1)[:, :W]
+        take = lambda a: jnp.take_along_axis(a, order, axis=1)
+        valid = take(visited)
+        # mask padding columns to zero: stale values from unvisited nodes
+        # would defeat the all-passed fast path and inflate the host-side
+        # string LUTs
+        takem = lambda a: jnp.where(valid, take(a), 0)
+        res = {
+            "ids": jnp.where(valid, order, -1).astype(jnp.int32),
+            "feas": (take(out["feasible"]) & valid).astype(jnp.int8),
+        }
+        if cfg.filters:
+            res["codes"] = jnp.stack(
+                [takem(out[f"code:{f}"]).astype(code_dtype) for f in cfg.filters]
+            )
+        if cfg.scores:
+            res["raw"] = jnp.stack(
+                [takem(out[f"raw:{s}"]).astype(jnp.int32) for s, _w in cfg.scores]
+            )
+            res["norm"] = jnp.stack(
+                [takem(out[f"norm:{s}"]).astype(jnp.int8) for s, _w in cfg.scores]
+            )
+        return res
+
+    return jax.jit(run)
+
 
 def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
     """Build the jitted batch scheduling function for a static config/dims.
@@ -453,26 +546,41 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
         # visit order is expressed as a per-node rank r = (n - start) mod
         # n_true, and "the first K feasible in visit order" falls out of a
         # windowed prefix sum — no gathers, everything elementwise.
+        # cfg.sampling=False compiles the machinery out (valid when K
+        # covers all nodes and start==0: every feasible node is sampled,
+        # visit order == index order, the start index never moves).
         nt = dp.n_true
         K = dp.sample_k
         idx = jnp.arange(N, dtype=jnp.int32)
-        r = jnp.where(idx >= start, idx - start, idx - start + nt)  # visit rank
+        if cfg.sampling:
+            r = jnp.where(idx >= start, idx - start, idx - start + nt)  # visit rank
 
-        def rot_cumsum(mask):
-            """c[n] = number of True entries with visit rank <= r[n] (a
-            cumsum in rotation order), plus the total count."""
-            pref = jnp.cumsum(mask.astype(jnp.int32))
-            tot = pref[N - 1]
-            ps = jnp.where(start == 0, 0, jnp.take(pref, jnp.maximum(start - 1, 0)))
-            return jnp.where(idx >= start, pref - ps, pref + (tot - ps)), tot
+            def rot_cumsum(mask):
+                """c[n] = number of True entries with visit rank <= r[n] (a
+                cumsum in rotation order), plus the total count."""
+                pref = jnp.cumsum(mask.astype(jnp.int32))
+                tot = pref[N - 1]
+                ps = jnp.where(start == 0, 0, jnp.take(pref, jnp.maximum(start - 1, 0)))
+                return jnp.where(idx >= start, pref - ps, pref + (tot - ps)), tot
 
-        c, total = rot_cumsum(feasible)
-        sampled = feasible & (c <= K)
-        # nodes actually visited: up to and including the K-th feasible one
-        processed = jnp.where(
-            total >= K, jnp.sum(jnp.where(feasible & (c == K), r + 1, 0)), nt
-        )
-        count = jnp.minimum(total, K) * dp.pod_active[i]
+            c, total = rot_cumsum(feasible)
+            sampled = feasible & (c <= K)
+            # nodes actually visited: up to and including the K-th feasible
+            processed = jnp.where(
+                total >= K, jnp.sum(jnp.where(feasible & (c == K), r + 1, 0)), nt
+            )
+            count = jnp.minimum(total, K) * dp.pod_active[i]
+        else:
+            r = idx
+
+            def rot_cumsum(mask):
+                pref = jnp.cumsum(mask.astype(jnp.int32))
+                return pref, pref[N - 1]
+
+            sampled = feasible
+            total = jnp.sum(feasible.astype(jnp.int32))
+            processed = nt
+            count = total * dp.pod_active[i]
 
         # ----------------------------------------------------------- scores
         raws = {}
@@ -653,11 +761,43 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
                 out[f"norm:{n_}"] = norms[n_]
         return carry, out
 
+    def _expand_features(dp: DeviceProblem, dt) -> DeviceProblem:
+        """Expand the factored (pod-class × node-class) feature matrices to
+        the dense [P,N] views the step math reads.  Runs on-device inside
+        the jitted computation — the host never builds or ships them."""
+        pair = lambda cls, pi, ni: jnp.take(jnp.take(cls, pi, axis=0), ni, axis=1)
+        tu = pair(dp.taint_unsched_cls, dp.pod_tol_idx, dp.node_taint_idx)
+        idx_n = jnp.arange(N, dtype=jnp.int32)
+        tgt = dp.name_target[:, None]
+        return dp._replace(
+            taint_fail=pair(dp.taint_cls, dp.pod_tol_idx, dp.node_taint_idx),
+            taint_prefer=pair(dp.taint_prefer_cls, dp.pod_tol_idx, dp.node_taint_idx).astype(dt),
+            unsched_ok=(~dp.node_unsched)[None, :] | tu,
+            aff_code=pair(dp.aff_code_cls, dp.pod_aff_idx, dp.node_label_idx),
+            aff_pref=pair(dp.aff_pref_cls, dp.pod_pref_idx, dp.node_label_idx).astype(dt),
+            name_ok=jnp.where(tgt == -1, True, tgt == idx_n[None, :]),
+            incl=pair(dp.incl_cls, dp.pod_aff_idx, dp.node_label_idx),
+        )
+
     def _scan(carry0, dp: DeviceProblem):
+        dp = _expand_features(dp, carry0[0].dtype)
         carry, ys = lax.scan(functools.partial(step, dp), carry0, jnp.arange(P))
         ys["final_requested"] = carry[0]
         ys["final_pod_count"] = carry[2]
         ys["final_start"] = carry[-1]
+        # One fetchable [5,P] view of the per-pod scalar outputs: each
+        # device→host fetch pays a full host↔device roundtrip (tens of ms
+        # through a tunneled TPU), so non-trace callers read this single
+        # array instead of five.
+        ys["packed_pod"] = jnp.stack(
+            [
+                ys["selected"].astype(jnp.int32),
+                ys["feasible_count"].astype(jnp.int32),
+                ys["sample_start"].astype(jnp.int32),
+                ys["sample_processed"].astype(jnp.int32),
+                jnp.broadcast_to(ys["final_start"], (P,)).astype(jnp.int32),
+            ]
+        )
         return carry, ys
 
     CARRY0_FIELDS = (

@@ -10,19 +10,29 @@ device only ever sees numbers.
 
 Encoding layout (P = pending pods in queue order, N = nodes, R = resources):
 
-Static per-(pod,node) matrices — these never change as pods commit:
-- ``taint_fail``   [P,N] int32  index of first untolerated NoSchedule/
-                               NoExecute taint in the node's taint list
-                               (-1 = tolerated) — TaintToleration filter
-- ``taint_prefer`` [P,N] float  count of untolerated PreferNoSchedule taints
-                               — TaintToleration score
-- ``aff_code``     [P,N] int32  0 pass / 1 enforced-affinity fail /
-                               2 pod-affinity fail — NodeAffinity filter
-- ``aff_pref``     [P,N] float  matched preferred-term weight sum
-- ``unsched_ok``   [P,N] bool   NodeUnschedulable filter
-- ``name_ok``      [P,N] bool   NodeName filter
-- ``incl``         [P,N] bool   nodeSelector+requiredAffinity only —
-                               PodTopologySpread NodeInclusionPolicy mask
+Static per-(pod,node) features are FACTORED through equivalence classes —
+pods grouped by constraint signature (toleration set, affinity spec,
+preferred terms), nodes by taint/label signature — and shipped to the
+device as small class matrices plus per-pod/per-node class-index vectors;
+the kernel expands them to [P,N] on-device (ops/batch.py _expand_features).
+Factoring matters: at 10k pods × 5k nodes the dense matrices are ~700 MB
+of host→device traffic per round, the class form a few MB.
+- ``taint_cls``        [L,T] int16  index of first untolerated NoSchedule/
+                                   NoExecute taint (-1 = tolerated) per
+                                   (toleration-class, taint-class)
+- ``taint_prefer_cls`` [L,T] int16  count of untolerated PreferNoSchedule
+                                   taints — TaintToleration score
+- ``taint_unsched_cls``[L,T] bool   tolerates the unschedulable taint
+- ``pod_tol_idx`` [P] / ``node_taint_idx`` [N]: class indices
+- ``node_unsched``     [N]  bool   node.spec.unschedulable
+- ``aff_code_cls``     [A,M] int8  0 pass / 1 enforced-affinity fail /
+                                  2 pod-affinity fail — NodeAffinity filter
+- ``incl_cls``         [A,M] bool  nodeSelector+requiredAffinity only —
+                                  PodTopologySpread NodeInclusionPolicy mask
+- ``aff_pref_cls``     [B,M] int32 matched preferred-term weight sum
+- ``pod_aff_idx``/``pod_pref_idx`` [P], ``node_label_idx`` [N]: class indices
+- ``name_target``      [P] int32  NodeName filter: -1 = unconstrained,
+                                  node index, or -2 = named node absent
 
 Dynamic state (the lax.scan carry in ops/batch.py) is seeded with:
 - node ``requested``/``nonzero``/``pod_count`` from already-bound pods
@@ -40,7 +50,6 @@ are scale-invariant ratios.
 
 from __future__ import annotations
 
-import json
 import math
 from typing import Any, Callable, Mapping
 
@@ -75,8 +84,12 @@ HOSTNAME_KEY = "kubernetes.io/hostname"
 
 
 def _sig(obj: Any) -> str:
-    """Canonical signature for memoizing selector evaluation."""
-    return json.dumps(obj, sort_keys=True, separators=(",", ":"), default=str)
+    """Signature for memoizing selector evaluation and grouping equal
+    specs.  Used ONLY for deduplication — two semantically equal objects
+    that disagree on dict key order just land in separate (still-correct)
+    equivalence classes — so the fast non-canonical ``repr`` beats
+    canonical JSON (~4× cheaper, and this runs per pod per round)."""
+    return repr(obj)
 
 
 def _group(items: list[Any], keyfn: Callable[[Any], str]) -> "tuple[list[Any], np.ndarray]":
@@ -95,13 +108,15 @@ def _group(items: list[Any], keyfn: Callable[[Any], str]) -> "tuple[list[Any], n
     return reps, idx
 
 
-def _fit_resources(pod: Obj) -> dict[str, int]:
+def _fit_from_request(req: dict[str, int]) -> dict[str, int]:
     """Nonzero requests for the resources NodeResourcesFit checks
     (models/podresources.is_fit_resource — shared with the sequential
     plugin)."""
-    return {
-        r: v for r, v in pod_resource_request(pod).items() if v != 0 and is_fit_resource(r)
-    }
+    return {r: v for r, v in req.items() if v != 0 and is_fit_resource(r)}
+
+
+def _fit_resources(pod: Obj) -> dict[str, int]:
+    return _fit_from_request(pod_resource_request(pod))
 
 
 class SpreadConstraint:
@@ -135,24 +150,47 @@ def _namespace_of(pod: Obj) -> str:
 
 
 class _Memo:
-    """Memoized selector matchers shared across the encoding pass."""
+    """Memoized selector matchers shared across the encoding pass.
+
+    Signatures are themselves cached by object identity — the same
+    selector/term/pod dicts are matched against thousands of partners, and
+    re-serializing them per pair dominates encoding time at 10k pods."""
 
     def __init__(self, ns_labels: Mapping[str, Mapping[str, str]]):
         self.ns_labels = ns_labels
         self._label_sel: dict[tuple[str, str], bool] = {}
         self._term: dict[tuple[str, str, str], bool] = {}
+        self._sig_by_id: dict[int, str] = {}
+        self._lsig_by_id: dict[int, str] = {}
 
-    def label_selector(self, sel: "Obj | None", labels: Mapping[str, str]) -> bool:
-        k = (_sig(sel), _sig(sorted(labels.items())))
+    def sig_of(self, obj: Any) -> str:
+        k = id(obj)
+        v = self._sig_by_id.get(k)
+        if v is None:
+            v = _sig(obj)
+            self._sig_by_id[k] = v
+        return v
+
+    def label_sig_of(self, obj_with_meta: Obj) -> str:
+        """Label signature of a pod/node object, keyed by object identity."""
+        k = id(obj_with_meta)
+        v = self._lsig_by_id.get(k)
+        if v is None:
+            v = _sig(sorted((obj_with_meta["metadata"].get("labels") or {}).items()))
+            self._lsig_by_id[k] = v
+        return v
+
+    def label_selector(self, sel: "Obj | None", pod: Obj) -> bool:
+        k = (self.sig_of(sel), self.label_sig_of(pod))
         v = self._label_sel.get(k)
         if v is None:
-            v = match_label_selector(sel, labels)
+            v = match_label_selector(sel, pod["metadata"].get("labels") or {})
             self._label_sel[k] = v
         return v
 
     def affinity_term(self, term: Obj, owner_ns: str, target: Obj) -> bool:
-        k = (_sig(term) + "|" + owner_ns,
-             _sig(sorted((target["metadata"].get("labels") or {}).items())),
+        k = (self.sig_of(term) + "|" + owner_ns,
+             self.label_sig_of(target),
              _namespace_of(target))
         v = self._term.get(k)
         if v is None:
@@ -187,9 +225,11 @@ def encode(
     node_infos = build_node_infos(nodes, all_pods)
 
     # ------------------------------------------------------------- resources
+    req_of = [pod_resource_request(p) for p in pending]
+    fit_of = [_fit_from_request(req) for req in req_of]
     res_set: set[str] = {CPU, MEMORY}
-    for p in pending:
-        res_set |= set(_fit_resources(p))
+    for fr in fit_of:
+        res_set |= set(fr)
     pr.resource_names = sorted(res_set)
     res_idx = {r: i for i, r in enumerate(pr.resource_names)}
     R = pr.R = len(pr.resource_names)
@@ -220,7 +260,7 @@ def encode(
     pod_req = np.zeros((P, R), dtype=np.int64)
     pod_nonzero = np.zeros((P, 2), dtype=np.int64)
     for i, p in enumerate(pending):
-        for r, v in pod_resource_request(p).items():
+        for r, v in req_of[i].items():
             if r in res_idx:
                 pod_req[i, res_idx[r]] = v
         nz = pod_non_zero_request(p)
@@ -231,7 +271,7 @@ def encode(
     fit_checked = np.zeros((P, R), dtype=bool)
     fit_order: list[list[int]] = []
     for i, p in enumerate(pending):
-        cols = [res_idx[r] for r in _fit_resources(p)]
+        cols = [res_idx[r] for r in fit_of[i]]
         for c in cols:
             fit_checked[i, c] = True
         fit_order.append(cols)
@@ -242,8 +282,8 @@ def encode(
     def _gcd_scale(columns: "list[np.ndarray]") -> None:
         g = 0
         for arr in columns:
-            for v in arr:
-                g = math.gcd(g, int(v))
+            if arr.size:
+                g = math.gcd(g, int(np.gcd.reduce(np.abs(arr), initial=0)))
         g = g or 1
         for arr in columns:
             arr //= g
@@ -287,11 +327,14 @@ def encode(
                 and not tolerations_tolerate_taint(prefer_tols, t)
             )
             tu[a, b] = tolerates_unsched
-    pr.taint_fail = tf[tol_idx][:, taint_idx]
-    pr.taint_prefer = tp[tol_idx][:, taint_idx]
+    pr.taint_cls, pr.taint_prefer_cls = tf, tp
     # NodeUnschedulable: fails unless the pod tolerates the unschedulable
-    # taint (upstream nodeunschedulable.go).
-    pr.unsched_ok = ~node_unsched[None, :] | tu[tol_idx][:, taint_idx]
+    # taint (upstream nodeunschedulable.go) — the kernel combines
+    # taint_unsched_cls with node_unsched on-device.
+    pr.taint_unsched_cls = tu
+    pr.pod_tol_idx = tol_idx
+    pr.node_taint_idx = taint_idx
+    pr.node_unsched = node_unsched
 
     # NodeAffinity + nodeSelector (+ plugin-level addedAffinity), and the
     # spread inclusion mask (no addedAffinity).
@@ -329,8 +372,9 @@ def encode(
             if iok and spec["req"] is not None and not match_node_selector(spec["req"], labels, name):
                 iok = False
             inc[a, b] = iok
-    pr.aff_code = ac[aff_idx][:, nl_idx]
-    pr.incl = inc[aff_idx][:, nl_idx]
+    pr.aff_code_cls, pr.incl_cls = ac, inc
+    pr.pod_aff_idx = aff_idx
+    pr.node_label_idx = nl_idx
 
     # Preferred node-affinity weights.
     pref_reps, pref_idx = _group(
@@ -352,18 +396,17 @@ def encode(
                 if w and match_node_selector_term(item.get("preference") or {}, nl["labels"], nl["name"]):
                     total += w
             ap[a, b] = total
-    pr.aff_pref = ap[pref_idx][:, nl_idx]
+    pr.aff_pref_cls = ap
+    pr.pod_pref_idx = pref_idx
 
-    # NodeName
+    # NodeName: target node index (-1 unconstrained, -2 named node absent)
     name_to_idx = {nm: i for i, nm in enumerate(pr.node_names)}
-    name_ok = np.ones((P, N), dtype=bool)
+    name_target = np.full(P, -1, dtype=np.int32)
     for i, p in enumerate(pending):
         want = (p.get("spec") or {}).get("nodeName")
         if want:
-            name_ok[i] = False
-            if want in name_to_idx:
-                name_ok[i, name_to_idx[want]] = True
-    pr.name_ok = name_ok
+            name_target[i] = name_to_idx.get(want, -2)
+    pr.name_target = name_target
 
     # ------------------------------------------------------ topology domains
     topo_keys: list[str] = []
@@ -433,7 +476,7 @@ def encode(
     sg_specs: list[tuple[str, "Obj | None"]] = []  # (namespace, selector)
 
     def spread_group(ns: str, sel: "Obj | None") -> int:
-        k = ns + "|" + _sig(sel)
+        k = ns + "|" + memo.sig_of(sel)
         if k not in sg_table:
             sg_table[k] = len(sg_specs)
             sg_specs.append((ns, sel))
@@ -443,14 +486,13 @@ def encode(
     pod_spread_score: list[list[SpreadConstraint]] = []
     for i, p in enumerate(pending):
         ns = _namespace_of(p)
-        labels = p["metadata"].get("labels") or {}
         fl, sl = [], []
         for c in (p.get("spec") or {}).get("topologySpreadConstraints") or []:
             sc = SpreadConstraint(
                 key_id(c["topologyKey"]),
                 spread_group(ns, c.get("labelSelector")),
                 int(c.get("maxSkew") or 1),
-                memo.label_selector(c.get("labelSelector"), labels),
+                memo.label_selector(c.get("labelSelector"), p),
             )
             (fl if c.get("whenUnsatisfiable") == "DoNotSchedule" else sl).append(sc)
         pod_spread_filter.append(fl)
@@ -464,7 +506,7 @@ def encode(
             spread_match[s, j] = (
                 _namespace_of(p) == ns
                 and not p["metadata"].get("deletionTimestamp")
-                and memo.label_selector(sel, p["metadata"].get("labels") or {})
+                and memo.label_selector(sel, p)
             )
         for n_i, ni in enumerate(node_infos):
             cnt = 0
@@ -472,7 +514,7 @@ def encode(
                 if (
                     _namespace_of(ep) == ns
                     and not ep["metadata"].get("deletionTimestamp")
-                    and memo.label_selector(sel, ep["metadata"].get("labels") or {})
+                    and memo.label_selector(sel, ep)
                 ):
                     cnt += 1
             spread_counts0[s, n_i] = cnt
@@ -639,16 +681,21 @@ def encode(
 # --------------------------------------------------------- shape bucketing
 
 def _bucket(x: int) -> int:
-    """Next size in the {2^k, 1.5·2^k} series (≤33% padding waste) — the
-    jit cache then sees O(log) distinct shapes as pods/nodes churn instead
-    of one compile per exact dimension (SURVEY §7 hard part (b))."""
+    """Next size in the {2^k, 1.25·2^k, 1.5·2^k, 1.75·2^k} series (≤25%
+    padding waste) — the jit cache then sees O(log) distinct shapes as
+    pods/nodes churn instead of one compile per exact dimension (SURVEY §7
+    hard part (b)); scan wall time is linear in the padded pod axis, so
+    tighter buckets directly buy back kernel time."""
     if x <= 0:
         return 0
     if x <= 8:
         return 8
     k = math.ceil(math.log2(x))
-    mid = 3 * 2 ** (k - 2)
-    return mid if mid >= x else 2 ** k
+    for frac in (5, 6, 7):  # 1.25/1.5/1.75 × 2^(k-1)
+        mid = frac * 2 ** (k - 3)
+        if mid >= x:
+            return mid
+    return 2 ** k
 
 
 def _pad_axis(a: np.ndarray, axis: int, target: int, fill) -> np.ndarray:
@@ -676,11 +723,13 @@ def pad_problem(pr: BatchProblem) -> BatchProblem:
     pr.pod_active = _pad_axis(np.ones(P, dtype=bool), 0, P_pad, False)
     pr.node_active = _pad_axis(np.ones(N, dtype=bool), 0, N_pad, False)
 
-    # pod axis (rows)
+    # pod axis (rows).  Class-index vectors pad with class 0 — padding rows
+    # are never committed (pod_active False) and padded nodes never feasible
+    # (node_active False), so the class content is irrelevant.
     for name, fill in (
         ("pod_req", 0), ("pod_nonzero", 0), ("fit_checked", False),
-        ("taint_fail", -1), ("taint_prefer", 0), ("unsched_ok", True),
-        ("aff_code", 0), ("aff_pref", 0), ("name_ok", True), ("incl", False),
+        ("pod_tol_idx", 0), ("pod_aff_idx", 0), ("pod_pref_idx", 0),
+        ("name_target", -1),
         ("spf_key", -1), ("spf_group", 0), ("spf_skew", 1), ("spf_self", 0),
         ("sps_key", -1), ("sps_group", 0), ("sps_skew", 1), ("sps_self", 0),
         ("ip_aff_g", -1), ("ip_anti_g", -1), ("ip_pref_g", -1), ("ip_pref_w", 0),
@@ -695,11 +744,10 @@ def pad_problem(pr: BatchProblem) -> BatchProblem:
     for name, fill in (
         ("alloc", 0), ("max_pods", 0), ("nz_alloc", 0), ("requested0", 0),
         ("nonzero0", 0), ("pod_count0", 0),
+        ("node_taint_idx", 0), ("node_label_idx", 0), ("node_unsched", False),
     ):
         setattr(pr, name, _pad_axis(getattr(pr, name), 0, N_pad, fill))
     for name, fill in (
-        ("taint_fail", -1), ("taint_prefer", 0), ("unsched_ok", True),
-        ("aff_code", 0), ("aff_pref", 0), ("name_ok", True), ("incl", False),
         ("node_domain", -1), ("spread_counts0", 0),
     ):
         setattr(pr, name, _pad_axis(getattr(pr, name), 1, N_pad, fill))

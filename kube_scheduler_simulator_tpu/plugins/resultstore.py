@@ -154,9 +154,10 @@ class ResultStore:
             for cat, data in categories.items():
                 if cat not in e:
                     raise KeyError(f"unknown result category {cat!r}")
-                if isinstance(e[cat], dict):
+                if isinstance(e[cat], dict) and isinstance(data, dict):
                     e[cat].update(data)
                 else:
+                    # RawJSON (pre-marshaled) or scalar: replace wholesale
                     e[cat] = data
 
     # ------------------------------------------------------------------ read

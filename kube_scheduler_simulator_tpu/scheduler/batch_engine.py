@@ -68,7 +68,15 @@ FILTER_MESSAGES = {
 
 
 class BatchResult:
-    """Outcome of one batch scheduling pass, with lazy trace formatting."""
+    """Outcome of one batch scheduling pass, with lazy trace formatting.
+
+    The per-node trace arrives COMPACTED over each pod's visited nodes as
+    one int32 stack (ops/batch.build_compact_fn): visited node ids,
+    per-filter reason codes, the feasible mask, and raw/normalized scores.
+    Formatting converts rows to plain Python lists/strings once (C-side)
+    and indexes those — at bench scale, per-element numpy indexing and
+    ``str()`` calls are the difference between seconds and minutes of
+    annotation building."""
 
     def __init__(
         self, engine: "BatchEngine", pending: list[Obj], out: dict, pr: E.BatchProblem, nodes: list[Obj]
@@ -82,6 +90,7 @@ class BatchResult:
         self.feasible_count = np.asarray(out["feasible_count"])
         self.node_names = pr.node_names
         self.pod_keys = pr.pod_keys
+        self._lists: "dict | None" = None  # lazy tolist() caches
 
     @property
     def selected_nodes(self) -> "list[str | None]":
@@ -97,88 +106,290 @@ class BatchResult:
 
     # ------------------------------------------------------------ trace
 
-    def visited(self, i: int) -> "np.ndarray":
-        """[N] bool: nodes the sampled filter pass actually visited for pod
-        i (upstream stops at numFeasibleNodesToFind; unvisited nodes never
-        appear in diagnosis or the filter annotation)."""
-        start = int(np.asarray(self.out["sample_start"])[i])
-        processed = int(np.asarray(self.out["sample_processed"])[i])
-        nt = self.problem.N_true
-        rank = (np.arange(nt) - start) % max(nt, 1)
-        return rank < processed
+    def _tr(self) -> dict:
+        """Python views of the compact int trace (built once, vectorized).
+
+        Score values are pre-rendered to interned strings (one ``str()``
+        per DISTINCT value via a np.unique LUT), and final scores
+        pre-multiplied by plugin weight — per-element ``str()`` calls
+        would otherwise dominate annotation building at bench scale."""
+        if self._lists is None:
+            tr = self.out["trace"]
+            cfg = self._engine.cfg
+            codes = tr.get("codes")
+
+            def strs(arr: "np.ndarray") -> list:
+                """[P,W] ints → [P][W] of INTERNED str objects: np.unique +
+                object-LUT indexing formats each distinct value once
+                (unicode astype would re-format all P×W elements)."""
+                uniq, inv = np.unique(arr, return_inverse=True)
+                lut = np.array([str(int(v)) for v in uniq], dtype=object)
+                return lut[inv].reshape(arr.shape).tolist()
+
+            self._lists = {
+                "ids": tr["ids"].tolist(),
+                "codes": {f: codes[k].tolist() for k, f in enumerate(cfg.filters)}
+                if codes is not None
+                else {},
+                # [P,W] bool: any filter failed at this visited node
+                "fail_any": (codes != 0).any(axis=0)
+                if codes is not None
+                else np.zeros(tr["ids"].shape, bool),
+                "feas": tr["feas"].tolist(),
+                "norm_int": {s: tr["norm"][k] for k, (s, _w) in enumerate(cfg.scores)},
+                "raw_s": {s: strs(tr["raw"][k]) for k, (s, _w) in enumerate(cfg.scores)},
+                "final_s": {
+                    s: strs(tr["norm"][k].astype(np.int32) * int(w))
+                    for k, (s, w) in enumerate(cfg.scores)
+                },
+                # failure messages repeat across pods — memo by site
+                "msg_memo": {},
+            }
+            # one SHARED all-passed entry (never mutated): most visited
+            # nodes pass every filter, and the annotation writer only
+            # serializes these dicts
+            self._lists["passed_entry"] = {
+                p: PASSED_FILTER_MESSAGE for p in self._engine.filters
+            }
+        return self._lists
+
+    def _msg(self, i: int, n: int, plugin: str, code: int) -> str:
+        """Memoized failure-message formatting: messages depend only on
+        (plugin, code) plus the node's taints (TaintToleration) or the
+        pod's resource order (Fit), and repeat across thousands of
+        (pod, node) pairs in a big round."""
+        memo = self._tr()["msg_memo"]
+        if plugin == "TaintToleration":
+            key = (plugin, code, n)
+        elif plugin == "NodeResourcesFit":
+            key = (plugin, code, tuple(self.problem.fit_order[i]))
+        else:
+            key = (plugin, code, None)
+        v = memo.get(key)
+        if v is None:
+            v = self._engine.filter_message(self, i, n, plugin, code)
+            memo[key] = v
+        return v
 
     def filter_annotation(self, i: int) -> dict:
         """The scheduler-simulator/filter-result map for pod i: node →
         plugin → "passed"/failure message, honoring the first-failure
         short circuit of the sequential cycle."""
         assert self._engine.cfg.trace, "run with trace=True for annotations"
-        pr, out = self.problem, self.out
-        visited = self.visited(i)
-        nodes = [n for n in self._prefilter_nodes(i) if visited[n]]
+        tr = self._tr()
+        ids = tr["ids"][i]
+        narrowed = self._prefilter_node_set(i)
+        fail_any = tr["fail_any"][i]
+        passed_entry = tr["passed_entry"]
+        node_names = self.problem.node_names
         result: dict = {}
-        for n in nodes:
-            nm = pr.node_names[n]
+        if not fail_any.any():
+            # fast path: every visited node passes every filter — share
+            # ONE entry dict (the annotation writer only reads these)
+            for n in ids:
+                if n < 0:  # compact rows put padding at the tail
+                    break
+                if narrowed is not None and n not in narrowed:
+                    continue
+                result[node_names[n]] = passed_entry
+            return result
+        codes = tr["codes"]
+        # Iterate the FULL enabled filter list (profile order): plugins
+        # without a kernel are no-ops for supported workloads and the
+        # oracle still records "passed" for them.
+        plugins = [(p, codes.get(p)) for p in self._engine.filters]
+        for j, n in enumerate(ids):
+            if n < 0:
+                break
+            if narrowed is not None and n not in narrowed:
+                continue
+            if not fail_any[j]:
+                result[node_names[n]] = passed_entry
+                continue
             entry: dict = {}
-            # Iterate the FULL enabled filter list (profile order): plugins
-            # without a kernel are no-ops for supported workloads and the
-            # oracle still records "passed" for them.
-            for plugin in self._engine.filters:
-                code = (
-                    int(np.asarray(out[f"code:{plugin}"])[i, n])
-                    if f"code:{plugin}" in out
-                    else 0
-                )
+            for plugin, crow in plugins:
+                code = int(crow[i][j]) if crow is not None else 0
                 if code == 0:
                     entry[plugin] = PASSED_FILTER_MESSAGE
                 else:
-                    entry[plugin] = self._engine.filter_message(self, i, n, plugin, code)
+                    entry[plugin] = self._msg(i, n, plugin, code)
                     break
-            result[nm] = entry
+            result[node_names[n]] = entry
         return result
 
     def score_annotations(self, i: int) -> "tuple[dict, dict]":
         """(score, finalScore) maps for pod i over feasible nodes."""
         assert self._engine.cfg.trace
-        pr, out = self.problem, self.out
-        feasible = np.asarray(out["feasible"])[i]
         score: dict = {}
         final: dict = {}
         if int(self.feasible_count[i]) <= 1:
             return score, final
-        for n in np.nonzero(feasible)[0]:
-            nm = pr.node_names[n]
-            score[nm] = {}
-            final[nm] = {}
-            for plugin, weight in self._engine.cfg.scores:
-                raw = int(np.asarray(out[f"raw:{plugin}"])[i, n])
-                norm = int(np.asarray(out[f"norm:{plugin}"])[i, n])
-                score[nm][plugin] = str(raw)
-                final[nm][plugin] = str(norm * int(weight))
+        tr = self._tr()
+        ids = tr["ids"][i]
+        feas = tr["feas"][i]
+        rows = [
+            (plugin, tr["raw_s"][plugin][i], tr["final_s"][plugin][i])
+            for plugin, _weight in self._engine.cfg.scores
+        ]
+        node_names = self.problem.node_names
+        for j, n in enumerate(ids):
+            if n < 0:
+                break
+            if not feas[j]:
+                continue
+            nm = node_names[n]
+            score[nm] = {plugin: raw_s[j] for plugin, raw_s, _f in rows}
+            final[nm] = {plugin: final_s[j] for plugin, _r, final_s in rows}
         return score, final
 
     def diagnosis(self, i: int) -> dict[str, Status]:
         """Per-node failure Status map (for failure messages/postfilter)."""
         assert self._engine.cfg.trace
-        pr, out = self.problem, self.out
+        tr = self._tr()
+        ids = tr["ids"][i]
+        narrowed = self._prefilter_node_set(i)
+        codes = [(p, tr["codes"][p]) for p in self._engine.cfg.filters]
         diag: dict[str, Status] = {}
-        visited = self.visited(i)
-        for n in (n for n in self._prefilter_nodes(i) if visited[n]):
-            for plugin in self._engine.cfg.filters:
-                code = int(np.asarray(out[f"code:{plugin}"])[i, n])
+        fail_any = tr["fail_any"][i]
+        for j in np.nonzero(fail_any)[0]:
+            n = ids[j]
+            if n < 0:
+                continue
+            if narrowed is not None and n not in narrowed:
+                continue
+            for plugin, crow in codes:
+                code = int(crow[i][j])
                 if code != 0:  # only kernel plugins can fail (others no-op)
-                    msg = self._engine.filter_message(self, i, n, plugin, code)
-                    diag[pr.node_names[n]] = Status.unschedulable(msg)
+                    msg = self._msg(i, n, plugin, code)
+                    diag[self.problem.node_names[n]] = Status.unschedulable(msg)
                     break
         return diag
 
-    def _prefilter_nodes(self, i: int) -> list[int]:
+    # ------------------------------------------------- pre-marshaled JSON
+
+    def _fr(self) -> dict:
+        """Per-round fragments for direct annotation-JSON assembly: node
+        key fragments, the shared all-passed entry's bytes, and sorted
+        score-plugin key fragments.  Joining pre-escaped fragments is
+        byte-identical to go_marshal on the dict (escaping is per-char,
+        sorting reproduced explicitly) and skips the dominant json.dumps
+        cost at bench scale — the parity suites pin the bytes."""
+        tr = self._tr()
+        if "frags" not in tr:
+            from kube_scheduler_simulator_tpu.utils.gojson import go_marshal, go_string_key
+
+            names = self.problem.node_names
+            splugins = sorted(s for s, _w in self._engine.cfg.scores)
+            tr["frags"] = {
+                "key": [go_string_key(nm) for nm in names],
+                "passed": go_marshal(tr["passed_entry"]),
+                "splug": [(go_string_key(s) + '"', s) for s in splugins],
+            }
+        return tr["frags"]
+
+    def filter_annotation_json(self, i: int) -> "str":
+        """go_marshal(filter_annotation(i)) assembled from fragments."""
+        from kube_scheduler_simulator_tpu.utils.gojson import RawJSON, go_marshal
+
+        tr = self._tr()
+        fr = self._fr()
+        ids = tr["ids"][i]
+        narrowed = self._prefilter_node_set(i)
+        fail_any = tr["fail_any"][i]
+        names = self.problem.node_names
+        visited = []
+        for j, n in enumerate(ids):
+            if n < 0:
+                break
+            if narrowed is not None and n not in narrowed:
+                continue
+            visited.append((j, n))
+        visited.sort(key=lambda t: names[t[1]])  # go_marshal key order
+        key_frag = fr["key"]
+        passed = fr["passed"]
+        parts = []
+        if not fail_any.any():
+            for _j, n in visited:
+                parts.append(key_frag[n] + passed)
+        else:
+            codes = tr["codes"]
+            plugins = [(p, codes.get(p)) for p in self._engine.filters]
+            for j, n in visited:
+                if not fail_any[j]:
+                    parts.append(key_frag[n] + passed)
+                    continue
+                entry: dict = {}
+                for plugin, crow in plugins:
+                    code = int(crow[i][j]) if crow is not None else 0
+                    if code == 0:
+                        entry[plugin] = PASSED_FILTER_MESSAGE
+                    else:
+                        entry[plugin] = self._msg(i, n, plugin, code)
+                        break
+                parts.append(key_frag[n] + go_marshal(entry))
+        return RawJSON("{" + ",".join(parts) + "}")
+
+    def score_annotations_json(self, i: int) -> "tuple[str, str]":
+        """(score, finalScore) annotation JSON assembled from fragments.
+        Score values are numeric strings — no escaping needed."""
+        from kube_scheduler_simulator_tpu.utils.gojson import RawJSON
+
+        tr = self._tr()
+        fr = self._fr()
+        ids = tr["ids"][i]
+        feas = tr["feas"][i]
+        names = self.problem.node_names
+        key_frag = fr["key"]
+        splug = fr["splug"]
+        raw_rows = [(frag, tr["raw_s"][s][i]) for frag, s in splug]
+        fin_rows = [(frag, tr["final_s"][s][i]) for frag, s in splug]
+        feas_nodes = []
+        for j, n in enumerate(ids):
+            if n < 0:
+                break
+            if feas[j]:
+                feas_nodes.append((j, n))
+        feas_nodes.sort(key=lambda t: names[t[1]])
+        s_parts = []
+        f_parts = []
+        for j, n in feas_nodes:
+            s_parts.append(
+                key_frag[n] + "{" + ",".join(frag + row[j] + '"' for frag, row in raw_rows) + "}"
+            )
+            f_parts.append(
+                key_frag[n] + "{" + ",".join(frag + row[j] + '"' for frag, row in fin_rows) + "}"
+            )
+        return (
+            RawJSON("{" + ",".join(s_parts) + "}"),
+            RawJSON("{" + ",".join(f_parts) + "}"),
+        )
+
+    def totals_map(self, i: int) -> dict[int, int]:
+        """Visited node index → weighted score total (Σ weight×normalized,
+        recomputed from the compact trace — trace mode)."""
+        tr = self._tr()
+        ids = tr["ids"][i]
+        totals: dict[int, int] = {n: 0 for n in ids if n >= 0}
+        for (plugin, weight) in self._engine.cfg.scores:
+            norm_row = tr["norm_int"][plugin][i]
+            for j, n in enumerate(ids):
+                if n >= 0:
+                    totals[n] += int(norm_row[j]) * int(weight)
+        return totals
+
+    def feasible_idx(self, i: int) -> set[int]:
+        """Visited node indices that passed all filters (trace mode)."""
+        tr = self._tr()
+        return {n for n, f in zip(tr["ids"][i], tr["feas"][i]) if n >= 0 and f}
+
+    def _prefilter_node_set(self, i: int) -> "set[int] | None":
         """Node indices surviving PreFilter narrowing (NodeAffinity
         matchFields pinning restricts which nodes the cycle visits)."""
         narrowed = self._engine.prefilter_node_names(self.pending[i])
         if narrowed is None:
-            return list(range(self.problem.N_true))
+            return None
         idx = {nm: j for j, nm in enumerate(self.problem.node_names)}
-        return sorted(idx[nm] for nm in narrowed if nm in idx)
+        return {idx[nm] for nm in narrowed if nm in idx}
 
 
 class BatchEngine:
@@ -224,6 +435,9 @@ class BatchEngine:
             seed=seed,
         )
         self._fn_cache: dict = {}
+        # trace-compaction executables, keyed by (scan key, visited-width
+        # bucket) — kept apart so _fn_cache counts scan executables only
+        self._compact_cache: dict = {}
         self.last_timings: dict[str, float] = {}
         # Cumulative observability counters (surfaced by /api/v1/metrics):
         # rounds = schedule() calls, compiles = jit-cache misses,
@@ -399,27 +613,57 @@ class BatchEngine:
         dp, dims = B.lower(pr, dtype=self.dtype)
         import jax.numpy as jnp
 
+        sample_k = num_feasible_nodes_to_find(len(nodes), self.percentage_of_nodes_to_score)
+        start0 = start_index % max(len(nodes), 1)
         dp = dp._replace(
             tb_base=jnp.asarray(base_counter & 0xFFFFFFFF, dtype=jnp.uint32),
-            sample_k=jnp.asarray(
-                num_feasible_nodes_to_find(len(nodes), self.percentage_of_nodes_to_score),
-                dtype=jnp.int32,
-            ),
-            start0=jnp.asarray(start_index % max(len(nodes), 1), dtype=jnp.int32),
+            sample_k=jnp.asarray(sample_k, dtype=jnp.int32),
+            start0=jnp.asarray(start0, dtype=jnp.int32),
         )
-        key = (tuple(sorted(dims.items())), self.cfg)
+        # Compile out the sampling machinery when it cannot engage this
+        # round (full coverage, no rotation): visit order == index order.
+        cfg = self.cfg._replace(sampling=sample_k < len(nodes) or start0 != 0)
+        key = (tuple(sorted(dims.items())), cfg)
         fn = self._fn_cache.get(key)
         t2 = time.perf_counter()
         if fn is None:
             # donate: dp is rebuilt per round, so its buffers can alias
             # into the scan carry instead of being copied
-            fn = B.build_batch_fn(self.cfg, dims, donate=True)
+            fn = B.build_batch_fn(cfg, dims, donate=True)
             self._fn_cache[key] = fn
             self.compiles += 1
-        out = fn(dp)
-        # "_"-prefixed entries (the donation-aliased final carry) stay on
-        # device and are not part of the result contract
-        out = {k: np.asarray(v) for k, v in out.items() if not k.startswith("_")}
+        out_dev = fn(dp)
+        # one roundtrip: the packed [5,P] per-pod view (see ops/batch)
+        packed = np.asarray(out_dev["packed_pod"])
+        out = {
+            "selected": packed[0],
+            "feasible_count": packed[1],
+            "sample_start": packed[2],
+            "sample_processed": packed[3],
+            "final_start": packed[4, 0] if packed.shape[1] else np.int32(0),
+        }
+        if self.trace:
+            # Compact the [P,N] trace down to each pod's visited nodes on
+            # device, then fetch the two stacks (2 roundtrips, ~visited/N
+            # of the dense volume — the tunnel D2H path is ~10 MB/s).
+            max_processed = int(packed[3].max()) if packed.shape[1] else 1
+            W = min(dims["N"], E._bucket(max(max_processed, 1)))
+            ckey = (key, W)
+            cfn = self._compact_cache.get(ckey)
+            if cfn is None:
+                cfn = B.build_compact_fn(cfg, dims, W)
+                self._compact_cache[ckey] = cfn
+                self.compiles += 1
+            tr_keys = ("sample_start", "sample_processed", "feasible")
+            cout = cfn(
+                {
+                    k: v
+                    for k, v in out_dev.items()
+                    if k in tr_keys or k.startswith(("code:", "raw:", "norm:"))
+                },
+                dp.n_true,
+            )
+            out["trace"] = {k: np.asarray(v) for k, v in cout.items()}
         t3 = time.perf_counter()
         self.last_timings = {
             "encode_s": t1 - t0,
@@ -428,8 +672,11 @@ class BatchEngine:
             "total_s": t3 - t0,
         }
         self.rounds += 1
-        for k, v in self.last_timings.items():
-            self.cum_timings[k] = self.cum_timings.get(k, 0.0) + v
+        # rebind (not mutate) so the metrics scrape thread can copy the
+        # captured dict without holding a lock
+        self.cum_timings = {
+            k: self.cum_timings.get(k, 0.0) + v for k, v in self.last_timings.items()
+        }
         return BatchResult(self, pending, out, pr, nodes)
 
     # ----------------------------------------------------- trace helpers

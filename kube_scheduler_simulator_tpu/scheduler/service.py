@@ -38,17 +38,6 @@ def _pod_key(pod: Obj) -> str:
     return f"{pod['metadata'].get('namespace', 'default')}/{pod['metadata']['name']}"
 
 
-def _safe_copy(d: dict) -> dict:
-    """Copy a dict that another thread (the background scheduler loop) may
-    be inserting into; retries the rare mid-iteration resize."""
-    for _ in range(5):
-        try:
-            return dict(d)
-        except RuntimeError:
-            continue
-    return {}
-
-
 class SchedulerService:
     def __init__(
         self,
@@ -102,6 +91,8 @@ class SchedulerService:
             "batch_restarts": 0,
             "sequential_pods": 0,
         }
+        # guards batch_fallbacks against the metrics scrape thread
+        self._stats_lock = threading.Lock()
 
     # ----------------------------------------------------------- extension
 
@@ -269,17 +260,20 @@ class SchedulerService:
     # ------------------------------------------------------------- run loop
 
     def pending_pods(self) -> list[Obj]:
+        # copy_objects=False: the scheduling paths only read pod specs
+        # (the reference reads the informer cache the same way); at scale,
+        # deep-copying annotation-laden pods dominates the round otherwise
         return [
             p
-            for p in self.cluster_store.list("pods")
+            for p in self.cluster_store.list("pods", copy_objects=False)
             if not (p.get("spec") or {}).get("nodeName") and not p["metadata"].get("deletionTimestamp")
         ]
 
     def build_snapshot(self) -> Snapshot:
         return Snapshot(
-            self.cluster_store.list("nodes"),
-            self.cluster_store.list("pods"),
-            self.cluster_store.list("namespaces"),
+            self.cluster_store.list("nodes", copy_objects=False),
+            self.cluster_store.list("pods", copy_objects=False),
+            self.cluster_store.list("namespaces", copy_objects=False),
         )
 
     def schedule_pending(self, max_rounds: int = 3) -> dict[str, ScheduleResult]:
@@ -339,7 +333,7 @@ class SchedulerService:
         pending = fw.sort_pods(self.pending_pods())
         if not pending:
             return {}
-        nodes = self.cluster_store.list("nodes")
+        nodes = self.cluster_store.list("nodes", copy_objects=False)
         if self.use_batch == "auto" and len(pending) * max(len(nodes), 1) < self.batch_min_work:
             self._count_fallback("below batch_min_work")
             return None
@@ -363,9 +357,9 @@ class SchedulerService:
             tail = pending[i:]
             result = eng.schedule(
                 nodes,
-                self.cluster_store.list("pods"),
+                self.cluster_store.list("pods", copy_objects=False),
                 tail,
-                self.cluster_store.list("namespaces"),
+                self.cluster_store.list("namespaces", copy_objects=False),
                 base_counter=fw.sched_counter,
                 start_index=fw.next_start_node_index,
             )
@@ -407,8 +401,9 @@ class SchedulerService:
         return results
 
     def _count_fallback(self, reason: str) -> None:
-        fb = self.stats["batch_fallbacks"]
-        fb[reason] = fb.get(reason, 0) + 1
+        with self._stats_lock:
+            fb = self.stats["batch_fallbacks"]
+            fb[reason] = fb.get(reason, 0) + 1
 
     def metrics(self) -> dict[str, Any]:
         """Observability snapshot for the metrics endpoint (the reference
@@ -416,17 +411,21 @@ class SchedulerService:
         pkg/debuggablescheduler/debuggable_scheduler.go:13-15; here the
         simulator's own counters are first-class)."""
         eng = self._batch_engine
+        with self._stats_lock:
+            fallbacks = dict(self.stats["batch_fallbacks"])
         return {
             "batch_commits": self.stats["batch_commits"],
             "batch_pods": self.stats["batch_pods"],
             "batch_restarts": self.stats["batch_restarts"],
             "sequential_pods": self.stats["sequential_pods"],
-            "batch_fallbacks": _safe_copy(self.stats["batch_fallbacks"]),
+            "batch_fallbacks": fallbacks,
             "engine_rounds": eng.rounds if eng else 0,
             "engine_compiles": eng.compiles if eng else 0,
             "engine_cache_entries": len(eng._fn_cache) if eng else 0,
-            "engine_last_timings": _safe_copy(eng.last_timings) if eng else {},
-            "engine_cum_timings": _safe_copy(eng.cum_timings) if eng else {},
+            # the engine rebinds these dicts wholesale per round, so
+            # copying the captured object is race-free
+            "engine_last_timings": dict(eng.last_timings) if eng else {},
+            "engine_cum_timings": dict(eng.cum_timings) if eng else {},
         }
 
     def _commit_batch_pod(
@@ -466,11 +465,13 @@ class SchedulerService:
 
                     narrowed = PreFilterResult(names)
             rs.add_pre_filter_result(ns, name, pn, SUCCESS_MESSAGE, narrowed)
-        rs.add_batch_results(ns, name, filter=result.filter_annotation(i))
+        # pre-marshaled fragments (RawJSON) — byte-identical to marshaling
+        # the dict forms, without the json.dumps cost per pod
+        rs.add_batch_results(ns, name, filter=result.filter_annotation_json(i))
         if feasible_count > 1:
             for pn in point_names["pre_score"]:
                 rs.add_pre_score_result(ns, name, pn, SUCCESS_MESSAGE)
-            score, final = result.score_annotations(i)
+            score, final = result.score_annotations_json(i)
             rs.add_batch_results(ns, name, score=score, finalScore=final)
 
         if sel >= 0:

@@ -67,3 +67,13 @@ class DIContainer:
 
     def import_cluster_resource_service(self) -> "ClusterResourceImporter | None":
         return self._importer
+
+    def tpu_scorer_bridge(self):
+        """Lazily-built extenderv1 scorer endpoint backend (SURVEY §7 step
+        8): lets a real Go scheduler delegate Filter/Prioritize to the TPU
+        kernel."""
+        if getattr(self, "_scorer_bridge", None) is None:
+            from kube_scheduler_simulator_tpu.scheduler.scorer_bridge import TPUScorerBridge
+
+            self._scorer_bridge = TPUScorerBridge(self._scheduler_service)
+        return self._scorer_bridge

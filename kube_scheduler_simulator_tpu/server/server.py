@@ -9,6 +9,10 @@ Routes (reference simulator/server/server.go:42-57):
     POST /api/v1/import                      → 200
     GET  /api/v1/listwatchresources          → JSON-lines server push (SSE analog)
     POST /api/v1/extender/filter/:id | prioritize/:id | preempt/:id | bind/:id
+    POST /api/v1/tpuscorer/filter | prioritize → extenderv1 endpoint backed by
+                                               the TPU batch kernel (point a
+                                               real scheduler's extender here;
+                                               scheduler/scorer_bridge.py)
     POST /api/v1/scenarios                   → run a KEP-140 Scenario, return it
                                                with status/timeline (the
                                                reference only scaffolds this)
@@ -207,6 +211,12 @@ def _make_handler(server: SimulatorServer):
                     ext = di.extender_service()
                     result = getattr(ext, verb)(id_, self._body() or {})
                     self._send_json(200, result)
+                elif url.path in ("/api/v1/tpuscorer/filter", "/api/v1/tpuscorer/prioritize"):
+                    # extenderv1 endpoint backed by the TPU batch kernel: a
+                    # REAL scheduler's extender stanza can point here
+                    bridge = di.tpu_scorer_bridge()
+                    verb = url.path.rsplit("/", 1)[1]
+                    self._send_json(200, getattr(bridge, verb)(self._body() or {}))
                 elif m := _RESOURCE_RE.match(url.path):
                     kind = m.group(1)
                     if kind not in KINDS:

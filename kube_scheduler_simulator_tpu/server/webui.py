@@ -5,10 +5,14 @@ SURVEY.md §2.2) as a single static page (no build step, no node_modules):
 
 - per-resource views with pods bucketed under their node (or
   "unscheduled"), mirroring web/store/pod.ts:12-50
+- per-kind DATA TABLES for every kind (the reference's
+  web/components/ResourceViews/DataTables), toggled with the cluster view
 - create resources from editable YAML-ish JSON templates
-  (web/components/lib/templates/*)
+  (web/components/lib/templates/*); EDIT any object as JSON and apply
+  (server-side-apply analog, the reference's monaco editor role)
 - per-pod scheduling-result dialog rendering every
-  scheduler-simulator/* annotation (the reference's result dialog)
+  scheduler-simulator/* annotation, with the result-history annotation
+  expanded into a per-attempt viewer (the reference's result dialog)
 - scheduler configuration editor (GET/POST /api/v1/schedulerconfiguration)
 - export / import / reset buttons
 - live updates over the /api/v1/listwatchresources stream
@@ -47,13 +51,14 @@ HTML = r"""<!doctype html>
 <body>
 <header>
   <h1>kube-scheduler-simulator <span class="muted" style="color:#cfe0ff">TPU-native</span></h1>
+  <button id="viewtoggle" onclick="toggleView()">Tables</button>
   <button onclick="newResource()">+ Create</button>
   <button onclick="openSchedConfig()">Scheduler&nbsp;Config</button>
   <button onclick="doExport()">Export</button>
   <button onclick="doImport()">Import</button>
   <button onclick="doReset()">Reset</button>
 </header>
-<main>
+<main id="clusterview">
   <div class="panel">
     <h2>Nodes &amp; Pods</h2>
     <div id="nodes"></div>
@@ -62,6 +67,9 @@ HTML = r"""<!doctype html>
     <h2>Other resources</h2>
     <div id="others"></div>
   </div>
+</main>
+<main id="tablesview" style="display:none; grid-template-columns:1fr;">
+  <div class="panel"><div id="tables"></div></div>
 </main>
 <dialog id="dlg"><div id="dlgbody"></div><p style="text-align:right"><button onclick="dlg.close()">Close</button></p></dialog>
 <script>
@@ -88,6 +96,7 @@ async function refreshAll() {
 }
 
 function render() {
+  if (tablesMode) { renderTables(); return; }
   const nodesDiv = document.getElementById("nodes");
   nodesDiv.innerHTML = "";
   const buckets = {"(unscheduled)": []};
@@ -134,6 +143,73 @@ function render() {
 
 function esc(s){ return String(s).replace(/&/g,"&amp;").replace(/</g,"&lt;"); }
 
+let tablesMode = false;
+function toggleView() {
+  tablesMode = !tablesMode;
+  document.getElementById("clusterview").style.display = tablesMode ? "none" : "";
+  document.getElementById("tablesview").style.display = tablesMode ? "grid" : "";
+  document.getElementById("viewtoggle").textContent = tablesMode ? "Cluster" : "Tables";
+  render();
+}
+
+// column extractors per kind (the reference's DataTables headers)
+const TABLE_COLS = {
+  pods: [["namespace", o=>(o.metadata||{}).namespace||""], ["name", o=>o.metadata.name],
+         ["node", o=>(o.spec||{}).nodeName||""], ["phase", o=>(o.status||{}).phase||""],
+         ["cpu req", o=>{try{return o.spec.containers[0].resources.requests.cpu||""}catch(e){return ""}}],
+         ["selectedNode", o=>((o.metadata||{}).annotations||{})["scheduler-simulator/selected-node"]||""]],
+  nodes: [["name", o=>o.metadata.name], ["cpu", o=>{try{return o.status.allocatable.cpu}catch(e){return ""}}],
+          ["memory", o=>{try{return o.status.allocatable.memory}catch(e){return ""}}],
+          ["pods", o=>{try{return o.status.allocatable.pods}catch(e){return ""}}],
+          ["taints", o=>(((o.spec||{}).taints)||[]).map(t=>t.key).join(",")]],
+  persistentvolumes: [["name", o=>o.metadata.name], ["capacity", o=>{try{return o.spec.capacity.storage}catch(e){return ""}}],
+                      ["class", o=>(o.spec||{}).storageClassName||""], ["claim", o=>{try{return o.spec.claimRef.name}catch(e){return ""}}]],
+  persistentvolumeclaims: [["namespace", o=>(o.metadata||{}).namespace||""], ["name", o=>o.metadata.name],
+                           ["class", o=>(o.spec||{}).storageClassName||""], ["phase", o=>(o.status||{}).phase||""]],
+  storageclasses: [["name", o=>o.metadata.name], ["provisioner", o=>o.provisioner||""]],
+  priorityclasses: [["name", o=>o.metadata.name], ["value", o=>o.value]],
+  namespaces: [["name", o=>o.metadata.name], ["phase", o=>(o.status||{}).phase||""]],
+  deployments: [["namespace", o=>(o.metadata||{}).namespace||""], ["name", o=>o.metadata.name],
+                ["replicas", o=>(o.spec||{}).replicas]],
+  replicasets: [["namespace", o=>(o.metadata||{}).namespace||""], ["name", o=>o.metadata.name],
+                ["replicas", o=>(o.spec||{}).replicas]],
+};
+
+function renderTables() {
+  const root = document.getElementById("tables");
+  root.innerHTML = "";
+  for (const k of KINDS) {
+    const cols = TABLE_COLS[k] || [["name", o=>o.metadata.name]];
+    const objs = Object.values(state[k]);
+    const h = document.createElement("h2");
+    h.textContent = `${k} (${objs.length})`;
+    root.appendChild(h);
+    const tbl = document.createElement("table");
+    tbl.className = "kv";
+    tbl.dataset.kind = k;
+    const hr = document.createElement("tr");
+    for (const [label] of cols) {
+      const th = document.createElement("td");
+      th.innerHTML = `<b>${esc(label)}</b>`;
+      hr.appendChild(th);
+    }
+    tbl.appendChild(hr);
+    for (const o of objs) {
+      const tr = document.createElement("tr");
+      tr.style.cursor = "pointer";
+      tr.addEventListener("click", () => k === "pods" ? showPod(o) : showObject(k, o));
+      for (const [, fn] of cols) {
+        const td = document.createElement("td");
+        let v = ""; try { v = fn(o); } catch (e) {}
+        td.textContent = v === undefined ? "" : v;
+        tr.appendChild(td);
+      }
+      tbl.appendChild(tr);
+    }
+    root.appendChild(tbl);
+  }
+}
+
 function deleteButton(kind, k) {
   // built via DOM (not inline onclick) so stored object names can't inject
   // script through attribute strings
@@ -145,11 +221,33 @@ function deleteButton(kind, k) {
   return p;
 }
 
+function historyViewer(annos) {
+  // result-history is a JSON array of per-attempt maps; render newest
+  // last, one expandable block per attempt (the reference appends every
+  // scheduling attempt's full result set, storereflector.go:148-167)
+  const raw = annos["scheduler-simulator/result-history"];
+  if (!raw) return "";
+  let hist;
+  try { hist = JSON.parse(raw); } catch (e) { return ""; }
+  if (!Array.isArray(hist)) return "";
+  let out = `<h3 style="margin:10px 0 4px">result history (${hist.length} attempt${hist.length===1?"":"s"})</h3>`;
+  hist.forEach((attempt, idx) => {
+    let rows = "";
+    for (const [k,v] of Object.entries(attempt)) {
+      let pretty = v;
+      try { pretty = JSON.stringify(JSON.parse(v), null, 1); } catch (e) {}
+      rows += `<tr><td>${esc(String(k).replace("scheduler-simulator/",""))}</td><td><pre style="margin:0;white-space:pre-wrap">${esc(pretty)}</pre></td></tr>`;
+    }
+    out += `<details ${idx===hist.length-1?"open":""}><summary>attempt ${idx+1}</summary><table class="kv">${rows}</table></details>`;
+  });
+  return out;
+}
+
 function showPod(p) {
   const annos = (p.metadata||{}).annotations || {};
   let rows = "";
   for (const [k,v] of Object.entries(annos)) {
-    if (!k.startsWith("scheduler-simulator/")) continue;
+    if (!k.startsWith("scheduler-simulator/") || k === "scheduler-simulator/result-history") continue;
     let pretty = v;
     try { pretty = JSON.stringify(JSON.parse(v), null, 1); } catch (e) {}
     rows += `<tr><td>${esc(k.replace("scheduler-simulator/",""))}</td><td><pre style="margin:0;white-space:pre-wrap">${esc(pretty)}</pre></td></tr>`;
@@ -159,7 +257,9 @@ function showPod(p) {
     `<h2>Pod ${esc(key(p))} — scheduling results</h2>
      <p class="muted">node: ${esc((p.spec||{}).nodeName||"(unscheduled)")}</p>
      <table class="kv">${rows || "<tr><td>no scheduler-simulator/* annotations yet</td></tr>"}</table>
+     ${historyViewer(annos)}
      <details><summary>manifest</summary><pre>${esc(JSON.stringify(p,null,2))}</pre></details>`;
+  body.appendChild(editButton("pods", p));
   body.appendChild(deleteButton("pods", key(p)));
   dlg.showModal();
 }
@@ -169,7 +269,41 @@ function showObject(kind, o) {
   body.innerHTML =
     `<h2>${esc(kind)} / ${esc(key(o))}</h2>
      <pre>${esc(JSON.stringify(o,null,2))}</pre>`;
+  body.appendChild(editButton(kind, o));
   body.appendChild(deleteButton(kind, key(o)));
+  dlg.showModal();
+}
+
+function editButton(kind, o) {
+  const b = document.createElement("button");
+  b.textContent = "Edit";
+  b.addEventListener("click", () => editObject(kind, o));
+  const p = document.createElement("p");
+  p.appendChild(b);
+  return p;
+}
+
+function editObject(kind, o) {
+  const body = document.getElementById("dlgbody");
+  body.innerHTML = `<h2>Edit ${esc(kind)} / ${esc(key(o))}</h2>`;
+  const ta = document.createElement("textarea");
+  ta.id = "editbody";
+  ta.value = JSON.stringify(o, null, 2);
+  ta.style.minHeight = "340px";
+  body.appendChild(ta);
+  const b = document.createElement("button");
+  b.textContent = "Apply";
+  b.addEventListener("click", async () => {
+    try {
+      const obj = JSON.parse(ta.value);
+      const ns = (obj.metadata||{}).namespace;
+      await api("PUT", `/api/v1/resources/${kind}/${obj.metadata.name}` + (ns?`?namespace=${ns}`:""), obj);
+      dlg.close();
+    } catch (e) { alert(e.message); }
+  });
+  const p = document.createElement("p");
+  p.appendChild(b);
+  body.appendChild(p);
   dlg.showModal();
 }
 

@@ -311,12 +311,19 @@ class ClusterStore:
             raise NotFoundError(f"{kind} {k!r} not found")
         return obj
 
-    def list(self, kind: str, namespace: str | None = None) -> list[Obj]:
-        """Objects sorted by (namespace, name) — etcd key order."""
+    def list(self, kind: str, namespace: str | None = None, copy_objects: bool = True) -> list[Obj]:
+        """Objects sorted by (namespace, name) — etcd key order.
+
+        ``copy_objects=False`` returns the live objects WITHOUT deep
+        copies for read-only consumers (the scheduler's encode/snapshot
+        hot paths — the reference reads straight from the informer cache
+        the same way, client-go lister contract).  Callers must not
+        mutate the result; at 10k pods carrying megabyte annotation
+        maps, deep-copying dominates the scheduling round otherwise."""
         with self._lock:
             bucket = self._bucket(kind)
             return [
-                copy.deepcopy(o)
+                (copy.deepcopy(o) if copy_objects else o)
                 for _, o in sorted(bucket.items())
                 if namespace is None or o["metadata"].get("namespace") == namespace
             ]

@@ -16,6 +16,7 @@ used for memory/pods/storage).
 
 from __future__ import annotations
 
+import functools
 import math
 import re
 from fractions import Fraction
@@ -44,13 +45,23 @@ _QUANTITY_RE = re.compile(
 
 
 def parse_quantity(q: "str | int | float") -> Fraction:
-    """Parse a Kubernetes quantity into an exact Fraction of base units."""
+    """Parse a Kubernetes quantity into an exact Fraction of base units.
+
+    String parses are cached: a cluster snapshot repeats a handful of
+    distinct quantity strings across thousands of pods, and the Fraction
+    arithmetic dominates encoding time otherwise (Fractions are immutable,
+    so sharing the returned object is safe)."""
     if isinstance(q, bool):
         raise ValueError(f"invalid quantity: {q!r}")
     if isinstance(q, int):
         return Fraction(q)
     if isinstance(q, float):
         return Fraction(str(q))
+    return _parse_quantity_str(q)
+
+
+@functools.lru_cache(maxsize=4096)
+def _parse_quantity_str(q: str) -> Fraction:
     s = q.strip()
     m = _QUANTITY_RE.match(s)
     if not m:
@@ -70,13 +81,26 @@ def parse_quantity(q: "str | int | float") -> Fraction:
 
 def milli_value(q: "str | int | float") -> int:
     """Quantity.MilliValue: value * 1000, rounded up (away from zero)."""
-    v = parse_quantity(q) * 1000
-    return _ceil(v)
+    if isinstance(q, str):
+        return _milli_value_str(q)
+    return _ceil(parse_quantity(q) * 1000)
+
+
+@functools.lru_cache(maxsize=4096)
+def _milli_value_str(q: str) -> int:
+    return _ceil(_parse_quantity_str(q) * 1000)
 
 
 def value(q: "str | int | float") -> int:
     """Quantity.Value: rounded up (away from zero) to an integer."""
+    if isinstance(q, str):
+        return _value_str(q)
     return _ceil(parse_quantity(q))
+
+
+@functools.lru_cache(maxsize=4096)
+def _value_str(q: str) -> int:
+    return _ceil(_parse_quantity_str(q))
 
 
 def _ceil(v: Fraction) -> int:

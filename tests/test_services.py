@@ -200,3 +200,39 @@ def test_import_cluster_resources():
     importer.import_cluster_resources()
     assert [n["metadata"]["name"] for n in dst_store.list("nodes")] == ["external-node"]
     assert [p["metadata"]["name"] for p in dst_store.list("pods")] == ["external-pod"]
+
+
+def test_import_live_cluster_via_stubbed_kube_client():
+    """KubeClusterSnapSource lists the 7 kinds from a kube API client
+    (reference clusterresourceimporter imports a real cluster through a
+    kubeconfig clientset, importer.go:44-60); a stub client stands in for
+    the live API."""
+    from kube_scheduler_simulator_tpu.services.importer import KubeClusterSnapSource
+
+    listed_paths: list[str] = []
+
+    class StubClient:
+        def list_kind(self, path: str) -> dict:
+            listed_paths.append(path)
+            if path.endswith("/nodes"):
+                return {"items": [_node("live-node")]}
+            if path.endswith("/pods"):
+                pod = _pod("live-pod")
+                pod["metadata"]["managedFields"] = [{"manager": "kubelet"}]
+                return {"items": [pod]}
+            if path.endswith("/namespaces"):
+                return {"items": [{"metadata": {"name": "team-a"}}]}
+            return {"items": []}
+
+    dst_store, dst_svc, dst_snap = build()
+    src = KubeClusterSnapSource(client=StubClient())
+    ClusterResourceImporter(src, dst_snap).import_cluster_resources()
+
+    assert len(listed_paths) == 7
+    assert any("storage.k8s.io" in p for p in listed_paths)
+    assert [n["metadata"]["name"] for n in dst_store.list("nodes")] == ["live-node"]
+    pods = dst_store.list("pods")
+    assert [p["metadata"]["name"] for p in pods] == ["live-pod"]
+    # cluster-managed noise stripped on the way in
+    assert "managedFields" not in pods[0]["metadata"]
+    assert "team-a" in [n["metadata"]["name"] for n in dst_store.list("namespaces")]
