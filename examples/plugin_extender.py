@@ -1,0 +1,80 @@
+"""Sample plugin extender: export NodeResourcesFit's PreFilter state.
+
+Python rebuild of the reference's plugin-extender sample (reference
+simulator/docs/sample/plugin-extender/extender.go:16-80), which hooks
+AfterPreFilter on NodeResourcesFit and exports the plugin's computed
+preFilterState (the pod's resource request) into a custom pod annotation
+via the shared result store — the designed fault-injection / state-export
+surface of the debuggable scheduler (reference wrappedplugin.go:47-171).
+
+An extender is any object with ``before_<point>`` / ``after_<point>``
+methods; it is attached per plugin name through
+``SchedulerService.set_plugin_extenders`` (the library surface
+``pkg.debuggablescheduler.new_scheduler_command(plugin_extenders=...)``,
+the reference's WithPluginExtenders).
+
+Run the demo:  PYTHONPATH=. python examples/plugin_extender.py
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+Obj = dict[str, Any]
+
+EXPORT_ANNOTATION = "scheduler-simulator/prefilter-state-fit"
+
+
+class FitPreFilterExporter:
+    """AfterPreFilter hook on NodeResourcesFit: records the request the
+    plugin computed (what the Go sample extracts via reflection from the
+    upstream preFilterState) as a custom result annotation."""
+
+    def __init__(self, result_store: Any):
+        self.result_store = result_store
+
+    def after_pre_filter(self, state, pod: Obj, result, status):
+        from kube_scheduler_simulator_tpu.models.podresources import pod_resource_request
+
+        ns = pod["metadata"].get("namespace", "default")
+        name = pod["metadata"]["name"]
+        request = {k: str(v) for k, v in sorted(pod_resource_request(pod).items())}
+        self.result_store.add_custom_result(
+            ns, name, EXPORT_ANNOTATION, json.dumps(request, separators=(",", ":"))
+        )
+        return result, status
+
+
+def main() -> None:
+    from kube_scheduler_simulator_tpu.pkg.debuggablescheduler import new_scheduler
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+    store = ClusterStore()
+    store.create(
+        "nodes",
+        {
+            "metadata": {"name": "node-1"},
+            "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "110"}},
+        },
+    )
+    store.create(
+        "pods",
+        {
+            "metadata": {"name": "pod-1", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "500m", "memory": "256Mi"}}}]},
+        },
+    )
+    svc, _result_store = new_scheduler(
+        store,
+        plugin_extenders={"NodeResourcesFit": FitPreFilterExporter},
+    )
+    svc.schedule_pending(max_rounds=1)
+    pod = store.get("pods", "pod-1")
+    annos = pod["metadata"].get("annotations") or {}
+    print("selected-node:", annos.get("scheduler-simulator/selected-node"))
+    print(f"{EXPORT_ANNOTATION}:", annos.get(EXPORT_ANNOTATION))
+
+
+if __name__ == "__main__":
+    main()

@@ -93,6 +93,36 @@ def is_success(status: "Status | None") -> bool:
     return status is None or status.is_success()
 
 
+class WaitingPod:
+    """A pod parked at Permit (upstream framework.waitingPod): one or
+    more permit plugins returned Wait with a timeout; the pod is bound
+    only once every plugin calls ``allow`` (or rejected/expired).  The
+    reference records the Wait status + timeout per plugin (reference
+    wrappedplugin.go:582-611) and upstream's binding cycle blocks on this
+    object; the simulator's synchronous loop keeps it in
+    Framework.waiting_pods and finishes the bind on the triggering call.
+    """
+
+    def __init__(self, pod: Obj, node_name: str, state: "CycleState", plugin_timeouts: dict[str, float], now: float):
+        self.pod = pod
+        self.node_name = node_name
+        self.state = state
+        # plugin → absolute deadline
+        self.deadlines = {p: now + t for p, t in plugin_timeouts.items()}
+        self.pending = set(plugin_timeouts)
+        self.rejected: "str | None" = None  # rejection message
+
+    @property
+    def key(self) -> str:
+        return f"{self.pod['metadata'].get('namespace', 'default')}/{self.pod['metadata']['name']}"
+
+    def pending_plugins(self) -> "set[str]":
+        return set(self.pending)
+
+    def earliest_deadline(self) -> float:
+        return min(self.deadlines.values()) if self.deadlines else 0.0
+
+
 class PreFilterResult:
     """framework.PreFilterResult: optional node-name allowlist."""
 

@@ -52,10 +52,15 @@ class DefaultPreemption:
     """PostFilter: find a node where evicting lower-priority pods makes the
     pod schedulable; nominate it and delete the victims.
 
-    Candidate selection follows upstream's core rules: only nodes whose
-    filter status was plain Unschedulable are candidates; victims are
-    lower-priority pods removed lowest-priority-first until the pod fits;
-    the node needing the fewest/lowest-priority victims wins.
+    Upstream v1.26 semantics (pkg/scheduler/framework/preemption):
+    - selectVictimsOnNode: remove ALL lower-priority pods, require the pod
+      to fit, then reprieve (re-add) as many as possible — PDB-violating
+      pods reprieved first to minimize violations, both groups in
+      MoreImportantPod order (priority desc, then earlier start time).
+    - pickOneNodeForPreemption criteria, in order: fewest PDB violations,
+      lowest highest-victim priority, smallest priority sum, fewest
+      victims, latest start time of the highest-priority victim, node
+      order.
     """
 
     name = "DefaultPreemption"
@@ -71,16 +76,18 @@ class DefaultPreemption:
         if fwk is None or snap is None:
             return None, Status.unschedulable("preemption not possible")
         incoming_priority = pod_priority(pod)
+        pdbs = self._pdbs()
         candidates: dict[str, list[Obj]] = {}
+        violations: dict[str, int] = {}
         for node_name, status in filtered_node_status_map.items():
             if status is not None and status.code.name == "UNSCHEDULABLE_AND_UNRESOLVABLE":
                 continue
             ni = snap.get(node_name)
             if ni is None:
                 continue
-            victims = self._find_victims(fwk, state, pod, ni, incoming_priority)
-            if victims is not None:
-                candidates[node_name] = victims
+            found = self._select_victims_on_node(fwk, state, pod, ni, incoming_priority, pdbs)
+            if found is not None:
+                candidates[node_name], violations[node_name] = found
 
         # Extender preempt pass (upstream Evaluator.callExtenders): preempt-
         # verb extenders narrow the candidate map before the best candidate
@@ -92,14 +99,9 @@ class DefaultPreemption:
             except Exception as e:
                 return None, Status.error(f"preemption extender: {e}")
 
-        best: "tuple[int, int, str] | None" = None  # (len, max prio, name)
-        for node_name, victims in candidates.items():
-            key = (len(victims), max((pod_priority(v) for v in victims), default=-(10**9)), node_name)
-            if best is None or key < best:
-                best = key
-        if best is None:
+        node_name = self._pick_one_node(candidates, violations)
+        if node_name is None:
             return None, Status.unschedulable("preemption: 0/%d nodes are available" % len(filtered_node_status_map))
-        node_name = best[2]
         victims = candidates[node_name]
         store = getattr(self.handle, "cluster_store", None)
         for v in victims:
@@ -113,22 +115,125 @@ class DefaultPreemption:
                 ni.remove_pod(v)
         return node_name, None
 
-    def _find_victims(self, fwk: Any, state: CycleState, pod: Obj, ni: NodeInfo, incoming_priority: int):
-        """Remove lower-priority pods (lowest first) until the pod passes
-        Filter on a scratch copy; None if impossible."""
-        lower = sorted(
-            (p for p in ni.pods if pod_priority(p) < incoming_priority),
-            key=pod_priority,
-        )
+    # ------------------------------------------------------------- helpers
+
+    def _pdbs(self) -> list[Obj]:
+        store = getattr(self.handle, "cluster_store", None) if self.handle else None
+        if store is None:
+            return []
+        try:
+            return store.list("poddisruptionbudgets", copy_objects=False)
+        except Exception:
+            return []
+
+    def _violates_pdb(self, victim: Obj, pdbs: list[Obj], budget: dict[int, int]) -> bool:
+        """Would evicting ``victim`` violate any matching PDB, given the
+        remaining per-PDB budget for this dry run?"""
+        from kube_scheduler_simulator_tpu.utils.labels import match_label_selector
+
+        vio = False
+        for idx, pdb in enumerate(pdbs):
+            if (pdb["metadata"].get("namespace") or "default") != (
+                victim["metadata"].get("namespace") or "default"
+            ):
+                continue
+            if not match_label_selector(
+                (pdb.get("spec") or {}).get("selector"), victim["metadata"].get("labels") or {}
+            ):
+                continue
+            if idx not in budget:
+                budget[idx] = int(((pdb.get("status") or {}).get("disruptionsAllowed")) or 0)
+            budget[idx] -= 1
+            if budget[idx] < 0:
+                vio = True
+        return vio
+
+    @staticmethod
+    def _start_time(p: Obj) -> str:
+        return (p.get("status") or {}).get("startTime") or p["metadata"].get("creationTimestamp") or ""
+
+    def _more_important(self, p: Obj) -> tuple:
+        """MoreImportantPod sort key: higher priority first, then earlier
+        start time."""
+        return (-pod_priority(p), self._start_time(p))
+
+    def _select_victims_on_node(
+        self, fwk: Any, state: CycleState, pod: Obj, ni: NodeInfo, incoming_priority: int, pdbs: list[Obj]
+    ) -> "tuple[list[Obj], int] | None":
+        lower = [p for p in ni.pods if pod_priority(p) < incoming_priority]
         if not lower:
             return None
         scratch = NodeInfo(ni.node)
         for p in ni.pods:
             scratch.add_pod(p)
+        # remove every lower-priority pod; the incoming pod must fit then
+        for p in lower:
+            scratch.remove_pod(p)
+        if not fwk.run_filter_plugins_silently(state, pod, scratch):
+            return None
+        # split by PDB violation, each group in MoreImportantPod order;
+        # reprieve the violating group first (minimizes violations)
+        budget: dict[int, int] = {}
+        violating, non_violating = [], []
+        for p in sorted(lower, key=self._more_important):
+            (violating if self._violates_pdb(p, pdbs, budget) else non_violating).append(p)
         victims: list[Obj] = []
-        for victim in lower:
-            scratch.remove_pod(victim)
-            victims.append(victim)
+        num_violating = 0
+
+        def reprieve(p: Obj) -> bool:
+            scratch.add_pod(p)
             if fwk.run_filter_plugins_silently(state, pod, scratch):
-                return victims
-        return None
+                return True
+            scratch.remove_pod(p)
+            return False
+
+        for p in violating:
+            if not reprieve(p):
+                victims.append(p)
+                num_violating += 1
+        for p in non_violating:
+            if not reprieve(p):
+                victims.append(p)
+        if not victims:
+            return None
+        return victims, num_violating
+
+    def _pick_one_node(
+        self, candidates: dict[str, list[Obj]], violations: dict[str, int]
+    ) -> "str | None":
+        """pickOneNodeForPreemption: lexicographic upstream criteria; node
+        insertion order (the filtered map order) breaks remaining ties."""
+        best_name: "str | None" = None
+        best_key: "tuple | None" = None
+        for name, victims in candidates.items():
+            if not victims:
+                return name  # no victims needed at all — immediately best
+            high_prio = max(pod_priority(v) for v in victims)
+            # latest start time among the highest-priority victims wins —
+            # _ReverseStr flips the string comparison inside the ascending
+            # tuple ordering
+            latest_start = max(
+                self._start_time(v) for v in victims if pod_priority(v) == high_prio
+            )
+            full_key = (
+                violations.get(name, 0),
+                high_prio,
+                sum(pod_priority(v) for v in victims),
+                len(victims),
+                _ReverseStr(latest_start),
+            )
+            if best_key is None or full_key < best_key:
+                best_key = full_key
+                best_name = name
+        return best_name
+
+
+class _ReverseStr(str):
+    """Orders strings DESCENDING inside an ascending tuple comparison
+    (pickOneNodeForPreemption prefers the LATEST victim start time)."""
+
+    def __lt__(self, other):  # type: ignore[override]
+        return str.__gt__(self, other)
+
+    def __gt__(self, other):  # type: ignore[override]
+        return str.__lt__(self, other)

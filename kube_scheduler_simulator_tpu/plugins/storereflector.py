@@ -58,11 +58,21 @@ class StoreReflector:
         name = pod["metadata"]["name"]
         self._pending[f"{ns}/{name}"] = pod
 
-    def flush_all(self, cluster_store: Any) -> None:
-        """Flush every queued pod's results to its annotations."""
+    def flush_all(self, cluster_store: Any, skip_keys: "set[str] | None" = None) -> None:
+        """Flush every queued pod's results to its annotations.
+
+        ``skip_keys`` (ns/name) stay queued WITH their stored results —
+        pods parked at Permit must keep accumulating until the binding
+        cycle finishes, exactly as the reference's reflector only fires on
+        pod-update events (which a waiting pod hasn't produced yet)."""
+        requeue: dict[str, Obj] = {}
         while self._pending:
-            _, pod = self._pending.popitem()
+            key, pod = self._pending.popitem()
+            if skip_keys and key in skip_keys:
+                requeue[key] = pod
+                continue
             self.flush_pod(cluster_store, pod)
+        self._pending.update(requeue)
 
     # ----------------------------------------------------------------- flush
 

@@ -54,7 +54,11 @@ def _pod_volumes(pod: Obj) -> list:
     return [
         v
         for v in (pod.get("spec") or {}).get("volumes") or []
-        if "persistentVolumeClaim" in v or "awsElasticBlockStore" in v or "gcePersistentDisk" in v
+        if "persistentVolumeClaim" in v
+        or "awsElasticBlockStore" in v
+        or "gcePersistentDisk" in v
+        or "azureDisk" in v
+        or "csi" in v
     ]
 
 

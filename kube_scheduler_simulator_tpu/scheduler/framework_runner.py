@@ -15,7 +15,13 @@ from __future__ import annotations
 
 from typing import Any
 
-from kube_scheduler_simulator_tpu.models.framework import Code, CycleState, PreFilterResult, Status
+from kube_scheduler_simulator_tpu.models.framework import (
+    Code,
+    CycleState,
+    PreFilterResult,
+    Status,
+    WaitingPod,
+)
 from kube_scheduler_simulator_tpu.models.nodeinfo import NodeInfo
 from kube_scheduler_simulator_tpu.models.snapshot import Snapshot
 from kube_scheduler_simulator_tpu.models.wrapped import WrappedPlugin
@@ -24,6 +30,8 @@ Obj = dict[str, Any]
 
 MIN_FEASIBLE_NODES_TO_FIND = 100
 MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5
+# upstream maxTimeout for permit Wait (15 minutes)
+MAX_PERMIT_TIMEOUT_S = 15 * 60.0
 
 
 def num_feasible_nodes_to_find(num_all_nodes: int, percentage: int) -> int:
@@ -56,9 +64,17 @@ class FrameworkHandle:
     def set_snapshot(self, snap: Snapshot) -> None:
         self._snapshot = snap
 
+    # upstream framework.Handle's waiting-pod surface (plugins use these
+    # to approve/reject parked pods, e.g. coscheduling-style gangs)
+    def get_waiting_pod(self, namespace: str, name: str):
+        return self.framework.get_waiting_pod(namespace, name) if self.framework else None
+
+    def iterate_over_waiting_pods(self):
+        return self.framework.iterate_over_waiting_pods() if self.framework else []
+
 
 class ScheduleResult:
-    __slots__ = ("selected_node", "feasible_nodes", "diagnosis", "status", "nominated_node")
+    __slots__ = ("selected_node", "feasible_nodes", "diagnosis", "status", "nominated_node", "waiting_on")
 
     def __init__(
         self,
@@ -67,12 +83,15 @@ class ScheduleResult:
         diagnosis: "dict[str, Status] | None" = None,
         status: "Status | None" = None,
         nominated_node: "str | None" = None,
+        waiting_on: "str | None" = None,
     ):
         self.selected_node = selected_node
         self.feasible_nodes = feasible_nodes or []
         self.diagnosis = diagnosis or {}
         self.status = status
         self.nominated_node = nominated_node
+        # node the pod is parked on at Permit (WaitingPod machinery)
+        self.waiting_on = waiting_on
 
     @property
     def success(self) -> bool:
@@ -118,6 +137,8 @@ class Framework:
         # a round as attempt sched_counter+i — makes the identical pick.
         self.sched_counter = 0
         self.profile_name = profile_name
+        # pods parked at Permit (key → WaitingPod); see allow_waiting_pod
+        self.waiting_pods: dict[str, WaitingPod] = {}
         # "reservoir" = upstream selectHost semantics (uniform over tied
         # maxima), made deterministic via a counter-keyed hash draw shared
         # with the batch kernel; "first" = first-max in visit order,
@@ -228,22 +249,55 @@ class Framework:
                 return ScheduleResult(status=status, diagnosis=diagnosis)
         snapshot.assume(pod, selected)
 
-        # Permit (Wait treated as approved once recorded; there is no async
-        # waiting-pod machinery in the simulator's synchronous cycle).
+        # Permit: Wait parks the pod in waiting_pods (upstream's
+        # waitingPodsMap) — binding happens when every waiting plugin
+        # calls allow_waiting_pod, or the pod is rejected/expired.
+        wait_timeouts: dict[str, float] = {}
         for wp in self.plugins["permit"]:
-            status, _timeout = wp.permit(state, pod, selected)
-            if status is not None and not status.is_success() and not status.is_wait():
+            status, timeout = wp.permit(state, pod, selected)
+            if status is not None and status.is_wait():
+                # upstream clamps 0/negative AND oversized timeouts to the
+                # 15 min max
+                t = float(timeout) if timeout and timeout > 0 else MAX_PERMIT_TIMEOUT_S
+                wait_timeouts[wp.original.name] = min(t, MAX_PERMIT_TIMEOUT_S)
+            elif status is not None and not status.is_success():
                 snapshot.forget(pod, selected)
                 self._unreserve(state, pod, selected)
                 return ScheduleResult(status=status, diagnosis=diagnosis)
+        if wait_timeouts:
+            import time as _time
+
+            waiting = WaitingPod(pod, selected, state, wait_timeouts, _time.monotonic())
+            self.waiting_pods[waiting.key] = waiting
+            return ScheduleResult(diagnosis=diagnosis, waiting_on=selected)
+
+        return self._finish_binding(
+            state, pod, selected, diagnosis, [ni.name for ni in feasible], snapshot
+        )
+
+    def _finish_binding(
+        self,
+        state: CycleState,
+        pod: Obj,
+        selected: str,
+        diagnosis: dict[str, Status],
+        feasible_names: list[str],
+        snapshot: "Snapshot | None",
+    ) -> ScheduleResult:
+        """PreBind → Bind → PostBind (also runs when a waiting pod is
+        finally allowed, where the round snapshot no longer exists)."""
+
+        def fail(status: Status) -> ScheduleResult:
+            if snapshot is not None:
+                snapshot.forget(pod, selected)
+            self._unreserve(state, pod, selected)
+            return ScheduleResult(status=status, diagnosis=diagnosis)
 
         # PreBind
         for wp in self.plugins["pre_bind"]:
             status = wp.pre_bind(state, pod, selected)
             if status is not None and not status.is_success():
-                snapshot.forget(pod, selected)
-                self._unreserve(state, pod, selected)
-                return ScheduleResult(status=status, diagnosis=diagnosis)
+                return fail(status)
 
         # Bind: an interested extender binder takes precedence over bind
         # plugins (upstream sched.extendersBinding).
@@ -266,13 +320,9 @@ class Framework:
                     },
                 )
             except Exception as e:  # webhook down/timeout: clean up state
-                snapshot.forget(pod, selected)
-                self._unreserve(state, pod, selected)
-                return ScheduleResult(status=Status.error(str(e)), diagnosis=diagnosis)
+                return fail(Status.error(str(e)))
             if result and result.get("error"):
-                snapshot.forget(pod, selected)
-                self._unreserve(state, pod, selected)
-                return ScheduleResult(status=Status.error(result["error"]), diagnosis=diagnosis)
+                return fail(Status.error(result["error"]))
             # Upstream: the extender webhook binds against the apiserver
             # itself.  Our extender can't reach the in-memory store, so the
             # simulator performs the store bind on its behalf after a
@@ -287,9 +337,7 @@ class Framework:
                 if status is not None and status.is_skip():
                     continue
                 if status is not None and not status.is_success():
-                    snapshot.forget(pod, selected)
-                    self._unreserve(state, pod, selected)
-                    return ScheduleResult(status=status, diagnosis=diagnosis)
+                    return fail(status)
                 break
 
         for wp in self.plugins["post_bind"]:
@@ -297,9 +345,54 @@ class Framework:
 
         return ScheduleResult(
             selected_node=selected,
-            feasible_nodes=[ni.name for ni in feasible],
+            feasible_nodes=feasible_names,
             diagnosis=diagnosis,
         )
+
+    # --------------------------------------------------------- waiting pods
+
+    def get_waiting_pod(self, namespace: str, name: str) -> "WaitingPod | None":
+        """upstream Handle.GetWaitingPod analog."""
+        return self.waiting_pods.get(f"{namespace}/{name}")
+
+    def iterate_over_waiting_pods(self):
+        """upstream Handle.IterateOverWaitingPods analog."""
+        return list(self.waiting_pods.values())
+
+    def allow_waiting_pod(self, namespace: str, name: str, plugin: str) -> "ScheduleResult | None":
+        """Plugin ``plugin`` approves the waiting pod; once every permit
+        plugin has approved, the bind cycle completes (upstream
+        waitingPod.Allow).  Returns the final result when binding ran."""
+        wp = self.get_waiting_pod(namespace, name)
+        if wp is None:
+            return None
+        wp.pending.discard(plugin)
+        if wp.pending:
+            return None
+        del self.waiting_pods[wp.key]
+        return self._finish_binding(wp.state, wp.pod, wp.node_name, {}, [], None)
+
+    def reject_waiting_pod(self, namespace: str, name: str, message: str = "rejected") -> "ScheduleResult | None":
+        """upstream waitingPod.Reject: unreserve and fail the pod."""
+        wp = self.waiting_pods.pop(f"{namespace}/{name}", None)
+        if wp is None:
+            return None
+        self._unreserve(wp.state, wp.pod, wp.node_name)
+        return ScheduleResult(status=Status.unschedulable(message))
+
+    def expire_waiting_pods(self, now: "float | None" = None) -> dict[str, ScheduleResult]:
+        """Reject every waiting pod whose earliest permit deadline passed
+        (upstream rejects on timer expiry)."""
+        import time as _time
+
+        now = _time.monotonic() if now is None else now
+        out: dict[str, ScheduleResult] = {}
+        for key in [k for k, w in self.waiting_pods.items() if w.earliest_deadline() <= now]:
+            ns, name = key.split("/", 1)
+            res = self.reject_waiting_pod(ns, name, "pod rejected: permit wait timeout expired")
+            if res is not None:
+                out[key] = res
+        return out
 
     # ------------------------------------------------------------- internals
 

@@ -42,9 +42,15 @@ class TPUScorerBridge:
     """Serve the current profile's kernels over extenderv1 JSON."""
 
     def __init__(self, scheduler_service: Any):
+        import threading
+
         self.scheduler_service = scheduler_service
         self._engine: Any = None
         self._engine_fw: Any = None
+        # ThreadingHTTPServer serves each request on its own thread; the
+        # shared engine (jit cache, counters) is not thread-safe, so
+        # kernel passes serialize here
+        self._lock = threading.Lock()
         # Observability (surfaced via /api/v1/metrics)
         self.requests = {"filter": 0, "prioritize": 0}
         self.fallbacks = 0
@@ -82,15 +88,16 @@ class TPUScorerBridge:
     def _run(self, pod: Obj, nodes: list[Obj]):
         """One kernel pass of the pod over the candidate nodes; None when
         the profile × workload needs the sequential fallback."""
-        fw = self._framework()
-        eng = self._engine_for(fw)
-        ok, _why = eng.supported([pod], nodes)
-        if not ok:
-            return None
-        store = self.scheduler_service.cluster_store
-        return eng.schedule(
-            nodes, store.list("pods"), [pod], store.list("namespaces")
-        )
+        with self._lock:
+            fw = self._framework()
+            eng = self._engine_for(fw)
+            ok, _why = eng.supported([pod], nodes)
+            if not ok:
+                return None
+            store = self.scheduler_service.cluster_store
+            return eng.schedule(
+                nodes, store.list("pods"), [pod], store.list("namespaces")
+            )
 
     # --------------------------------------------------------------- verbs
 

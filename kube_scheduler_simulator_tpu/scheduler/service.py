@@ -263,18 +263,45 @@ class SchedulerService:
         # copy_objects=False: the scheduling paths only read pod specs
         # (the reference reads the informer cache the same way); at scale,
         # deep-copying annotation-laden pods dominates the round otherwise
+        waiting = self.framework.waiting_pods if self.framework is not None else {}
         return [
             p
             for p in self.cluster_store.list("pods", copy_objects=False)
-            if not (p.get("spec") or {}).get("nodeName") and not p["metadata"].get("deletionTimestamp")
+            if not (p.get("spec") or {}).get("nodeName")
+            and not p["metadata"].get("deletionTimestamp")
+            and _pod_key(p) not in waiting
         ]
 
     def build_snapshot(self) -> Snapshot:
-        return Snapshot(
+        snap = Snapshot(
             self.cluster_store.list("nodes", copy_objects=False),
             self.cluster_store.list("pods", copy_objects=False),
             self.cluster_store.list("namespaces", copy_objects=False),
         )
+        # pods parked at Permit hold their reservation (upstream keeps
+        # assumed pods in the scheduler cache until bound) — without this,
+        # later rounds would schedule other pods into the same capacity
+        if self.framework is not None:
+            for w in self.framework.waiting_pods.values():
+                snap.assume(w.pod, w.node_name)
+        return snap
+
+    def _pods_with_waiting_assumed(self) -> list[Obj]:
+        """Store pods with waiting pods shown as bound to their reserved
+        node (for the batch encoder's node-usage seeding)."""
+        pods = self.cluster_store.list("pods", copy_objects=False)
+        fw = self.framework
+        if fw is None or not fw.waiting_pods:
+            return pods
+        waiting = {key: w for key, w in fw.waiting_pods.items()}
+        out = []
+        for p in pods:
+            w = waiting.get(_pod_key(p))
+            if w is not None:
+                out.append({**p, "spec": {**(p.get("spec") or {}), "nodeName": w.node_name}})
+            else:
+                out.append(p)
+        return out
 
     def schedule_pending(self, max_rounds: int = 3) -> dict[str, ScheduleResult]:
         """Drain the pending queue: sort by QueueSort, schedule each pod in
@@ -307,9 +334,48 @@ class SchedulerService:
             if not round_results:
                 break
             results.update(round_results)
-            if not any(r.success or r.nominated_node for r in round_results.values()):
+            if not any(r.success or r.nominated_node or r.waiting_on for r in round_results.values()):
                 break
         return results
+
+    def allow_waiting_pod(self, namespace: str, name: str, plugin: str) -> "ScheduleResult | None":
+        """Approve a waiting pod on ``plugin``'s behalf; when that was the
+        last pending permit plugin, the bind cycle runs and the full
+        result set (including the recorded Wait) flushes to annotations."""
+        assert self.framework is not None, "scheduler not started"
+        res = self.framework.allow_waiting_pod(namespace, name, plugin)
+        if res is not None:
+            self.reflector.flush_all(self.cluster_store, skip_keys=set(self.framework.waiting_pods))
+        return res
+
+    def reject_waiting_pod(self, namespace: str, name: str, message: str = "rejected") -> "ScheduleResult | None":
+        assert self.framework is not None, "scheduler not started"
+        res = self.framework.reject_waiting_pod(namespace, name, message)
+        if res is not None:
+            try:
+                pod = self.cluster_store.get("pods", name, namespace)
+                self._record_failure(pod, res)
+            except KeyError:
+                pass
+            self.reflector.flush_all(self.cluster_store, skip_keys=set(self.framework.waiting_pods))
+        return res
+
+    def process_waiting_pods(self, now: "float | None" = None) -> dict[str, ScheduleResult]:
+        """Expire waiting pods whose permit deadline passed, recording the
+        rejection like any scheduling failure (the background loop calls
+        this each tick; tests drive it with an explicit ``now``)."""
+        fw = self.framework
+        if fw is None or not fw.waiting_pods:
+            return {}
+        by_key = {}
+        for key, w in list(fw.waiting_pods.items()):
+            by_key[key] = w.pod
+        expired = fw.expire_waiting_pods(now)
+        for key, res in expired.items():
+            self._record_failure(by_key[key], res)
+        if expired:
+            self.reflector.flush_all(self.cluster_store, skip_keys=set(fw.waiting_pods))
+        return expired
 
     # ------------------------------------------------------------ batch path
 
@@ -357,7 +423,7 @@ class SchedulerService:
             tail = pending[i:]
             result = eng.schedule(
                 nodes,
-                self.cluster_store.list("pods", copy_objects=False),
+                self._pods_with_waiting_assumed(),
                 tail,
                 self.cluster_store.list("namespaces", copy_objects=False),
                 base_counter=fw.sched_counter,
@@ -397,7 +463,7 @@ class SchedulerService:
                     results[_pod_key(pod)] = self.schedule_one(pod, snapshot)
                 break
         self.stats["batch_commits"] += 1
-        self.reflector.flush_all(self.cluster_store)
+        self.reflector.flush_all(self.cluster_store, skip_keys=set(fw.waiting_pods))
         return results
 
     def _count_fallback(self, reason: str) -> None:
@@ -503,11 +569,12 @@ class SchedulerService:
             snapshot = self.build_snapshot()
         result = self.framework.schedule_one(pod, snapshot)
         self.stats["sequential_pods"] += 1
-        if not result.success:
+        if not result.success and not result.waiting_on:
             self._record_failure(pod, result)
         # The reference's informer flushes results asynchronously after the
         # cycle; flush the queued pods now that all results are recorded.
-        self.reflector.flush_all(self.cluster_store)
+        # Waiting pods keep their results queued until permit resolves.
+        self.reflector.flush_all(self.cluster_store, skip_keys=set(self.framework.waiting_pods))
         return result
 
     def _record_failure(self, pod: Obj, result: ScheduleResult) -> None:
@@ -544,7 +611,8 @@ class SchedulerService:
             msg = status.message() if status is not None else ""
             counts[msg] = counts.get(msg, 0) + 1
         num = len(result.diagnosis)
-        parts = sorted(f"{c} {m}" for m, c in counts.items() if m)
+        # upstream sorts the distinct REASON strings, then prefixes counts
+        parts = [f"{counts[m]} {m}" for m in sorted(counts) if m]
         if not parts:
             return result.status.message() if result.status else "no nodes available"
         return f"0/{num} nodes are available: {', '.join(parts)}."
@@ -566,8 +634,10 @@ class SchedulerService:
                 if self._bg_stop.is_set():
                     break
                 try:
-                    if self.framework is not None and self.pending_pods():
-                        self.schedule_pending(max_rounds=1)
+                    if self.framework is not None:
+                        self.process_waiting_pods()
+                        if self.pending_pods():
+                            self.schedule_pending(max_rounds=1)
                 except Exception:  # pragma: no cover - keep the loop alive
                     pass
 

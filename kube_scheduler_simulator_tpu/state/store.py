@@ -51,9 +51,14 @@ KINDS: tuple[str, ...] = (
     "namespaces",
     "deployments",
     "replicasets",
+    # consumed by DefaultPreemption (PDB-violation counting) and
+    # NodeVolumeLimits (per-driver CSI attach limits) — the reference's
+    # real apiserver serves these natively
+    "poddisruptionbudgets",
+    "csinodes",
 )
 NAMESPACED_KINDS: frozenset[str] = frozenset(
-    {"pods", "persistentvolumeclaims", "deployments", "replicasets"}
+    {"pods", "persistentvolumeclaims", "deployments", "replicasets", "poddisruptionbudgets"}
 )
 
 KIND_NAMES: dict[str, str] = {

@@ -488,3 +488,277 @@ class TestSchedulerConfig:
         # old config still active and scheduling works
         store.create("pods", make_pod("p1"))
         assert svc.schedule_pending()["default/p1"].success
+
+
+class TestWaitingPods:
+    """Permit Wait machinery (reference wrappedplugin.go:582-611 records
+    Wait + timeout; upstream parks the pod in the waitingPodsMap until
+    every permit plugin allows, rejects, or the timeout expires)."""
+
+    class GatePermit:
+        name = "GatePermit"
+
+        def __init__(self, args=None, handle=None):
+            self.handle = handle
+            self.timeout = float((args or {}).get("timeout") or 60.0)
+
+        def permit(self, state, pod, node_name):
+            from kube_scheduler_simulator_tpu.models.framework import Status
+
+            return Status.wait("waiting for the gang"), self.timeout
+
+    def _service(self):
+        store = ClusterStore()
+        store.create("nodes", make_node("node-1"))
+        svc = SchedulerService(store, tie_break="first")
+        svc.set_out_of_tree_registries({"GatePermit": lambda args, handle: self.GatePermit(args, handle)})
+        svc.start_scheduler(
+            {
+                "profiles": [
+                    {
+                        "schedulerName": "default-scheduler",
+                        "plugins": {
+                            "multiPoint": {
+                                "enabled": [
+                                    {"name": "PrioritySort"},
+                                    {"name": "NodeResourcesFit"},
+                                    {"name": "GatePermit"},
+                                    {"name": "DefaultBinder"},
+                                ],
+                                "disabled": [{"name": "*"}],
+                            }
+                        },
+                    }
+                ],
+                "percentageOfNodesToScore": 100,
+            }
+        )
+        return store, svc
+
+    def test_wait_then_allow_binds(self):
+        store, svc = self._service()
+        store.create("pods", make_pod("gated"))
+        results = svc.schedule_pending(max_rounds=1)
+        res = results["default/gated"]
+        assert not res.success and res.waiting_on == "node-1"
+        # parked: not bound, excluded from the pending queue
+        assert store.get("pods", "gated")["spec"].get("nodeName") is None
+        assert svc.pending_pods() == []
+        waiting = svc.framework.iterate_over_waiting_pods()
+        assert [w.key for w in waiting] == ["default/gated"]
+        assert waiting[0].pending_plugins() == {"GatePermit"}
+        # results stay queued while waiting (the reference's reflector
+        # only fires on pod-update events, which a parked pod hasn't
+        # produced) — no annotations yet
+        assert "annotations" not in store.get("pods", "gated")["metadata"]
+
+        final = svc.allow_waiting_pod("default", "gated", "GatePermit")
+        assert final is not None and final.selected_node == "node-1"
+        pod = store.get("pods", "gated")
+        assert pod["spec"]["nodeName"] == "node-1"
+        # ONE flush carries the whole cycle: the recorded Wait + the bind
+        annos = pod["metadata"]["annotations"]
+        assert json.loads(annos[anno.PERMIT_STATUS_RESULT])["GatePermit"] == "wait"
+        assert json.loads(annos[anno.BIND_RESULT])["DefaultBinder"] == "success"
+        assert svc.framework.waiting_pods == {}
+
+    def test_wait_then_reject(self):
+        store, svc = self._service()
+        store.create("pods", make_pod("gated"))
+        svc.schedule_pending(max_rounds=1)
+        res = svc.framework.reject_waiting_pod("default", "gated", "gang incomplete")
+        assert res is not None and not res.success
+        assert store.get("pods", "gated")["spec"].get("nodeName") is None
+        assert svc.framework.waiting_pods == {}
+        # back in the queue for the next attempt
+        assert [p["metadata"]["name"] for p in svc.pending_pods()] == ["gated"]
+
+    def test_wait_timeout_expires(self):
+        import time
+
+        store, svc = self._service()
+        store.create("pods", make_pod("gated"))
+        svc.schedule_pending(max_rounds=1)
+        # not yet expired
+        assert svc.process_waiting_pods(now=time.monotonic()) == {}
+        expired = svc.process_waiting_pods(now=time.monotonic() + 61)
+        assert set(expired) == {"default/gated"}
+        pod = store.get("pods", "gated")
+        assert pod["spec"].get("nodeName") is None
+        cond = pod["status"]["conditions"][0]
+        assert "timeout" in cond["message"]
+
+
+    def test_waiting_pod_holds_its_reservation(self):
+        """A parked pod's capacity must stay reserved (upstream keeps
+        assumed pods in the cache until bound) — another pod must not
+        squeeze into the same room while Permit waits."""
+        store, svc = self._service()  # node-1 has 4 cpu
+        gated = make_pod("gated", cpu="3000m")
+        store.create("pods", gated)
+        svc.schedule_pending(max_rounds=1)
+        assert [w.key for w in svc.framework.iterate_over_waiting_pods()] == ["default/gated"]
+        # a second pod needing more than the REMAINING capacity must fail
+        store.create("pods", make_pod("intruder", cpu="2000m"))
+        res = svc.schedule_pending(max_rounds=1)["default/intruder"]
+        assert not res.success and not res.waiting_on
+        # the waiting pod still completes into its reserved room
+        final = svc.allow_waiting_pod("default", "gated", "GatePermit")
+        assert final is not None and final.selected_node == "node-1"
+        assert store.get("pods", "gated")["spec"]["nodeName"] == "node-1"
+
+
+
+class TestPreemptionFidelity:
+    """Upstream selectVictimsOnNode/pickOneNodeForPreemption semantics:
+    remove-all + reprieve (highest priority reprieved first), PDB
+    violation counting, and the lexicographic node-selection criteria."""
+
+    def _svc(self, store):
+        svc = SchedulerService(store, tie_break="first")
+        svc.start_scheduler({"percentageOfNodesToScore": 100})
+        return svc
+
+    def test_reprieve_spares_high_priority_victim(self):
+        store = ClusterStore()
+        store.create("nodes", make_node("node-1", cpu="4"))
+        v_high = make_pod("v-high", cpu="1000m")
+        v_high["spec"]["nodeName"] = "node-1"
+        v_high["spec"]["priority"] = 50
+        store.create("pods", v_high)
+        v_low = make_pod("v-low", cpu="2500m")
+        v_low["spec"]["nodeName"] = "node-1"
+        v_low["spec"]["priority"] = 1
+        store.create("pods", v_low)
+        incoming = make_pod("incoming", cpu="2500m")
+        incoming["spec"]["priority"] = 100
+        store.create("pods", incoming)
+
+        svc = self._svc(store)
+        results = svc.schedule_pending(max_rounds=1)
+        res = results["default/incoming"]
+        assert res.nominated_node == "node-1"
+        # greedy lowest-first would also evict v-low, but the reprieve
+        # pass must KEEP v-high on the node
+        assert store.get("pods", "v-high")["spec"]["nodeName"] == "node-1"
+        with pytest.raises(KeyError):
+            store.get("pods", "v-low")
+
+    def test_pdb_violations_steer_node_choice(self):
+        store = ClusterStore()
+        for n in ("node-1", "node-2"):
+            store.create("nodes", make_node(n, cpu="4"))
+        for i, n in enumerate(("node-1", "node-2")):
+            v = make_pod(f"victim-{i+1}", cpu="3000m", labels={"app": "a" if n == "node-1" else "b"})
+            v["spec"]["nodeName"] = n
+            v["spec"]["priority"] = 0
+            store.create("pods", v)
+        # protecting node-1's victim makes evicting it a PDB violation
+        store.create("poddisruptionbudgets", {
+            "metadata": {"name": "pdb-a", "namespace": "default"},
+            "spec": {"selector": {"matchLabels": {"app": "a"}}},
+            "status": {"disruptionsAllowed": 0},
+        })
+        incoming = make_pod("incoming", cpu="3000m")
+        incoming["spec"]["priority"] = 10
+        store.create("pods", incoming)
+
+        svc = self._svc(store)
+        results = svc.schedule_pending(max_rounds=1)
+        assert results["default/incoming"].nominated_node == "node-2"
+        # the protected victim survives; the unprotected one is evicted
+        assert store.get("pods", "victim-1")["spec"]["nodeName"] == "node-1"
+        with pytest.raises(KeyError):
+            store.get("pods", "victim-2")
+
+    def test_fewest_victims_tiebreak(self):
+        store = ClusterStore()
+        store.create("nodes", make_node("node-1", cpu="4"))
+        store.create("nodes", make_node("node-2", cpu="4"))
+        # node-1 needs TWO evictions, node-2 needs one (same priorities)
+        for i in range(2):
+            v = make_pod(f"n1-v{i}", cpu="1500m")
+            v["spec"]["nodeName"] = "node-1"
+            v["spec"]["priority"] = 0
+            store.create("pods", v)
+        v = make_pod("n2-v0", cpu="3000m")
+        v["spec"]["nodeName"] = "node-2"
+        v["spec"]["priority"] = 0
+        store.create("pods", v)
+        filler = make_pod("n1-filler", cpu="1000m")
+        filler["spec"]["nodeName"] = "node-1"
+        filler["spec"]["priority"] = 100
+        store.create("pods", filler)
+        filler2 = make_pod("n2-filler", cpu="1000m")
+        filler2["spec"]["nodeName"] = "node-2"
+        filler2["spec"]["priority"] = 100
+        store.create("pods", filler2)
+        incoming = make_pod("incoming", cpu="3000m")
+        incoming["spec"]["priority"] = 10
+        store.create("pods", incoming)
+
+        svc = self._svc(store)
+        results = svc.schedule_pending(max_rounds=1)
+        assert results["default/incoming"].nominated_node == "node-2"
+
+
+class TestNodeVolumeLimitsCSI:
+    """CSI attach limits resolved per driver via PVC → StorageClass →
+    provisioner and capped by the node's CSINode allocatable count
+    (upstream nodevolumelimits/csi.go)."""
+
+    def _base(self):
+        store = ClusterStore()
+        store.create("nodes", make_node("node-1", cpu="32"))
+        store.create("csinodes", {
+            "metadata": {"name": "node-1"},
+            "spec": {"drivers": [{"name": "ebs.csi.aws.com", "allocatable": {"count": 2}}]},
+        })
+        store.create("storageclasses", {
+            "metadata": {"name": "fast"},
+            "provisioner": "ebs.csi.aws.com",
+        })
+        for i in range(3):
+            store.create("persistentvolumeclaims", {
+                "metadata": {"name": f"claim-{i}", "namespace": "default"},
+                "spec": {"storageClassName": "fast", "accessModes": ["ReadWriteOnce"]},
+            })
+        svc = SchedulerService(store, tie_break="first")
+        svc.start_scheduler({"percentageOfNodesToScore": 100})
+        return store, svc
+
+    def test_csinode_allocatable_caps_driver(self):
+        store, svc = self._base()
+        # two attached volumes already on the node through the same driver
+        bound = make_pod("existing")
+        bound["spec"]["nodeName"] = "node-1"
+        bound["spec"]["volumes"] = [
+            {"name": f"v{i}", "persistentVolumeClaim": {"claimName": f"claim-{i}"}} for i in range(2)
+        ]
+        store.create("pods", bound)
+        incoming = make_pod("incoming")
+        incoming["spec"]["volumes"] = [{"name": "v", "persistentVolumeClaim": {"claimName": "claim-2"}}]
+        store.create("pods", incoming)
+        res = svc.schedule_pending(max_rounds=1)["default/incoming"]
+        assert not res.success
+        assert any("max volume count" in s.message() for s in res.diagnosis.values())
+
+    def test_inline_csi_volume_counts(self):
+        store, svc = self._base()
+        incoming = make_pod("incoming")
+        incoming["spec"]["volumes"] = [
+            {"name": f"v{i}", "csi": {"driver": "ebs.csi.aws.com"}} for i in range(3)
+        ]
+        store.create("pods", incoming)
+        res = svc.schedule_pending(max_rounds=1)["default/incoming"]
+        assert not res.success  # 3 > CSINode allocatable 2
+
+    def test_other_driver_not_capped(self):
+        store, svc = self._base()
+        incoming = make_pod("incoming")
+        incoming["spec"]["volumes"] = [
+            {"name": f"v{i}", "csi": {"driver": "other.csi.io"}} for i in range(3)
+        ]
+        store.create("pods", incoming)
+        res = svc.schedule_pending(max_rounds=1)["default/incoming"]
+        assert res.success  # falls back to the generic 256 limit
