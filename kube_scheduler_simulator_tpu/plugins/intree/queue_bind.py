@@ -209,10 +209,11 @@ class DefaultPreemption:
             if not victims:
                 return name  # no victims needed at all — immediately best
             high_prio = max(pod_priority(v) for v in victims)
-            # latest start time among the highest-priority victims wins —
-            # _ReverseStr flips the string comparison inside the ascending
-            # tuple ordering
-            latest_start = max(
+            # upstream GetEarliestPodStartTime: the node whose EARLIEST
+            # start time among its highest-priority victims is LATEST wins
+            # — _ReverseStr flips the string comparison inside the
+            # ascending tuple ordering
+            earliest_start = min(
                 self._start_time(v) for v in victims if pod_priority(v) == high_prio
             )
             full_key = (
@@ -220,7 +221,7 @@ class DefaultPreemption:
                 high_prio,
                 sum(pod_priority(v) for v in victims),
                 len(victims),
-                _ReverseStr(latest_start),
+                _ReverseStr(earliest_start),
             )
             if best_key is None or full_key < best_key:
                 best_key = full_key

@@ -275,18 +275,29 @@ class NodeVolumeLimits(_VolumeLimits):
                 out[d["name"]] = int(cnt)
         return out
 
-    def _pod_volume_ids(self, pod: Obj) -> "set[tuple[str, str]]":
+    _CACHE_KEY = "NodeVolumeLimits/cycle-cache"
+
+    def _pod_volume_ids(self, pod: Obj, drv_memo: "dict | None" = None) -> "set[tuple[str, str]]":
         """(driver, unique volume id) pairs a pod attaches.  PVC-backed
         volumes are identified by the claim (pods sharing a PVC share ONE
         attachment — upstream counts unique volume handles); inline csi:
-        volumes are unique per pod+volume."""
+        volumes are unique per pod+volume.  ``drv_memo`` caches the
+        PVC → driver resolution (3 store lookups otherwise)."""
         ns = pod["metadata"].get("namespace", "default")
         out: set[tuple[str, str]] = set()
         for v in (pod.get("spec") or {}).get("volumes") or []:
-            driver = self._driver_of(v, ns)
+            pvc_ref = v.get("persistentVolumeClaim")
+            if pvc_ref is not None and drv_memo is not None:
+                mk = (ns, pvc_ref.get("claimName", ""))
+                if mk in drv_memo:
+                    driver = drv_memo[mk]
+                else:
+                    driver = self._driver_of(v, ns)
+                    drv_memo[mk] = driver
+            else:
+                driver = self._driver_of(v, ns)
             if driver is None:
                 continue
-            pvc_ref = v.get("persistentVolumeClaim")
             if pvc_ref:
                 vid = f"pvc:{ns}/{pvc_ref.get('claimName', '')}"
             else:
@@ -295,13 +306,31 @@ class NodeVolumeLimits(_VolumeLimits):
         return out
 
     def filter(self, state: CycleState, pod: Obj, node_info: NodeInfo) -> "Status | None":
-        want = self._pod_volume_ids(pod)
+        # cycle-scoped memo: the incoming pod's volume set, every existing
+        # pod's set (keyed ns/name — the cycle's snapshot is stable), and
+        # PVC→driver / CSINode resolutions — upstream computes these once
+        # per cycle too; without it, every candidate node re-walks the
+        # PVC→StorageClass chains through deep-copying store lookups
+        cache = state.read(self._CACHE_KEY)
+        if cache is None:
+            cache = {"drv": {}, "pods": {}, "limits": {}}
+            cache["want"] = self._pod_volume_ids(pod, cache["drv"])
+            state.write(self._CACHE_KEY, cache)
+        want = cache["want"]
         if not want:
             return None
-        limits = self._csinode_limits(node_info.name)
+        limits = cache["limits"].get(node_info.name)
+        if limits is None:
+            limits = self._csinode_limits(node_info.name)
+            cache["limits"][node_info.name] = limits
         attached: set[tuple[str, str]] = set()
         for p in node_info.pods:
-            attached |= self._pod_volume_ids(p)
+            pk = f"{p['metadata'].get('namespace', 'default')}/{p['metadata']['name']}"
+            ids = cache["pods"].get(pk)
+            if ids is None:
+                ids = self._pod_volume_ids(p, cache["drv"])
+                cache["pods"][pk] = ids
+            attached |= ids
         new = want - attached
         for driver in {d for d, _ in new}:
             used = sum(1 for d, _ in attached if d == driver)

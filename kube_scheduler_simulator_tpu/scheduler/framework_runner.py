@@ -367,6 +367,8 @@ class Framework:
         if wp is None:
             return None
         wp.pending.discard(plugin)
+        # an approved plugin's timer stops (upstream Allow cancels it)
+        wp.deadlines.pop(plugin, None)
         if wp.pending:
             return None
         del self.waiting_pods[wp.key]

@@ -345,6 +345,13 @@ class SchedulerService:
         assert self.framework is not None, "scheduler not started"
         res = self.framework.allow_waiting_pod(namespace, name, plugin)
         if res is not None:
+            if not res.success:
+                # the deferred bind cycle failed (e.g. binder webhook down)
+                # — record it like any scheduling failure
+                try:
+                    self._record_failure(self.cluster_store.get("pods", name, namespace), res)
+                except KeyError:
+                    pass
             self.reflector.flush_all(self.cluster_store, skip_keys=set(self.framework.waiting_pods))
         return res
 
@@ -597,9 +604,23 @@ class SchedulerService:
                 ],
             }
         }
-        if result.nominated_node:
-            patch["status"]["nominatedNodeName"] = result.nominated_node
+        # None DELETES via merge-patch: a failure without a nomination must
+        # clear any stale nominatedNodeName, and the no-op guard below
+        # relies on the comparison converging
+        patch["status"]["nominatedNodeName"] = result.nominated_node or None
         try:
+            # Skip no-op patches: re-recording an identical failure would
+            # emit a MODIFIED event that wakes the background loop, which
+            # fails the pod again — a self-perpetuating churn (upstream's
+            # backoff queue prevents the equivalent).
+            current = self.cluster_store.get("pods", name, ns)
+            cur_status = current.get("status") or {}
+            cur_conditions = cur_status.get("conditions") or []
+            if (
+                cur_conditions == patch["status"]["conditions"]
+                and cur_status.get("nominatedNodeName") == patch["status"].get("nominatedNodeName")
+            ):
+                return
             self.cluster_store.patch("pods", name, patch, ns)
         except KeyError:
             pass

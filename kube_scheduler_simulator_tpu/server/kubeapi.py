@@ -1,0 +1,423 @@
+"""Kubernetes-API-compatible HTTP surface over the in-memory cluster store.
+
+The reference boots a REAL kube-apiserver on its own port (:3131) next to
+the simulator API (:1212) so kubectl/client-go and external schedulers can
+talk to the simulated cluster directly (reference
+simulator/k8sapiserver/k8sapiserver.go:34-88; the web UI's per-resource
+clients hit it too, web/api/v1/*.ts).  This build replaces the apiserver
+with the in-memory store (SURVEY.md §7 step 1); this module serves the
+store through the kube REST conventions so generic clients keep working:
+
+- discovery: ``GET /api``, ``GET /api/v1``, ``GET /apis``,
+  ``GET /apis/{group}/{version}`` (APIVersions / APIResourceList /
+  APIGroupList documents)
+- collections: ``GET/POST`` on ``/api/v1/pods`` (all namespaces),
+  ``/api/v1/namespaces/{ns}/pods``, ``/api/v1/nodes``, … and the grouped
+  kinds under ``/apis/{group}/{version}/…`` (storageclasses, csinodes,
+  priorityclasses, deployments, replicasets, poddisruptionbudgets)
+- objects: ``GET/PUT/PATCH/DELETE`` on ``…/{name}`` (PATCH is
+  strategic-merge-lite: JSON merge patch semantics, what the store's
+  ``patch`` implements)
+- ``?watch=true``: chunked watch stream of kube WatchEvents
+  (``{"type":"ADDED","object":{…}}``), resuming from ``resourceVersion``
+- the ``binding`` subresource: ``POST …/pods/{name}/binding`` — how a
+  real (external) scheduler commits a placement
+
+Served by ``KubeAPIServer`` on its own port, mirroring the reference's
+two-port layout.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+from urllib.parse import parse_qs, urlparse
+
+from kube_scheduler_simulator_tpu.state.store import (
+    AlreadyExistsError,
+    NAMESPACED_KINDS,
+    NotFoundError,
+)
+
+Obj = dict[str, Any]
+
+# (group, version, resource, kind name, store kind)
+CORE_RESOURCES = (
+    ("", "v1", "pods", "Pod", "pods"),
+    ("", "v1", "nodes", "Node", "nodes"),
+    ("", "v1", "namespaces", "Namespace", "namespaces"),
+    ("", "v1", "persistentvolumes", "PersistentVolume", "persistentvolumes"),
+    ("", "v1", "persistentvolumeclaims", "PersistentVolumeClaim", "persistentvolumeclaims"),
+)
+GROUP_RESOURCES = (
+    ("storage.k8s.io", "v1", "storageclasses", "StorageClass", "storageclasses"),
+    ("storage.k8s.io", "v1", "csinodes", "CSINode", "csinodes"),
+    ("scheduling.k8s.io", "v1", "priorityclasses", "PriorityClass", "priorityclasses"),
+    ("apps", "v1", "deployments", "Deployment", "deployments"),
+    ("apps", "v1", "replicasets", "ReplicaSet", "replicasets"),
+    ("policy", "v1", "poddisruptionbudgets", "PodDisruptionBudget", "poddisruptionbudgets"),
+)
+ALL_RESOURCES = CORE_RESOURCES + GROUP_RESOURCES
+_BY_RESOURCE = {r[2]: r for r in ALL_RESOURCES}
+
+
+def _api_version(group: str, version: str) -> str:
+    return version if not group else f"{group}/{version}"
+
+
+class _Route:
+    __slots__ = ("kind", "store_kind", "api_version", "namespace", "name", "subresource")
+
+    def __init__(self, kind, store_kind, api_version, namespace, name, subresource):
+        self.kind = kind
+        self.store_kind = store_kind
+        self.api_version = api_version
+        self.namespace = namespace
+        self.name = name
+        self.subresource = subresource
+
+
+def resolve(path: str) -> "_Route | None":
+    """Map a kube REST path to (kind, namespace, name, subresource)."""
+    parts = [p for p in path.split("/") if p]
+    if not parts:
+        return None
+    if parts[0] == "api":
+        if len(parts) < 3 or parts[1] != "v1":
+            return None
+        rest = parts[2:]
+        group, version = "", "v1"
+    elif parts[0] == "apis":
+        if len(parts) < 4:
+            return None
+        group, version = parts[1], parts[2]
+        rest = parts[3:]
+    else:
+        return None
+    namespace = None
+    if rest[0] == "namespaces" and len(rest) >= 3:
+        # /namespaces/{ns}/{resource}... — but /namespaces/{name} itself is
+        # an object route of the namespaces resource
+        namespace, rest = rest[1], rest[2:]
+    elif rest[0] == "namespaces" and len(rest) == 2:
+        rest = ["namespaces", rest[1]]
+    resource = rest[0]
+    entry = _BY_RESOURCE.get(resource)
+    if entry is None or entry[0] != group or entry[1] != version:
+        return None
+    name = rest[1] if len(rest) > 1 else None
+    subresource = rest[2] if len(rest) > 2 else None
+    return _Route(entry[3], entry[4], _api_version(group, version), namespace, name, subresource)
+
+
+def discovery_document(path: str) -> "Obj | None":
+    parts = [p for p in path.split("/") if p]
+    if parts == ["api"]:
+        return {"kind": "APIVersions", "versions": ["v1"]}
+    if parts == ["apis"]:
+        groups = sorted({g for g, *_ in GROUP_RESOURCES})
+        return {
+            "kind": "APIGroupList",
+            "apiVersion": "v1",
+            "groups": [
+                {
+                    "name": g,
+                    "versions": [{"groupVersion": f"{g}/v1", "version": "v1"}],
+                    "preferredVersion": {"groupVersion": f"{g}/v1", "version": "v1"},
+                }
+                for g in groups
+            ],
+        }
+    if parts == ["api", "v1"] or (len(parts) == 3 and parts[0] == "apis" and parts[2] == "v1"):
+        if parts[0] == "api":
+            rows = [r for r in CORE_RESOURCES]
+            gv = "v1"
+        else:
+            rows = [r for r in GROUP_RESOURCES if r[0] == parts[1]]
+            if not rows:
+                return None
+            gv = f"{parts[1]}/v1"
+        return {
+            "kind": "APIResourceList",
+            "groupVersion": gv,
+            "resources": [
+                {
+                    "name": resource,
+                    "singularName": kind.lower(),
+                    "namespaced": store_kind in NAMESPACED_KINDS,
+                    "kind": kind,
+                    "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"],
+                }
+                for _g, _v, resource, kind, store_kind in rows
+            ]
+            + (
+                [{"name": "pods/binding", "singularName": "", "namespaced": True, "kind": "Binding", "verbs": ["create"]}]
+                if parts[0] == "api"
+                else []
+            ),
+        }
+    return None
+
+
+class KubeAPIServer:
+    """The simulator's kube-API port (reference layout: kube API on its
+    own port next to the simulator API)."""
+
+    def __init__(self, cluster_store: Any, port: int = 3131):
+        self.store = cluster_store
+        self.port = port
+        self._httpd: "ThreadingHTTPServer | None" = None
+        self._thread: "threading.Thread | None" = None
+        self._stop = threading.Event()
+
+    def start(self, background: bool = True) -> int:
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        if background:
+            self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+            self._thread.start()
+        else:
+            self._httpd.serve_forever()
+        return self.port
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def _make_handler(server: KubeAPIServer):
+    store = server.store
+
+    def envelope(obj: Obj, api_version: str, kind: str) -> Obj:
+        out = dict(obj)
+        out.setdefault("apiVersion", api_version)
+        out.setdefault("kind", kind)
+        return out
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args) -> None:  # quiet
+            pass
+
+        def _send_json(self, code: int, body: Obj) -> None:
+            data = json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _status_err(self, code: int, reason: str, message: str) -> None:
+            self._send_json(
+                code,
+                {
+                    "kind": "Status",
+                    "apiVersion": "v1",
+                    "status": "Failure",
+                    "reason": reason,
+                    "message": message,
+                    "code": code,
+                },
+            )
+
+        def _body(self) -> Obj:
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b""
+            return json.loads(raw) if raw else {}
+
+        # ------------------------------------------------------------- GET
+
+        def do_GET(self) -> None:
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
+            doc = discovery_document(url.path)
+            if doc is not None:
+                self._send_json(200, doc)
+                return
+            rt = resolve(url.path)
+            if rt is None:
+                self._status_err(404, "NotFound", f"no handler for {url.path}")
+                return
+            try:
+                if rt.name is None:
+                    if (q.get("watch") or ["false"])[0] == "true":
+                        self._watch(rt, q)
+                    else:
+                        items = store.list(rt.store_kind, rt.namespace)
+                        self._send_json(
+                            200,
+                            {
+                                "kind": f"{rt.kind}List",
+                                "apiVersion": rt.api_version,
+                                "metadata": {"resourceVersion": str(store.resource_version)},
+                                "items": [envelope(o, rt.api_version, rt.kind) for o in items],
+                            },
+                        )
+                else:
+                    obj = store.get(rt.store_kind, rt.name, rt.namespace)
+                    self._send_json(200, envelope(obj, rt.api_version, rt.kind))
+            except NotFoundError as e:
+                self._status_err(404, "NotFound", str(e))
+
+        def _watch(self, rt: "_Route", q: dict) -> None:
+            """Chunked kube watch stream: {"type": ..., "object": ...}."""
+            events: "queue.Queue" = queue.Queue()
+            unsubscribe = store.subscribe([rt.store_kind], events.put)
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def write_event(type_: str, obj: Obj) -> None:
+                    line = (
+                        json.dumps({"type": type_, "object": envelope(obj, rt.api_version, rt.kind)})
+                        + "\n"
+                    ).encode()
+                    self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                    self.wfile.flush()
+
+                rv = int((q.get("resourceVersion") or ["0"])[0] or 0)
+                if rv == 0:
+                    # kube semantics: rv=0/absent → synthetic ADDED for the
+                    # current state first; capture the state's rv ATOMICALLY
+                    # with the list so queued events from the subscribe/list
+                    # window aren't replayed twice out of order
+                    with store.lock:
+                        items = store.list(rt.store_kind, rt.namespace)
+                        rv = store.resource_version
+                    for o in items:
+                        write_event("ADDED", o)
+                else:
+                    # resume: replay the missed backlog from the event log
+                    # (410 Gone when it was compacted away, kube-style)
+                    from kube_scheduler_simulator_tpu.state.store import (
+                        ResourceExpiredError,
+                    )
+
+                    try:
+                        backlog = store.events_since(rt.store_kind, rv)
+                    except ResourceExpiredError as e:
+                        write_event_raw = {
+                            "type": "ERROR",
+                            "object": {
+                                "kind": "Status",
+                                "apiVersion": "v1",
+                                "status": "Failure",
+                                "reason": "Expired",
+                                "message": str(e),
+                                "code": 410,
+                            },
+                        }
+                        line = (json.dumps(write_event_raw) + "\n").encode()
+                        self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                        self.wfile.flush()
+                        return
+                    for ev in backlog:
+                        if rt.namespace and (ev.obj["metadata"].get("namespace") or "default") != rt.namespace:
+                            continue
+                        write_event(ev.type, ev.obj)
+                        rv = max(rv, ev.resource_version)
+                while not server._stop.is_set():
+                    try:
+                        ev = events.get(timeout=0.25)
+                    except queue.Empty:
+                        continue
+                    if rt.namespace and (ev.obj["metadata"].get("namespace") or "default") != rt.namespace:
+                        continue
+                    if ev.resource_version <= rv:
+                        continue
+                    write_event(ev.type, ev.obj)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            finally:
+                unsubscribe()
+
+        # ------------------------------------------------------------ POST
+
+        def do_POST(self) -> None:
+            url = urlparse(self.path)
+            rt = resolve(url.path)
+            if rt is None:
+                self._status_err(404, "NotFound", f"no handler for {url.path}")
+                return
+            try:
+                body = self._body()
+                if rt.subresource == "binding" and rt.store_kind == "pods":
+                    # the scheduler's bind call: POST …/pods/{name}/binding
+                    target = ((body.get("target") or {}).get("name")) or ""
+                    store.bind_pod(rt.namespace or "default", rt.name, target)
+                    self._send_json(
+                        201,
+                        {"kind": "Status", "apiVersion": "v1", "status": "Success", "code": 201},
+                    )
+                    return
+                if rt.namespace:
+                    body.setdefault("metadata", {}).setdefault("namespace", rt.namespace)
+                created = store.create(rt.store_kind, body)
+                self._send_json(201, envelope(created, rt.api_version, rt.kind))
+            except AlreadyExistsError as e:
+                self._status_err(409, "AlreadyExists", str(e))
+            except NotFoundError as e:
+                self._status_err(404, "NotFound", str(e))
+            except Exception as e:
+                self._status_err(400, "BadRequest", f"{type(e).__name__}: {e}")
+
+        # ---------------------------------------------------- PUT / PATCH
+
+        def do_PUT(self) -> None:
+            url = urlparse(self.path)
+            rt = resolve(url.path)
+            if rt is None or rt.name is None:
+                self._status_err(404, "NotFound", f"no handler for {url.path}")
+                return
+            try:
+                body = self._body()
+                body.setdefault("metadata", {}).setdefault("name", rt.name)
+                if rt.namespace:
+                    body["metadata"].setdefault("namespace", rt.namespace)
+                updated = store.apply(rt.store_kind, body)
+                self._send_json(200, envelope(updated, rt.api_version, rt.kind))
+            except Exception as e:
+                self._status_err(400, "BadRequest", f"{type(e).__name__}: {e}")
+
+        def do_PATCH(self) -> None:
+            url = urlparse(self.path)
+            rt = resolve(url.path)
+            if rt is None or rt.name is None:
+                self._status_err(404, "NotFound", f"no handler for {url.path}")
+                return
+            try:
+                patched = store.patch(rt.store_kind, rt.name, self._body(), rt.namespace)
+                self._send_json(200, envelope(patched, rt.api_version, rt.kind))
+            except NotFoundError as e:
+                self._status_err(404, "NotFound", str(e))
+            except Exception as e:
+                self._status_err(400, "BadRequest", f"{type(e).__name__}: {e}")
+
+        # ---------------------------------------------------------- DELETE
+
+        def do_DELETE(self) -> None:
+            url = urlparse(self.path)
+            rt = resolve(url.path)
+            if rt is None or rt.name is None:
+                self._status_err(404, "NotFound", f"no handler for {url.path}")
+                return
+            try:
+                store.delete(rt.store_kind, rt.name, rt.namespace)
+                self._send_json(
+                    200, {"kind": "Status", "apiVersion": "v1", "status": "Success", "code": 200}
+                )
+            except NotFoundError as e:
+                self._status_err(404, "NotFound", str(e))
+
+    return Handler

@@ -60,10 +60,22 @@ _RESOURCE_RE = re.compile(r"^/api/v1/resources/([a-z]+)(?:/([^/]+))?$")
 class SimulatorServer:
     """NewSimulatorServer analog (reference server/server.go:26-66)."""
 
-    def __init__(self, di: DIContainer, port: int = 1212, cors_allowed_origins: "list[str] | None" = None):
+    def __init__(
+        self,
+        di: DIContainer,
+        port: int = 1212,
+        cors_allowed_origins: "list[str] | None" = None,
+        kube_api_port: "int | None" = None,
+    ):
+        """``kube_api_port``: also serve the kube-API-compatible surface
+        (server/kubeapi.py) on this port — the reference's two-port layout
+        (kube API :3131 next to the simulator API :1212).  None disables
+        it; 0 binds an ephemeral port (tests)."""
         self.di = di
         self.port = port
         self.cors = cors_allowed_origins or []
+        self.kube_api_port = kube_api_port
+        self.kube_api_server: Any = None
         self._httpd: "ThreadingHTTPServer | None" = None
         self._thread: "threading.Thread | None" = None
         self._stop = threading.Event()  # ends open watch streams on shutdown
@@ -77,6 +89,11 @@ class SimulatorServer:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), handler)
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
+        if self.kube_api_port is not None:
+            from kube_scheduler_simulator_tpu.server.kubeapi import KubeAPIServer
+
+            self.kube_api_server = KubeAPIServer(self.di.cluster_store, port=self.kube_api_port)
+            self.kube_api_port = self.kube_api_server.start(background=True)
         # The scheduler runs continuously like the reference's
         # `go sched.Run(ctx)` (scheduler.go:183).
         self.di.scheduler_service().start_background()
@@ -89,6 +106,9 @@ class SimulatorServer:
 
     def shutdown(self) -> None:
         self._stop.set()
+        if self.kube_api_server is not None:
+            self.kube_api_server.shutdown()
+            self.kube_api_server = None
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd = None

@@ -41,9 +41,16 @@ def start_simulator(config_path: "str | None" = None, use_batch: str = "auto", b
     if di.import_cluster_resource_service() is not None:
         di.import_cluster_resource_service().import_cluster_resources()
 
-    server = SimulatorServer(di, port=cfg.port, cors_allowed_origins=cfg.cors_allowed_origin_list)
+    server = SimulatorServer(
+        di,
+        port=cfg.port,
+        cors_allowed_origins=cfg.cors_allowed_origin_list,
+        kube_api_port=cfg.kube_api_port,
+    )
     port = server.start(background=True)
-    logger.info("simulator server started on :%d", port)
+    logger.info(
+        "simulator server started on :%d (kube API on :%s)", port, server.kube_api_port
+    )
 
     if not block:
         return server

@@ -71,6 +71,8 @@ KIND_NAMES: dict[str, str] = {
     "namespaces": "Namespace",
     "deployments": "Deployment",
     "replicasets": "ReplicaSet",
+    "poddisruptionbudgets": "PodDisruptionBudget",
+    "csinodes": "CSINode",
 }
 
 EVENT_ADDED = "ADDED"
