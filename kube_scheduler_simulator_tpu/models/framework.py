@@ -158,6 +158,14 @@ class CycleState:
     def delete(self, key: str) -> None:
         self._data.pop(key, None)
 
+    def clone(self) -> "CycleState":
+        """Shallow clone (upstream CycleState.Clone): entries are shared;
+        writers that mutate an entry on a clone must copy-on-write it
+        (the ``add_pod_to_state`` extensions do)."""
+        c = CycleState()
+        c._data = dict(self._data)
+        return c
+
 
 class Plugin(Protocol):
     name: str

@@ -41,9 +41,21 @@ class Snapshot:
         self.namespace_labels: dict[str, dict[str, str]] = {
             ns["metadata"]["name"]: ns["metadata"].get("labels") or {} for ns in namespaces or []
         }
+        # UNBOUND pods nominated onto a node by preemption (upstream's
+        # nominator): other pods' filter runs must account for them
+        self.nominated: dict[str, list[Obj]] = {}
+        for p in pods:
+            if (p.get("spec") or {}).get("nodeName"):
+                continue
+            nn = (p.get("status") or {}).get("nominatedNodeName")
+            if nn:
+                self.nominated.setdefault(nn, []).append(p)
 
     def get(self, name: str) -> "NodeInfo | None":
         return self._by_name.get(name)
+
+    def nominated_pods(self, node_name: str) -> list[Obj]:
+        return self.nominated.get(node_name, [])
 
     def have_pods_with_affinity(self) -> list[NodeInfo]:
         return [ni for ni in self.node_infos if any(_pod_has_affinity(p) for p in ni.pods)]
@@ -60,6 +72,20 @@ class Snapshot:
             spec["nodeName"] = node_name
             pod["spec"] = spec
             ni.add_pod(pod)
+        # an assumed pod is no longer a pending nomination — leaving it in
+        # self.nominated would double-count its resources for later pods
+        me = pod["metadata"]
+        key = (me.get("namespace", "default"), me["name"])
+        for nn, lst in list(self.nominated.items()):
+            kept = [
+                q
+                for q in lst
+                if (q["metadata"].get("namespace", "default"), q["metadata"]["name"]) != key
+            ]
+            if kept:
+                self.nominated[nn] = kept
+            elif nn in self.nominated:
+                del self.nominated[nn]
 
     def forget(self, pod: Obj, node_name: str) -> None:
         ni = self._by_name.get(node_name)

@@ -124,6 +124,38 @@ class InterPodAffinity:
         )
         return None, None
 
+    def add_pod_to_state(self, state: CycleState, pod: Obj, pod_to_add: Obj, node_info: NodeInfo) -> None:
+        """upstream PreFilterExtensions.AddPod: account ``pod_to_add`` (a
+        nominated pod assumed onto ``node_info``) into the precomputed
+        pair counts on a CLONED cycle state (copy-on-write)."""
+        st = state.read(self.PRE_FILTER_KEY)
+        if st is None:
+            return
+        ns_labels = self._ns_labels()
+        labels = node_info.node["metadata"].get("labels") or {}
+        new = {
+            "existing_anti": dict(st["existing_anti"]),
+            "affinity": dict(st["affinity"]),
+            "anti": dict(st["anti"]),
+        }
+        add_ns = pod_to_add["metadata"].get("namespace", "default")
+        for term in required_anti_affinity_terms(pod_to_add):
+            key = term.get("topologyKey", "")
+            if key in labels and affinity_term_matches_pod(term, add_ns, pod, ns_labels):
+                pair = (key, labels[key])
+                new["existing_anti"][pair] = new["existing_anti"].get(pair, 0) + 1
+        incoming_ns = pod["metadata"].get("namespace", "default")
+        for dest, terms in (
+            ("affinity", required_affinity_terms(pod)),
+            ("anti", required_anti_affinity_terms(pod)),
+        ):
+            for term in terms:
+                key = term.get("topologyKey", "")
+                if key in labels and affinity_term_matches_pod(term, incoming_ns, pod_to_add, ns_labels):
+                    pair = (key, labels[key])
+                    new[dest][pair] = new[dest].get(pair, 0) + 1
+        state.write(self.PRE_FILTER_KEY, new)
+
     def filter(self, state: CycleState, pod: Obj, node_info: NodeInfo) -> "Status | None":
         st = state.read(self.PRE_FILTER_KEY)
         if st is None:

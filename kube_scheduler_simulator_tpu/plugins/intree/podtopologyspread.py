@@ -116,6 +116,33 @@ class PodTopologySpread:
         state.write(self.PRE_FILTER_KEY, {"constraints": constraints, "counts": counts, "min": min_match})
         return None, None
 
+    def add_pod_to_state(self, state: CycleState, pod: Obj, pod_to_add: Obj, node_info: NodeInfo) -> None:
+        """upstream PreFilterExtensions.AddPod on a cloned state: bump the
+        matching pair counts for a nominated pod assumed onto the node.
+        The per-constraint min stays as computed at PreFilter — adding a
+        pod can only raise a domain's count, so keeping the old min is
+        conservative (upstream's critical-path approximation behaves the
+        same way for the non-critical domains)."""
+        st = state.read(self.PRE_FILTER_KEY)
+        if not st or not st["constraints"]:
+            return
+        labels = node_info.node["metadata"].get("labels") or {}
+        add_ns = pod_to_add["metadata"].get("namespace", "default")
+        ns = pod["metadata"].get("namespace", "default")
+        counts = dict(st["counts"])
+        for c in st["constraints"]:
+            key = c["topologyKey"]
+            if key not in labels:
+                continue
+            if not _node_passes_inclusion(pod, node_info.node):
+                continue
+            if add_ns == ns and match_label_selector(
+                c.get("labelSelector"), pod_to_add["metadata"].get("labels") or {}
+            ):
+                pair = (key, labels[key])
+                counts[pair] = counts.get(pair, 0) + 1
+        state.write(self.PRE_FILTER_KEY, {"constraints": st["constraints"], "counts": counts, "min": st["min"]})
+
     def filter(self, state: CycleState, pod: Obj, node_info: NodeInfo) -> "Status | None":
         st = state.read(self.PRE_FILTER_KEY)
         if not st or not st["constraints"]:

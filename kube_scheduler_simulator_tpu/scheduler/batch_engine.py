@@ -413,6 +413,7 @@ class BatchEngine:
         tie_break: str = "first",
         seed: int = 0,
         bucket: bool = True,
+        profile_dir: "str | None" = None,
     ):
         self.filters = list(
             filters
@@ -429,6 +430,12 @@ class BatchEngine:
         # Pad P/N/group dims to bucket boundaries so churning workloads
         # reuse compiled executables (SURVEY §7 hard part (b)).
         self.bucket = bucket
+        # JAX profiler integration (the §5 tracing gap): when set (or via
+        # $KSS_TPU_PROFILE_DIR), each schedule() round is captured as an
+        # XLA trace viewable in TensorBoard/Perfetto.
+        import os
+
+        self.profile_dir = profile_dir or os.environ.get("KSS_TPU_PROFILE_DIR") or None
         self.cfg = B.BatchConfig(
             filters=tuple(f for f in self.filters if f in KERNEL_FILTERS),
             scores=tuple((s, w) for s, w in self.scores),
@@ -530,6 +537,16 @@ class BatchEngine:
         """Can this profile × workload run fully on the batch path?"""
         if self._unsupported_config:
             return False, self._unsupported_config
+        # An unbound pod nominated by an earlier preemption reserves its
+        # node for other pods' filter runs (upstream
+        # RunFilterPluginsWithNominatedPods) — the kernel doesn't model
+        # that, so such rounds take the exact sequential cycle.
+        if any(
+            (p.get("status") or {}).get("nominatedNodeName")
+            and not (p.get("spec") or {}).get("nodeName")
+            for p in pending
+        ):
+            return False, "nominated pods present (preemption in flight)"
         # Feasible-node sampling (numFeasibleNodesToFind + rotating start)
         # runs IN the kernel.  The one case it can't express is a PreFilter
         # that narrows the node list while sampling is active: upstream
@@ -598,6 +615,22 @@ class BatchEngine:
         framework's attempt counter for the round's first pod (keys the
         reservoir tie-break draws); ``start_index`` is the framework's
         rotating next_start_node_index at round start."""
+        if self.profile_dir:
+            import jax
+
+            with jax.profiler.trace(self.profile_dir):
+                return self._schedule(nodes, all_pods, pending, namespaces, base_counter, start_index)
+        return self._schedule(nodes, all_pods, pending, namespaces, base_counter, start_index)
+
+    def _schedule(
+        self,
+        nodes: list[Obj],
+        all_pods: list[Obj],
+        pending: list[Obj],
+        namespaces: "list[Obj] | None" = None,
+        base_counter: int = 0,
+        start_index: int = 0,
+    ) -> BatchResult:
         from kube_scheduler_simulator_tpu.scheduler.framework_runner import (
             num_feasible_nodes_to_find,
         )

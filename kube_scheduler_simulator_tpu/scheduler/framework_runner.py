@@ -205,7 +205,7 @@ class Framework:
         for i in range(n):
             ni = node_infos[(self.next_start_node_index + i) % n]
             processed += 1
-            status = self._run_filters(state, pod, ni)
+            status = self._run_filters_with_nominated(state, pod, ni, snapshot)
             if status is None:
                 feasible.append(ni)
                 if len(feasible) >= num_to_find:
@@ -407,6 +407,46 @@ class Framework:
             if status is not None and not status.is_success():
                 return status
         return None
+
+    def _run_filters_with_nominated(
+        self, state: CycleState, pod: Obj, ni: NodeInfo, snapshot: Snapshot
+    ) -> "Status | None":
+        """Upstream RunFilterPluginsWithNominatedPods: when equal-or-
+        higher-priority pods are NOMINATED onto the node (preemption
+        happened, victims evicted, nominee not yet bound), the pod must
+        pass filters BOTH with those pods' resources accounted AND
+        without them — otherwise it could steal the capacity preemption
+        just freed for the nominee."""
+        from kube_scheduler_simulator_tpu.plugins.intree.queue_bind import pod_priority
+
+        me = pod["metadata"]
+        nominated = [
+            q
+            for q in snapshot.nominated_pods(ni.name)
+            if pod_priority(q) >= pod_priority(pod)
+            and not (
+                q["metadata"]["name"] == me["name"]
+                and q["metadata"].get("namespace", "default") == me.get("namespace", "default")
+            )
+        ]
+        if nominated:
+            scratch = NodeInfo(ni.node)
+            for p in ni.pods:
+                scratch.add_pod(p)
+            # cloned cycle state + AddPod extensions so STATE-based
+            # plugins (InterPodAffinity, PodTopologySpread) see the
+            # nominated pods too, not just node-resource readers
+            cloned = state.clone()
+            for q in nominated:
+                scratch.add_pod(q)
+                for wp in self.plugins["filter"]:
+                    add = getattr(wp.original, "add_pod_to_state", None)
+                    if add is not None:
+                        add(cloned, pod, q, ni)
+            status = self._run_filters(cloned, pod, scratch)
+            if status is not None and not status.is_success():
+                return status
+        return self._run_filters(state, pod, ni)
 
     def _run_post_filters(self, state: CycleState, pod: Obj, diagnosis: dict[str, Status]) -> "str | None":
         for wp in self.plugins["post_filter"]:

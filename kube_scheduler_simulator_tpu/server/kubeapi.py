@@ -99,11 +99,9 @@ def resolve(path: str) -> "_Route | None":
         return None
     namespace = None
     if rest[0] == "namespaces" and len(rest) >= 3:
-        # /namespaces/{ns}/{resource}... — but /namespaces/{name} itself is
-        # an object route of the namespaces resource
+        # /namespaces/{ns}/{resource}... — /namespaces/{name} falls
+        # through as an object route of the namespaces resource
         namespace, rest = rest[1], rest[2:]
-    elif rest[0] == "namespaces" and len(rest) == 2:
-        rest = ["namespaces", rest[1]]
     resource = rest[0]
     entry = _BY_RESOURCE.get(resource)
     if entry is None or entry[0] != group or entry[1] != version:
@@ -250,7 +248,12 @@ def _make_handler(server: KubeAPIServer):
             try:
                 if rt.name is None:
                     if (q.get("watch") or ["false"])[0] == "true":
-                        self._watch(rt, q)
+                        try:
+                            rv = int((q.get("resourceVersion") or ["0"])[0] or 0)
+                        except ValueError:
+                            self._status_err(400, "BadRequest", "resourceVersion must be an integer")
+                            return
+                        self._watch(rt, rv)
                     else:
                         items = store.list(rt.store_kind, rt.namespace)
                         self._send_json(
@@ -268,7 +271,7 @@ def _make_handler(server: KubeAPIServer):
             except NotFoundError as e:
                 self._status_err(404, "NotFound", str(e))
 
-        def _watch(self, rt: "_Route", q: dict) -> None:
+        def _watch(self, rt: "_Route", rv: int) -> None:
             """Chunked kube watch stream: {"type": ..., "object": ...}."""
             events: "queue.Queue" = queue.Queue()
             unsubscribe = store.subscribe([rt.store_kind], events.put)
@@ -286,7 +289,6 @@ def _make_handler(server: KubeAPIServer):
                     self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
                     self.wfile.flush()
 
-                rv = int((q.get("resourceVersion") or ["0"])[0] or 0)
                 if rv == 0:
                     # kube semantics: rv=0/absent → synthetic ADDED for the
                     # current state first; capture the state's rv ATOMICALLY
@@ -355,6 +357,9 @@ def _make_handler(server: KubeAPIServer):
                 if rt.subresource == "binding" and rt.store_kind == "pods":
                     # the scheduler's bind call: POST …/pods/{name}/binding
                     target = ((body.get("target") or {}).get("name")) or ""
+                    if not target:
+                        self._status_err(400, "BadRequest", "binding requires target.name")
+                        return
                     store.bind_pod(rt.namespace or "default", rt.name, target)
                     self._send_json(
                         201,

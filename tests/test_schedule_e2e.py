@@ -762,3 +762,81 @@ class TestNodeVolumeLimitsCSI:
         store.create("pods", incoming)
         res = svc.schedule_pending(max_rounds=1)["default/incoming"]
         assert res.success  # falls back to the generic 256 limit
+
+
+class TestNominatedPods:
+    """Upstream RunFilterPluginsWithNominatedPods: an unbound pod
+    NOMINATED onto a node by preemption reserves that capacity against
+    equal-or-lower-priority pods until it binds."""
+
+    def test_nomination_blocks_equal_priority_rival(self):
+        store = ClusterStore()
+        store.create("nodes", make_node("node-0", cpu="4"))
+        # rival sorts FIRST (same priority, earlier creation) but must not
+        # steal the nominee's reserved room
+        rival = make_pod("a-rival", cpu="3000m")
+        rival["spec"]["priority"] = 10
+        rival["metadata"]["creationTimestamp"] = "2024-01-01T00:00:00Z"
+        store.create("pods", rival)
+        nominee = make_pod("nominee", cpu="3000m")
+        nominee["spec"]["priority"] = 10
+        nominee["metadata"]["creationTimestamp"] = "2024-01-01T00:00:01Z"
+        nominee["status"] = {"nominatedNodeName": "node-0"}
+        store.create("pods", nominee)
+
+        svc = SchedulerService(store, tie_break="first")
+        svc.start_scheduler({"percentageOfNodesToScore": 100})
+        results = svc.schedule_pending(max_rounds=1)
+        assert not results["default/a-rival"].success
+        assert results["default/nominee"].selected_node == "node-0"
+        assert store.get("pods", "nominee")["spec"]["nodeName"] == "node-0"
+
+    def test_lower_priority_pod_ignores_nomination_of_lower(self):
+        # a HIGHER-priority incoming pod may ignore lower-priority
+        # nominations (upstream only adds >= priority nominated pods)
+        store = ClusterStore()
+        store.create("nodes", make_node("node-0", cpu="4"))
+        nominee = make_pod("nominee", cpu="3000m")
+        nominee["spec"]["priority"] = 1
+        nominee["status"] = {"nominatedNodeName": "node-0"}
+        store.create("pods", nominee)
+        vip = make_pod("vip", cpu="3000m")
+        vip["spec"]["priority"] = 100
+        store.create("pods", vip)
+        svc = SchedulerService(store, tie_break="first")
+        svc.start_scheduler({"percentageOfNodesToScore": 100})
+        results = svc.schedule_pending(max_rounds=1)
+        assert results["default/vip"].selected_node == "node-0"
+
+    def test_nominated_pod_seen_by_antiaffinity(self):
+        # STATE-based plugins must see nominated pods too (upstream runs
+        # the PreFilter AddPod extensions on a cloned state): the incoming
+        # pod's required anti-affinity matches the nominee's labels, so
+        # the nominee's node must be filtered out even though the nominee
+        # isn't bound yet
+        store = ClusterStore()
+        for i in range(2):
+            store.create("nodes", make_node(f"node-{i}", cpu="8"))
+        nominee = make_pod("nominee", cpu="100m", labels={"app": "db"})
+        nominee["spec"]["priority"] = 50
+        nominee["status"] = {"nominatedNodeName": "node-0"}
+        nominee["metadata"]["creationTimestamp"] = "2024-01-01T00:00:01Z"
+        store.create("pods", nominee)
+        incoming = make_pod("incoming", cpu="100m")
+        incoming["spec"]["priority"] = 50
+        incoming["metadata"]["creationTimestamp"] = "2024-01-01T00:00:00Z"
+        incoming["spec"]["affinity"] = {
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector": {"matchLabels": {"app": "db"}},
+                     "topologyKey": "kubernetes.io/hostname"}
+                ]
+            }
+        }
+        store.create("pods", incoming)
+        svc = SchedulerService(store, tie_break="first")
+        svc.start_scheduler({"percentageOfNodesToScore": 100})
+        results = svc.schedule_pending(max_rounds=1)
+        # incoming sorts first; it must avoid node-0 (nominee's node)
+        assert results["default/incoming"].selected_node == "node-1"
+        assert results["default/nominee"].selected_node == "node-0"
