@@ -147,6 +147,10 @@ def run_config(name, P, N, plugins, spread=False, interpod=False, oracle_sample=
         "config": name,
         "pods": P,
         "nodes": N,
+        # cfg1 is deliberately tiny: batch dispatch overhead exceeds the
+        # sequential cycle there, which is why SchedulerService's auto
+        # mode routes rounds below batch_min_work to the sequential path
+        **({"note": "below batch_min_work in auto mode; sequential path serves this size"} if P * N < 2048 else {}),
         "wall_s": round(best, 4),
         "compile_s": round(compile_s, 2),
         "encode_s": round(eng.last_timings["encode_s"], 4),

@@ -14,6 +14,15 @@ from kube_scheduler_simulator_tpu.models.nodeinfo import NodeInfo, build_node_in
 Obj = dict[str, Any]
 
 
+def has_pending_nomination(pod: Obj) -> bool:
+    """Unbound pod carrying a preemption nomination — the single
+    definition shared by Snapshot (sequential reservation) and the batch
+    engine's supported() gate, so the two paths can't drift."""
+    return bool((pod.get("status") or {}).get("nominatedNodeName")) and not (
+        (pod.get("spec") or {}).get("nodeName")
+    )
+
+
 def _pod_has_affinity(pod: Obj) -> bool:
     aff = (pod.get("spec") or {}).get("affinity") or {}
     pa = aff.get("podAffinity") or {}
@@ -45,11 +54,8 @@ class Snapshot:
         # nominator): other pods' filter runs must account for them
         self.nominated: dict[str, list[Obj]] = {}
         for p in pods:
-            if (p.get("spec") or {}).get("nodeName"):
-                continue
-            nn = (p.get("status") or {}).get("nominatedNodeName")
-            if nn:
-                self.nominated.setdefault(nn, []).append(p)
+            if has_pending_nomination(p):
+                self.nominated.setdefault(p["status"]["nominatedNodeName"], []).append(p)
 
     def get(self, name: str) -> "NodeInfo | None":
         return self._by_name.get(name)
@@ -91,3 +97,14 @@ class Snapshot:
         ni = self._by_name.get(node_name)
         if ni is not None:
             ni.remove_pod(pod)
+        # an assumed-then-forgotten pod (Permit reject, bind failure) gets
+        # its nomination reservation back — assume() had dropped it
+        if has_pending_nomination(pod):
+            nn = pod["status"]["nominatedNodeName"]
+            lst = self.nominated.setdefault(nn, [])
+            me = (pod["metadata"].get("namespace", "default"), pod["metadata"]["name"])
+            if all(
+                (q["metadata"].get("namespace", "default"), q["metadata"]["name"]) != me
+                for q in lst
+            ):
+                lst.append(pod)

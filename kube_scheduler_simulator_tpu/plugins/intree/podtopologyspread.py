@@ -126,6 +126,8 @@ class PodTopologySpread:
         st = state.read(self.PRE_FILTER_KEY)
         if not st or not st["constraints"]:
             return
+        if not _node_passes_inclusion(pod, node_info.node):
+            return
         labels = node_info.node["metadata"].get("labels") or {}
         add_ns = pod_to_add["metadata"].get("namespace", "default")
         ns = pod["metadata"].get("namespace", "default")
@@ -133,8 +135,6 @@ class PodTopologySpread:
         for c in st["constraints"]:
             key = c["topologyKey"]
             if key not in labels:
-                continue
-            if not _node_passes_inclusion(pod, node_info.node):
                 continue
             if add_ns == ns and match_label_selector(
                 c.get("labelSelector"), pod_to_add["metadata"].get("labels") or {}

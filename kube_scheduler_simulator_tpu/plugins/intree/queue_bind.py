@@ -85,7 +85,7 @@ class DefaultPreemption:
             ni = snap.get(node_name)
             if ni is None:
                 continue
-            found = self._select_victims_on_node(fwk, state, pod, ni, incoming_priority, pdbs)
+            found = self._select_victims_on_node(fwk, state, pod, ni, incoming_priority, pdbs, snap)
             if found is not None:
                 candidates[node_name], violations[node_name] = found
 
@@ -158,7 +158,8 @@ class DefaultPreemption:
         return (-pod_priority(p), self._start_time(p))
 
     def _select_victims_on_node(
-        self, fwk: Any, state: CycleState, pod: Obj, ni: NodeInfo, incoming_priority: int, pdbs: list[Obj]
+        self, fwk: Any, state: CycleState, pod: Obj, ni: NodeInfo, incoming_priority: int, pdbs: list[Obj],
+        snap: Any = None,
     ) -> "tuple[list[Obj], int] | None":
         lower = [p for p in ni.pods if pod_priority(p) < incoming_priority]
         if not lower:
@@ -169,7 +170,7 @@ class DefaultPreemption:
         # remove every lower-priority pod; the incoming pod must fit then
         for p in lower:
             scratch.remove_pod(p)
-        if not fwk.run_filter_plugins_silently(state, pod, scratch):
+        if not fwk.run_filter_plugins_silently(state, pod, scratch, snapshot=snap):
             return None
         # split by PDB violation, each group in MoreImportantPod order;
         # reprieve the violating group first (minimizes violations)
@@ -182,7 +183,7 @@ class DefaultPreemption:
 
         def reprieve(p: Obj) -> bool:
             scratch.add_pod(p)
-            if fwk.run_filter_plugins_silently(state, pod, scratch):
+            if fwk.run_filter_plugins_silently(state, pod, scratch, snapshot=snap):
                 return True
             scratch.remove_pod(p)
             return False

@@ -541,11 +541,9 @@ class BatchEngine:
         # node for other pods' filter runs (upstream
         # RunFilterPluginsWithNominatedPods) — the kernel doesn't model
         # that, so such rounds take the exact sequential cycle.
-        if any(
-            (p.get("status") or {}).get("nominatedNodeName")
-            and not (p.get("spec") or {}).get("nodeName")
-            for p in pending
-        ):
+        from kube_scheduler_simulator_tpu.models.snapshot import has_pending_nomination
+
+        if any(has_pending_nomination(p) for p in pending):
             return False, "nominated pods present (preemption in flight)"
         # Feasible-node sampling (numFeasibleNodesToFind + rotating start)
         # runs IN the kernel.  The one case it can't express is a PreFilter

@@ -156,9 +156,48 @@ class Framework:
         """Upstream sched.numFeasibleNodesToFind."""
         return num_feasible_nodes_to_find(num_all_nodes, self.percentage_of_nodes_to_score)
 
-    def run_filter_plugins_silently(self, state: CycleState, pod: Obj, node_info: NodeInfo) -> bool:
+    def run_filter_plugins_silently(
+        self,
+        state: CycleState,
+        pod: Obj,
+        node_info: NodeInfo,
+        snapshot: "Snapshot | None" = None,
+    ) -> bool:
         """Run the ORIGINAL filter plugins without recording (used by
-        preemption's victim search)."""
+        preemption's victim search).  With ``snapshot``, other pods'
+        pending nominations on this node are accounted first — upstream's
+        dry run goes through RunFilterPluginsWithNominatedPods, so a
+        preemptor can't be nominated onto capacity already reserved for a
+        higher-priority nominee."""
+        if snapshot is not None:
+            from kube_scheduler_simulator_tpu.plugins.intree.queue_bind import pod_priority
+
+            me = pod["metadata"]
+            nominated = [
+                q
+                for q in snapshot.nominated_pods(node_info.name)
+                if pod_priority(q) >= pod_priority(pod)
+                and not (
+                    q["metadata"]["name"] == me["name"]
+                    and q["metadata"].get("namespace", "default") == me.get("namespace", "default")
+                )
+            ]
+            if nominated:
+                scratch = NodeInfo(node_info.node)
+                for p in node_info.pods:
+                    scratch.add_pod(p)
+                cloned = state.clone()
+                for q in nominated:
+                    scratch.add_pod(q)
+                    for wp in self.plugins["filter"]:
+                        add = getattr(wp.original, "add_pod_to_state", None)
+                        if add is not None:
+                            add(cloned, pod, q, node_info)
+                if not self._silent_pass(cloned, pod, scratch):
+                    return False
+        return self._silent_pass(state, pod, node_info)
+
+    def _silent_pass(self, state: CycleState, pod: Obj, node_info: NodeInfo) -> bool:
         for wp in self.plugins["filter"]:
             status = wp.original.filter(state, pod, node_info)
             if status is not None and not status.is_success():

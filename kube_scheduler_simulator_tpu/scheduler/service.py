@@ -604,10 +604,11 @@ class SchedulerService:
                 ],
             }
         }
-        # None DELETES via merge-patch: a failure without a nomination must
-        # clear any stale nominatedNodeName, and the no-op guard below
-        # relies on the comparison converging
-        patch["status"]["nominatedNodeName"] = result.nominated_node or None
+        # Only a NEW nomination touches nominatedNodeName — upstream's
+        # failure handler keeps an existing nomination on plain failures
+        # (nominating ModeNoop), and the no-op guard below then converges.
+        if result.nominated_node:
+            patch["status"]["nominatedNodeName"] = result.nominated_node
         try:
             # Skip no-op patches: re-recording an identical failure would
             # emit a MODIFIED event that wakes the background loop, which
@@ -616,9 +617,9 @@ class SchedulerService:
             current = self.cluster_store.get("pods", name, ns)
             cur_status = current.get("status") or {}
             cur_conditions = cur_status.get("conditions") or []
-            if (
-                cur_conditions == patch["status"]["conditions"]
-                and cur_status.get("nominatedNodeName") == patch["status"].get("nominatedNodeName")
+            if cur_conditions == patch["status"]["conditions"] and (
+                result.nominated_node is None
+                or cur_status.get("nominatedNodeName") == result.nominated_node
             ):
                 return
             self.cluster_store.patch("pods", name, patch, ns)

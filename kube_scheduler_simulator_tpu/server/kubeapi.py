@@ -237,6 +237,26 @@ def _make_handler(server: KubeAPIServer):
         def do_GET(self) -> None:
             url = urlparse(self.path)
             q = parse_qs(url.query)
+            # the handshake endpoints kubectl/client-go probe first
+            if url.path == "/version":
+                self._send_json(
+                    200,
+                    {
+                        "major": "1",
+                        "minor": "26",
+                        "gitVersion": "v1.26.0-simulator",
+                        "platform": "tpu/simulator",
+                    },
+                )
+                return
+            if url.path in ("/healthz", "/readyz", "/livez"):
+                data = b"ok"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
             doc = discovery_document(url.path)
             if doc is not None:
                 self._send_json(200, doc)

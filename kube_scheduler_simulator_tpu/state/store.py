@@ -246,9 +246,45 @@ class ClusterStore:
             meta.setdefault("creationTimestamp", _rfc3339(self._clock()))
             if kind == "pods":
                 o.setdefault("status", {}).setdefault("phase", "Pending")
+                self._admit_priority(o)
             bucket[k] = o
             self._emit(kind, EVENT_ADDED, o)
             return copy.deepcopy(o)
+
+    # The ONE admission plugin the reference keeps enabled is Priority
+    # (reference simulator/k8sapiserver/k8sapiserver.go:158-163): it
+    # resolves spec.priorityClassName into spec.priority at create time
+    # (built-in system classes included), applies the globalDefault class
+    # when no name is given, and rejects unknown class names.
+    _SYSTEM_PRIORITY_CLASSES = {
+        "system-cluster-critical": 2000000000,
+        "system-node-critical": 2000001000,
+    }
+
+    def _admit_priority(self, pod: Obj) -> None:
+        spec = pod.setdefault("spec", {})
+        if spec.get("priority") is not None:
+            return
+        name = spec.get("priorityClassName")
+        if not name:
+            default = None
+            for pc in self._bucket("priorityclasses").values():
+                if pc.get("globalDefault"):
+                    default = pc
+                    break
+            if default is not None:
+                spec["priorityClassName"] = default["metadata"]["name"]
+                spec["priority"] = int(default.get("value") or 0)
+            else:
+                spec["priority"] = 0
+            return
+        if name in self._SYSTEM_PRIORITY_CLASSES:
+            spec["priority"] = self._SYSTEM_PRIORITY_CLASSES[name]
+            return
+        pc = self._bucket("priorityclasses").get(name)
+        if pc is None:
+            raise ValueError(f"no PriorityClass with name {name} was found")
+        spec["priority"] = int(pc.get("value") or 0)
 
     def update(self, kind: str, obj: Mapping[str, Any]) -> Obj:
         with self._lock:
@@ -373,10 +409,15 @@ class ClusterStore:
         delete_order = ("deployments", "replicasets") + tuple(
             k for k in KINDS if k not in ("deployments", "replicasets")
         )
+        # Apply dependencies first: namespaces and priorityclasses before
+        # pods (Priority admission resolves priorityClassName at pod
+        # create, so a payload carrying both must land the class first).
+        apply_first = ("namespaces", "priorityclasses")
+        apply_order = apply_first + tuple(k for k in KINDS if k not in apply_first)
         with self._lock:
             for kind in delete_order:
-                # Delete everything not in the target state, then apply.
-                # Key computation must default the namespace exactly like
+                # Delete everything not in the target state.  Key
+                # computation must default the namespace exactly like
                 # create/apply do, or namespaced objects without an explicit
                 # namespace would be deleted+recreated instead of updated.
                 def keyed(o: Mapping[str, Any]) -> str:
@@ -390,6 +431,7 @@ class ClusterStore:
                     if k not in want:
                         obj = self._bucket(kind)[k]
                         self.delete(kind, obj["metadata"]["name"], obj["metadata"].get("namespace"))
+            for kind in apply_order:
                 for o in data.get(kind, []):
                     self.apply(kind, o)
 

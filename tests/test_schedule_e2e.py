@@ -791,7 +791,7 @@ class TestNominatedPods:
         assert results["default/nominee"].selected_node == "node-0"
         assert store.get("pods", "nominee")["spec"]["nodeName"] == "node-0"
 
-    def test_lower_priority_pod_ignores_nomination_of_lower(self):
+    def test_higher_priority_pod_ignores_lower_nomination(self):
         # a HIGHER-priority incoming pod may ignore lower-priority
         # nominations (upstream only adds >= priority nominated pods)
         store = ClusterStore()

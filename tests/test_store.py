@@ -175,3 +175,47 @@ class TestDumpRestore:
         for s in (s1, s2):
             s.create("pods", pod("p1"))
         assert s1.get("pods", "p1")["metadata"]["uid"] == s2.get("pods", "p1")["metadata"]["uid"]
+
+
+class TestPriorityAdmission:
+    """The reference disables ALL admission plugins except Priority
+    (k8sapiserver.go:158-163); the store emulates it at pod create."""
+
+    def test_priority_class_resolved(self):
+        from kube_scheduler_simulator_tpu.state import ClusterStore
+
+        store = ClusterStore()
+        store.create("priorityclasses", {"metadata": {"name": "high"}, "value": 1000})
+        pod = store.create("pods", {"metadata": {"name": "p"}, "spec": {"priorityClassName": "high",
+                           "containers": [{"name": "c"}]}})
+        assert pod["spec"]["priority"] == 1000
+
+    def test_global_default_applied(self):
+        from kube_scheduler_simulator_tpu.state import ClusterStore
+
+        store = ClusterStore()
+        store.create("priorityclasses", {"metadata": {"name": "team-default"}, "value": 7, "globalDefault": True})
+        pod = store.create("pods", {"metadata": {"name": "p"}, "spec": {"containers": [{"name": "c"}]}})
+        assert pod["spec"]["priority"] == 7
+        assert pod["spec"]["priorityClassName"] == "team-default"
+
+    def test_unknown_class_rejected_and_system_classes_builtin(self):
+        import pytest
+
+        from kube_scheduler_simulator_tpu.state import ClusterStore
+
+        store = ClusterStore()
+        with pytest.raises(ValueError):
+            store.create("pods", {"metadata": {"name": "p"}, "spec": {"priorityClassName": "nope",
+                         "containers": [{"name": "c"}]}})
+        pod = store.create("pods", {"metadata": {"name": "crit"}, "spec": {
+            "priorityClassName": "system-node-critical", "containers": [{"name": "c"}]}})
+        assert pod["spec"]["priority"] == 2000001000
+
+    def test_explicit_priority_wins(self):
+        from kube_scheduler_simulator_tpu.state import ClusterStore
+
+        store = ClusterStore()
+        pod = store.create("pods", {"metadata": {"name": "p"}, "spec": {"priority": 42,
+                           "containers": [{"name": "c"}]}})
+        assert pod["spec"]["priority"] == 42
