@@ -329,6 +329,70 @@ def _minmax_normalize(raw, feasible):
 
 # ------------------------------------------------------------------- kernel
 
+NODE_AXIS_SPECS = {
+    # [N, ...] node-major state: shard axis 0
+    "alloc": (0,),
+    "max_pods": (0,),
+    "nz_alloc": (0,),
+    "requested0": (0,),
+    "nonzero0": (0,),
+    "pod_count0": (0,),
+    # per-node class-index vectors — the on-device [P,N] feature
+    # expansion inherits the node sharding from these
+    "node_taint_idx": (0,),
+    "node_label_idx": (0,),
+    "node_unsched": (0,),
+    # [KT/SG/G, N]: shard the node axis
+    "node_domain": (1,),
+    "spread_counts0": (1,),
+    "gdom": (1,),
+}
+
+
+def shard_device_problem(dp: "DeviceProblem", mesh, axis_name: str = "nodes") -> "DeviceProblem":
+    """Place a lowered DeviceProblem onto ``mesh`` with the NODE axis
+    sharded — the tensor-parallel axis of this workload: every per-step
+    filter/score is elementwise over nodes, and the cross-node reductions
+    (feasible counts, normalize max/min, argmax select) become XLA
+    collectives over ICI.  Everything else (pod-axis features, class
+    matrices, [G,D] counts) replicates.  This is the scaling-axis mapping
+    SURVEY.md §5 calls out: the reference scales via goroutine parallelism
+    over nodes; the TPU build scales the node axis across chips."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    nm = mesh.shape[axis_name]
+    replicated = NamedSharding(mesh, P())
+
+    def spec_for(name: str, val):
+        axes = NODE_AXIS_SPECS.get(name)
+        if axes is None:
+            return replicated
+        ndim = getattr(val, "ndim", 1)
+        for ax in axes:
+            if val.shape[ax] % nm:
+                raise ValueError(
+                    f"{name} axis {ax} ({val.shape[ax]}) not divisible by the "
+                    f"{nm}-device mesh — pad the node axis to a multiple "
+                    f"(BatchEngine does via pad_problem(node_multiple=...))"
+                )
+        parts = [axis_name if i in axes else None for i in range(max(ndim, 1))]
+        return NamedSharding(mesh, P(*parts))
+
+    shardings = DeviceProblem(
+        **{
+            name: (
+                tuple(replicated for _ in val)
+                if isinstance(val, tuple)
+                else spec_for(name, val)
+            )
+            for name, val in dp._asdict().items()
+        }
+    )
+    # one pytree-level transfer instead of ~70 per-field dispatches
+    return jax.device_put(dp, shardings)
+
+
 def build_compact_fn(cfg: BatchConfig, dims: dict, W: int):
     """Build the trace-compaction function: gather each pod's VISITED
     nodes (the only ones the annotation trail mentions — upstream stops

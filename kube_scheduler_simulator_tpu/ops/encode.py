@@ -707,15 +707,20 @@ def _pad_axis(a: np.ndarray, axis: int, target: int, fill) -> np.ndarray:
     return np.pad(a, widths, constant_values=fill)
 
 
-def pad_problem(pr: BatchProblem) -> BatchProblem:
+def pad_problem(pr: BatchProblem, node_multiple: int = 1) -> BatchProblem:
     """Pad the pod/node/group axes of an encoded problem to bucket
     boundaries, with ``pod_active``/``node_active`` masks so padding rows
     never schedule and padded nodes are never feasible.  The unrolled
     per-constraint dims (KC/KS/KA/KB/KP/KO) stay exact — padding them
     would multiply kernel work, and they are workload-type-stable.  Host
-    metadata (node_names/pod_keys, P_true/N_true) keeps the true sizes."""
+    metadata (node_names/pod_keys, P_true/N_true) keeps the true sizes.
+
+    ``node_multiple``: round the padded node axis up to a multiple (mesh
+    sharding needs the sharded axis divisible by the device count)."""
     P, N = pr.P, pr.N
     P_pad, N_pad = _bucket(P), _bucket(N)
+    if node_multiple > 1:
+        N_pad = ((N_pad + node_multiple - 1) // node_multiple) * node_multiple
     SG_pad = _bucket(pr.SG) if pr.SG else pr.SG
     G_pad = _bucket(pr.G) if pr.G else pr.G
 

@@ -414,7 +414,12 @@ class BatchEngine:
         seed: int = 0,
         bucket: bool = True,
         profile_dir: "str | None" = None,
+        mesh: Any = None,
     ):
+        """``mesh``: a ``jax.sharding.Mesh`` with a "nodes" axis — the
+        problem's node axis shards across the mesh's devices
+        (ops/batch.shard_device_problem) and cross-node reductions become
+        XLA collectives over ICI.  None = single-device."""
         self.filters = list(
             filters
             if filters is not None
@@ -436,6 +441,7 @@ class BatchEngine:
         import os
 
         self.profile_dir = profile_dir or os.environ.get("KSS_TPU_PROFILE_DIR") or None
+        self.mesh = mesh
         self.cfg = B.BatchConfig(
             filters=tuple(f for f in self.filters if f in KERNEL_FILTERS),
             scores=tuple((s, w) for s, w in self.scores),
@@ -643,7 +649,10 @@ class BatchEngine:
             added_affinity=self.added_affinity,
         )
         if self.bucket:
-            pr = E.pad_problem(pr)
+            # mesh sharding needs the node axis divisible by the device count
+            pr = E.pad_problem(
+                pr, node_multiple=self.mesh.size if self.mesh is not None else 1
+            )
         t1 = time.perf_counter()
         dp, dims = B.lower(pr, dtype=self.dtype)
         import jax.numpy as jnp
@@ -658,13 +667,21 @@ class BatchEngine:
         # Compile out the sampling machinery when it cannot engage this
         # round (full coverage, no rotation): visit order == index order.
         cfg = self.cfg._replace(sampling=sample_k < len(nodes) or start0 != 0)
-        key = (tuple(sorted(dims.items())), cfg)
+        if self.mesh is not None:
+            # multi-chip: shard the node axis over the mesh; the jitted
+            # computation picks the shardings up from the placed arrays
+            # (donation is skipped — sharded carries would need matching
+            # output shardings to alias)
+            dp = B.shard_device_problem(dp, self.mesh)
+        key = (tuple(sorted(dims.items())), cfg, id(self.mesh) if self.mesh is not None else None)
         fn = self._fn_cache.get(key)
         t2 = time.perf_counter()
         if fn is None:
-            # donate: dp is rebuilt per round, so its buffers can alias
-            # into the scan carry instead of being copied
-            fn = B.build_batch_fn(cfg, dims, donate=True)
+            # single-device: donate — dp is rebuilt per round, so its
+            # buffers can alias into the scan carry instead of being
+            # copied; mesh: no donation (sharded carries would need
+            # matching output shardings to alias)
+            fn = B.build_batch_fn(cfg, dims, donate=self.mesh is None)
             self._fn_cache[key] = fn
             self.compiles += 1
         out_dev = fn(dp)

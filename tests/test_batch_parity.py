@@ -911,3 +911,72 @@ def test_large_scale_seeded_parity_sweep():
     assert_parity(oracle, batch, svc)
     scheduled = sum(1 for r in oracle.values() if r.success)
     assert scheduled == P, f"only {scheduled}/{P} scheduled"
+
+
+def test_batch_engine_mesh_sharded_parity():
+    """BatchEngine(mesh=...) — the productized multi-chip path — must
+    produce the identical selection to the single-device engine on a
+    virtual 8-device CPU mesh (node axis sharded; reductions become XLA
+    collectives)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.local_devices(backend="cpu")
+    assert len(devices) >= 8, "conftest forces 8 virtual CPU devices"
+    mesh = Mesh(np.array(devices[:8]), ("nodes",))
+
+    random.seed(21)
+    nodes = [
+        mk_node(
+            f"node-{i}",
+            cpu_m=random.choice([8000, 16000]),
+            mem_mi=16384,
+            labels={"kubernetes.io/hostname": f"node-{i}", "topology.kubernetes.io/zone": f"z{i % 4}"},
+        )
+        for i in range(32)
+    ]
+    pods = [
+        mk_pod(
+            f"pod-{i}",
+            cpu_m=random.choice([200, 400, 800]),
+            mem_mi=256,
+            labels={"app": f"a{i % 3}"},
+            topologySpreadConstraints=[
+                {
+                    "maxSkew": 3,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": f"a{i % 3}"}},
+                }
+            ]
+            if i % 2 == 0
+            else [],
+        )
+        for i in range(24)
+    ]
+    plugins = ["NodeResourcesFit", "TaintToleration", "PodTopologySpread"]
+    scores = [("NodeResourcesFit", 1), ("TaintToleration", 3), ("PodTopologySpread", 2)]
+
+    # pin the single-device reference to a CPU device so both runs use
+    # identical float arithmetic even on TPU-attached hosts
+    with jax.default_device(devices[0]):
+        single = BatchEngine(filters=plugins, scores=scores)
+        res1 = single.schedule(nodes, pods, pods, [])
+
+    sharded = BatchEngine(filters=plugins, scores=scores, mesh=mesh)
+    with mesh:
+        res2 = sharded.schedule(nodes, pods, pods, [])
+
+    assert res1.selected_nodes == res2.selected_nodes
+    assert list(res1.feasible_count) == list(res2.feasible_count)
+
+    # an UNEVEN node count must still work on the mesh (the node axis is
+    # padded up to a multiple of the device count)
+    sharded9 = BatchEngine(filters=plugins, scores=scores, mesh=mesh)
+    with jax.default_device(devices[0]):
+        single9 = BatchEngine(filters=plugins, scores=scores)
+        res1b = single9.schedule(nodes[:9], pods, pods, [])
+    with mesh:
+        res2b = sharded9.schedule(nodes[:9], pods, pods, [])
+    assert res1b.selected_nodes == res2b.selected_nodes
