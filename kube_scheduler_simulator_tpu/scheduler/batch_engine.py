@@ -318,19 +318,31 @@ class BatchResult:
         else:
             codes = tr["codes"]
             plugins = [(p, codes.get(p)) for p in self._engine.filters]
+            # failing entries repeat across thousands of (pod, node)
+            # pairs — memoize the marshaled bytes by (first failing
+            # plugin, message): that pair fully determines the entry
+            # (the passed prefix is the profile order up to the failure)
+            entry_memo = tr.setdefault("entry_memo", {})
             for j, n in visited:
                 if not fail_any[j]:
                     parts.append(key_frag[n] + passed)
                     continue
-                entry: dict = {}
-                for plugin, crow in plugins:
+                frag = None
+                for idx, (plugin, crow) in enumerate(plugins):
                     code = int(crow[i][j]) if crow is not None else 0
-                    if code == 0:
-                        entry[plugin] = PASSED_FILTER_MESSAGE
-                    else:
-                        entry[plugin] = self._msg(i, n, plugin, code)
+                    if code != 0:
+                        msg = self._msg(i, n, plugin, code)
+                        ek = (idx, msg)
+                        frag = entry_memo.get(ek)
+                        if frag is None:
+                            entry = {p: PASSED_FILTER_MESSAGE for p, _c in plugins[:idx]}
+                            entry[plugin] = msg
+                            frag = go_marshal(entry)
+                            entry_memo[ek] = frag
                         break
-                parts.append(key_frag[n] + go_marshal(entry))
+                if frag is None:  # all kernel plugins passed (fail_any from
+                    frag = passed  # a plugin later pruned — defensive)
+                parts.append(key_frag[n] + frag)
         return RawJSON("{" + ",".join(parts) + "}")
 
     def score_annotations_json(self, i: int) -> "tuple[str, str]":
@@ -648,11 +660,11 @@ class BatchEngine:
             hard_pod_affinity_weight=self.hard_pod_affinity_weight,
             added_affinity=self.added_affinity,
         )
-        if self.bucket:
-            # mesh sharding needs the node axis divisible by the device count
-            pr = E.pad_problem(
-                pr, node_multiple=self.mesh.size if self.mesh is not None else 1
-            )
+        # mesh sharding needs the node axis divisible by the mesh's "nodes"
+        # axis — pad it even with bucketing off
+        node_multiple = int(self.mesh.shape["nodes"]) if self.mesh is not None else 1
+        if self.bucket or node_multiple > 1:
+            pr = E.pad_problem(pr, node_multiple=node_multiple)
         t1 = time.perf_counter()
         dp, dims = B.lower(pr, dtype=self.dtype)
         import jax.numpy as jnp

@@ -980,3 +980,15 @@ def test_batch_engine_mesh_sharded_parity():
     with mesh:
         res2b = sharded9.schedule(nodes[:9], pods, pods, [])
     assert res1b.selected_nodes == res2b.selected_nodes
+
+    # a nonzero rotation start compiles the SAMPLING kernel variant in —
+    # its rotation-rank prefix sums are the most order-sensitive
+    # cross-node reductions, so pin them under sharding too
+    with jax.default_device(devices[0]):
+        res1c = BatchEngine(filters=plugins, scores=scores).schedule(
+            nodes, pods, pods, [], start_index=5
+        )
+    sharded_rot = BatchEngine(filters=plugins, scores=scores, mesh=mesh)
+    with mesh:
+        res2c = sharded_rot.schedule(nodes, pods, pods, [], start_index=5)
+    assert res1c.selected_nodes == res2c.selected_nodes
