@@ -78,6 +78,7 @@ SCORE_KERNELS = (
     "NodeAffinity",
     "PodTopologySpread",
     "InterPodAffinity",
+    "ImageLocality",
 )
 
 
@@ -108,6 +109,9 @@ class DeviceProblem(NamedTuple):
     pod_aff_idx: Any      # [P] int32
     pod_pref_idx: Any     # [P] int32
     node_label_idx: Any   # [N] int32
+    img_cls: Any          # [IC,MC] int8: COMPLETE ImageLocality score
+    pod_img_idx: Any      # [P] int32
+    node_img_idx: Any     # [N] int32
     name_target: Any      # [P] int32: -1 free, node idx, -2 absent node
     taint_fail: Any       # [P,N] int16 (expanded on-device)
     taint_prefer: Any     # [P,N] (expanded on-device)
@@ -116,6 +120,7 @@ class DeviceProblem(NamedTuple):
     aff_pref: Any         # [P,N] (expanded on-device)
     name_ok: Any          # [P,N] bool (expanded on-device)
     incl: Any             # [P,N] bool (expanded on-device)
+    img_score: Any        # [P,N] (expanded on-device)
     node_domain: Any      # [KT,N] int32
     spf: Any              # spread filter constraints (key,grp,skew,self) [P,KC]
     sps: Any              # spread score constraints [P,KS]
@@ -237,6 +242,9 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
         pod_aff_idx=i32(pr.pod_aff_idx),
         pod_pref_idx=i32(pr.pod_pref_idx),
         node_label_idx=i32(pr.node_label_idx),
+        img_cls=jnp.asarray(pr.img_cls, dtype=jnp.int8),
+        pod_img_idx=i32(pr.pod_img_idx),
+        node_img_idx=i32(pr.node_img_idx),
         name_target=i32(pr.name_target),
         # expanded on-device inside the jitted kernel (_expand_features)
         taint_fail=jnp.int32(0),
@@ -246,6 +254,7 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
         aff_pref=jnp.int32(0),
         name_ok=jnp.int32(0),
         incl=jnp.int32(0),
+        img_score=jnp.int32(0),
         node_domain=i32(pr.node_domain),
         spf=(i32(pr.spf_key), i32(pr.spf_group), f(pr.spf_skew), f(pr.spf_self)),
         sps=(i32(pr.sps_key), i32(pr.sps_group), f(pr.sps_skew), f(pr.sps_self)),
@@ -341,6 +350,7 @@ NODE_AXIS_SPECS = {
     # expansion inherits the node sharding from these
     "node_taint_idx": (0,),
     "node_label_idx": (0,),
+    "node_img_idx": (0,),
     "node_unsched": (0,),
     # [KT/SG/G, N]: shard the node axis
     "node_domain": (1,),
@@ -670,6 +680,12 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
                 std = jnp.abs(frac[:, 0] - frac[:, 1]) / 2.0
                 raw = jnp.floor((1.0 - std) * MAX_NODE_SCORE)
                 norm = raw
+            elif name == "ImageLocality":
+                # the complete upstream score was precomputed per
+                # (pod-image-class, node-image-class) at encode time —
+                # it's pure per-pair, no ScoreExtensions
+                raw = dp.img_score[i]
+                norm = raw
             elif name == "TaintToleration":
                 raw = dp.taint_prefer[i]
                 norm = _default_normalize(raw, sampled, reverse=True)
@@ -841,6 +857,7 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
             aff_pref=pair(dp.aff_pref_cls, dp.pod_pref_idx, dp.node_label_idx).astype(dt),
             name_ok=jnp.where(tgt == -1, True, tgt == idx_n[None, :]),
             incl=pair(dp.incl_cls, dp.pod_aff_idx, dp.node_label_idx),
+            img_score=pair(dp.img_cls, dp.pod_img_idx, dp.node_img_idx).astype(dt),
         )
 
     def _scan(carry0, dp: DeviceProblem):

@@ -399,6 +399,59 @@ def encode(
     pr.aff_pref_cls = ap
     pr.pod_pref_idx = pref_idx
 
+    # ImageLocality: the score is pure per-(pod, node) — no carry
+    # dependence — so the COMPLETE upstream score (size×spread summed over
+    # the pod's container images, thresholded to [0,100]) is computed here
+    # per (container-image-list class × node-image-set class) and expanded
+    # on-device like the other factored features.
+    from kube_scheduler_simulator_tpu.plugins.intree.imagelocality import (
+        _normalized_image_name,
+        score_from_total,
+    )
+
+    node_image_sets = [
+        tuple(
+            sorted(
+                {
+                    nm
+                    for img in (n.get("status") or {}).get("images") or []
+                    for nm in img.get("names") or []
+                }
+            )
+        )
+        for n in nodes
+    ]
+    img_states: dict[str, tuple[int, int]] = {}
+    for n in nodes:
+        for img in (n.get("status") or {}).get("images") or []:
+            size = int(img.get("sizeBytes") or 0)
+            for nm in img.get("names") or []:
+                sz, cnt = img_states.get(nm, (size, 0))
+                img_states[nm] = (sz, cnt + 1)
+    pod_image_lists = [
+        tuple(
+            _normalized_image_name(c.get("image") or "")
+            for c in (p.get("spec") or {}).get("containers") or []
+        )
+        for p in pending
+    ]
+    pimg_reps, pimg_idx = _group(pod_image_lists, repr)
+    nimg_reps, nimg_idx = _group(node_image_sets, repr)
+    img_cls = np.zeros((len(pimg_reps), len(nimg_reps)), dtype=np.int8)
+    if img_states:  # all-zero when no node publishes images
+        nimg_sets = [set(ns) for ns in nimg_reps]
+        for a, images in enumerate(pimg_reps):
+            for b, nset_s in enumerate(nimg_sets):
+                total = 0
+                for nm in images:
+                    if nm in nset_s and nm in img_states:
+                        size, cnt = img_states[nm]
+                        total += int(size * cnt / N) if N else 0
+                img_cls[a, b] = score_from_total(total, len(images))
+    pr.img_cls = img_cls
+    pr.pod_img_idx = pimg_idx
+    pr.node_img_idx = nimg_idx
+
     # NodeName: target node index (-1 unconstrained, -2 named node absent)
     name_to_idx = {nm: i for i, nm in enumerate(pr.node_names)}
     name_target = np.full(P, -1, dtype=np.int32)
@@ -734,7 +787,7 @@ def pad_problem(pr: BatchProblem, node_multiple: int = 1) -> BatchProblem:
     for name, fill in (
         ("pod_req", 0), ("pod_nonzero", 0), ("fit_checked", False),
         ("pod_tol_idx", 0), ("pod_aff_idx", 0), ("pod_pref_idx", 0),
-        ("name_target", -1),
+        ("pod_img_idx", 0), ("name_target", -1),
         ("spf_key", -1), ("spf_group", 0), ("spf_skew", 1), ("spf_self", 0),
         ("sps_key", -1), ("sps_group", 0), ("sps_skew", 1), ("sps_self", 0),
         ("ip_aff_g", -1), ("ip_anti_g", -1), ("ip_pref_g", -1), ("ip_pref_w", 0),
@@ -749,7 +802,8 @@ def pad_problem(pr: BatchProblem, node_multiple: int = 1) -> BatchProblem:
     for name, fill in (
         ("alloc", 0), ("max_pods", 0), ("nz_alloc", 0), ("requested0", 0),
         ("nonzero0", 0), ("pod_count0", 0),
-        ("node_taint_idx", 0), ("node_label_idx", 0), ("node_unsched", False),
+        ("node_taint_idx", 0), ("node_label_idx", 0), ("node_img_idx", 0),
+        ("node_unsched", False),
     ):
         setattr(pr, name, _pad_axis(getattr(pr, name), 0, N_pad, fill))
     for name, fill in (

@@ -24,6 +24,18 @@ def _normalized_image_name(name: str) -> str:
     return name
 
 
+def score_from_total(total: int, num_containers: int) -> int:
+    """Map the summed size×spread to [0, MAX_NODE_SCORE] (upstream
+    calculatePriority) — shared by this plugin and the batch encoder so
+    the two can't drift."""
+    max_threshold = MAX_CONTAINER_THRESHOLD * num_containers
+    if total < MIN_THRESHOLD:
+        return 0
+    if total > max_threshold:
+        return int(MAX_NODE_SCORE)
+    return int(MAX_NODE_SCORE * (total - MIN_THRESHOLD) / (max_threshold - MIN_THRESHOLD))
+
+
 class ImageLocality:
     name = "ImageLocality"
 
@@ -65,9 +77,4 @@ class ImageLocality:
             if name in node_images and name in image_states:
                 size, cnt = image_states[name]
                 sum_scores += int(size * cnt / total_nodes) if total_nodes else 0
-        max_threshold = MAX_CONTAINER_THRESHOLD * len(containers)
-        if sum_scores < MIN_THRESHOLD:
-            return 0, None
-        if sum_scores > max_threshold:
-            return MAX_NODE_SCORE, None
-        return int(MAX_NODE_SCORE * (sum_scores - MIN_THRESHOLD) / (max_threshold - MIN_THRESHOLD)), None
+        return score_from_total(sum_scores, len(containers)), None

@@ -7,10 +7,10 @@ one XLA computation (ops/batch.py) over features encoded once on the host
 onto pods (reference simulator/scheduler/plugin/resultstore/store.go:38-89)
 is reproduced byte-identically from the returned result tensors.
 
-Scope (round 1): kernels for NodeUnschedulable, NodeName, TaintToleration,
-NodeAffinity, NodeResourcesFit (LeastAllocated/MostAllocated over
-cpu+memory), NodeResourcesBalancedAllocation, PodTopologySpread,
-InterPodAffinity.  ``supported()`` reports whether a workload/profile
+Kernels: NodeUnschedulable, NodeName, TaintToleration, NodeAffinity,
+NodeResourcesFit (LeastAllocated/MostAllocated over cpu+memory),
+NodeResourcesBalancedAllocation, PodTopologySpread, InterPodAffinity,
+ImageLocality.  ``supported()`` reports whether a workload/profile
 combination is fully covered; callers fall back to the sequential oracle
 (scheduler/framework_runner.py) otherwise.  Preemption (PostFilter) stays
 host-side and is not run by the batch pass.
@@ -47,7 +47,6 @@ NOOP_IF_UNUSED = {
     "VolumeBinding": lambda pod: not _pod_volumes(pod),
     "VolumeZone": lambda pod: not _pod_volumes(pod),
 }
-NOOP_SCORES = {"ImageLocality"}  # zero contribution when no node images
 
 
 def _pod_volumes(pod: Obj) -> list:
@@ -605,13 +604,8 @@ class BatchEngine:
                 if not checker(p):
                     return False, f"workload exercises {f} (no batch kernel)"
         for s, _w in self.scores:
-            if s in KERNEL_SCORES:
-                continue
-            if s in NOOP_SCORES:
-                if s == "ImageLocality" and any((n.get("status") or {}).get("images") for n in nodes):
-                    return False, "workload exercises ImageLocality (no batch kernel)"
-                continue
-            return False, f"score plugin {s} has no batch kernel"
+            if s not in KERNEL_SCORES:
+                return False, f"score plugin {s} has no batch kernel"
         return True, ""
 
     # ------------------------------------------------------------- running

@@ -992,3 +992,54 @@ def test_batch_engine_mesh_sharded_parity():
     with mesh:
         res2c = sharded_rot.schedule(nodes, pods, pods, [], start_index=5)
     assert res1c.selected_nodes == res2c.selected_nodes
+
+
+def test_imagelocality_kernel_parity():
+    """ImageLocality scores (size×spread, thresholded) must match the
+    sequential plugin byte-for-byte — including nodes WITH images, which
+    previously forced a whole-round sequential fallback."""
+    random.seed(31)
+    nodes = []
+    for i in range(12):
+        n = mk_node(f"node-{i}", cpu_m=16000, mem_mi=16384,
+                    labels={"kubernetes.io/hostname": f"node-{i}"})
+        images = []
+        if i % 2 == 0:
+            images.append({"names": ["registry.io/app:v1"], "sizeBytes": 600 * 1024 * 1024})
+        if i % 3 == 0:
+            images.append({"names": ["registry.io/db:v2"], "sizeBytes": 900 * 1024 * 1024})
+        if images:
+            n["status"]["images"] = images
+        nodes.append(n)
+    pods = []
+    for i in range(18):
+        p = mk_pod(f"pod-{i}", cpu_m=200, mem_mi=128)
+        p["spec"]["containers"][0]["image"] = "registry.io/app:v1" if i % 2 else "registry.io/db:v2"
+        if i % 5 == 0:
+            p["spec"]["containers"].append(
+                {"name": "c2", "image": "registry.io/app:v1", "resources": {"requests": {"cpu": "50m"}}}
+            )
+        pods.append(p)
+    oracle, batch, svc = run_both(
+        nodes, pods, ["NodeResourcesFit", "ImageLocality"]
+    )
+    assert_parity(oracle, batch, svc)
+    # the kernel must actually have produced nonzero image scores
+    import numpy as np
+
+    raws = batch.out["trace"]["raw"]
+    assert int(np.abs(raws).sum()) > 0
+
+
+def test_imagelocality_no_longer_forces_fallback():
+    store = ClusterStore()
+    node = mk_node("node-0", cpu_m=64000, mem_mi=65536)
+    node["status"]["images"] = [{"names": ["img:1"], "sizeBytes": 500 * 1024 * 1024}]
+    store.create("nodes", node)
+    store.create("nodes", mk_node("node-1", cpu_m=64000, mem_mi=65536))
+    for i in range(10):
+        store.create("pods", mk_pod(f"pod-{i}", cpu_m=100, mem_mi=64))
+    svc = SchedulerService(store, tie_break="first", use_batch="auto", batch_min_work=0)
+    svc.start_scheduler({"percentageOfNodesToScore": 100})  # default profile incl. ImageLocality
+    svc.schedule_pending(max_rounds=1)
+    assert svc.stats["batch_pods"] == 10, svc.stats
