@@ -10,7 +10,8 @@ is the cluster's dynamic state and whose body vectorizes one full
 scheduling cycle over ALL nodes:
 
     carry = (requested [N,R], nonzero [N,2], pod_count [N],
-             spread_counts [SG,N], ip_sel/ip_own/ip_anti [G,D+1])
+             ports_used [N,PT], spread_counts [SG,N],
+             ip_sel/ip_own/ip_anti [G,D+1])
     step  = filters [N] → scores [N] → normalize → argmax → scatter-commit
 
 Every per-plugin semantic (first-failure short circuit, per-plugin
@@ -65,6 +66,7 @@ class BatchConfig(NamedTuple):
 FILTER_KERNELS = (
     "NodeUnschedulable",
     "NodeName",
+    "NodePorts",
     "TaintToleration",
     "NodeAffinity",
     "NodeResourcesFit",
@@ -113,6 +115,8 @@ class DeviceProblem(NamedTuple):
     pod_img_idx: Any      # [P] int32
     node_img_idx: Any     # [N] int32
     name_target: Any      # [P] int32: -1 free, node idx, -2 absent node
+    pod_ports: Any        # [P,PT] bool: wanted host-port classes
+    port_conflict: Any    # [PT,PT] bool: class-pair conflicts
     taint_fail: Any       # [P,N] int16 (expanded on-device)
     taint_prefer: Any     # [P,N] (expanded on-device)
     unsched_ok: Any       # [P,N] bool (expanded on-device)
@@ -160,6 +164,7 @@ class DeviceProblem(NamedTuple):
     requested0: Any       # [N,R]
     nonzero0: Any         # [N,2]
     pod_count0: Any       # [N]
+    ports_used0: Any      # [N,PT]: used host-port class counts
     spread_counts0: Any   # [SG,N]
     ip_sel0: Any          # [G,D+1]
     ip_own0: Any          # [G,D+1]
@@ -246,6 +251,8 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
         pod_img_idx=i32(pr.pod_img_idx),
         node_img_idx=i32(pr.node_img_idx),
         name_target=i32(pr.name_target),
+        pod_ports=b(pr.pod_ports),
+        port_conflict=f(pr.port_conflict),
         # expanded on-device inside the jitted kernel (_expand_features)
         taint_fail=jnp.int32(0),
         taint_prefer=jnp.int32(0),
@@ -282,13 +289,14 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
         requested0=f(pr.requested0),
         nonzero0=f(pr.nonzero0),
         pod_count0=f(pr.pod_count0),
+        ports_used0=f(pr.ports_used0),
         spread_counts0=f(pr.spread_counts0),
         ip_sel0=f(pad(np.asarray(pr.ip_sel0))),
         ip_own0=f(pad(np.asarray(pr.ip_own0))),
         ip_anti0=f(pad(np.asarray(pr.ip_anti0))),
     )
     dims = dict(
-        P=pr.P, N=pr.N, R=pr.R, D=D, SG=pr.SG, G=pr.G,
+        P=pr.P, N=pr.N, R=pr.R, D=D, SG=pr.SG, G=pr.G, PT=pr.PT,
         KC=pr.KC, KS=pr.KS, KA=pr.KA, KB=pr.KB, KP=pr.KP, KO=pr.KO,
         key_struct=tuple(key_struct),
     )
@@ -356,6 +364,7 @@ NODE_AXIS_SPECS = {
     "node_domain": (1,),
     "spread_counts0": (1,),
     "gdom": (1,),
+    "ports_used0": (0,),
 }
 
 
@@ -499,7 +508,7 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
         return lax.switch(u, [lambda v, uu=uu: expand_u(uu, v, dp) for uu in range(KU)], vec)
 
     def step(dp: DeviceProblem, carry, xs):
-        requested, nonzero, pod_count, spread_counts, ip_sel, ip_own, ip_anti, start = carry
+        requested, nonzero, pod_count, ports_used, spread_counts, ip_sel, ip_own, ip_anti, start = carry
         i = xs
         dt = requested.dtype
         pod_req = dp.pod_req[i]
@@ -518,6 +527,12 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
                 apply(name, jnp.where(dp.unsched_ok[i], 0, 1))
             elif name == "NodeName":
                 apply(name, jnp.where(dp.name_ok[i], 0, 1))
+            elif name == "NodePorts" and dims["PT"] > 0:
+                # ports_used is already in wanted-class conflict space
+                # (encode seeds bound pods through the conflict relation;
+                # commits below add C @ pod_ports)
+                clash = jnp.sum(ports_used * dp.pod_ports[i][None, :].astype(dt), axis=1)
+                apply(name, (clash > 0).astype(jnp.int32))
             elif name == "TaintToleration":
                 tfail = dp.taint_fail[i].astype(jnp.int32)
                 apply(name, jnp.where(tfail < 0, 0, tfail + 1))
@@ -798,6 +813,12 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
         requested = requested + oh[:, None] * pod_req[None, :]
         nonzero = nonzero + oh[:, None] * dp.pod_nonzero[i][None, :]
         pod_count = pod_count + oh
+        if dims["PT"] > 0:
+            # project the committed pod's triples onto every wanted class
+            # they conflict with (its own classes included — C is reflexive
+            # on identical triples)
+            proj = _mv(dp.port_conflict, dp.pod_ports[i].astype(dt))  # [PT]
+            ports_used = ports_used + oh[:, None] * proj[None, :]
         if SG > 0:
             spread_counts = spread_counts + dp.spread_match[:, i][:, None] * oh[None, :]
         if use_ip:
@@ -824,7 +845,7 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
         # (upstream: next_start_node_index = (start + processed) % n)
         next_start = jnp.where(nt > 0, (start + processed) % jnp.maximum(nt, 1), 0)
         next_start = jnp.where(dp.pod_active[i], next_start, start)
-        carry = (requested, nonzero, pod_count, spread_counts, ip_sel, ip_own, ip_anti, next_start)
+        carry = (requested, nonzero, pod_count, ports_used, spread_counts, ip_sel, ip_own, ip_anti, next_start)
         out = {
             "selected": sel,
             "feasible_count": count,
@@ -882,7 +903,7 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
         return carry, ys
 
     CARRY0_FIELDS = (
-        "requested0", "nonzero0", "pod_count0", "spread_counts0",
+        "requested0", "nonzero0", "pod_count0", "ports_used0", "spread_counts0",
         "ip_sel0", "ip_own0", "ip_anti0", "start0",
     )
 

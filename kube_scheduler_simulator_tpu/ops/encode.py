@@ -452,6 +452,52 @@ def encode(
     pr.pod_img_idx = pimg_idx
     pr.node_img_idx = nimg_idx
 
+    # NodePorts: port classes are the distinct (protocol, hostIP,
+    # hostPort) triples PENDING pods want — PT stays bounded by the
+    # pending workload regardless of how many bound pods hold ports.
+    # Everything else is projected INTO that class space through the
+    # conflict relation (0.0.0.0 overlaps any IP):
+    #   ports_used0[n, w] = # occupying triples on node n conflicting
+    #                       with wanted class w
+    #   commit adds C @ pod_ports[i] (the committed pod's triples are
+    #   themselves pending classes; C maps them to every class they
+    #   conflict with)
+    # and the filter is simply clash[n] = Σ_w pod_ports[i][w]·used[n][w].
+    from kube_scheduler_simulator_tpu.plugins.intree.node_basic import (
+        _host_ports,
+        _ports_conflict,
+    )
+
+    port_table: dict[tuple, int] = {}
+    pend_port_ids: list[list[int]] = []
+    for p in pending:
+        ids = []
+        for t in _host_ports(p):
+            if t not in port_table:
+                port_table[t] = len(port_table)
+            ids.append(port_table[t])
+        pend_port_ids.append(ids)
+    PT = len(port_table)
+    pr.PT = PT
+    pod_ports = np.zeros((P, max(PT, 1)), dtype=bool)
+    for i, ids in enumerate(pend_port_ids):
+        for t in ids:
+            pod_ports[i, t] = True
+    triples = list(port_table)
+    ports_used0 = np.zeros((N, max(PT, 1)), dtype=np.int64)
+    if PT:
+        for n_i, ni in enumerate(node_infos):
+            for bp in ni.pods:
+                for bt in _host_ports(bp):
+                    for w, wt in enumerate(triples):
+                        if _ports_conflict(bt, wt):
+                            ports_used0[n_i, w] += 1
+    port_conflict = np.zeros((max(PT, 1), max(PT, 1)), dtype=bool)
+    for a, ta in enumerate(triples):
+        for b, tb in enumerate(triples):
+            port_conflict[a, b] = _ports_conflict(ta, tb)
+    pr.pod_ports, pr.ports_used0, pr.port_conflict = pod_ports, ports_used0, port_conflict
+
     # NodeName: target node index (-1 unconstrained, -2 named node absent)
     name_to_idx = {nm: i for i, nm in enumerate(pr.node_names)}
     name_target = np.full(P, -1, dtype=np.int32)
@@ -787,7 +833,7 @@ def pad_problem(pr: BatchProblem, node_multiple: int = 1) -> BatchProblem:
     for name, fill in (
         ("pod_req", 0), ("pod_nonzero", 0), ("fit_checked", False),
         ("pod_tol_idx", 0), ("pod_aff_idx", 0), ("pod_pref_idx", 0),
-        ("pod_img_idx", 0), ("name_target", -1),
+        ("pod_img_idx", 0), ("name_target", -1), ("pod_ports", False),
         ("spf_key", -1), ("spf_group", 0), ("spf_skew", 1), ("spf_self", 0),
         ("sps_key", -1), ("sps_group", 0), ("sps_skew", 1), ("sps_self", 0),
         ("ip_aff_g", -1), ("ip_anti_g", -1), ("ip_pref_g", -1), ("ip_pref_w", 0),
@@ -803,7 +849,7 @@ def pad_problem(pr: BatchProblem, node_multiple: int = 1) -> BatchProblem:
         ("alloc", 0), ("max_pods", 0), ("nz_alloc", 0), ("requested0", 0),
         ("nonzero0", 0), ("pod_count0", 0),
         ("node_taint_idx", 0), ("node_label_idx", 0), ("node_img_idx", 0),
-        ("node_unsched", False),
+        ("node_unsched", False), ("ports_used0", 0),
     ):
         setattr(pr, name, _pad_axis(getattr(pr, name), 0, N_pad, fill))
     for name, fill in (

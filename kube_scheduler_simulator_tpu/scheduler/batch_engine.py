@@ -38,7 +38,6 @@ KERNEL_FILTERS = set(B.FILTER_KERNELS)
 KERNEL_SCORES = set(B.SCORE_KERNELS)
 # Plugins safely treated as no-ops when the workload doesn't exercise them.
 NOOP_IF_UNUSED = {
-    "NodePorts": lambda pod: not nb._host_ports(pod),
     "VolumeRestrictions": lambda pod: not _pod_volumes(pod),
     "EBSLimits": lambda pod: not _pod_volumes(pod),
     "GCEPDLimits": lambda pod: not _pod_volumes(pod),
@@ -64,6 +63,7 @@ def _pod_volumes(pod: Obj) -> list:
 FILTER_MESSAGES = {
     "NodeUnschedulable": {1: nb.NODE_UNSCHEDULABLE_ERR},
     "NodeName": {1: nb.NODE_NAME_ERR},
+    "NodePorts": {1: nb.NODE_PORTS_ERR},
     "NodeAffinity": {1: na.ERR_REASON_ENFORCED, 2: na.ERR_REASON_POD},
     "PodTopologySpread": {1: pts.ERR_REASON_LABEL, 2: pts.ERR_REASON},
     "InterPodAffinity": {1: ip.ERR_EXISTING_ANTI, 2: ip.ERR_AFFINITY, 3: ip.ERR_ANTI_AFFINITY},
@@ -586,6 +586,12 @@ class BatchEngine:
                 "PreFilter node narrowing while feasible-node sampling (or a "
                 "rotated start index) is active"
             )
+        # the host-port conflict matrix is O(PT^2) — cap the class count
+        distinct_ports: set = set()
+        for p in pending:
+            distinct_ports.update(nb._host_ports(p))
+        if len(distinct_ports) > 128:
+            return False, f"{len(distinct_ports)} distinct host ports exceed the batch kernel cap"
         # the Fit filter's reason bitmask covers at most 30 resource columns
         from kube_scheduler_simulator_tpu.ops.encode import _fit_resources
 
