@@ -507,6 +507,11 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
             return expand_u(0, vec, dp)
         return lax.switch(u, [lambda v, uu=uu: expand_u(uu, v, dp) for uu in range(KU)], vec)
 
+    # the carry ALWAYS contains ports_used (a [N,1] dummy when no pending
+    # pod wants host ports) — only the NodePorts work is gated, matching
+    # the SG/G convention, so the carry structure never branches
+    use_ports = dims["PT"] > 0
+
     def step(dp: DeviceProblem, carry, xs):
         requested, nonzero, pod_count, ports_used, spread_counts, ip_sel, ip_own, ip_anti, start = carry
         i = xs
@@ -527,7 +532,7 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
                 apply(name, jnp.where(dp.unsched_ok[i], 0, 1))
             elif name == "NodeName":
                 apply(name, jnp.where(dp.name_ok[i], 0, 1))
-            elif name == "NodePorts" and dims["PT"] > 0:
+            elif name == "NodePorts" and use_ports:
                 # ports_used is already in wanted-class conflict space
                 # (encode seeds bound pods through the conflict relation;
                 # commits below add C @ pod_ports)
@@ -813,7 +818,7 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
         requested = requested + oh[:, None] * pod_req[None, :]
         nonzero = nonzero + oh[:, None] * dp.pod_nonzero[i][None, :]
         pod_count = pod_count + oh
-        if dims["PT"] > 0:
+        if use_ports:
             # project the committed pod's triples onto every wanted class
             # they conflict with (its own classes included — C is reflexive
             # on identical triples)

@@ -486,11 +486,17 @@ def encode(
     triples = list(port_table)
     ports_used0 = np.zeros((N, max(PT, 1)), dtype=np.int64)
     if PT:
+        # conflict requires equal (protocol, port), so index the wanted
+        # classes by that pair — each bound triple then checks at most a
+        # handful of candidates instead of all PT classes
+        by_proto_port: dict[tuple, list[int]] = {}
+        for w, (proto, _ip, port) in enumerate(triples):
+            by_proto_port.setdefault((proto, port), []).append(w)
         for n_i, ni in enumerate(node_infos):
             for bp in ni.pods:
                 for bt in _host_ports(bp):
-                    for w, wt in enumerate(triples):
-                        if _ports_conflict(bt, wt):
+                    for w in by_proto_port.get((bt[0], bt[2]), ()):
+                        if _ports_conflict(bt, triples[w]):
                             ports_used0[n_i, w] += 1
     port_conflict = np.zeros((max(PT, 1), max(PT, 1)), dtype=bool)
     for a, ta in enumerate(triples):
