@@ -10,7 +10,8 @@ is the cluster's dynamic state and whose body vectorizes one full
 scheduling cycle over ALL nodes:
 
     carry = (requested [N,R], nonzero [N,2], pod_count [N],
-             ports_used [N,PT], spread_counts [SG,N],
+             ports_used [N,PT], restr_used [N,VR], cloud_used [N,3],
+             csi_att [N,V], spread_counts [SG,N],
              ip_sel/ip_own/ip_anti [G,D+1])
     step  = filters [N] → scores [N] → normalize → argmax → scatter-commit
 
@@ -70,9 +71,19 @@ FILTER_KERNELS = (
     "TaintToleration",
     "NodeAffinity",
     "NodeResourcesFit",
+    "VolumeRestrictions",
+    "EBSLimits",
+    "GCEPDLimits",
+    "NodeVolumeLimits",
+    "AzureDiskLimits",
+    "VolumeBinding",
+    "VolumeZone",
     "PodTopologySpread",
     "InterPodAffinity",
 )
+# per-family cloud volume-count limits: (cloud_cnt column, default limit)
+# — mirrors plugins/intree/volumes.py EBSLimits/GCEPDLimits/AzureDiskLimits
+CLOUD_LIMIT_COL = {"EBSLimits": (0, 39.0), "GCEPDLimits": (1, 16.0), "AzureDiskLimits": (2, 16.0)}
 SCORE_KERNELS = (
     "NodeResourcesFit",
     "NodeResourcesBalancedAllocation",
@@ -117,6 +128,20 @@ class DeviceProblem(NamedTuple):
     name_target: Any      # [P] int32: -1 free, node idx, -2 absent node
     pod_ports: Any        # [P,PT] bool: wanted host-port classes
     port_conflict: Any    # [PT,PT] bool: class-pair conflicts
+    # Volume plugins (ops/encode._encode_volumes): static class matrices
+    # for VolumeBinding/VolumeZone, NodePorts-style conflict classes for
+    # VolumeRestrictions, per-family counts for the cloud limits, and the
+    # (driver, volume-id) attachment model for CSI NodeVolumeLimits.
+    vb_cls: Any           # [VC,M] int8: VolumeBinding code per class pair
+    vz_cls: Any           # [VC,M] int8: VolumeZone code per class pair
+    pod_vol_idx: Any      # [P] int32: pod volume-class index
+    pod_restr: Any        # [P,VR] bool: wanted volume-conflict classes
+    restr_conflict: Any   # [VR,VR]: class-pair conflicts
+    cloud_cnt: Any        # [P,3]: per-family cloud volume counts
+    pod_csi: Any          # [P,V] bool: wanted CSI volume-id classes
+    csi_drv_oh: Any       # [V,DR]: volume-id → driver one-hot
+    csi_seed_used: Any    # [N,DR]: existing per-driver attachments not in V
+    csi_limit: Any        # [N,DR]: per-driver caps (CSINode allocatable)
     taint_fail: Any       # [P,N] int16 (expanded on-device)
     taint_prefer: Any     # [P,N] (expanded on-device)
     unsched_ok: Any       # [P,N] bool (expanded on-device)
@@ -125,6 +150,8 @@ class DeviceProblem(NamedTuple):
     name_ok: Any          # [P,N] bool (expanded on-device)
     incl: Any             # [P,N] bool (expanded on-device)
     img_score: Any        # [P,N] (expanded on-device)
+    vb_code: Any          # [P,N] int8 (expanded on-device)
+    vz_code: Any          # [P,N] int8 (expanded on-device)
     node_domain: Any      # [KT,N] int32
     spf: Any              # spread filter constraints (key,grp,skew,self) [P,KC]
     sps: Any              # spread score constraints [P,KS]
@@ -165,6 +192,9 @@ class DeviceProblem(NamedTuple):
     nonzero0: Any         # [N,2]
     pod_count0: Any       # [N]
     ports_used0: Any      # [N,PT]: used host-port class counts
+    restr_used0: Any      # [N,VR]: occupying volume-conflict counts
+    cloud_used0: Any      # [N,3]: per-family cloud volume counts
+    csi_attached0: Any    # [N,V]: CSI volume-id attachment bits
     spread_counts0: Any   # [SG,N]
     ip_sel0: Any          # [G,D+1]
     ip_own0: Any          # [G,D+1]
@@ -253,6 +283,16 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
         name_target=i32(pr.name_target),
         pod_ports=b(pr.pod_ports),
         port_conflict=f(pr.port_conflict),
+        vb_cls=jnp.asarray(pr.vb_cls, dtype=jnp.int8),
+        vz_cls=jnp.asarray(pr.vz_cls, dtype=jnp.int8),
+        pod_vol_idx=i32(pr.pod_vol_idx),
+        pod_restr=b(pr.pod_restr),
+        restr_conflict=f(pr.restr_conflict),
+        cloud_cnt=f(pr.cloud_cnt),
+        pod_csi=b(pr.pod_csi),
+        csi_drv_oh=f(pr.csi_drv_oh),
+        csi_seed_used=f(pr.csi_seed_used),
+        csi_limit=f(pr.csi_limit),
         # expanded on-device inside the jitted kernel (_expand_features)
         taint_fail=jnp.int32(0),
         taint_prefer=jnp.int32(0),
@@ -262,6 +302,8 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
         name_ok=jnp.int32(0),
         incl=jnp.int32(0),
         img_score=jnp.int32(0),
+        vb_code=jnp.int32(0),
+        vz_code=jnp.int32(0),
         node_domain=i32(pr.node_domain),
         spf=(i32(pr.spf_key), i32(pr.spf_group), f(pr.spf_skew), f(pr.spf_self)),
         sps=(i32(pr.sps_key), i32(pr.sps_group), f(pr.sps_skew), f(pr.sps_self)),
@@ -290,6 +332,9 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
         nonzero0=f(pr.nonzero0),
         pod_count0=f(pr.pod_count0),
         ports_used0=f(pr.ports_used0),
+        restr_used0=f(pr.restr_used0),
+        cloud_used0=f(pr.cloud_used0),
+        csi_attached0=f(pr.csi_attached0),
         spread_counts0=f(pr.spread_counts0),
         ip_sel0=f(pad(np.asarray(pr.ip_sel0))),
         ip_own0=f(pad(np.asarray(pr.ip_own0))),
@@ -298,6 +343,7 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
     dims = dict(
         P=pr.P, N=pr.N, R=pr.R, D=D, SG=pr.SG, G=pr.G, PT=pr.PT,
         KC=pr.KC, KS=pr.KS, KA=pr.KA, KB=pr.KB, KP=pr.KP, KO=pr.KO,
+        VR=pr.VR, VID=pr.VID, DR=pr.DR, CLOUD=pr.CLOUD,
         key_struct=tuple(key_struct),
     )
     return dp, dims
@@ -365,6 +411,11 @@ NODE_AXIS_SPECS = {
     "spread_counts0": (1,),
     "gdom": (1,),
     "ports_used0": (0,),
+    "restr_used0": (0,),
+    "cloud_used0": (0,),
+    "csi_attached0": (0,),
+    "csi_seed_used": (0,),
+    "csi_limit": (0,),
 }
 
 
@@ -507,13 +558,20 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
             return expand_u(0, vec, dp)
         return lax.switch(u, [lambda v, uu=uu: expand_u(uu, v, dp) for uu in range(KU)], vec)
 
-    # the carry ALWAYS contains ports_used (a [N,1] dummy when no pending
-    # pod wants host ports) — only the NodePorts work is gated, matching
-    # the SG/G convention, so the carry structure never branches
+    # the carry ALWAYS contains ports_used / restr_used / cloud_used /
+    # csi_att (dummy [N,1]/[N,3] columns when the workload doesn't exercise
+    # them) — only the per-plugin work is gated, matching the SG/G
+    # convention, so the carry structure never branches
     use_ports = dims["PT"] > 0
+    use_restr = dims["VR"] > 0 and "VolumeRestrictions" in cfg.filters
+    use_cloud = dims["CLOUD"] > 0
+    use_csi = dims["VID"] > 0 and "NodeVolumeLimits" in cfg.filters
 
     def step(dp: DeviceProblem, carry, xs):
-        requested, nonzero, pod_count, ports_used, spread_counts, ip_sel, ip_own, ip_anti, start = carry
+        (
+            requested, nonzero, pod_count, ports_used, restr_used, cloud_used,
+            csi_att, spread_counts, ip_sel, ip_own, ip_anti, start,
+        ) = carry
         i = xs
         dt = requested.dtype
         pod_req = dp.pod_req[i]
@@ -553,6 +611,25 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
                 for r in range(dims["R"]):
                     code = code | (insuff[:, r].astype(jnp.int32) << (r + 1))
                 apply(name, code)
+            elif name == "VolumeBinding":
+                apply(name, dp.vb_code[i].astype(jnp.int32))
+            elif name == "VolumeZone":
+                apply(name, dp.vz_code[i].astype(jnp.int32))
+            elif name == "VolumeRestrictions" and use_restr:
+                clash = jnp.sum(restr_used * dp.pod_restr[i][None, :].astype(dt), axis=1)
+                apply(name, (clash > 0).astype(jnp.int32))
+            elif name in CLOUD_LIMIT_COL and use_cloud:
+                col, limit = CLOUD_LIMIT_COL[name]
+                want = dp.cloud_cnt[i, col]
+                over = (want > 0) & (cloud_used[:, col] + want > limit)
+                apply(name, over.astype(jnp.int32))
+            elif name == "NodeVolumeLimits" and use_csi:
+                pod_v = dp.pod_csi[i].astype(dt)
+                new = pod_v[None, :] * (1.0 - csi_att)            # [N,V]
+                need_d = _mv(new, dp.csi_drv_oh)                  # [N,DR]
+                used_d = dp.csi_seed_used + _mv(csi_att, dp.csi_drv_oh)
+                over = (need_d > 0) & (used_d + need_d > dp.csi_limit)
+                apply(name, jnp.any(over, axis=1).astype(jnp.int32))
             elif name == "PodTopologySpread" and use_spread_f:
                 code = jnp.zeros(N, dtype=jnp.int32)
                 incl_row = dp.incl[i]
@@ -824,6 +901,15 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
             # on identical triples)
             proj = _mv(dp.port_conflict, dp.pod_ports[i].astype(dt))  # [PT]
             ports_used = ports_used + oh[:, None] * proj[None, :]
+        if use_restr:
+            rproj = _mv(dp.restr_conflict, dp.pod_restr[i].astype(dt))  # [VR]
+            restr_used = restr_used + oh[:, None] * rproj[None, :]
+        if use_cloud:
+            cloud_used = cloud_used + oh[:, None] * dp.cloud_cnt[i][None, :]
+        if use_csi:
+            # attachment bits OR in the committed pod's volume ids (shared
+            # PVC-backed ids stay one attachment — max, not add)
+            csi_att = jnp.maximum(csi_att, oh[:, None] * dp.pod_csi[i][None, :].astype(dt))
         if SG > 0:
             spread_counts = spread_counts + dp.spread_match[:, i][:, None] * oh[None, :]
         if use_ip:
@@ -850,7 +936,10 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
         # (upstream: next_start_node_index = (start + processed) % n)
         next_start = jnp.where(nt > 0, (start + processed) % jnp.maximum(nt, 1), 0)
         next_start = jnp.where(dp.pod_active[i], next_start, start)
-        carry = (requested, nonzero, pod_count, ports_used, spread_counts, ip_sel, ip_own, ip_anti, next_start)
+        carry = (
+            requested, nonzero, pod_count, ports_used, restr_used, cloud_used,
+            csi_att, spread_counts, ip_sel, ip_own, ip_anti, next_start,
+        )
         out = {
             "selected": sel,
             "feasible_count": count,
@@ -884,6 +973,8 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
             name_ok=jnp.where(tgt == -1, True, tgt == idx_n[None, :]),
             incl=pair(dp.incl_cls, dp.pod_aff_idx, dp.node_label_idx),
             img_score=pair(dp.img_cls, dp.pod_img_idx, dp.node_img_idx).astype(dt),
+            vb_code=pair(dp.vb_cls, dp.pod_vol_idx, dp.node_label_idx),
+            vz_code=pair(dp.vz_cls, dp.pod_vol_idx, dp.node_label_idx),
         )
 
     def _scan(carry0, dp: DeviceProblem):
@@ -908,7 +999,8 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
         return carry, ys
 
     CARRY0_FIELDS = (
-        "requested0", "nonzero0", "pod_count0", "ports_used0", "spread_counts0",
+        "requested0", "nonzero0", "pod_count0", "ports_used0", "restr_used0",
+        "cloud_used0", "csi_attached0", "spread_counts0",
         "ip_sel0", "ip_own0", "ip_anti0", "start0",
     )
 

@@ -206,12 +206,16 @@ def encode(
     namespaces: "list[Obj] | None" = None,
     hard_pod_affinity_weight: int = 1,
     added_affinity: "Obj | None" = None,
+    volumes: "dict[str, list[Obj]] | None" = None,
 ) -> BatchProblem:
     """Encode a scheduling snapshot.
 
     ``pending`` must already be in queue (QueueSort) order; ``all_pods`` is
     the full pod list (bound pods seed the node usage state, mirroring the
-    oracle's build_node_infos snapshot).
+    oracle's build_node_infos snapshot).  ``volumes`` carries the volume
+    resource kinds the volume-plugin kernels resolve on the host
+    (persistentvolumeclaims / persistentvolumes / storageclasses /
+    csinodes, keyed by store kind); omitted kinds encode as empty.
     """
     pr = BatchProblem()
     P, N = len(pending), len(nodes)
@@ -504,6 +508,10 @@ def encode(
             port_conflict[a, b] = _ports_conflict(ta, tb)
     pr.pod_ports, pr.ports_used0, pr.port_conflict = pod_ports, ports_used0, port_conflict
 
+    # Volume plugins (VolumeBinding/VolumeZone static class matrices;
+    # VolumeRestrictions + the NodeVolumeLimits family dynamic classes).
+    _encode_volumes(pr, pending, node_infos, nl_reps, volumes or {})
+
     # NodeName: target node index (-1 unconstrained, -2 named node absent)
     name_to_idx = {nm: i for i, nm in enumerate(pr.node_names)}
     name_target = np.full(P, -1, dtype=np.int32)
@@ -783,6 +791,276 @@ def encode(
     return pr
 
 
+def _encode_volumes(
+    pr: BatchProblem,
+    pending: list[Obj],
+    node_infos: list[NodeInfo],
+    nl_reps: list[Obj],
+    volumes: "dict[str, list[Obj]]",
+) -> None:
+    """Lower the volume filter plugins to batch tensors.
+
+    Mirrors plugins/intree/volumes.py (the sequential oracle, itself
+    pinned to upstream v1.26 — reference wrappedplugin.go delegates these
+    to the in-tree plugins) with every PVC → PV / StorageClass / CSINode
+    string lookup resolved HERE on the host:
+
+    - VolumeBinding / VolumeZone are STATIC per (pod-volume-class ×
+      node-label-class): codes with the oracle's first-failing-claim
+      semantics, expanded on-device like the NodeAffinity matrices.
+    - VolumeRestrictions follows the NodePorts recipe: conflict classes =
+      the distinct (kind, id, readOnly) cloud-volume triples pending pods
+      mount; ``restr_used0[n,w]`` counts occupying volumes conflicting
+      with class w, and the kernel's commit projects a placed pod's
+      triples through the conflict relation.
+    - EBS/GCE/AzureDisk limits are per-family counts (no dedup — the
+      oracle counts per mount); CSI NodeVolumeLimits tracks the distinct
+      (driver, volume-id) attachments per node: ids referenced by pending
+      pods get carry bits (``csi_attached0``), all other existing
+      attachments collapse into per-driver seed counts, and per-driver
+      caps come from each node's CSINode allocatable (default 256).
+    """
+    P, N = len(pending), len(node_infos)
+    M = len(nl_reps)
+    from kube_scheduler_simulator_tpu.plugins.intree.volumes import (
+        REGION_LABELS,
+        ZONE_LABELS,
+        _azure,
+        _ebs,
+        _gce_pd,
+        _pod_pvc_names,
+    )
+
+    def _ns_of(o: Obj) -> str:
+        return o["metadata"].get("namespace") or "default"
+
+    pvc_by = {(_ns_of(o), o["metadata"]["name"]): o for o in volumes.get("persistentvolumeclaims") or []}
+    pv_by = {o["metadata"]["name"]: o for o in volumes.get("persistentvolumes") or []}
+    sc_by = {o["metadata"]["name"]: o for o in volumes.get("storageclasses") or []}
+    csinode_by = {o["metadata"]["name"]: o for o in volumes.get("csinodes") or []}
+
+    # ------------------------------------------- VolumeBinding / VolumeZone
+    vol_reps, vol_idx = _group(
+        [(_namespace_of(p), tuple(_pod_pvc_names(p))) for p in pending], repr
+    )
+    VC = len(vol_reps)
+    vb = np.zeros((VC, M), dtype=np.int8)
+    vz = np.zeros((VC, M), dtype=np.int8)
+    aff_memo: dict[tuple[int, int], bool] = {}
+    for a, (ns, claims) in enumerate(vol_reps):
+        for claim in claims:
+            pvc = pvc_by.get((ns, claim))
+            if pvc is None:
+                continue  # missing PVC = PreFilter reject; supported() de-batches
+            vol_name = (pvc.get("spec") or {}).get("volumeName")
+            if not vol_name:
+                sc_name = (pvc.get("spec") or {}).get("storageClassName")
+                sc = sc_by.get(sc_name) if sc_name else None
+                if (sc or {}).get("volumeBindingMode", "Immediate") != "WaitForFirstConsumer":
+                    # node-independent failure — first-fails every node class
+                    vb[a] = np.where(vb[a] == 0, 1, vb[a])
+                continue
+            pv = pv_by.get(vol_name)
+            if pv is None:
+                continue
+            required = ((pv.get("spec") or {}).get("nodeAffinity") or {}).get("required")
+            if required is not None:
+                for b, nl in enumerate(nl_reps):
+                    if vb[a, b]:
+                        continue
+                    k = (id(required), b)
+                    ok = aff_memo.get(k)
+                    if ok is None:
+                        ok = match_node_selector(required, nl["labels"], nl["name"])
+                        aff_memo[k] = ok
+                    if not ok:
+                        vb[a, b] = 2
+            pv_labels = pv["metadata"].get("labels") or {}
+            if any(l in pv_labels for ls in (ZONE_LABELS, REGION_LABELS) for l in ls):
+                for b, nl in enumerate(nl_reps):
+                    if vz[a, b]:
+                        continue
+                    nlabels = nl["labels"]
+                    fail = False
+                    for label_set in (ZONE_LABELS, REGION_LABELS):
+                        for label in label_set:
+                            if label in pv_labels and label in nlabels:
+                                if nlabels[label] not in set(pv_labels[label].split("__")):
+                                    fail = True
+                                    break
+                        if fail:
+                            break
+                    if fail:
+                        vz[a, b] = 1
+    pr.vb_cls, pr.vz_cls, pr.pod_vol_idx = vb, vz, vol_idx
+
+    # ------------------------------------------------- VolumeRestrictions
+    def cloud_triples(p: Obj) -> list[tuple]:
+        out = []
+        for v in (p.get("spec") or {}).get("volumes") or []:
+            for extract, key in (
+                (_gce_pd, "gcePersistentDisk"),
+                (_ebs, "awsElasticBlockStore"),
+                (_azure, "azureDisk"),
+            ):
+                vid = extract(v)
+                if vid:
+                    out.append((key, vid, bool((v.get(key) or {}).get("readOnly", False))))
+        return out
+
+    triples: list[tuple] = []
+    tri_idx: dict[tuple, int] = {}
+    pend_tri: list[list[int]] = []
+    for p in pending:
+        ids = []
+        for t in cloud_triples(p):
+            if t not in tri_idx:
+                tri_idx[t] = len(triples)
+                triples.append(t)
+            ids.append(tri_idx[t])
+        pend_tri.append(ids)
+    VR = len(triples)
+    pr.VR = VR
+    pod_restr = np.zeros((P, max(VR, 1)), dtype=bool)
+    for i, ids in enumerate(pend_tri):
+        for t in ids:
+            pod_restr[i, t] = True
+
+    def _restr_conflict(a: tuple, b: tuple) -> bool:
+        return a[0] == b[0] and a[1] == b[1] and not (a[2] and b[2])
+
+    restr_conflict = np.zeros((max(VR, 1), max(VR, 1)), dtype=bool)
+    for a, ta in enumerate(triples):
+        for b, tb in enumerate(triples):
+            restr_conflict[a, b] = _restr_conflict(ta, tb)
+    restr_used0 = np.zeros((N, max(VR, 1)), dtype=np.int64)
+    if VR:
+        by_kind_id: dict[tuple, list[int]] = {}
+        for w, (kind, vid, _ro) in enumerate(triples):
+            by_kind_id.setdefault((kind, vid), []).append(w)
+        for n_i, ni in enumerate(node_infos):
+            for bp in ni.pods:
+                for bt in cloud_triples(bp):
+                    for w in by_kind_id.get((bt[0], bt[1]), ()):
+                        if _restr_conflict(bt, triples[w]):
+                            restr_used0[n_i, w] += 1
+    pr.pod_restr, pr.restr_conflict, pr.restr_used0 = pod_restr, restr_conflict, restr_used0
+
+    # -------------------------------------- EBS/GCE/Azure volume counts
+    CLOUD_KEYS = ("awsElasticBlockStore", "gcePersistentDisk", "azureDisk")
+
+    def cloud_counts(p: Obj) -> "list[int]":
+        vols = (p.get("spec") or {}).get("volumes") or []
+        return [sum(1 for v in vols if v.get(k)) for k in CLOUD_KEYS]
+
+    cloud_cnt = np.zeros((P, 3), dtype=np.int64)
+    for i, p in enumerate(pending):
+        cloud_cnt[i] = cloud_counts(p)
+    cloud_used0 = np.zeros((N, 3), dtype=np.int64)
+    pr.CLOUD = int(cloud_cnt.any())
+    if pr.CLOUD:
+        for n_i, ni in enumerate(node_infos):
+            for bp in ni.pods:
+                cloud_used0[n_i] += cloud_counts(bp)
+    pr.cloud_cnt, pr.cloud_used0 = cloud_cnt, cloud_used0
+
+    # ------------------------------------------- CSI NodeVolumeLimits
+    drv_memo: dict[tuple[str, str], "str | None"] = {}
+
+    def driver_of(v: Obj, ns: str) -> "str | None":
+        """CSI driver a volume attaches through (mirrors the oracle's
+        NodeVolumeLimits._driver_of resolution chain)."""
+        csi = v.get("csi")
+        if csi:
+            return csi.get("driver") or ""
+        ref = v.get("persistentVolumeClaim")
+        if not ref:
+            return None
+        mk = (ns, ref.get("claimName", ""))
+        if mk in drv_memo:
+            return drv_memo[mk]
+        driver: "str | None" = None
+        pvc = pvc_by.get(mk)
+        if pvc is not None:
+            vol_name = (pvc.get("spec") or {}).get("volumeName")
+            if vol_name:
+                pv = pv_by.get(vol_name)
+                d = (((pv or {}).get("spec") or {}).get("csi") or {}).get("driver")
+                if d:
+                    driver = d
+            if driver is None:
+                sc_name = (pvc.get("spec") or {}).get("storageClassName")
+                sc = sc_by.get(sc_name) if sc_name else None
+                driver = sc.get("provisioner") if sc is not None else None
+        drv_memo[mk] = driver
+        return driver
+
+    def vol_ids(p: Obj) -> "set[tuple[str, str]]":
+        """(driver, unique volume id) pairs — PVC-backed ids shared across
+        pods (one attachment), inline csi: ids unique per pod+volume."""
+        ns = _namespace_of(p)
+        out: set[tuple[str, str]] = set()
+        for v in (p.get("spec") or {}).get("volumes") or []:
+            driver = driver_of(v, ns)
+            if driver is None:
+                continue
+            ref = v.get("persistentVolumeClaim")
+            if ref:
+                vid = f"pvc:{ns}/{ref.get('claimName', '')}"
+            else:
+                vid = f"inline:{ns}/{p['metadata']['name']}/{v.get('name', '')}"
+            out.add((driver, vid))
+        return out
+
+    vid_table: dict[str, int] = {}
+    vid_driver: list[str] = []
+    pend_vids: list[list[int]] = []
+    for p in pending:
+        ids = []
+        for driver, vid in sorted(vol_ids(p)):
+            if vid not in vid_table:
+                vid_table[vid] = len(vid_table)
+                vid_driver.append(driver)
+            ids.append(vid_table[vid])
+        pend_vids.append(ids)
+    VID = len(vid_table)
+    drv_table: dict[str, int] = {}
+    for d in vid_driver:
+        if d not in drv_table:
+            drv_table[d] = len(drv_table)
+    DR = len(drv_table)
+    pr.VID, pr.DR = VID, DR
+    pod_csi = np.zeros((P, max(VID, 1)), dtype=bool)
+    for i, ids in enumerate(pend_vids):
+        for t in ids:
+            pod_csi[i, t] = True
+    csi_drv_oh = np.zeros((max(VID, 1), max(DR, 1)), dtype=np.int64)
+    for v, d in enumerate(vid_driver):
+        csi_drv_oh[v, drv_table[d]] = 1
+    csi_attached0 = np.zeros((N, max(VID, 1)), dtype=np.int64)
+    csi_seed_used = np.zeros((N, max(DR, 1)), dtype=np.int64)
+    csi_limit = np.full((N, max(DR, 1)), 256, dtype=np.int64)
+    if VID:
+        for n_i, ni in enumerate(node_infos):
+            seen: set[tuple[str, str]] = set()
+            for bp in ni.pods:
+                seen |= vol_ids(bp)
+            for driver, vid in seen:
+                t = vid_table.get(vid)
+                if t is not None:
+                    csi_attached0[n_i, t] = 1
+                elif driver in drv_table:
+                    csi_seed_used[n_i, drv_table[driver]] += 1
+            # per-driver caps from the node's CSINode allocatable
+            csinode = csinode_by.get(ni.name)
+            for d in ((csinode or {}).get("spec") or {}).get("drivers") or []:
+                cnt = (d.get("allocatable") or {}).get("count")
+                if d.get("name") in drv_table and cnt is not None:
+                    csi_limit[n_i, drv_table[d["name"]]] = int(cnt)
+    pr.pod_csi, pr.csi_drv_oh = pod_csi, csi_drv_oh
+    pr.csi_attached0, pr.csi_seed_used, pr.csi_limit = csi_attached0, csi_seed_used, csi_limit
+
+
 # --------------------------------------------------------- shape bucketing
 
 def _bucket(x: int) -> int:
@@ -840,6 +1118,7 @@ def pad_problem(pr: BatchProblem, node_multiple: int = 1) -> BatchProblem:
         ("pod_req", 0), ("pod_nonzero", 0), ("fit_checked", False),
         ("pod_tol_idx", 0), ("pod_aff_idx", 0), ("pod_pref_idx", 0),
         ("pod_img_idx", 0), ("name_target", -1), ("pod_ports", False),
+        ("pod_vol_idx", 0), ("pod_restr", False), ("cloud_cnt", 0), ("pod_csi", False),
         ("spf_key", -1), ("spf_group", 0), ("spf_skew", 1), ("spf_self", 0),
         ("sps_key", -1), ("sps_group", 0), ("sps_skew", 1), ("sps_self", 0),
         ("ip_aff_g", -1), ("ip_anti_g", -1), ("ip_pref_g", -1), ("ip_pref_w", 0),
@@ -856,6 +1135,8 @@ def pad_problem(pr: BatchProblem, node_multiple: int = 1) -> BatchProblem:
         ("nonzero0", 0), ("pod_count0", 0),
         ("node_taint_idx", 0), ("node_label_idx", 0), ("node_img_idx", 0),
         ("node_unsched", False), ("ports_used0", 0),
+        ("restr_used0", 0), ("cloud_used0", 0), ("csi_attached0", 0),
+        ("csi_seed_used", 0), ("csi_limit", 0),
     ):
         setattr(pr, name, _pad_axis(getattr(pr, name), 0, N_pad, fill))
     for name, fill in (
@@ -877,6 +1158,34 @@ def pad_problem(pr: BatchProblem, node_multiple: int = 1) -> BatchProblem:
         for name in ("ip_sel0", "ip_own0", "ip_anti0"):
             setattr(pr, name, _pad_axis(getattr(pr, name), 0, G_pad, 0))
         pr.G = G_pad
+
+    # Volume class axes: padded classes are never wanted (pod_restr /
+    # pod_csi padding is False) and their conflict/driver rows are zero,
+    # so they can't fail a filter or perturb a count.
+    if pr.VR:
+        VR_pad = _bucket(pr.VR)
+        if VR_pad > pr.VR:
+            pr.pod_restr = _pad_axis(pr.pod_restr, 1, VR_pad, False)
+            pr.restr_conflict = _pad_axis(
+                _pad_axis(pr.restr_conflict, 0, VR_pad, False), 1, VR_pad, False
+            )
+            pr.restr_used0 = _pad_axis(pr.restr_used0, 1, VR_pad, 0)
+            pr.VR = VR_pad
+    if pr.VID:
+        VID_pad = _bucket(pr.VID)
+        if VID_pad > pr.VID:
+            pr.pod_csi = _pad_axis(pr.pod_csi, 1, VID_pad, False)
+            pr.csi_drv_oh = _pad_axis(pr.csi_drv_oh, 0, VID_pad, 0)
+            pr.csi_attached0 = _pad_axis(pr.csi_attached0, 1, VID_pad, 0)
+            pr.VID = VID_pad
+        DR_pad = _bucket(pr.DR)
+        if DR_pad > pr.DR:
+            # padded driver columns: need_d stays 0 there (zero one-hot
+            # rows), and the over-limit check requires need_d > 0
+            pr.csi_drv_oh = _pad_axis(pr.csi_drv_oh, 1, DR_pad, 0)
+            pr.csi_seed_used = _pad_axis(pr.csi_seed_used, 1, DR_pad, 0)
+            pr.csi_limit = _pad_axis(pr.csi_limit, 1, DR_pad, 0)
+            pr.DR = DR_pad
 
     # Identity-key expansions dynamic_slice [base, base+N) out of the
     # domain axis; with N padded the axis must extend past the last base.

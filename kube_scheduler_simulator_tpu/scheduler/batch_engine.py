@@ -7,11 +7,14 @@ one XLA computation (ops/batch.py) over features encoded once on the host
 onto pods (reference simulator/scheduler/plugin/resultstore/store.go:38-89)
 is reproduced byte-identically from the returned result tensors.
 
-Kernels: NodeUnschedulable, NodeName, TaintToleration, NodeAffinity,
-NodeResourcesFit (LeastAllocated/MostAllocated over cpu+memory),
-NodeResourcesBalancedAllocation, PodTopologySpread, InterPodAffinity,
-ImageLocality.  ``supported()`` reports whether a workload/profile
-combination is fully covered; callers fall back to the sequential oracle
+Kernels: NodeUnschedulable, NodeName, NodePorts, TaintToleration,
+NodeAffinity, NodeResourcesFit (LeastAllocated/MostAllocated over
+cpu+memory), NodeResourcesBalancedAllocation, PodTopologySpread,
+InterPodAffinity, ImageLocality, and the volume family — VolumeBinding,
+VolumeZone, VolumeRestrictions, EBS/GCE/AzureDisk limits, CSI
+NodeVolumeLimits (PVC/PV/StorageClass/CSINode lookups resolved at encode
+time).  ``supported()`` reports whether a workload/profile combination is
+fully covered; callers fall back to the sequential oracle
 (scheduler/framework_runner.py) otherwise.  Preemption (PostFilter) stays
 host-side and is not run by the batch pass.
 """
@@ -30,41 +33,29 @@ from kube_scheduler_simulator_tpu.plugins.intree import interpodaffinity as ip
 from kube_scheduler_simulator_tpu.plugins.intree import node_basic as nb
 from kube_scheduler_simulator_tpu.plugins.intree import nodeaffinity as na
 from kube_scheduler_simulator_tpu.plugins.intree import podtopologyspread as pts
+from kube_scheduler_simulator_tpu.plugins.intree import volumes as vol
 from kube_scheduler_simulator_tpu.plugins.resultstore import PASSED_FILTER_MESSAGE
 
 Obj = dict[str, Any]
 
 KERNEL_FILTERS = set(B.FILTER_KERNELS)
 KERNEL_SCORES = set(B.SCORE_KERNELS)
-# Plugins safely treated as no-ops when the workload doesn't exercise them.
-NOOP_IF_UNUSED = {
-    "VolumeRestrictions": lambda pod: not _pod_volumes(pod),
-    "EBSLimits": lambda pod: not _pod_volumes(pod),
-    "GCEPDLimits": lambda pod: not _pod_volumes(pod),
-    "NodeVolumeLimits": lambda pod: not _pod_volumes(pod),
-    "AzureDiskLimits": lambda pod: not _pod_volumes(pod),
-    "VolumeBinding": lambda pod: not _pod_volumes(pod),
-    "VolumeZone": lambda pod: not _pod_volumes(pod),
-}
 
-
-def _pod_volumes(pod: Obj) -> list:
-    return [
-        v
-        for v in (pod.get("spec") or {}).get("volumes") or []
-        if "persistentVolumeClaim" in v
-        or "awsElasticBlockStore" in v
-        or "gcePersistentDisk" in v
-        or "azureDisk" in v
-        or "csi" in v
-    ]
-
+# the resource kinds the volume kernels resolve on the host
+VOLUME_KINDS = ("persistentvolumeclaims", "persistentvolumes", "storageclasses", "csinodes")
 
 FILTER_MESSAGES = {
     "NodeUnschedulable": {1: nb.NODE_UNSCHEDULABLE_ERR},
     "NodeName": {1: nb.NODE_NAME_ERR},
     "NodePorts": {1: nb.NODE_PORTS_ERR},
     "NodeAffinity": {1: na.ERR_REASON_ENFORCED, 2: na.ERR_REASON_POD},
+    "VolumeBinding": {1: vol.ERR_UNBOUND_IMMEDIATE_PVC, 2: vol.ERR_VOLUME_NODE_CONFLICT},
+    "VolumeZone": {1: vol.ERR_VOLUME_ZONE},
+    "VolumeRestrictions": {1: vol.ERR_DISK_CONFLICT},
+    "EBSLimits": {1: vol.ERR_MAX_VOLUME_COUNT},
+    "GCEPDLimits": {1: vol.ERR_MAX_VOLUME_COUNT},
+    "AzureDiskLimits": {1: vol.ERR_MAX_VOLUME_COUNT},
+    "NodeVolumeLimits": {1: vol.ERR_MAX_VOLUME_COUNT},
     "PodTopologySpread": {1: pts.ERR_REASON_LABEL, 2: pts.ERR_REASON},
     "InterPodAffinity": {1: ip.ERR_EXISTING_ANTI, 2: ip.ERR_AFFINITY, 3: ip.ERR_ANTI_AFFINITY},
 }
@@ -546,12 +537,32 @@ class BatchEngine:
         )
         eng._unsupported_config = unsupported
         eng._framework = framework
+        # volume kernels resolve PVC/PV/StorageClass/CSINode objects at
+        # encode time; pull them from the framework's cluster store
+        eng._store = getattr(framework.handle, "cluster_store", None)
         return eng
+
+    def _volumes(self) -> "dict[str, list[Obj]]":
+        """The volume resource kinds for encode() (empty without a store)."""
+        store = getattr(self, "_store", None)
+        if store is None:
+            return {}
+        out: dict[str, list[Obj]] = {}
+        for k in VOLUME_KINDS:
+            try:
+                out[k] = store.list(k, copy_objects=False)
+            except Exception:
+                out[k] = []
+        return out
 
     # ---------------------------------------------------------- supported
 
-    def supported(self, pending: list[Obj], nodes: list[Obj]) -> "tuple[bool, str]":
-        """Can this profile × workload run fully on the batch path?"""
+    def supported(
+        self, pending: list[Obj], nodes: list[Obj], volumes: "dict[str, list[Obj]] | None" = None
+    ) -> "tuple[bool, str]":
+        """Can this profile × workload run fully on the batch path?
+        ``volumes``: pre-fetched volume kinds (see ``_volumes``) so one
+        store listing serves both this check and the encode pass."""
         if self._unsupported_config:
             return False, self._unsupported_config
         # An unbound pod nominated by an earlier preemption reserves its
@@ -600,15 +611,42 @@ class BatchEngine:
             distinct |= set(_fit_resources(p))
         if len(distinct) > 30:
             return False, f"{len(distinct)} distinct requested resources exceed the batch kernel's bitmask"
+        # Volume workload checks: a pod referencing a MISSING PVC is a
+        # VolumeBinding PreFilter reject (a whole-pod unresolvable status
+        # the kernel doesn't model — oracle volumes.py pre_filter), and
+        # the dynamic volume classes are capped like host ports.
+        if "VolumeBinding" in self.filters:
+            pvc_pods = [(p, claims) for p in pending if (claims := vol._pod_pvc_names(p))]
+            if pvc_pods:
+                if volumes is None and getattr(self, "_store", None) is None:
+                    return False, "PVC-mounting pods need a cluster store for the volume kernels"
+                vols = volumes if volumes is not None else self._volumes()
+                pvc_keys = {
+                    (o["metadata"].get("namespace") or "default", o["metadata"]["name"])
+                    for o in vols.get("persistentvolumeclaims") or []
+                }
+                for p, claims in pvc_pods:
+                    ns = p["metadata"].get("namespace", "default")
+                    for c in claims:
+                        if (ns, c) not in pvc_keys:
+                            return False, "pod references a missing PersistentVolumeClaim (PreFilter reject)"
+        distinct_restr: set = set()
+        distinct_vids = 0
+        for p in pending:
+            vols = (p.get("spec") or {}).get("volumes") or []
+            for v in vols:
+                for k in ("gcePersistentDisk", "awsElasticBlockStore", "azureDisk"):
+                    if v.get(k):
+                        distinct_restr.add((k, repr(v.get(k))))
+                if v.get("persistentVolumeClaim") or v.get("csi"):
+                    distinct_vids += 1
+        if len(distinct_restr) > 128:
+            return False, f"{len(distinct_restr)} distinct conflict volumes exceed the batch kernel cap"
+        if distinct_vids > 256:
+            return False, f"{distinct_vids} CSI/PVC volume mounts exceed the batch kernel cap"
         for f in self.filters:
-            if f in KERNEL_FILTERS:
-                continue
-            checker = NOOP_IF_UNUSED.get(f)
-            if checker is None:
+            if f not in KERNEL_FILTERS:
                 return False, f"filter plugin {f} has no batch kernel"
-            for p in pending:
-                if not checker(p):
-                    return False, f"workload exercises {f} (no batch kernel)"
         for s, _w in self.scores:
             if s not in KERNEL_SCORES:
                 return False, f"score plugin {s} has no batch kernel"
@@ -624,19 +662,21 @@ class BatchEngine:
         namespaces: "list[Obj] | None" = None,
         base_counter: int = 0,
         start_index: int = 0,
+        volumes: "dict[str, list[Obj]] | None" = None,
     ) -> BatchResult:
         """One batch scheduling pass over ``pending`` (already in queue
         order).  Returns per-pod selections plus (trace mode) everything
         needed to format the annotation trail.  ``base_counter`` is the
         framework's attempt counter for the round's first pod (keys the
         reservoir tie-break draws); ``start_index`` is the framework's
-        rotating next_start_node_index at round start."""
+        rotating next_start_node_index at round start; ``volumes`` is the
+        pre-fetched volume-kind dict (defaults to listing the store)."""
         if self.profile_dir:
             import jax
 
             with jax.profiler.trace(self.profile_dir):
-                return self._schedule(nodes, all_pods, pending, namespaces, base_counter, start_index)
-        return self._schedule(nodes, all_pods, pending, namespaces, base_counter, start_index)
+                return self._schedule(nodes, all_pods, pending, namespaces, base_counter, start_index, volumes)
+        return self._schedule(nodes, all_pods, pending, namespaces, base_counter, start_index, volumes)
 
     def _schedule(
         self,
@@ -646,6 +686,7 @@ class BatchEngine:
         namespaces: "list[Obj] | None" = None,
         base_counter: int = 0,
         start_index: int = 0,
+        volumes: "dict[str, list[Obj]] | None" = None,
     ) -> BatchResult:
         from kube_scheduler_simulator_tpu.scheduler.framework_runner import (
             num_feasible_nodes_to_find,
@@ -659,6 +700,7 @@ class BatchEngine:
             namespaces,
             hard_pod_affinity_weight=self.hard_pod_affinity_weight,
             added_affinity=self.added_affinity,
+            volumes=volumes if volumes is not None else self._volumes(),
         )
         # mesh sharding needs the node axis divisible by the mesh's "nodes"
         # axis — pad it even with bucketing off

@@ -413,7 +413,8 @@ class SchedulerService:
         if self._batch_engine is None:
             self._batch_engine = BatchEngine.from_framework(fw, trace=True)
         eng = self._batch_engine
-        ok, why = eng.supported(pending, nodes)
+        volumes = eng._volumes()  # one store listing serves check + encode
+        ok, why = eng.supported(pending, nodes, volumes=volumes)
         if not ok:
             self._count_fallback(why)
             return None
@@ -435,6 +436,7 @@ class SchedulerService:
                 self.cluster_store.list("namespaces", copy_objects=False),
                 base_counter=fw.sched_counter,
                 start_index=fw.next_start_node_index,
+                volumes=volumes,
             )
             snapshot = self.build_snapshot()
             sample_start = result.out["sample_start"]

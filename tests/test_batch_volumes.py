@@ -1,0 +1,291 @@
+"""Volume-plugin batch kernels vs the sequential oracle.
+
+The volume filter family (VolumeBinding, VolumeZone, VolumeRestrictions,
+EBS/GCE/AzureDisk limits, CSI NodeVolumeLimits) previously forced any
+PVC-mounting workload off the batch path; these suites pin that the
+kernels (ops/encode._encode_volumes + ops/batch.py) reproduce the oracle
+(plugins/intree/volumes.py) exactly — including the in-round dynamics
+(conflicts/counts against pods committed earlier in the same batch) and
+byte-identical annotations through SchedulerService.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from kube_scheduler_simulator_tpu.scheduler.batch_engine import BatchEngine
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+from tests.test_batch_parity import mk_node, mk_pod
+
+Obj = dict[str, Any]
+
+
+def mk_pv(name: str, labels=None, node_affinity=None, csi_driver=None) -> Obj:
+    pv: Obj = {
+        "metadata": {"name": name, "labels": labels or {}},
+        "spec": {"capacity": {"storage": "10Gi"}, "accessModes": ["ReadWriteOnce"]},
+    }
+    if node_affinity is not None:
+        pv["spec"]["nodeAffinity"] = {"required": node_affinity}
+    if csi_driver:
+        pv["spec"]["csi"] = {"driver": csi_driver, "volumeHandle": name}
+    return pv
+
+
+def mk_pvc(name: str, ns: str = "default", volume_name=None, storage_class=None) -> Obj:
+    pvc: Obj = {
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"accessModes": ["ReadWriteOnce"], "resources": {"requests": {"storage": "1Gi"}}},
+    }
+    if volume_name:
+        pvc["spec"]["volumeName"] = volume_name
+    if storage_class:
+        pvc["spec"]["storageClassName"] = storage_class
+    return pvc
+
+
+def mk_sc(name: str, binding_mode: str = "Immediate", provisioner: str = "csi.example.com") -> Obj:
+    return {
+        "metadata": {"name": name},
+        "provisioner": provisioner,
+        "volumeBindingMode": binding_mode,
+    }
+
+
+def mk_csinode(node_name: str, driver: str, count: int) -> Obj:
+    return {
+        "metadata": {"name": node_name},
+        "spec": {"drivers": [{"name": driver, "allocatable": {"count": count}}]},
+    }
+
+
+def pvc_volume(claim: str, vol_name: str = "v") -> Obj:
+    return {"name": vol_name, "persistentVolumeClaim": {"claimName": claim}}
+
+
+def run_both_services(build_store, cfg=None, expect_engaged=True):
+    """Schedule the same cluster through the sequential and the batch
+    service; assert batch engaged (no fallback) and byte-identical pod
+    annotations + placements.  Returns the batch service."""
+    store_seq = build_store()
+    svc_seq = SchedulerService(store_seq, tie_break="first", use_batch="off")
+    svc_seq.start_scheduler(cfg)
+    svc_seq.schedule_pending(max_rounds=1)
+
+    store_bat = build_store()
+    svc_bat = SchedulerService(store_bat, tie_break="first", use_batch="auto", batch_min_work=0)
+    svc_bat.start_scheduler(cfg)
+    svc_bat.schedule_pending(max_rounds=1)
+    if expect_engaged:
+        assert svc_bat.stats["batch_commits"] >= 1, svc_bat.stats["batch_fallbacks"]
+        assert not svc_bat.stats["batch_fallbacks"], svc_bat.stats["batch_fallbacks"]
+
+    for p_seq in store_seq.list("pods"):
+        name = p_seq["metadata"]["name"]
+        ns = p_seq["metadata"].get("namespace") or "default"
+        p_bat = store_bat.get("pods", name, ns)
+        seq_annos = p_seq["metadata"].get("annotations") or {}
+        bat_annos = p_bat["metadata"].get("annotations") or {}
+        assert seq_annos == bat_annos, (
+            f"{ns}/{name} annotation divergence:\n"
+            + "\n".join(
+                f"  {k}:\n   seq={seq_annos.get(k)}\n   bat={bat_annos.get(k)}"
+                for k in sorted(set(seq_annos) | set(bat_annos))
+                if seq_annos.get(k) != bat_annos.get(k)
+            )
+        )
+        assert (p_seq.get("spec") or {}).get("nodeName") == (p_bat.get("spec") or {}).get("nodeName"), name
+        assert (p_seq.get("status") or {}) == (p_bat.get("status") or {}), name
+    return svc_bat
+
+
+def test_volume_binding_parity():
+    """Bound PVs with node affinity pin pods to matching nodes; unbound
+    WaitForFirstConsumer passes everywhere; unbound Immediate fails the
+    pod on every node — all byte-identical to the oracle."""
+
+    def build_store():
+        store = ClusterStore()
+        for i in range(4):
+            store.create(
+                "nodes",
+                mk_node(f"node-{i}", 4000, 8192, labels={"zone": f"z{i % 2}", "kubernetes.io/hostname": f"node-{i}"}),
+            )
+        store.create("storageclasses", mk_sc("wfc", binding_mode="WaitForFirstConsumer"))
+        store.create("storageclasses", mk_sc("imm", binding_mode="Immediate"))
+        store.create(
+            "persistentvolumes",
+            mk_pv(
+                "pv-z1",
+                node_affinity={
+                    "nodeSelectorTerms": [
+                        {"matchExpressions": [{"key": "zone", "operator": "In", "values": ["z1"]}]}
+                    ]
+                },
+            ),
+        )
+        store.create("persistentvolumeclaims", mk_pvc("claim-bound", volume_name="pv-z1"))
+        store.create("persistentvolumeclaims", mk_pvc("claim-wfc", storage_class="wfc"))
+        store.create("persistentvolumeclaims", mk_pvc("claim-imm", storage_class="imm"))
+        store.create("pods", mk_pod("pod-bound", cpu_m=100, volumes=[pvc_volume("claim-bound")]))
+        store.create("pods", mk_pod("pod-wfc", cpu_m=100, volumes=[pvc_volume("claim-wfc")]))
+        store.create("pods", mk_pod("pod-imm", cpu_m=100, volumes=[pvc_volume("claim-imm")]))
+        store.create("pods", mk_pod("pod-plain", cpu_m=100))
+        return store
+
+    svc = run_both_services(build_store)
+    store = svc.cluster_store
+    # the bound claim's PV only matches z1 nodes
+    assert store.get("pods", "pod-bound")["spec"]["nodeName"] in ("node-1", "node-3")
+    assert store.get("pods", "pod-wfc")["spec"].get("nodeName")
+    assert not store.get("pods", "pod-imm")["spec"].get("nodeName")
+
+
+def test_volume_zone_parity():
+    """A bound PV carrying zone labels restricts pods to nodes in that
+    zone (first-failing-claim semantics, oracle VolumeZone)."""
+
+    def build_store():
+        store = ClusterStore()
+        for i in range(4):
+            store.create(
+                "nodes",
+                mk_node(
+                    f"node-{i}",
+                    4000,
+                    8192,
+                    labels={
+                        "topology.kubernetes.io/zone": f"z{i % 2}",
+                        "kubernetes.io/hostname": f"node-{i}",
+                    },
+                ),
+            )
+        store.create(
+            "persistentvolumes",
+            mk_pv("pv-zoned", labels={"topology.kubernetes.io/zone": "z0"}),
+        )
+        store.create("persistentvolumeclaims", mk_pvc("claim-zoned", volume_name="pv-zoned"))
+        store.create("pods", mk_pod("pod-zoned", cpu_m=100, volumes=[pvc_volume("claim-zoned")]))
+        store.create("pods", mk_pod("pod-free", cpu_m=100))
+        return store
+
+    svc = run_both_services(build_store)
+    assert svc.cluster_store.get("pods", "pod-zoned")["spec"]["nodeName"] in ("node-0", "node-2")
+
+
+def test_volume_restrictions_in_round_dynamics():
+    """Two pending pods mounting the same (non-readOnly) GCE PD must land
+    on different nodes — the second pod's conflict is against a pod
+    committed EARLIER IN THE SAME BATCH (the carry update), and a bound
+    pod seeds the conflict counts for a third node."""
+
+    def gce_volume(pd: str, ro: bool = False) -> Obj:
+        return {"name": "d", "gcePersistentDisk": {"pdName": pd, "readOnly": ro}}
+
+    def build_store():
+        store = ClusterStore()
+        for i in range(3):
+            store.create("nodes", mk_node(f"node-{i}", 4000, 8192))
+        blocker = mk_pod("blocker", cpu_m=100, volumes=[gce_volume("disk-a")])
+        blocker["spec"]["nodeName"] = "node-0"
+        store.create("pods", blocker)
+        store.create("pods", mk_pod("pod-1", cpu_m=100, volumes=[gce_volume("disk-a")]))
+        store.create("pods", mk_pod("pod-2", cpu_m=100, volumes=[gce_volume("disk-a")]))
+        store.create("pods", mk_pod("pod-3", cpu_m=100, volumes=[gce_volume("disk-a")]))
+        return store
+
+    svc = run_both_services(build_store)
+    store = svc.cluster_store
+    placed = {store.get("pods", f"pod-{i}")["spec"].get("nodeName") for i in (1, 2)}
+    assert placed == {"node-1", "node-2"}  # node-0 blocked by the bound pod
+    assert not store.get("pods", "pod-3")["spec"].get("nodeName")  # no node left
+
+
+def test_csi_volume_limits_parity():
+    """CSI NodeVolumeLimits: per-driver CSINode caps with unique-attachment
+    dedup — two pods sharing one PVC consume ONE attachment (may co-locate)
+    while distinct PVCs consume distinct ones."""
+
+    def build_store():
+        store = ClusterStore()
+        for i in range(2):
+            store.create("nodes", mk_node(f"node-{i}", 8000, 8192))
+            store.create("csinodes", mk_csinode(f"node-{i}", "csi.example.com", 1))
+        store.create("storageclasses", mk_sc("wfc", binding_mode="WaitForFirstConsumer"))
+        for c in ("shared", "solo-a", "solo-b"):
+            store.create("persistentvolumeclaims", mk_pvc(f"claim-{c}", storage_class="wfc"))
+        # two pods share one claim: 1 attachment, both fit on one node
+        store.create("pods", mk_pod("shared-1", cpu_m=100, volumes=[pvc_volume("claim-shared")]))
+        store.create("pods", mk_pod("shared-2", cpu_m=100, volumes=[pvc_volume("claim-shared")]))
+        # two pods with distinct claims: second must go to the other node
+        store.create("pods", mk_pod("solo-a", cpu_m=100, volumes=[pvc_volume("claim-solo-a")]))
+        store.create("pods", mk_pod("solo-b", cpu_m=100, volumes=[pvc_volume("claim-solo-b")]))
+        return store
+
+    run_both_services(build_store)
+
+
+def test_ebs_limits_and_seeded_counts():
+    """EBSLimits: per-family counts (no dedup), seeded from bound pods."""
+
+    def ebs_volume(vid: str, name: str) -> Obj:
+        return {"name": name, "awsElasticBlockStore": {"volumeID": vid}}
+
+    def build_store():
+        store = ClusterStore()
+        for i in range(2):
+            store.create("nodes", mk_node(f"node-{i}", 64000, 65536, pods=200))
+        # node-0 already holds 38 of the 39 allowed EBS attachments
+        heavy = mk_pod(
+            "heavy", cpu_m=100, volumes=[ebs_volume(f"vol-{j}", f"v{j}") for j in range(38)]
+        )
+        heavy["spec"]["nodeName"] = "node-0"
+        store.create("pods", heavy)
+        # wants 2 → only node-1 fits; a 1-volume pod still fits node-0
+        store.create(
+            "pods", mk_pod("wants-two", cpu_m=100, volumes=[ebs_volume("vol-x", "x"), ebs_volume("vol-y", "y")])
+        )
+        store.create("pods", mk_pod("wants-one", cpu_m=100, volumes=[ebs_volume("vol-z", "z")]))
+        return store
+
+    svc = run_both_services(build_store)
+    assert svc.cluster_store.get("pods", "wants-two")["spec"]["nodeName"] == "node-1"
+
+
+def test_missing_pvc_falls_back_sequential():
+    """A pod referencing a missing PVC is a VolumeBinding PreFilter reject
+    — the round de-batches and the sequential path records the exact
+    '%s not found' unresolvable result."""
+
+    def build_store():
+        store = ClusterStore()
+        store.create("nodes", mk_node("node-0", 4000, 8192))
+        store.create("pods", mk_pod("pod-ghost", cpu_m=100, volumes=[pvc_volume("nope")]))
+        return store
+
+    svc = run_both_services(build_store, expect_engaged=False)
+    assert any(
+        "missing PersistentVolumeClaim" in reason for reason in svc.stats["batch_fallbacks"]
+    ), svc.stats["batch_fallbacks"]
+    assert not svc.cluster_store.get("pods", "pod-ghost")["spec"].get("nodeName")
+
+
+def test_volume_workload_no_longer_forces_fallback():
+    """The default full profile with PVC-mounting pods stays on the batch
+    path (was: any volume de-batched the whole round)."""
+    store = ClusterStore()
+    for i in range(3):
+        store.create("nodes", mk_node(f"node-{i}", 4000, 8192))
+    store.create("storageclasses", mk_sc("wfc", binding_mode="WaitForFirstConsumer"))
+    store.create("persistentvolumeclaims", mk_pvc("c1", storage_class="wfc"))
+    store.create("pods", mk_pod("p1", cpu_m=100, volumes=[pvc_volume("c1")]))
+
+    svc = SchedulerService(store, tie_break="first", use_batch="auto", batch_min_work=0)
+    svc.start_scheduler(None)  # FULL default profile
+    fw = svc.framework
+    eng = BatchEngine.from_framework(fw, trace=True)
+    pending = fw.sort_pods(svc.pending_pods())
+    ok, why = eng.supported(pending, store.list("nodes"))
+    assert ok, why
