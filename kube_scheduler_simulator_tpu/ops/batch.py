@@ -81,9 +81,13 @@ FILTER_KERNELS = (
     "PodTopologySpread",
     "InterPodAffinity",
 )
-# per-family cloud volume-count limits: (cloud_cnt column, default limit)
-# — mirrors plugins/intree/volumes.py EBSLimits/GCEPDLimits/AzureDiskLimits
-CLOUD_LIMIT_COL = {"EBSLimits": (0, 39.0), "GCEPDLimits": (1, 16.0), "AzureDiskLimits": (2, 16.0)}
+# per-family cloud volume-count limits: (cloud_cnt column, default limit),
+# sourced from the oracle plugin classes so limits can't drift
+from kube_scheduler_simulator_tpu.plugins.intree.volumes import CLOUD_LIMIT_PLUGINS
+
+CLOUD_LIMIT_COL = {
+    cls.name: (col, float(cls.default_limit)) for col, cls in enumerate(CLOUD_LIMIT_PLUGINS)
+}
 SCORE_KERNELS = (
     "NodeResourcesFit",
     "NodeResourcesBalancedAllocation",
@@ -202,12 +206,18 @@ class DeviceProblem(NamedTuple):
 
 
 def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
-    """Convert host BatchProblem → DeviceProblem (+ static dims dict)."""
+    """Convert host BatchProblem → DeviceProblem (+ static dims dict).
+
+    The returned arrays are HOST (numpy) arrays: callers ship the whole
+    pytree with ONE ``jax.device_put`` (plain or sharded — see
+    BatchEngine._schedule / shard_device_problem).  Through a tunneled
+    TPU every individual H2D dispatch pays ~100 ms latency, so ~70
+    per-field transfers would cost more than the kernel itself."""
     if dtype is None:
-        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-    f = lambda x: jnp.asarray(np.asarray(x), dtype=dtype)
-    i32 = lambda x: jnp.asarray(np.asarray(x), dtype=jnp.int32)
-    b = lambda x: jnp.asarray(np.asarray(x), dtype=bool)
+        dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    f = lambda x: np.asarray(x, dtype=dtype)
+    i32 = lambda x: np.asarray(x, dtype=np.int32)
+    b = lambda x: np.asarray(x, dtype=bool)
     D = pr.D
     group_key = np.asarray(pr.group_key)
     gdom = np.asarray(pr.node_domain)[np.clip(group_key, 0, None)]  # [G,N]
@@ -265,26 +275,26 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
         pod_req=f(pr.pod_req),
         pod_nonzero=f(pr.pod_nonzero),
         fit_checked=b(pr.fit_checked),
-        taint_cls=jnp.asarray(pr.taint_cls, dtype=jnp.int16),
-        taint_prefer_cls=jnp.asarray(pr.taint_prefer_cls, dtype=jnp.int16),
+        taint_cls=np.asarray(pr.taint_cls, dtype=np.int16),
+        taint_prefer_cls=np.asarray(pr.taint_prefer_cls, dtype=np.int16),
         taint_unsched_cls=b(pr.taint_unsched_cls),
         pod_tol_idx=i32(pr.pod_tol_idx),
         node_taint_idx=i32(pr.node_taint_idx),
         node_unsched=b(pr.node_unsched),
-        aff_code_cls=jnp.asarray(pr.aff_code_cls, dtype=jnp.int8),
+        aff_code_cls=np.asarray(pr.aff_code_cls, dtype=np.int8),
         incl_cls=b(pr.incl_cls),
         aff_pref_cls=i32(pr.aff_pref_cls),
         pod_aff_idx=i32(pr.pod_aff_idx),
         pod_pref_idx=i32(pr.pod_pref_idx),
         node_label_idx=i32(pr.node_label_idx),
-        img_cls=jnp.asarray(pr.img_cls, dtype=jnp.int8),
+        img_cls=np.asarray(pr.img_cls, dtype=np.int8),
         pod_img_idx=i32(pr.pod_img_idx),
         node_img_idx=i32(pr.node_img_idx),
         name_target=i32(pr.name_target),
         pod_ports=b(pr.pod_ports),
         port_conflict=f(pr.port_conflict),
-        vb_cls=jnp.asarray(pr.vb_cls, dtype=jnp.int8),
-        vz_cls=jnp.asarray(pr.vz_cls, dtype=jnp.int8),
+        vb_cls=np.asarray(pr.vb_cls, dtype=np.int8),
+        vz_cls=np.asarray(pr.vz_cls, dtype=np.int8),
         pod_vol_idx=i32(pr.pod_vol_idx),
         pod_restr=b(pr.pod_restr),
         restr_conflict=f(pr.restr_conflict),
@@ -294,16 +304,16 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
         csi_seed_used=f(pr.csi_seed_used),
         csi_limit=f(pr.csi_limit),
         # expanded on-device inside the jitted kernel (_expand_features)
-        taint_fail=jnp.int32(0),
-        taint_prefer=jnp.int32(0),
-        unsched_ok=jnp.int32(0),
-        aff_code=jnp.int32(0),
-        aff_pref=jnp.int32(0),
-        name_ok=jnp.int32(0),
-        incl=jnp.int32(0),
-        img_score=jnp.int32(0),
-        vb_code=jnp.int32(0),
-        vz_code=jnp.int32(0),
+        taint_fail=np.int32(0),
+        taint_prefer=np.int32(0),
+        unsched_ok=np.int32(0),
+        aff_code=np.int32(0),
+        aff_pref=np.int32(0),
+        name_ok=np.int32(0),
+        incl=np.int32(0),
+        img_score=np.int32(0),
+        vb_code=np.int32(0),
+        vz_code=np.int32(0),
         node_domain=i32(pr.node_domain),
         spf=(i32(pr.spf_key), i32(pr.spf_group), f(pr.spf_skew), f(pr.spf_self)),
         sps=(i32(pr.sps_key), i32(pr.sps_group), f(pr.sps_skew), f(pr.sps_self)),
@@ -319,10 +329,10 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
         ip_self_match=b(pr.ip_self_match),
         pod_active=b(pr.pod_active),
         node_active=b(pr.node_active),
-        tb_base=jnp.asarray(0, dtype=jnp.uint32),
-        sample_k=jnp.asarray(pr.N_true, dtype=jnp.int32),
-        start0=jnp.asarray(0, dtype=jnp.int32),
-        n_true=jnp.asarray(pr.N_true, dtype=jnp.int32),
+        tb_base=np.uint32(0),
+        sample_k=np.int32(pr.N_true),
+        start0=np.int32(0),
+        n_true=np.int32(pr.N_true),
         key_valid=tuple(b(v) for v in key_valid),
         key_oh=tuple(f(o) for o in key_oh),
         g_ku=i32(g_ku),
@@ -463,23 +473,33 @@ def shard_device_problem(dp: "DeviceProblem", mesh, axis_name: str = "nodes") ->
     return jax.device_put(dp, shardings)
 
 
-def build_compact_fn(cfg: BatchConfig, dims: dict, W: int):
-    """Build the trace-compaction function: gather each pod's VISITED
-    nodes (the only ones the annotation trail mentions — upstream stops
-    filtering at numFeasibleNodesToFind) out of the [P,N] trace arrays
-    into [*,P,W] stacks, where W is a bucket over the round's max visited
-    count.  Two outputs → two device→host fetches instead of ~20 [P,N]
-    ones; through a tunneled TPU (~10 MB/s D2H) this is the difference
-    between milliseconds and minutes per round.
+def build_compact_fn(cfg: BatchConfig, dims: dict, W: int, WS: int):
+    """Build the trace-compaction function: reduce the [P,N] trace arrays
+    to exactly what the annotation writer reads, and nothing more —
+    through a tunneled TPU (~10 MB/s D2H) the fetch volume IS the trace
+    cost, and a dense per-filter fetch is minutes per round.
 
-    Outputs, dtype-packed to minimize fetch volume (values are all exact
-    integers by kernel construction, so the casts are lossless):
-      ids   [P,W]   int32  visited node ids (-1 pad)
-      codes [F,P,W] int16  filter reason codes (int32 when the Fit
-                           bitmask needs >15 bits)
-      feas  [P,W]   int8   feasible mask
-      raw   [S,P,W] int32  raw scores (InterPodAffinity sums can be large)
-      norm  [S,P,W] int8   normalized scores (0..MAX_NODE_SCORE)
+    - The filter trail records, per visited node, "passed" for every
+      plugin before the FIRST failure and the failure itself (the
+      sequential cycle short-circuits there) — so one (plugin, code)
+      plane suffices, not F planes.
+    - Scores only exist at FEASIBLE nodes (≤ sample_k of them), so the
+      score stacks compact to WS = bucket(max feasible), not the visited
+      width W.
+    - The visited ids themselves are NOT fetched: the visit window is
+      deterministic from (sample_start, sample_processed, n_true), and
+      the host reproduces the ascending-index column order with
+      arithmetic (BatchResult._visited_ids).
+
+    Outputs (exact integers by kernel construction; casts lossless):
+      fail_plug [P,W]    int8   index into cfg.filters of the first
+                                failing filter per visited node (-1 none),
+                                columns in ascending node-index order
+      fail_code [P,W]    int16  that filter's reason code (int32 when the
+                                Fit bitmask needs >15 bits)
+      sids      [P,WS]   int32  feasible node ids (-1 pad), ascending
+      raw       [S,P,WS] int32  raw scores at feasible nodes
+      norm      [S,P,WS] int8   normalized scores (0..MAX_NODE_SCORE)
     """
     P, N = dims["P"], dims["N"]
     code_dtype = jnp.int16 if dims["R"] + 1 <= 15 else jnp.int32
@@ -494,24 +514,23 @@ def build_compact_fn(cfg: BatchConfig, dims: dict, W: int):
         order = jnp.argsort(jnp.where(visited, idx, N + idx), axis=1)[:, :W]
         take = lambda a: jnp.take_along_axis(a, order, axis=1)
         valid = take(visited)
-        # mask padding columns to zero: stale values from unvisited nodes
-        # would defeat the all-passed fast path and inflate the host-side
-        # string LUTs
-        takem = lambda a: jnp.where(valid, take(a), 0)
-        res = {
-            "ids": jnp.where(valid, order, -1).astype(jnp.int32),
-            "feas": (take(out["feasible"]) & valid).astype(jnp.int8),
-        }
+        res = {}
         if cfg.filters:
-            res["codes"] = jnp.stack(
-                [takem(out[f"code:{f}"]).astype(code_dtype) for f in cfg.filters]
-            )
+            # the step already tracked (first failing filter, code) planes
+            res["fail_plug"] = jnp.where(valid, take(out["fail_plug"]), -1).astype(jnp.int8)
+            res["fail_code"] = jnp.where(valid, take(out["fail_code"]), 0).astype(code_dtype)
+        feas = out["feasible"]
+        sorder = jnp.argsort(jnp.where(feas, idx, N + idx), axis=1)[:, :WS]
+        stake = lambda a: jnp.take_along_axis(a, sorder, axis=1)
+        svalid = stake(feas)
+        res["sids"] = jnp.where(svalid, sorder, -1).astype(jnp.int32)
         if cfg.scores:
+            stakem = lambda a: jnp.where(svalid, stake(a), 0)
             res["raw"] = jnp.stack(
-                [takem(out[f"raw:{s}"]).astype(jnp.int32) for s, _w in cfg.scores]
+                [stakem(out[f"raw:{s}"]).astype(jnp.int32) for s, _w in cfg.scores]
             )
             res["norm"] = jnp.stack(
-                [takem(out[f"norm:{s}"]).astype(jnp.int8) for s, _w in cfg.scores]
+                [stakem(out[f"norm:{s}"]).astype(jnp.int8) for s, _w in cfg.scores]
             )
         return res
 
@@ -575,14 +594,24 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
         i = xs
         dt = requested.dtype
         pod_req = dp.pod_req[i]
-        codes = {}  # plugin -> [N] reason code (0 = pass)
+        # First-failure tracking IN the step (what the annotation trail
+        # records — the cycle short-circuits at the first failing filter):
+        # two [N] planes per pod instead of F per-filter planes, an
+        # order-of-magnitude less HBM traffic and fetch volume in trace
+        # mode.  fail_plug = index into cfg.filters, -1 = all passed.
+        fail_plug = jnp.full(N, -1, dtype=jnp.int8)
+        fail_code = jnp.zeros(N, dtype=jnp.int32)
 
         # ---------------------------------------------------------- filters
         feasible = dp.node_active  # padding columns are never feasible
+        filter_pos = {f: k for k, f in enumerate(cfg.filters)}
 
         def apply(name, code):
-            nonlocal feasible
-            codes[name] = code
+            nonlocal feasible, fail_plug, fail_code
+            if cfg.trace:
+                hit = (fail_plug < 0) & (code != 0)
+                fail_plug = jnp.where(hit, jnp.int8(filter_pos[name]), fail_plug)
+                fail_code = jnp.where(hit, code, fail_code)
             feasible = feasible & (code == 0)
 
         for name in cfg.filters:
@@ -708,8 +737,8 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
                         fail = active & (cnt > 0)
                         code = jnp.where((code == 0) & fail, 3, code)
                 apply(name, code)
-            else:  # kernel inactive for this problem (no constraints)
-                codes[name] = jnp.zeros(N, dtype=jnp.int32)
+            # else: kernel inactive for this problem (no constraints) —
+            # it can never fail, so it contributes nothing to the planes
 
         # ------------------------------------------- feasible-node sampling
         # Upstream visits nodes from a rotating start index and stops after
@@ -948,9 +977,8 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
         }
         if cfg.trace:
             out["feasible"] = sampled
-            out["totals"] = totals
-            for n_, c_ in codes.items():
-                out[f"code:{n_}"] = c_
+            out["fail_plug"] = fail_plug
+            out["fail_code"] = fail_code
             for n_ in raws:
                 out[f"raw:{n_}"] = raws[n_]
                 out[f"norm:{n_}"] = norms[n_]

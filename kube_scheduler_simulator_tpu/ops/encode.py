@@ -823,12 +823,15 @@ def _encode_volumes(
     P, N = len(pending), len(node_infos)
     M = len(nl_reps)
     from kube_scheduler_simulator_tpu.plugins.intree.volumes import (
+        CLOUD_LIMIT_PLUGINS,
         REGION_LABELS,
         ZONE_LABELS,
-        _azure,
-        _ebs,
-        _gce_pd,
+        NodeVolumeLimits,
         _pod_pvc_names,
+        pod_cloud_triples,
+        pod_csi_volume_ids,
+        resolve_csi_driver,
+        volumes_conflict,
     )
 
     def _ns_of(o: Obj) -> str:
@@ -838,6 +841,16 @@ def _encode_volumes(
     pv_by = {o["metadata"]["name"]: o for o in volumes.get("persistentvolumes") or []}
     sc_by = {o["metadata"]["name"]: o for o in volumes.get("storageclasses") or []}
     csinode_by = {o["metadata"]["name"]: o for o in volumes.get("csinodes") or []}
+
+    def dget(kind: str, name: str, namespace: "str | None" = None) -> "Obj | None":
+        """Dict-backed object source for the shared resolution helpers."""
+        if kind == "persistentvolumeclaims":
+            return pvc_by.get((namespace, name))
+        if kind == "persistentvolumes":
+            return pv_by.get(name)
+        if kind == "storageclasses":
+            return sc_by.get(name)
+        return None
 
     # ------------------------------------------- VolumeBinding / VolumeZone
     vol_reps, vol_idx = _group(
@@ -895,25 +908,12 @@ def _encode_volumes(
     pr.vb_cls, pr.vz_cls, pr.pod_vol_idx = vb, vz, vol_idx
 
     # ------------------------------------------------- VolumeRestrictions
-    def cloud_triples(p: Obj) -> list[tuple]:
-        out = []
-        for v in (p.get("spec") or {}).get("volumes") or []:
-            for extract, key in (
-                (_gce_pd, "gcePersistentDisk"),
-                (_ebs, "awsElasticBlockStore"),
-                (_azure, "azureDisk"),
-            ):
-                vid = extract(v)
-                if vid:
-                    out.append((key, vid, bool((v.get(key) or {}).get("readOnly", False))))
-        return out
-
     triples: list[tuple] = []
     tri_idx: dict[tuple, int] = {}
     pend_tri: list[list[int]] = []
     for p in pending:
         ids = []
-        for t in cloud_triples(p):
+        for t in pod_cloud_triples(p):
             if t not in tri_idx:
                 tri_idx[t] = len(triples)
                 triples.append(t)
@@ -926,13 +926,10 @@ def _encode_volumes(
         for t in ids:
             pod_restr[i, t] = True
 
-    def _restr_conflict(a: tuple, b: tuple) -> bool:
-        return a[0] == b[0] and a[1] == b[1] and not (a[2] and b[2])
-
     restr_conflict = np.zeros((max(VR, 1), max(VR, 1)), dtype=bool)
     for a, ta in enumerate(triples):
         for b, tb in enumerate(triples):
-            restr_conflict[a, b] = _restr_conflict(ta, tb)
+            restr_conflict[a, b] = volumes_conflict(ta, tb)
     restr_used0 = np.zeros((N, max(VR, 1)), dtype=np.int64)
     if VR:
         by_kind_id: dict[tuple, list[int]] = {}
@@ -940,14 +937,14 @@ def _encode_volumes(
             by_kind_id.setdefault((kind, vid), []).append(w)
         for n_i, ni in enumerate(node_infos):
             for bp in ni.pods:
-                for bt in cloud_triples(bp):
+                for bt in pod_cloud_triples(bp):
                     for w in by_kind_id.get((bt[0], bt[1]), ()):
-                        if _restr_conflict(bt, triples[w]):
+                        if volumes_conflict(bt, triples[w]):
                             restr_used0[n_i, w] += 1
     pr.pod_restr, pr.restr_conflict, pr.restr_used0 = pod_restr, restr_conflict, restr_used0
 
     # -------------------------------------- EBS/GCE/Azure volume counts
-    CLOUD_KEYS = ("awsElasticBlockStore", "gcePersistentDisk", "azureDisk")
+    CLOUD_KEYS = tuple(cls.volume_key for cls in CLOUD_LIMIT_PLUGINS)
 
     def cloud_counts(p: Obj) -> "list[int]":
         vols = (p.get("spec") or {}).get("volumes") or []
@@ -965,52 +962,15 @@ def _encode_volumes(
     pr.cloud_cnt, pr.cloud_used0 = cloud_cnt, cloud_used0
 
     # ------------------------------------------- CSI NodeVolumeLimits
+    # shared resolution core (plugins/intree/volumes.py) over the dict
+    # indexes — one parity-critical implementation for oracle and kernel
     drv_memo: dict[tuple[str, str], "str | None"] = {}
 
     def driver_of(v: Obj, ns: str) -> "str | None":
-        """CSI driver a volume attaches through (mirrors the oracle's
-        NodeVolumeLimits._driver_of resolution chain)."""
-        csi = v.get("csi")
-        if csi:
-            return csi.get("driver") or ""
-        ref = v.get("persistentVolumeClaim")
-        if not ref:
-            return None
-        mk = (ns, ref.get("claimName", ""))
-        if mk in drv_memo:
-            return drv_memo[mk]
-        driver: "str | None" = None
-        pvc = pvc_by.get(mk)
-        if pvc is not None:
-            vol_name = (pvc.get("spec") or {}).get("volumeName")
-            if vol_name:
-                pv = pv_by.get(vol_name)
-                d = (((pv or {}).get("spec") or {}).get("csi") or {}).get("driver")
-                if d:
-                    driver = d
-            if driver is None:
-                sc_name = (pvc.get("spec") or {}).get("storageClassName")
-                sc = sc_by.get(sc_name) if sc_name else None
-                driver = sc.get("provisioner") if sc is not None else None
-        drv_memo[mk] = driver
-        return driver
+        return resolve_csi_driver(v, ns, dget)
 
     def vol_ids(p: Obj) -> "set[tuple[str, str]]":
-        """(driver, unique volume id) pairs — PVC-backed ids shared across
-        pods (one attachment), inline csi: ids unique per pod+volume."""
-        ns = _namespace_of(p)
-        out: set[tuple[str, str]] = set()
-        for v in (p.get("spec") or {}).get("volumes") or []:
-            driver = driver_of(v, ns)
-            if driver is None:
-                continue
-            ref = v.get("persistentVolumeClaim")
-            if ref:
-                vid = f"pvc:{ns}/{ref.get('claimName', '')}"
-            else:
-                vid = f"inline:{ns}/{p['metadata']['name']}/{v.get('name', '')}"
-            out.add((driver, vid))
-        return out
+        return pod_csi_volume_ids(p, driver_of, drv_memo)
 
     vid_table: dict[str, int] = {}
     vid_driver: list[str] = []
@@ -1039,7 +999,7 @@ def _encode_volumes(
         csi_drv_oh[v, drv_table[d]] = 1
     csi_attached0 = np.zeros((N, max(VID, 1)), dtype=np.int64)
     csi_seed_used = np.zeros((N, max(DR, 1)), dtype=np.int64)
-    csi_limit = np.full((N, max(DR, 1)), 256, dtype=np.int64)
+    csi_limit = np.full((N, max(DR, 1)), NodeVolumeLimits.default_limit, dtype=np.int64)
     if VID:
         for n_i, ni in enumerate(node_infos):
             seen: set[tuple[str, str]] = set()

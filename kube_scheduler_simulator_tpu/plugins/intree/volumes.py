@@ -149,25 +149,45 @@ def _azure(v: Obj) -> "str | None":
     return d.get("diskName") if d else None
 
 
+# (volume source key, unique-id field) for the single-attach cloud disks —
+# shared by VolumeRestrictions and the batch encoder's conflict classes
+CLOUD_ID_FIELDS = (
+    ("gcePersistentDisk", "pdName"),
+    ("awsElasticBlockStore", "volumeID"),
+    ("azureDisk", "diskName"),
+)
+
+
+def pod_cloud_triples(pod: Obj) -> "list[tuple[str, str, bool]]":
+    """The (kind, id, readOnly) cloud-disk mounts of a pod."""
+    out = []
+    for v in (pod.get("spec") or {}).get("volumes") or []:
+        for key, id_field in CLOUD_ID_FIELDS:
+            src = v.get(key)
+            vid = src.get(id_field) if src else None
+            if vid:
+                out.append((key, vid, bool(src.get("readOnly", False))))
+    return out
+
+
+def volumes_conflict(a: "tuple[str, str, bool]", b: "tuple[str, str, bool]") -> bool:
+    """Two mounts of the same cloud disk conflict unless both are
+    read-only (upstream volumerestrictions single-attach semantics)."""
+    return a[0] == b[0] and a[1] == b[1] and not (a[2] and b[2])
+
+
 class VolumeRestrictions(_VolumeHandleMixin):
     name = "VolumeRestrictions"
 
     def filter(self, state: CycleState, pod: Obj, node_info: NodeInfo) -> "Status | None":
-        pod_vols = (pod.get("spec") or {}).get("volumes") or []
-        for v in pod_vols:
-            for existing in node_info.pods:
-                for ev in (existing.get("spec") or {}).get("volumes") or []:
-                    for extract, readonly_key in (
-                        (_gce_pd, "gcePersistentDisk"),
-                        (_ebs, "awsElasticBlockStore"),
-                        (_azure, "azureDisk"),
-                    ):
-                        a, b = extract(v), extract(ev)
-                        if a and b and a == b:
-                            ro_a = (v.get(readonly_key) or {}).get("readOnly", False)
-                            ro_b = (ev.get(readonly_key) or {}).get("readOnly", False)
-                            if not (ro_a and ro_b):
-                                return Status.unschedulable(ERR_DISK_CONFLICT)
+        want = pod_cloud_triples(pod)
+        if not want:
+            return None
+        for existing in node_info.pods:
+            for et in pod_cloud_triples(existing):
+                for t in want:
+                    if volumes_conflict(t, et):
+                        return Status.unschedulable(ERR_DISK_CONFLICT)
         return None
 
 
@@ -226,38 +246,7 @@ class NodeVolumeLimits(_VolumeLimits):
 
     def _driver_of(self, volume: Obj, namespace: str) -> "str | None":
         """CSI driver name a volume attaches through, or None."""
-        csi = volume.get("csi")
-        if csi:
-            return csi.get("driver") or ""
-        pvc_ref = volume.get("persistentVolumeClaim")
-        if not pvc_ref:
-            return None
-        store = getattr(self.handle, "cluster_store", None) if self.handle else None
-        if store is None:
-            return None
-        try:
-            pvc = store.get("persistentvolumeclaims", pvc_ref.get("claimName", ""), namespace)
-        except Exception:
-            return None
-        # bound PV with a csi source names the driver directly
-        vol_name = (pvc.get("spec") or {}).get("volumeName")
-        if vol_name:
-            try:
-                pv = store.get("persistentvolumes", vol_name)
-                pv_csi = ((pv.get("spec") or {}).get("csi")) or {}
-                if pv_csi.get("driver"):
-                    return pv_csi["driver"]
-            except Exception:
-                pass
-        # otherwise resolve through the StorageClass provisioner
-        sc_name = (pvc.get("spec") or {}).get("storageClassName")
-        if not sc_name:
-            return None
-        try:
-            sc = store.get("storageclasses", sc_name)
-        except Exception:
-            return None
-        return sc.get("provisioner")
+        return resolve_csi_driver(volume, namespace, self._get)
 
     def _csinode_limits(self, node_name: str) -> dict[str, int]:
         """driver → allocatable attach count from the node's CSINode."""
@@ -278,32 +267,7 @@ class NodeVolumeLimits(_VolumeLimits):
     _CACHE_KEY = "NodeVolumeLimits/cycle-cache"
 
     def _pod_volume_ids(self, pod: Obj, drv_memo: "dict | None" = None) -> "set[tuple[str, str]]":
-        """(driver, unique volume id) pairs a pod attaches.  PVC-backed
-        volumes are identified by the claim (pods sharing a PVC share ONE
-        attachment — upstream counts unique volume handles); inline csi:
-        volumes are unique per pod+volume.  ``drv_memo`` caches the
-        PVC → driver resolution (3 store lookups otherwise)."""
-        ns = pod["metadata"].get("namespace", "default")
-        out: set[tuple[str, str]] = set()
-        for v in (pod.get("spec") or {}).get("volumes") or []:
-            pvc_ref = v.get("persistentVolumeClaim")
-            if pvc_ref is not None and drv_memo is not None:
-                mk = (ns, pvc_ref.get("claimName", ""))
-                if mk in drv_memo:
-                    driver = drv_memo[mk]
-                else:
-                    driver = self._driver_of(v, ns)
-                    drv_memo[mk] = driver
-            else:
-                driver = self._driver_of(v, ns)
-            if driver is None:
-                continue
-            if pvc_ref:
-                vid = f"pvc:{ns}/{pvc_ref.get('claimName', '')}"
-            else:
-                vid = f"inline:{ns}/{pod['metadata']['name']}/{v.get('name', '')}"
-            out.add((driver, vid))
-        return out
+        return pod_csi_volume_ids(pod, self._driver_of, drv_memo)
 
     def filter(self, state: CycleState, pod: Obj, node_info: NodeInfo) -> "Status | None":
         # cycle-scoped memo: the incoming pod's volume set, every existing
@@ -338,3 +302,68 @@ class NodeVolumeLimits(_VolumeLimits):
             if used + needed > limits.get(driver, self.default_limit):
                 return Status.unschedulable(ERR_MAX_VOLUME_COUNT)
         return None
+
+
+# Column order of the batch kernel's per-family cloud count arrays
+# (ops/encode cloud_cnt / ops/batch CLOUD_LIMIT_COL) — limits and volume
+# keys come from the plugin classes so a fix there propagates everywhere.
+CLOUD_LIMIT_PLUGINS = (EBSLimits, GCEPDLimits, AzureDiskLimits)
+
+
+def resolve_csi_driver(volume: Obj, ns: str, get) -> "str | None":
+    """CSI driver a volume attaches through — the upstream resolution
+    chain (inline ``csi:`` names it; PVC-backed resolves bound PV csi
+    driver, then StorageClass provisioner).  ``get(kind, name,
+    namespace=None) → obj | None`` abstracts the object source: the
+    cluster store here, plain dict indexes in the batch encoder — one
+    parity-critical implementation for both paths."""
+    csi = volume.get("csi")
+    if csi:
+        return csi.get("driver") or ""
+    ref = volume.get("persistentVolumeClaim")
+    if not ref:
+        return None
+    pvc = get("persistentvolumeclaims", ref.get("claimName", ""), ns)
+    if pvc is None:
+        return None
+    vol_name = (pvc.get("spec") or {}).get("volumeName")
+    if vol_name:
+        pv = get("persistentvolumes", vol_name)
+        d = (((pv or {}).get("spec") or {}).get("csi") or {}).get("driver")
+        if d:
+            return d
+    sc_name = (pvc.get("spec") or {}).get("storageClassName")
+    if not sc_name:
+        return None
+    sc = get("storageclasses", sc_name)
+    return sc.get("provisioner") if sc is not None else None
+
+
+def pod_csi_volume_ids(pod: Obj, driver_of, drv_memo: "dict | None" = None) -> "set[tuple[str, str]]":
+    """(driver, unique volume id) pairs a pod attaches.  PVC-backed
+    volumes are identified by the claim (pods sharing a PVC share ONE
+    attachment — upstream counts unique volume handles); inline csi:
+    volumes are unique per pod+volume.  ``driver_of(volume, ns)`` resolves
+    the driver; ``drv_memo`` caches PVC-backed resolutions (3 object
+    lookups each otherwise)."""
+    ns = pod["metadata"].get("namespace", "default")
+    out: set[tuple[str, str]] = set()
+    for v in (pod.get("spec") or {}).get("volumes") or []:
+        pvc_ref = v.get("persistentVolumeClaim")
+        if pvc_ref is not None and drv_memo is not None:
+            mk = (ns, pvc_ref.get("claimName", ""))
+            if mk in drv_memo:
+                driver = drv_memo[mk]
+            else:
+                driver = driver_of(v, ns)
+                drv_memo[mk] = driver
+        else:
+            driver = driver_of(v, ns)
+        if driver is None:
+            continue
+        if pvc_ref:
+            vid = f"pvc:{ns}/{pvc_ref.get('claimName', '')}"
+        else:
+            vid = f"inline:{ns}/{pod['metadata']['name']}/{v.get('name', '')}"
+        out.add((driver, vid))
+    return out

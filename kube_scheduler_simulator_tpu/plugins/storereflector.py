@@ -128,13 +128,28 @@ class StoreReflector:
 
 def _updated_history(existing: "str | None", new_results: dict[str, str]) -> str:
     """updateResultHistory analog (storereflector.go:148-167): history is a
-    JSON array of annotation maps, one per scheduling attempt."""
-    history: list[dict[str, str]] = []
+    JSON array of annotation maps, one per scheduling attempt.
+
+    The new attempt is SPLICED onto the existing array bytes instead of
+    parse-append-re-marshal: prior attempts embed the full (often
+    megabyte-scale) annotation set, and re-escaping them on every attempt
+    makes history maintenance quadratic.  Splicing is byte-identical
+    because the existing string is this function's own compact output."""
+    entry = {k: v for k, v in new_results.items() if k != anno.RESULT_HISTORY}
+    entry_json = go_marshal(entry)
     if existing:
-        try:
+        # splice fast path only for our own compact shape: an array of
+        # objects with no stray whitespace
+        if existing == "[]":
+            return "[" + entry_json + "]"
+        if existing.startswith("[{") and existing.endswith("}]"):
+            return existing[:-1] + "," + entry_json + "]"
+        try:  # foreign/corrupt annotation: fall back to parse-append
             history = json.loads(existing)
         except json.JSONDecodeError:
             history = []
-    entry = {k: v for k, v in new_results.items() if k != anno.RESULT_HISTORY}
-    history.append(entry)
-    return go_marshal(history)
+        if not isinstance(history, list):
+            history = []
+        history.append(entry)
+        return go_marshal(history)
+    return "[" + entry_json + "]"

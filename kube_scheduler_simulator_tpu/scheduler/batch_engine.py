@@ -64,13 +64,16 @@ FILTER_MESSAGES = {
 class BatchResult:
     """Outcome of one batch scheduling pass, with lazy trace formatting.
 
-    The per-node trace arrives COMPACTED over each pod's visited nodes as
-    one int32 stack (ops/batch.build_compact_fn): visited node ids,
-    per-filter reason codes, the feasible mask, and raw/normalized scores.
-    Formatting converts rows to plain Python lists/strings once (C-side)
-    and indexes those — at bench scale, per-element numpy indexing and
-    ``str()`` calls are the difference between seconds and minutes of
-    annotation building."""
+    The per-node trace arrives COMPACTED to the annotation writer's
+    minimal reads (ops/batch.build_compact_fn): one (first-failing
+    plugin, code) plane over each pod's visited window — whose node ids
+    the host re-derives arithmetically from (start, processed) rather
+    than fetching — plus feasible node ids and raw/normalized scores over
+    the feasible width only.  Formatting pre-renders score strings via
+    np.unique LUTs and assembles annotation JSON from precomputed
+    fragments — at bench scale, per-element numpy indexing and ``str()``
+    calls are the difference between seconds and minutes of annotation
+    building."""
 
     def __init__(
         self, engine: "BatchEngine", pending: list[Obj], out: dict, pr: E.BatchProblem, nodes: list[Obj]
@@ -110,26 +113,28 @@ class BatchResult:
         if self._lists is None:
             tr = self.out["trace"]
             cfg = self._engine.cfg
-            codes = tr.get("codes")
 
             def strs(arr: "np.ndarray") -> list:
-                """[P,W] ints → [P][W] of INTERNED str objects: np.unique +
-                object-LUT indexing formats each distinct value once
-                (unicode astype would re-format all P×W elements)."""
+                """[P,WS] ints → [P][WS] of INTERNED str objects: np.unique
+                + object-LUT indexing formats each distinct value once
+                (unicode astype would re-format all P×WS elements)."""
                 uniq, inv = np.unique(arr, return_inverse=True)
                 lut = np.array([str(int(v)) for v in uniq], dtype=object)
                 return lut[inv].reshape(arr.shape).tolist()
 
+            fp = tr.get("fail_plug")
             self._lists = {
-                "ids": tr["ids"].tolist(),
-                "codes": {f: codes[k].tolist() for k, f in enumerate(cfg.filters)}
-                if codes is not None
-                else {},
-                # [P,W] bool: any filter failed at this visited node
-                "fail_any": (codes != 0).any(axis=0)
-                if codes is not None
-                else np.zeros(tr["ids"].shape, bool),
-                "feas": tr["feas"].tolist(),
+                "fail_plug": fp,
+                "fail_code": tr.get("fail_code"),
+                # [P] bool: any visited node failed any filter
+                "fail_any_row": (fp >= 0).any(axis=1)
+                if fp is not None
+                else np.zeros(len(self.pending), bool),
+                "sids": tr["sids"],
+                # engine.filters position of each kernel filter: the trail
+                # records "passed" for every enabled plugin BEFORE the
+                # first failure, in profile order
+                "fail_pos": [self._engine.filters.index(f) for f in cfg.filters],
                 "norm_int": {s: tr["norm"][k] for k, (s, _w) in enumerate(cfg.scores)},
                 "raw_s": {s: strs(tr["raw"][k]) for k, (s, _w) in enumerate(cfg.scores)},
                 "final_s": {
@@ -146,6 +151,18 @@ class BatchResult:
                 p: PASSED_FILTER_MESSAGE for p in self._engine.filters
             }
         return self._lists
+
+    def _visited_ids(self, i: int) -> "np.ndarray":
+        """The nodes pod i's cycle visited, ascending node index — the
+        column order of the compact fail planes.  Derived (not fetched):
+        the visit window is (start + r) % n_true for r < processed."""
+        start = int(self.out["sample_start"][i])
+        proc = int(self.out["sample_processed"][i])
+        n_true = self.problem.N_true
+        if proc >= n_true:
+            return np.arange(n_true, dtype=np.int64)
+        r = np.arange(proc, dtype=np.int64)
+        return np.sort((start + r) % n_true)
 
     def _msg(self, i: int, n: int, plugin: str, code: int) -> str:
         """Memoized failure-message formatting: messages depend only on
@@ -171,43 +188,26 @@ class BatchResult:
         short circuit of the sequential cycle."""
         assert self._engine.cfg.trace, "run with trace=True for annotations"
         tr = self._tr()
-        ids = tr["ids"][i]
+        ids = self._visited_ids(i)
         narrowed = self._prefilter_node_set(i)
-        fail_any = tr["fail_any"][i]
         passed_entry = tr["passed_entry"]
         node_names = self.problem.node_names
+        filters = self._engine.filters
+        cfg_filters = self._engine.cfg.filters
+        fail_pos = tr["fail_pos"]
+        fp = tr["fail_plug"][i] if tr["fail_plug"] is not None else None
+        fc = tr["fail_code"][i] if tr["fail_code"] is not None else None
         result: dict = {}
-        if not fail_any.any():
-            # fast path: every visited node passes every filter — share
-            # ONE entry dict (the annotation writer only reads these)
-            for n in ids:
-                if n < 0:  # compact rows put padding at the tail
-                    break
-                if narrowed is not None and n not in narrowed:
-                    continue
-                result[node_names[n]] = passed_entry
-            return result
-        codes = tr["codes"]
-        # Iterate the FULL enabled filter list (profile order): plugins
-        # without a kernel are no-ops for supported workloads and the
-        # oracle still records "passed" for them.
-        plugins = [(p, codes.get(p)) for p in self._engine.filters]
         for j, n in enumerate(ids):
-            if n < 0:
-                break
             if narrowed is not None and n not in narrowed:
                 continue
-            if not fail_any[j]:
+            k = int(fp[j]) if fp is not None else -1
+            if k < 0:
                 result[node_names[n]] = passed_entry
                 continue
-            entry: dict = {}
-            for plugin, crow in plugins:
-                code = int(crow[i][j]) if crow is not None else 0
-                if code == 0:
-                    entry[plugin] = PASSED_FILTER_MESSAGE
-                else:
-                    entry[plugin] = self._msg(i, n, plugin, code)
-                    break
+            plugin = cfg_filters[k]
+            entry = {p: PASSED_FILTER_MESSAGE for p in filters[: fail_pos[k]]}
+            entry[plugin] = self._msg(i, int(n), plugin, int(fc[j]))
             result[node_names[n]] = entry
         return result
 
@@ -219,18 +219,15 @@ class BatchResult:
         if int(self.feasible_count[i]) <= 1:
             return score, final
         tr = self._tr()
-        ids = tr["ids"][i]
-        feas = tr["feas"][i]
+        sids = tr["sids"][i]
         rows = [
             (plugin, tr["raw_s"][plugin][i], tr["final_s"][plugin][i])
             for plugin, _weight in self._engine.cfg.scores
         ]
         node_names = self.problem.node_names
-        for j, n in enumerate(ids):
+        for j, n in enumerate(sids):
             if n < 0:
                 break
-            if not feas[j]:
-                continue
             nm = node_names[n]
             score[nm] = {plugin: raw_s[j] for plugin, raw_s, _f in rows}
             final[nm] = {plugin: final_s[j] for plugin, _r, final_s in rows}
@@ -240,23 +237,21 @@ class BatchResult:
         """Per-node failure Status map (for failure messages/postfilter)."""
         assert self._engine.cfg.trace
         tr = self._tr()
-        ids = tr["ids"][i]
+        fp = tr["fail_plug"]
+        if fp is None:
+            return {}
+        ids = self._visited_ids(i)
         narrowed = self._prefilter_node_set(i)
-        codes = [(p, tr["codes"][p]) for p in self._engine.cfg.filters]
+        cfg_filters = self._engine.cfg.filters
+        fc = tr["fail_code"][i]
         diag: dict[str, Status] = {}
-        fail_any = tr["fail_any"][i]
-        for j in np.nonzero(fail_any)[0]:
-            n = ids[j]
-            if n < 0:
-                continue
+        for j in np.nonzero(fp[i][: len(ids)] >= 0)[0]:
+            n = int(ids[j])
             if narrowed is not None and n not in narrowed:
                 continue
-            for plugin, crow in codes:
-                code = int(crow[i][j])
-                if code != 0:  # only kernel plugins can fail (others no-op)
-                    msg = self._msg(i, n, plugin, code)
-                    diag[self.problem.node_names[n]] = Status.unschedulable(msg)
-                    break
+            plugin = cfg_filters[int(fp[i][j])]
+            msg = self._msg(i, n, plugin, int(fc[j]))
+            diag[self.problem.node_names[n]] = Status.unschedulable(msg)
         return diag
 
     # ------------------------------------------------- pre-marshaled JSON
@@ -274,65 +269,79 @@ class BatchResult:
 
             names = self.problem.node_names
             splugins = sorted(s for s, _w in self._engine.cfg.scores)
+            key = [go_string_key(nm) for nm in names]
+            passed = go_marshal(tr["passed_entry"])
             tr["frags"] = {
-                "key": [go_string_key(nm) for nm in names],
-                "passed": go_marshal(tr["passed_entry"]),
+                "key": key,
+                "passed": passed,
                 "splug": [(go_string_key(s) + '"', s) for s in splugins],
+                # go_marshal key order = sorted node names; precomputed
+                # once so per-pod assembly never sorts
+                "order_by_name": np.array(
+                    sorted(range(len(names)), key=names.__getitem__), dtype=np.int64
+                ),
+                # whole all-passed entries, ready to select + join
+                "pass_arr": np.array([k + passed for k in key], dtype=object),
             }
         return tr["frags"]
 
     def filter_annotation_json(self, i: int) -> "str":
-        """go_marshal(filter_annotation(i)) assembled from fragments."""
+        """go_marshal(filter_annotation(i)) assembled from fragments.
+
+        Vectorized: the visited set becomes a node mask, the name-sorted
+        visited ids come from one precomputed order array (no per-pod
+        sort), and the dominant all-passed entries are selected out of a
+        prebuilt object array — Python-level work only happens at the
+        (rare) failing nodes."""
         from kube_scheduler_simulator_tpu.utils.gojson import RawJSON, go_marshal
 
         tr = self._tr()
         fr = self._fr()
-        ids = tr["ids"][i]
+        ids = self._visited_ids(i)
         narrowed = self._prefilter_node_set(i)
-        fail_any = tr["fail_any"][i]
-        names = self.problem.node_names
-        visited = []
-        for j, n in enumerate(ids):
-            if n < 0:
-                break
-            if narrowed is not None and n not in narrowed:
-                continue
-            visited.append((j, n))
-        visited.sort(key=lambda t: names[t[1]])  # go_marshal key order
-        key_frag = fr["key"]
-        passed = fr["passed"]
-        parts = []
-        if not fail_any.any():
-            for _j, n in visited:
-                parts.append(key_frag[n] + passed)
-        else:
-            codes = tr["codes"]
-            plugins = [(p, codes.get(p)) for p in self._engine.filters]
+        n_true = self.problem.N_true
+        mask = np.zeros(n_true, dtype=bool)
+        mask[ids] = True
+        if narrowed is not None:
+            nmask = np.zeros(n_true, dtype=bool)
+            nmask[list(narrowed)] = True
+            mask &= nmask
+        order = fr["order_by_name"]
+        sel = order[mask[order]]  # visited ids in go_marshal key order
+        fp = tr["fail_plug"]
+        if fp is None or not tr["fail_any_row"][i]:
+            return RawJSON("{" + ",".join(fr["pass_arr"][sel]) + "}")
+        # column of each node in the compact planes (ascending-id order)
+        col_of = np.empty(n_true, dtype=np.int64)
+        col_of[ids] = np.arange(len(ids))
+        cols = col_of[sel]
+        fps = fp[i][cols]
+        parts = fr["pass_arr"][sel].copy()
+        failing = np.nonzero(fps >= 0)[0]
+        if failing.size:
+            filters = self._engine.filters
+            cfg_filters = self._engine.cfg.filters
+            fail_pos = tr["fail_pos"]
+            key_frag = fr["key"]
+            fc_row = tr["fail_code"][i]
             # failing entries repeat across thousands of (pod, node)
             # pairs — memoize the marshaled bytes by (first failing
             # plugin, message): that pair fully determines the entry
             # (the passed prefix is the profile order up to the failure)
             entry_memo = tr.setdefault("entry_memo", {})
-            for j, n in visited:
-                if not fail_any[j]:
-                    parts.append(key_frag[n] + passed)
-                    continue
-                frag = None
-                for idx, (plugin, crow) in enumerate(plugins):
-                    code = int(crow[i][j]) if crow is not None else 0
-                    if code != 0:
-                        msg = self._msg(i, n, plugin, code)
-                        ek = (idx, msg)
-                        frag = entry_memo.get(ek)
-                        if frag is None:
-                            entry = {p: PASSED_FILTER_MESSAGE for p, _c in plugins[:idx]}
-                            entry[plugin] = msg
-                            frag = go_marshal(entry)
-                            entry_memo[ek] = frag
-                        break
-                if frag is None:  # all kernel plugins passed (fail_any from
-                    frag = passed  # a plugin later pruned — defensive)
-                parts.append(key_frag[n] + frag)
+            for t in failing:
+                k = int(fps[t])
+                n = int(sel[t])
+                plugin = cfg_filters[k]
+                msg = self._msg(i, n, plugin, int(fc_row[cols[t]]))
+                ek = (k, msg)
+                frag = entry_memo.get(ek)
+                if frag is None:
+                    entry = {p: PASSED_FILTER_MESSAGE for p in filters[: fail_pos[k]]}
+                    entry[plugin] = msg
+                    frag = go_marshal(entry)
+                    entry_memo[ek] = frag
+                parts[t] = key_frag[n] + frag
         return RawJSON("{" + ",".join(parts) + "}")
 
     def score_annotations_json(self, i: int) -> "tuple[str, str]":
@@ -342,19 +351,13 @@ class BatchResult:
 
         tr = self._tr()
         fr = self._fr()
-        ids = tr["ids"][i]
-        feas = tr["feas"][i]
+        sids = tr["sids"][i]
         names = self.problem.node_names
         key_frag = fr["key"]
         splug = fr["splug"]
         raw_rows = [(frag, tr["raw_s"][s][i]) for frag, s in splug]
         fin_rows = [(frag, tr["final_s"][s][i]) for frag, s in splug]
-        feas_nodes = []
-        for j, n in enumerate(ids):
-            if n < 0:
-                break
-            if feas[j]:
-                feas_nodes.append((j, n))
+        feas_nodes = [(j, int(n)) for j, n in enumerate(sids) if n >= 0]
         feas_nodes.sort(key=lambda t: names[t[1]])
         s_parts = []
         f_parts = []
@@ -371,22 +374,23 @@ class BatchResult:
         )
 
     def totals_map(self, i: int) -> dict[int, int]:
-        """Visited node index → weighted score total (Σ weight×normalized,
-        recomputed from the compact trace — trace mode)."""
+        """FEASIBLE node index → weighted score total (Σ weight ×
+        normalized, recomputed from the compact trace — trace mode).
+        Infeasible nodes carry no scores (the cycle never scores them)."""
         tr = self._tr()
-        ids = tr["ids"][i]
-        totals: dict[int, int] = {n: 0 for n in ids if n >= 0}
+        sids = tr["sids"][i]
+        totals: dict[int, int] = {int(n): 0 for n in sids if n >= 0}
         for (plugin, weight) in self._engine.cfg.scores:
             norm_row = tr["norm_int"][plugin][i]
-            for j, n in enumerate(ids):
+            for j, n in enumerate(sids):
                 if n >= 0:
-                    totals[n] += int(norm_row[j]) * int(weight)
+                    totals[int(n)] += int(norm_row[j]) * int(weight)
         return totals
 
     def feasible_idx(self, i: int) -> set[int]:
-        """Visited node indices that passed all filters (trace mode)."""
+        """Node indices that passed all filters (trace mode)."""
         tr = self._tr()
-        return {n for n, f in zip(tr["ids"][i], tr["feas"][i]) if n >= 0 and f}
+        return {int(n) for n in tr["sids"][i] if n >= 0}
 
     def _prefilter_node_set(self, i: int) -> "set[int] | None":
         """Node indices surviving PreFilter narrowing (NodeAffinity
@@ -631,19 +635,24 @@ class BatchEngine:
                         if (ns, c) not in pvc_keys:
                             return False, "pod references a missing PersistentVolumeClaim (PreFilter reject)"
         distinct_restr: set = set()
-        distinct_vids = 0
+        distinct_vids: set = set()
         for p in pending:
+            ns = p["metadata"].get("namespace", "default")
             vols = (p.get("spec") or {}).get("volumes") or []
             for v in vols:
-                for k in ("gcePersistentDisk", "awsElasticBlockStore", "azureDisk"):
-                    if v.get(k):
-                        distinct_restr.add((k, repr(v.get(k))))
-                if v.get("persistentVolumeClaim") or v.get("csi"):
-                    distinct_vids += 1
+                for t in vol.pod_cloud_triples({"spec": {"volumes": [v]}}):
+                    distinct_restr.add(t)
+                # distinct VOLUME IDS, matching the encoder's VID axis:
+                # PVC-backed ids dedup by claim, inline csi per pod+volume
+                ref = v.get("persistentVolumeClaim")
+                if ref:
+                    distinct_vids.add(f"pvc:{ns}/{ref.get('claimName', '')}")
+                elif v.get("csi"):
+                    distinct_vids.add(f"inline:{ns}/{p['metadata']['name']}/{v.get('name', '')}")
         if len(distinct_restr) > 128:
             return False, f"{len(distinct_restr)} distinct conflict volumes exceed the batch kernel cap"
-        if distinct_vids > 256:
-            return False, f"{distinct_vids} CSI/PVC volume mounts exceed the batch kernel cap"
+        if len(distinct_vids) > 256:
+            return False, f"{len(distinct_vids)} distinct CSI/PVC volume ids exceed the batch kernel cap"
         for f in self.filters:
             if f not in KERNEL_FILTERS:
                 return False, f"filter plugin {f} has no batch kernel"
@@ -709,14 +718,14 @@ class BatchEngine:
             pr = E.pad_problem(pr, node_multiple=node_multiple)
         t1 = time.perf_counter()
         dp, dims = B.lower(pr, dtype=self.dtype)
-        import jax.numpy as jnp
+        import jax
 
         sample_k = num_feasible_nodes_to_find(len(nodes), self.percentage_of_nodes_to_score)
         start0 = start_index % max(len(nodes), 1)
         dp = dp._replace(
-            tb_base=jnp.asarray(base_counter & 0xFFFFFFFF, dtype=jnp.uint32),
-            sample_k=jnp.asarray(sample_k, dtype=jnp.int32),
-            start0=jnp.asarray(start0, dtype=jnp.int32),
+            tb_base=np.uint32(base_counter & 0xFFFFFFFF),
+            sample_k=np.int32(sample_k),
+            start0=np.int32(start0),
         )
         # Compile out the sampling machinery when it cannot engage this
         # round (full coverage, no rotation): visit order == index order.
@@ -727,6 +736,10 @@ class BatchEngine:
             # (donation is skipped — sharded carries would need matching
             # output shardings to alias)
             dp = B.shard_device_problem(dp, self.mesh)
+        else:
+            # ONE pytree-level H2D transfer — per-field dispatches each
+            # pay the full tunnel latency (lower() returns host arrays)
+            dp = jax.device_put(dp)
         key = (tuple(sorted(dims.items())), cfg, id(self.mesh) if self.mesh is not None else None)
         fn = self._fn_cache.get(key)
         t2 = time.perf_counter()
@@ -749,23 +762,27 @@ class BatchEngine:
             "final_start": packed[4, 0] if packed.shape[1] else np.int32(0),
         }
         if self.trace:
-            # Compact the [P,N] trace down to each pod's visited nodes on
-            # device, then fetch the two stacks (2 roundtrips, ~visited/N
-            # of the dense volume — the tunnel D2H path is ~10 MB/s).
+            # Compact the [P,N] trace on device to the annotation writer's
+            # minimal reads — one (first-fail plugin, code) plane over the
+            # visited width, scores over the (much narrower) feasible
+            # width — then fetch; the tunnel D2H path is ~10 MB/s, so
+            # fetch volume is the trace cost (see build_compact_fn).
             max_processed = int(packed[3].max()) if packed.shape[1] else 1
             W = min(dims["N"], E._bucket(max(max_processed, 1)))
-            ckey = (key, W)
+            max_feasible = int(packed[1].max()) if packed.shape[1] else 1
+            WS = min(dims["N"], E._bucket(max(max_feasible, 1)))
+            ckey = (key, W, WS)
             cfn = self._compact_cache.get(ckey)
             if cfn is None:
-                cfn = B.build_compact_fn(cfg, dims, W)
+                cfn = B.build_compact_fn(cfg, dims, W, WS)
                 self._compact_cache[ckey] = cfn
                 self.compiles += 1
-            tr_keys = ("sample_start", "sample_processed", "feasible")
+            tr_keys = ("sample_start", "sample_processed", "feasible", "fail_plug", "fail_code")
             cout = cfn(
                 {
                     k: v
                     for k, v in out_dev.items()
-                    if k in tr_keys or k.startswith(("code:", "raw:", "norm:"))
+                    if k in tr_keys or k.startswith(("raw:", "norm:"))
                 },
                 dp.n_true,
             )
