@@ -229,8 +229,32 @@ def encode(
     node_infos = build_node_infos(nodes, all_pods)
 
     # ------------------------------------------------------------- resources
-    req_of = [pod_resource_request(p) for p in pending]
-    fit_of = [_fit_from_request(req) for req in req_of]
+    # Pods repeat identical resource shapes (same container templates);
+    # parse each DISTINCT (containers, initContainers, overhead) signature
+    # once — at 10k pods this collapses ~20 µs of quantity parsing per pod
+    # into one dict hit.
+    req_memo: dict[str, tuple] = {}
+
+    def _pod_resources(p: Obj) -> tuple:
+        spec = p.get("spec") or {}
+        k = (
+            memo.sig_of(spec.get("containers") or ())
+            + "|"
+            + memo.sig_of(spec.get("initContainers") or ())
+            + "|"
+            + memo.sig_of(spec.get("overhead") or ())
+        )
+        v = req_memo.get(k)
+        if v is None:
+            req = pod_resource_request(p)
+            nz = pod_non_zero_request(p)
+            v = (req, _fit_from_request(req), (nz[CPU], nz[MEMORY]))
+            req_memo[k] = v
+        return v
+
+    res_of = [_pod_resources(p) for p in pending]
+    req_of = [r[0] for r in res_of]
+    fit_of = [r[1] for r in res_of]
     res_set: set[str] = {CPU, MEMORY}
     for fr in fit_of:
         res_set |= set(fr)
@@ -255,9 +279,9 @@ def encode(
                 requested0[ni_i, res_idx[r]] = v
         cpu = mem = 0
         for p in ni.pods:
-            nz = pod_non_zero_request(p)
-            cpu += nz[CPU]
-            mem += nz[MEMORY]
+            _req, _fit, (nz_cpu, nz_mem) = _pod_resources(p)
+            cpu += nz_cpu
+            mem += nz_mem
         nonzero0[ni_i] = (cpu, mem)
         nz_alloc[ni_i] = (ni.allocatable.get(CPU, 0), ni.allocatable.get(MEMORY, 0))
 
@@ -267,8 +291,7 @@ def encode(
         for r, v in req_of[i].items():
             if r in res_idx:
                 pod_req[i, res_idx[r]] = v
-        nz = pod_non_zero_request(p)
-        pod_nonzero[i] = (nz[CPU], nz[MEMORY])
+        pod_nonzero[i] = res_of[i][2]
     # fit_checked: which resource columns the Fit filter checks for this pod
     # (want > 0 and an upstream-checked resource name); fit_order keeps the
     # pod-manifest iteration order for byte-identical failure messages
@@ -687,24 +710,36 @@ def encode(
             paa.get("preferredDuringSchedulingIgnoredDuringExecution") or [],
         )
 
-    # Pending pods' own term lists (padded) + "toward"-update lists.
+    # Pending pods' own term lists (padded) + "toward"-update lists —
+    # memoized by (affinity-spec signature, namespace): the group/weight
+    # lists depend on nothing else, and pods stamped from the same
+    # template share them.
     aff_groups: list[list[int]] = []
     anti_groups: list[list[int]] = []
     pref_groups: list[list[tuple[int, int]]] = []  # (group, signed weight)
     own_updates: list[list[tuple[int, int]]] = []  # (group, folded weight)
+    terms_memo: dict[str, tuple] = {}
     for p in pending:
         ns = _namespace_of(p)
-        req_aff, req_anti, pref_aff, pref_anti = pod_terms(p)
-        aff_groups.append([term_group(t, ns) for t in req_aff])
-        anti_groups.append([term_group(t, ns) for t in req_anti])
-        prefs = [(term_group((t.get("podAffinityTerm") or {}), ns), int(t.get("weight") or 0)) for t in pref_aff]
-        prefs += [(term_group((t.get("podAffinityTerm") or {}), ns), -int(t.get("weight") or 0)) for t in pref_anti]
-        pref_groups.append([(g, w) for g, w in prefs if w])
-        ups: list[tuple[int, int]] = []
-        if hard_pod_affinity_weight > 0:
-            ups += [(term_group(t, ns), hard_pod_affinity_weight) for t in req_aff]
-        ups += [(g, w) for g, w in prefs if w]
-        own_updates.append(ups)
+        tk = memo.sig_of((p.get("spec") or {}).get("affinity") or ()) + "|" + ns
+        entry = terms_memo.get(tk)
+        if entry is None:
+            req_aff, req_anti, pref_aff, pref_anti = pod_terms(p)
+            ag = [term_group(t, ns) for t in req_aff]
+            ng = [term_group(t, ns) for t in req_anti]
+            prefs = [(term_group((t.get("podAffinityTerm") or {}), ns), int(t.get("weight") or 0)) for t in pref_aff]
+            prefs += [(term_group((t.get("podAffinityTerm") or {}), ns), -int(t.get("weight") or 0)) for t in pref_anti]
+            pg = [(g, w) for g, w in prefs if w]
+            ups: list[tuple[int, int]] = []
+            if hard_pod_affinity_weight > 0:
+                ups += [(term_group(t, ns), hard_pod_affinity_weight) for t in req_aff]
+            ups += pg
+            entry = (ag, ng, pg, ups)
+            terms_memo[tk] = entry
+        aff_groups.append(entry[0])
+        anti_groups.append(entry[1])
+        pref_groups.append(entry[2])
+        own_updates.append(entry[3])
 
     # Existing pods' own terms create groups too (they poison/score toward
     # the pending pods).  Register ALL groups first, then seed the counts.
@@ -833,6 +868,29 @@ def _encode_volumes(
         resolve_csi_driver,
         volumes_conflict,
     )
+
+    # Fast path: no PENDING pod mounts anything → every volume kernel is
+    # inert regardless of what bound pods hold (conflicts/counts/codes
+    # only engage for wanted classes), so skip the per-pod grouping and
+    # seeding loops — they would otherwise tax every volume-free round.
+    if not any((p.get("spec") or {}).get("volumes") for p in pending):
+        pr.vb_cls = np.zeros((1, M), dtype=np.int8)
+        pr.vz_cls = np.zeros((1, M), dtype=np.int8)
+        pr.pod_vol_idx = np.zeros(P, dtype=np.int32)
+        pr.VR = 0
+        pr.pod_restr = np.zeros((P, 1), dtype=bool)
+        pr.restr_conflict = np.zeros((1, 1), dtype=bool)
+        pr.restr_used0 = np.zeros((N, 1), dtype=np.int64)
+        pr.CLOUD = 0
+        pr.cloud_cnt = np.zeros((P, 3), dtype=np.int64)
+        pr.cloud_used0 = np.zeros((N, 3), dtype=np.int64)
+        pr.VID = pr.DR = 0
+        pr.pod_csi = np.zeros((P, 1), dtype=bool)
+        pr.csi_drv_oh = np.zeros((1, 1), dtype=np.int64)
+        pr.csi_attached0 = np.zeros((N, 1), dtype=np.int64)
+        pr.csi_seed_used = np.zeros((N, 1), dtype=np.int64)
+        pr.csi_limit = np.full((N, 1), NodeVolumeLimits.default_limit, dtype=np.int64)
+        return
 
     def _ns_of(o: Obj) -> str:
         return o["metadata"].get("namespace") or "default"

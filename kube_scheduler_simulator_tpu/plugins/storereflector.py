@@ -31,6 +31,11 @@ class StoreReflector:
         self._stores: dict[str, Any] = {}
         self._in_flush: set[str] = set()
         self._pending: dict[str, Obj] = {}
+        # pod keys whose result-history this reflector has written since
+        # boot: their annotation is our own compact output, safe for the
+        # byte-splice append; anything else (imported snapshots, foreign
+        # annotations) gets parse-validated once first
+        self._history_written: set[str] = set()
 
     def add_result_store(self, store: Any, key: str) -> None:
         self._stores[key] = store
@@ -112,10 +117,13 @@ class StoreReflector:
             annotations = dict(fresh["metadata"].get("annotations") or {})
             annotations.update(merged)
             annotations[anno.RESULT_HISTORY] = _updated_history(
-                (fresh["metadata"].get("annotations") or {}).get(anno.RESULT_HISTORY), merged
+                (fresh["metadata"].get("annotations") or {}).get(anno.RESULT_HISTORY),
+                merged,
+                trusted=key in self._history_written,
             )
             fresh["metadata"]["annotations"] = annotations
             cluster_store.update("pods", fresh)
+            self._history_written.add(key)
 
         self._in_flush.add(key)
         try:
@@ -126,24 +134,27 @@ class StoreReflector:
             self._in_flush.discard(key)
 
 
-def _updated_history(existing: "str | None", new_results: dict[str, str]) -> str:
+def _updated_history(existing: "str | None", new_results: dict[str, str], trusted: bool = False) -> str:
     """updateResultHistory analog (storereflector.go:148-167): history is a
     JSON array of annotation maps, one per scheduling attempt.
 
-    The new attempt is SPLICED onto the existing array bytes instead of
-    parse-append-re-marshal: prior attempts embed the full (often
-    megabyte-scale) annotation set, and re-escaping them on every attempt
-    makes history maintenance quadratic.  Splicing is byte-identical
-    because the existing string is this function's own compact output."""
+    With ``trusted`` (the reflector wrote this pod's history itself since
+    boot), the new attempt is SPLICED onto the existing array bytes
+    instead of parse-append-re-marshal: prior attempts embed the full
+    (often megabyte-scale) annotation set, and re-escaping them on every
+    attempt makes history maintenance quadratic.  Splicing is
+    byte-identical because the existing string is this function's own
+    compact output.  Untrusted values (imported snapshots, foreign
+    annotations) are parse-validated; corrupt or non-array values reset
+    to a fresh single-entry history, as before."""
     entry = {k: v for k, v in new_results.items() if k != anno.RESULT_HISTORY}
     entry_json = go_marshal(entry)
     if existing:
-        # splice fast path only for our own compact shape: an array of
-        # objects with no stray whitespace
-        if existing == "[]":
-            return "[" + entry_json + "]"
-        if existing.startswith("[{") and existing.endswith("}]"):
-            return existing[:-1] + "," + entry_json + "]"
+        if trusted:
+            if existing == "[]":
+                return "[" + entry_json + "]"
+            if existing.startswith("[{") and existing.endswith("}]"):
+                return existing[:-1] + "," + entry_json + "]"
         try:  # foreign/corrupt annotation: fall back to parse-append
             history = json.loads(existing)
         except json.JSONDecodeError:

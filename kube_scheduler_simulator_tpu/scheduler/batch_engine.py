@@ -638,12 +638,10 @@ class BatchEngine:
         distinct_vids: set = set()
         for p in pending:
             ns = p["metadata"].get("namespace", "default")
-            vols = (p.get("spec") or {}).get("volumes") or []
-            for v in vols:
-                for t in vol.pod_cloud_triples({"spec": {"volumes": [v]}}):
-                    distinct_restr.add(t)
-                # distinct VOLUME IDS, matching the encoder's VID axis:
-                # PVC-backed ids dedup by claim, inline csi per pod+volume
+            distinct_restr.update(vol.pod_cloud_triples(p))
+            # distinct VOLUME IDS, matching the encoder's VID axis:
+            # PVC-backed ids dedup by claim, inline csi per pod+volume
+            for v in (p.get("spec") or {}).get("volumes") or []:
                 ref = v.get("persistentVolumeClaim")
                 if ref:
                     distinct_vids.add(f"pvc:{ns}/{ref.get('claimName', '')}")

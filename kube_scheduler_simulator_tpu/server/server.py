@@ -136,6 +136,20 @@ def _make_handler(server: SimulatorServer):
             self.end_headers()
             self.wfile.write(data)
 
+        def _send_yaml(self, code: int, obj: Any, raw: bool = False) -> None:
+            """YAML response (``?format=yaml`` / templates) — the
+            reference UI's editors and templates speak YAML."""
+            import yaml
+
+            text = obj if raw else yaml.safe_dump(obj, sort_keys=False, default_flow_style=False)
+            data = text.encode()
+            self.send_response(code)
+            self._cors_headers()
+            self.send_header("Content-Type", "application/yaml; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def _send_empty(self, code: int) -> None:
             self.send_response(code)
             self._cors_headers()
@@ -150,9 +164,20 @@ def _make_handler(server: SimulatorServer):
                 self.send_header("Access-Control-Allow-Headers", "Content-Type")
 
         def _body(self) -> Any:
+            """Request body as an object.  JSON by default; YAML when the
+            Content-Type says so (the reference web UI is YAML-first —
+            its monaco editor and creation templates speak YAML,
+            web/components/lib/templates/*.yaml)."""
             length = int(self.headers.get("Content-Length") or 0)
             raw = self.rfile.read(length) if length else b""
-            return json.loads(raw.decode()) if raw else None
+            if not raw:
+                return None
+            ctype = (self.headers.get("Content-Type") or "").lower()
+            if "yaml" in ctype:
+                import yaml
+
+                return yaml.safe_load(raw.decode())
+            return json.loads(raw.decode())
 
         # --------------------------------------------------------- methods
 
@@ -188,15 +213,28 @@ def _make_handler(server: SimulatorServer):
                     self._send_json(200, di.snapshot_service().snap())
                 elif url.path == "/api/v1/listwatchresources":
                     self._list_watch(q)
+                elif url.path.startswith("/api/v1/templates/"):
+                    # YAML creation templates per kind (the reference web
+                    # UI ships web/components/lib/templates/*.yaml)
+                    from kube_scheduler_simulator_tpu.server.webui import TEMPLATES_YAML
+
+                    kind = url.path.rsplit("/", 1)[1]
+                    if kind in TEMPLATES_YAML:
+                        self._send_yaml(200, TEMPLATES_YAML[kind], raw=True)
+                    else:
+                        self._send_json(404, {"message": f"no template for {kind}"})
                 elif m := _RESOURCE_RE.match(url.path):
                     kind, name = m.group(1), m.group(2)
                     ns = (q.get("namespace") or [None])[0]
+                    as_yaml = (q.get("format") or [""])[0] == "yaml"
                     if kind not in KINDS:
                         self._send_json(404, {"message": f"unknown resource kind {kind}"})
                     elif name is None:
-                        self._send_json(200, {"items": di.cluster_store.list(kind, ns)})
+                        obj = {"items": di.cluster_store.list(kind, ns)}
+                        self._send_yaml(200, obj) if as_yaml else self._send_json(200, obj)
                     else:
-                        self._send_json(200, di.cluster_store.get(kind, name, ns))
+                        obj = di.cluster_store.get(kind, name, ns)
+                        self._send_yaml(200, obj) if as_yaml else self._send_json(200, obj)
                 else:
                     self._send_json(404, {"message": "not found"})
             except NotFoundError as e:

@@ -7,9 +7,10 @@ SURVEY.md §2.2) as a single static page (no build step, no node_modules):
   "unscheduled"), mirroring web/store/pod.ts:12-50
 - per-kind DATA TABLES for every kind (the reference's
   web/components/ResourceViews/DataTables), toggled with the cluster view
-- create resources from editable YAML-ish JSON templates
-  (web/components/lib/templates/*); EDIT any object as JSON and apply
-  (server-side-apply analog, the reference's monaco editor role)
+- create resources from editable YAML templates served by the backend
+  (web/components/lib/templates/*), POSTed as application/yaml; EDIT any
+  object as YAML and apply (?format=yaml GET + YAML PUT — the reference's
+  monaco editor role, no client-side YAML lib)
 - per-pod scheduling-result dialog rendering every
   scheduler-simulator/* annotation, with the result-history annotation
   expanded into a per-attempt viewer (the reference's result dialog)
@@ -78,12 +79,17 @@ const state = Object.fromEntries(KINDS.map(k=>[k,{}]));
 const dlg = document.getElementById("dlg");
 const key = o => (o.metadata.namespace? o.metadata.namespace+"/" : "") + o.metadata.name;
 
-async function api(method, path, body) {
-  const r = await fetch(path, {method, headers:{"Content-Type":"application/json"},
-                               body: body===undefined? undefined : JSON.stringify(body)});
+async function api(method, path, body, ctype) {
+  // JSON round-trip by default; string bodies pass through raw (the YAML
+  // create/edit paths set ctype="application/yaml"), and non-JSON
+  // responses (?format=yaml, templates) come back as text
+  const raw = typeof body === "string";
+  const r = await fetch(path, {method, headers:{"Content-Type": ctype || "application/json"},
+                               body: body===undefined? undefined : (raw? body : JSON.stringify(body))});
   const text = await r.text();
   if (!r.ok) throw new Error(text || r.status);
-  return text ? JSON.parse(text) : null;
+  if (!text) return null;
+  return (r.headers.get("Content-Type")||"").includes("json") ? JSON.parse(text) : text;
 }
 
 async function refreshAll() {
@@ -283,21 +289,24 @@ function editButton(kind, o) {
   return p;
 }
 
-function editObject(kind, o) {
+async function editObject(kind, o) {
+  // YAML round-trip through the backend (?format=yaml GET, YAML PUT) —
+  // the reference's monaco editor role, no client-side YAML lib needed
+  const ns = (o.metadata||{}).namespace;
+  const path = `/api/v1/resources/${kind}/${o.metadata.name}` + (ns?`?namespace=${ns}`:"");
+  const yamlText = await api("GET", path + (ns?"&":"?") + "format=yaml");
   const body = document.getElementById("dlgbody");
-  body.innerHTML = `<h2>Edit ${esc(kind)} / ${esc(key(o))}</h2>`;
+  body.innerHTML = `<h2>Edit ${esc(kind)} / ${esc(key(o))} (YAML)</h2>`;
   const ta = document.createElement("textarea");
   ta.id = "editbody";
-  ta.value = JSON.stringify(o, null, 2);
+  ta.value = yamlText;
   ta.style.minHeight = "340px";
   body.appendChild(ta);
   const b = document.createElement("button");
   b.textContent = "Apply";
   b.addEventListener("click", async () => {
     try {
-      const obj = JSON.parse(ta.value);
-      const ns = (obj.metadata||{}).namespace;
-      await api("PUT", `/api/v1/resources/${kind}/${obj.metadata.name}` + (ns?`?namespace=${ns}`:""), obj);
+      await api("PUT", path, ta.value, "application/yaml");
       dlg.close();
     } catch (e) { alert(e.message); }
   });
@@ -313,31 +322,30 @@ async function del(kind, k) {
   dlg.close();
 }
 
-const TEMPLATES = {
-  pods: {kind:"Pod", metadata:{name:"pod-1", namespace:"default"}, spec:{containers:[{name:"c", resources:{requests:{cpu:"100m", memory:"128Mi"}}}]}},
-  nodes: {kind:"Node", metadata:{name:"node-1", labels:{"kubernetes.io/hostname":"node-1","topology.kubernetes.io/zone":"zone-a"}}, status:{allocatable:{cpu:"4", memory:"8Gi", pods:"110"}}},
-  deployments: {kind:"Deployment", metadata:{name:"dep-1", namespace:"default"}, spec:{replicas:3, selector:{matchLabels:{app:"dep-1"}}, template:{metadata:{labels:{app:"dep-1"}}, spec:{containers:[{name:"c", resources:{requests:{cpu:"100m"}}}]}}}},
-  persistentvolumes: {kind:"PersistentVolume", metadata:{name:"pv-1"}, spec:{capacity:{storage:"10Gi"}, accessModes:["ReadWriteOnce"], storageClassName:"standard"}},
-  persistentvolumeclaims: {kind:"PersistentVolumeClaim", metadata:{name:"pvc-1", namespace:"default"}, spec:{accessModes:["ReadWriteOnce"], storageClassName:"standard", resources:{requests:{storage:"1Gi"}}}},
-  storageclasses: {kind:"StorageClass", metadata:{name:"standard"}, provisioner:"kubernetes.io/no-provisioner"},
-  priorityclasses: {kind:"PriorityClass", metadata:{name:"high-priority"}, value:1000},
-  namespaces: {kind:"Namespace", metadata:{name:"team-a"}},
-};
+// Creation templates are YAML served by the backend (the reference ships
+// web/components/lib/templates/*.yaml); bodies POST as application/yaml.
+const TEMPLATE_KINDS = ["pods","nodes","deployments","persistentvolumes","persistentvolumeclaims","storageclasses","priorityclasses","namespaces"];
 
-function newResource() {
-  const opts = Object.keys(TEMPLATES).map(k=>`<option>${k}</option>`).join("");
+async function loadTemplate(kind) {
+  document.getElementById("newbody").value = await api("GET", `/api/v1/templates/${kind}`);
+}
+
+async function newResource() {
+  const opts = TEMPLATE_KINDS.map(k=>`<option>${k}</option>`).join("");
   document.getElementById("dlgbody").innerHTML =
-    `<h2>Create resource</h2>
-     <p><select id="newkind" onchange="document.getElementById('newbody').value=JSON.stringify(TEMPLATES[this.value],null,2)">${opts}</select></p>
-     <textarea id="newbody">${esc(JSON.stringify(TEMPLATES.pods,null,2))}</textarea>
+    `<h2>Create resource (YAML)</h2>
+     <p><select id="newkind" onchange="loadTemplate(this.value)">${opts}</select></p>
+     <textarea id="newbody"></textarea>
      <p><button onclick="createResource()">Create</button></p>`;
+  await loadTemplate("pods");
   dlg.showModal();
 }
 
 async function createResource() {
   const kind = document.getElementById("newkind").value;
   try {
-    await api("POST", `/api/v1/resources/${kind}`, JSON.parse(document.getElementById("newbody").value));
+    await api("POST", `/api/v1/resources/${kind}`,
+              document.getElementById("newbody").value, "application/yaml");
     dlg.close();
   } catch (e) { alert(e.message); }
 }
@@ -428,3 +436,93 @@ refreshAll().then(() => { watchLoop(); pollWorkloads(); });
 </body>
 </html>
 """
+
+# YAML creation templates per store kind, served at /api/v1/templates/{kind}
+# (the role of the reference's web/components/lib/templates/*.yaml files).
+# generateName is honored by the store with a deterministic counter suffix.
+TEMPLATES_YAML = {
+    "pods": """metadata:
+  generateName: pod-
+  namespace: default
+  labels: {}
+spec:
+  containers:
+    - name: main
+      image: registry.k8s.io/pause:3.5
+      resources:
+        requests:
+          cpu: 100m
+          memory: 128Mi
+  restartPolicy: Always
+""",
+    "nodes": """metadata:
+  generateName: node-
+  labels:
+    topology.kubernetes.io/zone: zone-a
+spec: {}
+status:
+  capacity:
+    cpu: "4"
+    memory: 32Gi
+    pods: "110"
+  allocatable:
+    cpu: "4"
+    memory: 32Gi
+    pods: "110"
+""",
+    "deployments": """metadata:
+  generateName: deployment-
+  namespace: default
+spec:
+  replicas: 3
+  selector:
+    matchLabels:
+      app: example
+  template:
+    metadata:
+      labels:
+        app: example
+    spec:
+      containers:
+        - name: main
+          resources:
+            requests:
+              cpu: 100m
+              memory: 128Mi
+""",
+    "persistentvolumes": """metadata:
+  generateName: pv-
+spec:
+  capacity:
+    storage: 1Gi
+  accessModes:
+    - ReadWriteOnce
+  persistentVolumeReclaimPolicy: Delete
+  storageClassName: standard
+""",
+    "persistentvolumeclaims": """metadata:
+  generateName: pvc-
+  namespace: default
+spec:
+  accessModes:
+    - ReadWriteOnce
+  storageClassName: standard
+  resources:
+    requests:
+      storage: 1Gi
+""",
+    "storageclasses": """metadata:
+  generateName: storageclass-
+provisioner: kubernetes.io/no-provisioner
+volumeBindingMode: WaitForFirstConsumer
+reclaimPolicy: Delete
+""",
+    "priorityclasses": """metadata:
+  generateName: priorityclass-
+value: 1000000
+globalDefault: false
+""",
+    "namespaces": """metadata:
+  generateName: namespace-
+""",
+}

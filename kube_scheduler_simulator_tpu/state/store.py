@@ -121,6 +121,20 @@ def _rfc3339(ts: float) -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
 
 
+# kube's generateName suffix alphabet (no vowels/ambiguous chars)
+_SUFFIX_ALPHABET = "bcdfghjklmnpqrstvwxz2456789"
+
+
+def _name_suffix(n: int) -> str:
+    """5-char generateName suffix derived from a counter (deterministic,
+    unlike the apiserver's random draw — scenario replay needs it)."""
+    out = []
+    for _ in range(5):
+        out.append(_SUFFIX_ALPHABET[n % len(_SUFFIX_ALPHABET)])
+        n //= len(_SUFFIX_ALPHABET)
+    return "".join(out)
+
+
 class ClusterStore:
     """Single-process cluster state for the seven simulator resource kinds."""
 
@@ -129,6 +143,7 @@ class ClusterStore:
         self._objs: dict[str, dict[str, Obj]] = {k: {} for k in KINDS}
         self._rv = 0
         self._uid_counter = 0
+        self._generate_name_counter = 0
         self._clock = clock or time.time
         self._event_log: dict[str, deque[Event]] = {k: deque(maxlen=event_log_size) for k in KINDS}
         self._evicted_rv: dict[str, int] = {k: 0 for k in KINDS}
@@ -235,6 +250,19 @@ class ClusterStore:
             meta = o.setdefault("metadata", {})
             if kind in NAMESPACED_KINDS:
                 meta.setdefault("namespace", "default")
+            if not meta.get("name") and meta.get("generateName"):
+                # apiserver generateName semantics (the reference UI's
+                # creation templates rely on it) with a counter-derived
+                # suffix instead of a random one: scenario replay must be
+                # deterministic (keps/140 determinism rules)
+                n = self._generate_name_counter
+                while True:
+                    cand = meta["generateName"] + _name_suffix(n)
+                    n += 1
+                    if _key({"metadata": {**meta, "name": cand}}) not in bucket:
+                        break
+                self._generate_name_counter = n
+                meta["name"] = cand
             k = _key(o)
             if not meta.get("name"):
                 raise ValueError(f"{kind} object has no metadata.name")

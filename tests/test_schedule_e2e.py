@@ -840,3 +840,27 @@ class TestNominatedPods:
         # incoming sorts first; it must avoid node-0 (nominee's node)
         assert results["default/incoming"].selected_node == "node-1"
         assert results["default/nominee"].selected_node == "node-0"
+
+
+def test_result_history_splice_and_foreign_values():
+    """History appends splice byte-identically to parse-append for our own
+    output, and foreign/corrupt values (imported snapshots, user edits)
+    reset to a valid single-entry array instead of being spliced onto."""
+    import json
+
+    from kube_scheduler_simulator_tpu.plugins.storereflector import _updated_history
+
+    attempt1 = {"scheduler-simulator/selected-node": "node-a", "scheduler-simulator/bind-result": '{"DefaultBinder":"success"}'}
+    attempt2 = {"scheduler-simulator/selected-node": "node-b"}
+    h1 = _updated_history(None, attempt1)
+    # trusted splice == parse-append byte-for-byte
+    spliced = _updated_history(h1, attempt2, trusted=True)
+    parsed = json.loads(h1)
+    parsed.append({k: v for k, v in attempt2.items()})
+    from kube_scheduler_simulator_tpu.utils.gojson import go_marshal
+
+    assert spliced == go_marshal(parsed)
+    # untrusted corrupt-but-shape-matching value resets, never splices
+    for bad in ('[{not json}]', "[ ]", '{"a":1}', "garbage"):
+        out = _updated_history(bad, attempt2, trusted=False)
+        assert json.loads(out) == [attempt2]

@@ -184,3 +184,57 @@ def test_metrics_endpoint(server):
     # /metrics is an alias
     with urllib.request.urlopen(f"http://127.0.0.1:{server.port}/metrics", timeout=10) as resp:
         assert resp.status == 200
+
+
+def test_yaml_resource_surface(server):
+    """YAML-first UI contract: templates endpoint, YAML create
+    (Content-Type: application/yaml), YAML GET (?format=yaml), and
+    apiserver generateName semantics on the store."""
+    import urllib.request
+
+    # template is valid YAML with generateName
+    url = f"http://127.0.0.1:{server.port}/api/v1/templates/nodes"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        text = r.read().decode()
+        assert "generateName: node-" in text
+    import yaml
+
+    tpl = yaml.safe_load(text)
+    assert tpl["status"]["allocatable"]["cpu"]
+
+    # create a node FROM the yaml template via application/yaml POST
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/api/v1/resources/nodes",
+        data=text.encode(),
+        method="POST",
+        headers={"Content-Type": "application/yaml"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        created = json.loads(r.read())
+    name = created["metadata"]["name"]
+    assert name.startswith("node-") and len(name) == len("node-") + 5
+
+    # a second create generates a DIFFERENT deterministic name
+    with urllib.request.urlopen(req, timeout=10) as r:
+        second = json.loads(r.read())
+    assert second["metadata"]["name"] != name
+
+    # YAML read-back of the object
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/api/v1/resources/nodes/{name}?format=yaml", timeout=10
+    ) as r:
+        assert r.headers["Content-Type"].startswith("application/yaml")
+        obj = yaml.safe_load(r.read())
+    assert obj["metadata"]["name"] == name
+
+    # YAML PUT (the UI's edit-as-YAML apply path)
+    obj["metadata"].setdefault("labels", {})["edited"] = "yes"
+    put = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/api/v1/resources/nodes/{name}",
+        data=yaml.safe_dump(obj).encode(),
+        method="PUT",
+        headers={"Content-Type": "application/yaml"},
+    )
+    with urllib.request.urlopen(put, timeout=10) as r:
+        updated = json.loads(r.read())
+    assert updated["metadata"]["labels"]["edited"] == "yes"
