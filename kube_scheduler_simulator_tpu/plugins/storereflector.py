@@ -31,11 +31,12 @@ class StoreReflector:
         self._stores: dict[str, Any] = {}
         self._in_flush: set[str] = set()
         self._pending: dict[str, Obj] = {}
-        # pod keys whose result-history this reflector has written since
-        # boot: their annotation is our own compact output, safe for the
-        # byte-splice append; anything else (imported snapshots, foreign
-        # annotations) gets parse-validated once first
-        self._history_written: set[str] = set()
+        # pod key → length of the result-history value this reflector
+        # last wrote.  Trust for the byte-splice append requires the
+        # CURRENT value to match that length: a user/import replacing the
+        # annotation (even with a shape-matching corrupt value) almost
+        # surely changes the length, dropping back to parse-validation.
+        self._history_written: dict[str, int] = {}
 
     def add_result_store(self, store: Any, key: str) -> None:
         self._stores[key] = store
@@ -116,14 +117,16 @@ class StoreReflector:
                 return
             annotations = dict(fresh["metadata"].get("annotations") or {})
             annotations.update(merged)
-            annotations[anno.RESULT_HISTORY] = _updated_history(
-                (fresh["metadata"].get("annotations") or {}).get(anno.RESULT_HISTORY),
+            existing = (fresh["metadata"].get("annotations") or {}).get(anno.RESULT_HISTORY)
+            new_history = _updated_history(
+                existing,
                 merged,
-                trusted=key in self._history_written,
+                trusted=self._history_written.get(key) == len(existing or ""),
             )
+            annotations[anno.RESULT_HISTORY] = new_history
             fresh["metadata"]["annotations"] = annotations
             cluster_store.update("pods", fresh)
-            self._history_written.add(key)
+            self._history_written[key] = len(new_history)
 
         self._in_flush.add(key)
         try:

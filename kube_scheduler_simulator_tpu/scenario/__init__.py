@@ -7,6 +7,7 @@ is implemented here as a first-class engine over the in-memory store.
 """
 
 from kube_scheduler_simulator_tpu.scenario.engine import ScenarioEngine
+from kube_scheduler_simulator_tpu.scenario.operator import ScenarioOperator
 from kube_scheduler_simulator_tpu.scenario.result import allocation_rate, node_utilization
 
-__all__ = ["ScenarioEngine", "allocation_rate", "node_utilization"]
+__all__ = ["ScenarioEngine", "ScenarioOperator", "allocation_rate", "node_utilization"]

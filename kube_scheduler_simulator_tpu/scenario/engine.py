@@ -27,6 +27,7 @@ detected when a full pass makes no progress (README.md:371-381).
 from __future__ import annotations
 
 import copy
+import threading
 from typing import Any
 
 from kube_scheduler_simulator_tpu.scenario.result import allocation_rate, node_utilization
@@ -43,6 +44,14 @@ class ScenarioError(Exception):
     pass
 
 
+def _major_of(step: Any) -> int:
+    """An operation's MajorStep — the KEP's ``step: {major: N}`` shape
+    (README.md:176-183) or a bare int."""
+    if isinstance(step, dict):
+        return int(step.get("major") or 0)
+    return int(step or 0)
+
+
 def _store_kind(type_meta: "Obj | str") -> str:
     """Map a TypeMeta kind ("Pod") or store kind ("pods") to a store kind."""
     kind = type_meta.get("kind") if isinstance(type_meta, dict) else type_meta
@@ -54,6 +63,12 @@ def _store_kind(type_meta: "Obj | str") -> str:
 
 
 class ScenarioEngine:
+    # Process-wide: a scenario run owns the cluster (KEP determinism —
+    # concurrent operations are forbidden, README.md:600-610); the
+    # operator's worker and the synchronous REST route both run under
+    # this lock so two runs can never interleave wipes/replays.
+    RUN_LOCK = threading.RLock()
+
     def __init__(self, cluster_store: Any, scheduler_service: Any, controller_manager: Any = None):
         self.store = cluster_store
         self.scheduler = scheduler_service
@@ -75,18 +90,22 @@ class ScenarioEngine:
         # Determinism (README.md:600-610): the scenario owns the cluster —
         # pause the always-on scheduler loop (manual/concurrent operations
         # are forbidden during a scenario) and start from an empty state.
-        was_background = getattr(self.scheduler, "is_background_running", lambda: False)()
-        if was_background:
-            self.scheduler.stop_background()
-        try:
-            return self._run_steps(scenario, status, timeline)
-        finally:
+        with self.RUN_LOCK:
+            was_background = getattr(self.scheduler, "is_background_running", lambda: False)()
             if was_background:
-                self.scheduler.start_background()
+                self.scheduler.stop_background()
+            try:
+                return self._run_steps(scenario, status, timeline)
+            finally:
+                if was_background:
+                    self.scheduler.start_background()
 
     def _run_steps(self, scenario: Obj, status: Obj, timeline: dict) -> Obj:
         spec = scenario.get("spec") or {}
-        self.store.restore({})
+        # Wipe the simulated cluster but PRESERVE Scenario objects: they
+        # are operator bookkeeping, not cluster resources — wiping them
+        # would silently delete scenarios queued behind this run.
+        self.store.restore({"scenarios": self.store.list("scenarios")})
 
         ops = list(spec.get("operations") or [])
         for op in ops:
@@ -102,7 +121,7 @@ class ScenarioEngine:
 
         by_major: dict[int, list[Obj]] = {}
         for op in ops:
-            by_major.setdefault(int(op.get("step", 0)), []).append(op)
+            by_major.setdefault(_major_of(op.get("step", 0)), []).append(op)
 
         minor = 0
         done = False
@@ -160,7 +179,8 @@ class ScenarioEngine:
         if op.get("createOperation") is not None:
             create = op["createOperation"]
             obj = create.get("object") or {}
-            kind = _store_kind(obj)
+            # KEP shape carries TypeMeta beside the object; accept either
+            kind = _store_kind(create.get("typeMeta") or obj)
             result = self.store.create(kind, obj)
             return {"id": oid, "step": step, "create": {"operation": create, "result": result}}, False
         if op.get("patchOperation") is not None:

@@ -36,6 +36,15 @@ class DIContainer:
         self._controller_manager.start()
         self._scheduler_service = SchedulerService(self.cluster_store, seed=seed, use_batch=use_batch)
         self._scheduler_service.start_scheduler(initial_scheduler_cfg)
+        # KEP-140 operator: reconciles Scenario OBJECTS (created via the
+        # kube-API group or resource routes) into finished runs; the
+        # synchronous POST /api/v1/scenarios path works without it.
+        from kube_scheduler_simulator_tpu.scenario import ScenarioOperator
+
+        self._scenario_operator = ScenarioOperator(
+            self.cluster_store, self._scheduler_service, self._controller_manager
+        )
+        self._scenario_operator.start()
         self._snapshot_service = SnapshotService(self.cluster_store, self._scheduler_service)
         # Reset captures the post-boot state (reference NewDIContainer order:
         # reset service is built at boot, capturing the initial keyspace).
@@ -46,6 +55,16 @@ class DIContainer:
             if external_snap_source is not None
             else None
         )
+
+    def scenario_operator(self):
+        return self._scenario_operator
+
+    def close(self) -> None:
+        """Tear down the container's background machinery (operator worker
+        thread + store subscriptions, controllers, scheduler loop)."""
+        self._scenario_operator.stop()
+        self._controller_manager.stop()
+        self._scheduler_service.stop_background()
 
     def scheduler_service(self) -> SchedulerService:
         return self._scheduler_service

@@ -59,6 +59,9 @@ GROUP_RESOURCES = (
     ("apps", "v1", "deployments", "Deployment", "deployments"),
     ("apps", "v1", "replicasets", "ReplicaSet", "replicasets"),
     ("policy", "v1", "poddisruptionbudgets", "PodDisruptionBudget", "poddisruptionbudgets"),
+    # KEP-140 Scenario CRD surface (reference scenario/api/v1alpha1);
+    # reconciled by scenario/operator.py
+    ("simulation.kube-scheduler-simulator.sigs.k8s.io", "v1alpha1", "scenarios", "Scenario", "scenarios"),
 )
 ALL_RESOURCES = CORE_RESOURCES + GROUP_RESOURCES
 _BY_RESOURCE = {r[2]: r for r in ALL_RESOURCES}
@@ -113,31 +116,31 @@ def resolve(path: str) -> "_Route | None":
 
 def discovery_document(path: str) -> "Obj | None":
     parts = [p for p in path.split("/") if p]
+    group_versions: dict[str, str] = {g: v for g, v, *_ in GROUP_RESOURCES}
     if parts == ["api"]:
         return {"kind": "APIVersions", "versions": ["v1"]}
     if parts == ["apis"]:
-        groups = sorted({g for g, *_ in GROUP_RESOURCES})
         return {
             "kind": "APIGroupList",
             "apiVersion": "v1",
             "groups": [
                 {
                     "name": g,
-                    "versions": [{"groupVersion": f"{g}/v1", "version": "v1"}],
-                    "preferredVersion": {"groupVersion": f"{g}/v1", "version": "v1"},
+                    "versions": [{"groupVersion": f"{g}/{v}", "version": v}],
+                    "preferredVersion": {"groupVersion": f"{g}/{v}", "version": v},
                 }
-                for g in groups
+                for g, v in sorted(group_versions.items())
             ],
         }
-    if parts == ["api", "v1"] or (len(parts) == 3 and parts[0] == "apis" and parts[2] == "v1"):
+    if parts == ["api", "v1"] or (
+        len(parts) == 3 and parts[0] == "apis" and group_versions.get(parts[1]) == parts[2]
+    ):
         if parts[0] == "api":
             rows = [r for r in CORE_RESOURCES]
             gv = "v1"
         else:
             rows = [r for r in GROUP_RESOURCES if r[0] == parts[1]]
-            if not rows:
-                return None
-            gv = f"{parts[1]}/v1"
+            gv = f"{parts[1]}/{parts[2]}"
         return {
             "kind": "APIResourceList",
             "groupVersion": gv,

@@ -112,7 +112,7 @@ class SimulatorServer:
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd = None
-        self.di.scheduler_service().stop_background()
+        self.di.close()
 
 
 def _make_handler(server: SimulatorServer):

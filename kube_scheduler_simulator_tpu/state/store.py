@@ -56,9 +56,13 @@ KINDS: tuple[str, ...] = (
     # real apiserver serves these natively
     "poddisruptionbudgets",
     "csinodes",
+    # KEP-140 Scenario objects (the reference scaffolds them as a CRD,
+    # scenario/api/v1alpha1/scenario_types.go); the ScenarioOperator
+    # reconciles them
+    "scenarios",
 )
 NAMESPACED_KINDS: frozenset[str] = frozenset(
-    {"pods", "persistentvolumeclaims", "deployments", "replicasets", "poddisruptionbudgets"}
+    {"pods", "persistentvolumeclaims", "deployments", "replicasets", "poddisruptionbudgets", "scenarios"}
 )
 
 KIND_NAMES: dict[str, str] = {
@@ -73,6 +77,7 @@ KIND_NAMES: dict[str, str] = {
     "replicasets": "ReplicaSet",
     "poddisruptionbudgets": "PodDisruptionBudget",
     "csinodes": "CSINode",
+    "scenarios": "Scenario",
 }
 
 EVENT_ADDED = "ADDED"
@@ -462,6 +467,9 @@ class ClusterStore:
             for kind in apply_order:
                 for o in data.get(kind, []):
                     self.apply(kind, o)
+            # same wholesale state → same generated names afterwards
+            # (scenario replay determinism depends on it)
+            self._generate_name_counter = 0
 
 
 def _merge(dst: dict[str, Any], patch: Mapping[str, Any]) -> None:

@@ -49,7 +49,13 @@ def test_discovery_documents(server):
     names = {r["name"] for r in core["resources"]}
     assert {"pods", "nodes", "namespaces", "persistentvolumes", "pods/binding"} <= names
     code, groups = _req(p, "GET", "/apis")
-    assert {g["name"] for g in groups["groups"]} == {"apps", "policy", "scheduling.k8s.io", "storage.k8s.io"}
+    assert {g["name"] for g in groups["groups"]} == {
+        "apps",
+        "policy",
+        "scheduling.k8s.io",
+        "storage.k8s.io",
+        "simulation.kube-scheduler-simulator.sigs.k8s.io",
+    }
     code, storage = _req(p, "GET", "/apis/storage.k8s.io/v1")
     assert {r["name"] for r in storage["resources"]} == {"storageclasses", "csinodes"}
 
