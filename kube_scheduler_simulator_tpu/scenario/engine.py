@@ -104,8 +104,10 @@ class ScenarioEngine:
         spec = scenario.get("spec") or {}
         # Wipe the simulated cluster but PRESERVE Scenario objects: they
         # are operator bookkeeping, not cluster resources — wiping them
-        # would silently delete scenarios queued behind this run.
-        self.store.restore({"scenarios": self.store.list("scenarios")})
+        # would silently delete scenarios queued behind this run.  The
+        # preserve happens atomically inside restore (a list-then-restore
+        # snapshot would race scenarios created in the gap).
+        self.store.restore({}, preserve=("scenarios",))
 
         ops = list(spec.get("operations") or [])
         for op in ops:

@@ -17,12 +17,12 @@ Lifecycle notes:
   a dedicated worker thread — a scenario run mutates the whole store
   (KEP determinism: all resources are deleted at scenario start,
   README.md:600-610), which must never happen inside an event callback.
-- The scenario wipe preserves Scenario OBJECTS (they are the operator's
-  bookkeeping, not simulated cluster resources — engine.run restores
-  them), so concurrently created scenarios survive an in-flight run and
-  get their turn.  Results write back as ``.status``; terminal phases
-  (Succeeded / Failed / Paused) are never auto-re-run, so the status
-  write does not loop.
+- The scenario wipe preserves Scenario OBJECTS in place, atomically
+  (they are the operator's bookkeeping, not simulated cluster resources
+  — ``store.restore(preserve=("scenarios",))``), so concurrently created
+  scenarios survive an in-flight run and get their turn.  Results write
+  back as ``.status``; terminal phases (Succeeded / Failed / Paused) are
+  never auto-re-run, so the status write does not loop.
 - Scenario runs serialize on ``ScenarioEngine.RUN_LOCK`` — the
   synchronous ``POST /api/v1/scenarios`` route shares it, so an operator
   reconcile and a REST run can never interleave their wipes/replays.
@@ -73,6 +73,11 @@ class ScenarioOperator:
         if self._thread is not None:
             self._queue.put(None)
             self._thread.join(timeout=10)
+            if self._thread.is_alive():
+                # a long scenario replay is still in flight: keep the
+                # thread reference so start() cannot spawn a duplicate
+                # worker; this one exits at the sentinel when the run ends
+                return
             self._thread = None
 
     def wait_idle(self, timeout: float = 30.0) -> None:
@@ -114,18 +119,21 @@ class ScenarioOperator:
                     continue  # deleted (or wiped by an earlier run) meanwhile
                 if not self._should_run(obj):
                     continue
-                try:
-                    finished = self.engine.run(obj)
-                except Exception as e:  # scenario bug: record the failure
-                    finished = dict(obj)
-                    finished["status"] = {"phase": "Failed", "message": f"{type(e).__name__}: {e}"}
-                # the run wiped the simulated cluster but PRESERVED
-                # Scenario objects (engine.run restores them) — write the
-                # result back as .status
-                try:
-                    self.store.patch("scenarios", name, {"status": finished["status"]}, ns)
-                except KeyError:
-                    pass  # deleted while running
+                # run AND status write-back under the run lock: a
+                # concurrent run starting between them could observe the
+                # scenario without its terminal status
+                with ScenarioEngine.RUN_LOCK:
+                    try:
+                        finished = self.engine.run(obj)
+                    except Exception as e:  # scenario bug: record the failure
+                        finished = dict(obj)
+                        finished["status"] = {"phase": "Failed", "message": f"{type(e).__name__}: {e}"}
+                    # the run wiped the simulated cluster but PRESERVED
+                    # Scenario objects — write the result back as .status
+                    try:
+                        self.store.patch("scenarios", name, {"status": finished["status"]}, ns)
+                    except KeyError:
+                        pass  # deleted while running
                 self.runs += 1
             finally:
                 self._queue.task_done()

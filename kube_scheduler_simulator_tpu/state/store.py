@@ -432,21 +432,32 @@ class ClusterStore:
         with self._lock:
             return {k: [copy.deepcopy(o) for _, o in sorted(b.items())] for k, b in self._objs.items()}
 
-    def restore(self, data: Mapping[str, list[Obj]]) -> None:
+    def restore(self, data: Mapping[str, list[Obj]], preserve: "Iterable[str]" = ()) -> None:
         """Wholesale state replacement (reset-service restore path,
         reference simulator/reset/reset.go:57-84).
 
         Deletion runs owners-first (deployments → replicasets → pods …) so
         the synchronous controller manager can't resurrect owned objects
-        mid-teardown."""
-        delete_order = ("deployments", "replicasets") + tuple(
-            k for k in KINDS if k not in ("deployments", "replicasets")
+        mid-teardown.  ``preserve`` kinds are left COMPLETELY untouched —
+        atomically, under the store lock (the scenario engine preserves
+        Scenario objects through its cluster wipe this way; a
+        snapshot-then-restore would race concurrent creates)."""
+        preserved = frozenset(preserve)
+        delete_order = tuple(
+            k
+            for k in ("deployments", "replicasets")
+            + tuple(k for k in KINDS if k not in ("deployments", "replicasets"))
+            if k not in preserved
         )
         # Apply dependencies first: namespaces and priorityclasses before
         # pods (Priority admission resolves priorityClassName at pod
         # create, so a payload carrying both must land the class first).
         apply_first = ("namespaces", "priorityclasses")
-        apply_order = apply_first + tuple(k for k in KINDS if k not in apply_first)
+        apply_order = tuple(
+            k
+            for k in apply_first + tuple(k for k in KINDS if k not in apply_first)
+            if k not in preserved
+        )
         with self._lock:
             for kind in delete_order:
                 # Delete everything not in the target state.  Key

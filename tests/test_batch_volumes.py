@@ -289,3 +289,98 @@ def test_volume_workload_no_longer_forces_fallback():
     pending = fw.sort_pods(svc.pending_pods())
     ok, why = eng.supported(pending, store.list("nodes"))
     assert ok, why
+
+
+def test_mixed_everything_differential_full_default_profile():
+    """Cross-feature differential: one workload exercising EVERY kernel
+    family at once — volumes (bound/WFC PVCs, gce conflicts, CSI limits),
+    host ports, images, taints, node+inter-pod affinity, spread — through
+    the FULL default profile with feasible-node sampling off, batch vs
+    sequential byte-identical annotations and placements."""
+    import random
+
+    def build_store():
+        rng = random.Random(4242)  # seeded per build: both stores identical
+        store = ClusterStore()
+        store.create("storageclasses", mk_sc("wfc", binding_mode="WaitForFirstConsumer"))
+        store.create(
+            "persistentvolumes",
+            mk_pv(
+                "pv-pinned",
+                labels={"topology.kubernetes.io/zone": "z0"},
+                node_affinity={
+                    "nodeSelectorTerms": [
+                        {"matchExpressions": [{"key": "disk", "operator": "In", "values": ["ssd"]}]}
+                    ]
+                },
+            ),
+        )
+        store.create("persistentvolumeclaims", mk_pvc("claim-pinned", volume_name="pv-pinned"))
+        for c in range(4):
+            store.create("persistentvolumeclaims", mk_pvc(f"claim-wfc-{c}", storage_class="wfc"))
+        for i in range(12):
+            node = mk_node(
+                f"node-{i}",
+                8000,
+                16384,
+                labels={
+                    "topology.kubernetes.io/zone": f"z{i % 3}",
+                    "kubernetes.io/hostname": f"node-{i}",
+                    "disk": "ssd" if i % 2 else "hdd",
+                },
+                taints=[{"key": "spot", "value": "t", "effect": "PreferNoSchedule"}] if i % 5 == 0 else None,
+            )
+            node["status"]["images"] = (
+                [{"names": [f"img-{i % 2}:v1"], "sizeBytes": 400 * 1024 * 1024}] if i % 3 == 0 else []
+            )
+            store.create("nodes", node)
+            store.create("csinodes", mk_csinode(f"node-{i}", "csi.example.com", 2))
+        for i in range(36):
+            p = mk_pod(
+                f"pod-{i}",
+                cpu_m=rng.choice([100, 250, 500]),
+                mem_mi=rng.choice([128, 256]),
+                labels={"app": f"app-{i % 4}"},
+            )
+            spec = p["spec"]
+            spec["containers"][0]["image"] = f"img-{i % 2}:v1"
+            if i % 6 == 0:
+                spec["volumes"] = [pvc_volume("claim-pinned")]
+            elif i % 6 == 1:
+                spec["volumes"] = [pvc_volume(f"claim-wfc-{i % 4}")]
+            elif i % 6 == 2:
+                spec["volumes"] = [
+                    {"name": "d", "gcePersistentDisk": {"pdName": f"disk-{i % 3}", "readOnly": i % 2 == 0}}
+                ]
+            if i % 7 == 0:
+                spec["containers"][0]["ports"] = [{"containerPort": 80, "hostPort": 8000 + (i % 3)}]
+            if i % 4 == 0:
+                spec["nodeSelector"] = {"disk": "ssd"}
+            if i % 3 == 0:
+                spec["topologySpreadConstraints"] = [
+                    {
+                        "maxSkew": 3,
+                        "topologyKey": "topology.kubernetes.io/zone",
+                        "whenUnsatisfiable": "DoNotSchedule",
+                        "labelSelector": {"matchLabels": {"app": f"app-{i % 4}"}},
+                    }
+                ]
+            if i % 5 == 1:
+                spec["affinity"] = {
+                    "podAntiAffinity": {
+                        "preferredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "weight": 7,
+                                "podAffinityTerm": {
+                                    "labelSelector": {"matchLabels": {"app": f"app-{i % 4}"}},
+                                    "topologyKey": "kubernetes.io/hostname",
+                                },
+                            }
+                        ]
+                    }
+                }
+            store.create("pods", p)
+        return store
+
+    svc = run_both_services(build_store, cfg={"percentageOfNodesToScore": 100})
+    assert svc.stats["batch_pods"] > 0
