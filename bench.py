@@ -161,7 +161,10 @@ def run_config(name, P, N, plugins, spread=False, interpod=False, oracle_sample=
 
     # Baseline: this repo's sequential oracle (stands in for the reference's
     # serialized Go loop, which publishes no numbers) on a subsample,
-    # extrapolated linearly in pods.
+    # extrapolated linearly in pods.  The same subsample doubles as the
+    # BASELINE.md parity columns: with tie_break="first" and the same queue
+    # order, the first `sample` commits evolve identically in both paths,
+    # so selected-node identity and finalscore deltas are exact.
     if oracle_sample:
         sample = min(oracle_sample, P)
         svc2 = SchedulerService(ClusterStore(), tie_break="first")
@@ -170,11 +173,45 @@ def run_config(name, P, N, plugins, spread=False, interpod=False, oracle_sample=
         for p in pods[:sample]:
             svc2.cluster_store.create("pods", p)
         svc2.start_scheduler(cfg)
+        # traced kernel pass over the SAME subsampled cluster (captured
+        # before the sequential run commits bindings)
+        fw2 = svc2.framework
+        pending2 = fw2.sort_pods(svc2.pending_pods())
+        eng2 = BatchEngine.from_framework(fw2, trace=True)
+        res2 = eng2.schedule(
+            svc2.cluster_store.list("nodes"),
+            svc2.cluster_store.list("pods"),
+            pending2,
+            svc2.cluster_store.list("namespaces"),
+        )
         t0 = time.perf_counter()
         svc2.schedule_pending(max_rounds=1)
         seq_s = (time.perf_counter() - t0) * (P / sample)
         out["seq_est_s"] = round(seq_s, 2)
         out["speedup_vs_seq"] = round(seq_s / best, 1)
+        identical = 0
+        max_delta = 0
+        for i, key in enumerate(res2.pod_keys):
+            ns_, name_ = key.split("/", 1)
+            pod = svc2.cluster_store.get("pods", name_, ns_)
+            annos = pod["metadata"].get("annotations") or {}
+            # compare the BINDING (profile-independent; the selected-node
+            # annotation only exists when reserve plugins are enabled)
+            if res2.selected_nodes[i] == (pod.get("spec") or {}).get("nodeName"):
+                identical += 1
+            want_final = json.loads(annos.get("scheduler-simulator/finalscore-result", "{}"))
+            _score, got_final = res2.score_annotations(i)
+            # symmetric: nodes/plugins present in only ONE side count as
+            # a delta vs 0 (a one-directional walk would hide batch-only
+            # divergences)
+            for node_name in set(want_final) | set(got_final):
+                want_row = want_final.get(node_name) or {}
+                got_row = got_final.get(node_name) or {}
+                for plug in set(want_row) | set(got_row):
+                    delta = abs(int(got_row.get(plug, 0)) - int(want_row.get(plug, 0)))
+                    max_delta = max(max_delta, delta)
+        out["parity_selected_identical_pct"] = round(100.0 * identical / sample, 2)
+        out["parity_max_abs_dfinalscore"] = max_delta
     return out
 
 

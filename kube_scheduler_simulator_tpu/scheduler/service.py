@@ -551,7 +551,11 @@ class SchedulerService:
 
         if sel >= 0:
             node_name = result.node_names[sel]
-            rs.add_selected_node(ns, name, node_name)
+            # selected-node is recorded BY the wrapped Reserve hooks
+            # (reference wrappedplugin.go:616-645) — a profile with no
+            # reserve plugins leaves it unset in the sequential path too
+            if point_names["reserve"]:
+                rs.add_selected_node(ns, name, node_name)
             for pn in point_names["reserve"]:
                 rs.add_reserve_result(ns, name, pn, SUCCESS_MESSAGE)
             for pn in point_names["pre_bind"]:

@@ -74,7 +74,7 @@ HTML = r"""<!doctype html>
 </main>
 <dialog id="dlg"><div id="dlgbody"></div><p style="text-align:right"><button onclick="dlg.close()">Close</button></p></dialog>
 <script>
-const KINDS = ["pods","nodes","persistentvolumes","persistentvolumeclaims","storageclasses","priorityclasses","namespaces","deployments","replicasets"];
+const KINDS = ["pods","nodes","persistentvolumes","persistentvolumeclaims","storageclasses","priorityclasses","namespaces","deployments","replicasets","scenarios"];
 const state = Object.fromEntries(KINDS.map(k=>[k,{}]));
 const dlg = document.getElementById("dlg");
 const key = o => (o.metadata.namespace? o.metadata.namespace+"/" : "") + o.metadata.name;
@@ -179,6 +179,9 @@ const TABLE_COLS = {
                 ["replicas", o=>(o.spec||{}).replicas]],
   replicasets: [["namespace", o=>(o.metadata||{}).namespace||""], ["name", o=>o.metadata.name],
                 ["replicas", o=>(o.spec||{}).replicas]],
+  scenarios: [["namespace", o=>(o.metadata||{}).namespace||""], ["name", o=>o.metadata.name],
+              ["phase", o=>(o.status||{}).phase||"(queued)"],
+              ["operations", o=>(((o.spec||{}).operations)||[]).length]],
 };
 
 function renderTables() {
@@ -324,7 +327,7 @@ async function del(kind, k) {
 
 // Creation templates are YAML served by the backend (the reference ships
 // web/components/lib/templates/*.yaml); bodies POST as application/yaml.
-const TEMPLATE_KINDS = ["pods","nodes","deployments","persistentvolumes","persistentvolumeclaims","storageclasses","priorityclasses","namespaces"];
+const TEMPLATE_KINDS = ["pods","nodes","deployments","persistentvolumes","persistentvolumeclaims","storageclasses","priorityclasses","namespaces","scenarios"];
 
 async function loadTemplate(kind) {
   document.getElementById("newbody").value = await api("GET", `/api/v1/templates/${kind}`);
@@ -415,12 +418,12 @@ async function watchLoop() {
   }
 }
 
-// deployments/replicasets are controller-internal kinds the watch stream
-// doesn't carry (it mirrors the reference's 7 kinds) — poll them instead.
+// deployments/replicasets/scenarios are kinds the watch stream doesn't
+// carry (it mirrors the reference's 7 kinds) — poll them instead.
 async function pollWorkloads() {
   for (;;) {
     try {
-      for (const k of ["deployments", "replicasets"]) {
+      for (const k of ["deployments", "replicasets", "scenarios"]) {
         const lst = await api("GET", `/api/v1/resources/${k}`);
         state[k] = {};
         for (const o of lst.items) state[k][key(o)] = o;
@@ -524,5 +527,46 @@ globalDefault: false
 """,
     "namespaces": """metadata:
   generateName: namespace-
+""",
+    "scenarios": """metadata:
+  generateName: scenario-
+  namespace: default
+spec:
+  operations:
+    - id: "1"
+      step:
+        major: 1
+      createOperation:
+        typeMeta:
+          kind: Node
+        object:
+          metadata:
+            generateName: node-
+          status:
+            allocatable:
+              cpu: "4"
+              memory: 32Gi
+              pods: "110"
+    - id: "2"
+      step:
+        major: 2
+      createOperation:
+        typeMeta:
+          kind: Pod
+        object:
+          metadata:
+            generateName: pod-
+            namespace: default
+          spec:
+            containers:
+              - name: main
+                resources:
+                  requests:
+                    cpu: 100m
+                    memory: 128Mi
+    - id: "3"
+      step:
+        major: 3
+      doneOperation: {}
 """,
 }

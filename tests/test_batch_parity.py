@@ -1095,3 +1095,45 @@ def test_nodeports_kernel_parity():
         i = int(key.split("-")[-1])
         if i % 3 == 0 and res.success:
             assert res.selected_node != "node-0", key
+
+
+def test_no_reserve_profile_omits_selected_node_annotation():
+    """selected-node is recorded BY the wrapped Reserve hooks (reference
+    wrappedplugin.go:616-645): a profile with no reserve plugins leaves it
+    unset — on the batch path too (it used to write it unconditionally)."""
+    import json as _json
+
+    from kube_scheduler_simulator_tpu.plugins import annotations as anno
+
+    def build_store():
+        store = ClusterStore()
+        for i in range(4):
+            store.create("nodes", mk_node(f"node-{i}", 4000, 8192))
+        for i in range(8):
+            store.create("pods", mk_pod(f"pod-{i}", cpu_m=100, mem_mi=128))
+        return store
+
+    cfg = {
+        "percentageOfNodesToScore": 100,
+        "profiles": [profile_with(["NodeResourcesFit"])],  # no reserve plugins
+    }
+    store_seq = build_store()
+    svc_seq = SchedulerService(store_seq, tie_break="first", use_batch="off")
+    svc_seq.start_scheduler(cfg)
+    svc_seq.schedule_pending(max_rounds=1)
+
+    store_bat = build_store()
+    svc_bat = SchedulerService(store_bat, tie_break="first", use_batch="auto", batch_min_work=0)
+    svc_bat.start_scheduler(cfg)
+    svc_bat.schedule_pending(max_rounds=1)
+    assert svc_bat.stats["batch_pods"] == 8, svc_bat.stats
+
+    for i in range(8):
+        seq_annos = store_seq.get("pods", f"pod-{i}")["metadata"].get("annotations") or {}
+        bat_annos = store_bat.get("pods", f"pod-{i}")["metadata"].get("annotations") or {}
+        assert seq_annos.get(anno.SELECTED_NODE, "") == ""
+        assert seq_annos == bat_annos, {
+            k: (seq_annos.get(k), bat_annos.get(k))
+            for k in set(seq_annos) | set(bat_annos)
+            if seq_annos.get(k) != bat_annos.get(k)
+        }
