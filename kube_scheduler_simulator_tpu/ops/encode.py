@@ -634,26 +634,59 @@ def encode(
         pod_spread_filter.append(fl)
         pod_spread_score.append(sl)
 
+    # Pod equivalence classes over (label signature, namespace,
+    # terminating): spread/inter-pod selectors see pods only through
+    # these, so each (selector, class) pair is evaluated ONCE and
+    # expanded by indexing — at 10k pods the per-(group × pod) memo
+    # lookups otherwise dominate encoding.
+    cls_index: dict[str, int] = {}
+    cls_reps: list[Obj] = []
+
+    def pod_cls(p: Obj) -> int:
+        k = (
+            memo.label_sig_of(p)
+            + "|"
+            + _namespace_of(p)
+            + ("|T" if p["metadata"].get("deletionTimestamp") else "|F")
+        )
+        c = cls_index.get(k)
+        if c is None:
+            c = len(cls_reps)
+            cls_index[k] = c
+            cls_reps.append(p)
+        return c
+
+    # topo_keys is empty iff NO pod (pending or bound) carries spread or
+    # inter-pod affinity constraints — the only consumers of the classes;
+    # skip the full-cluster classification pass for such workloads
+    if topo_keys:
+        pend_cls = np.fromiter((pod_cls(p) for p in pending), dtype=np.int64, count=P)
+        node_cls_counts: list[dict[int, int]] = []
+        for ni in node_infos:
+            ccnt: dict[int, int] = {}
+            for ep in ni.pods:
+                c = pod_cls(ep)
+                ccnt[c] = ccnt.get(c, 0) + 1
+            node_cls_counts.append(ccnt)
+    else:
+        pend_cls = np.zeros(P, dtype=np.int64)
+        node_cls_counts = [{} for _ in node_infos]
+
     SG = len(sg_specs)
     spread_match = np.zeros((max(SG, 1), P), dtype=bool)
     spread_counts0 = np.zeros((max(SG, 1), N), dtype=np.int64)
     for s, (ns, sel) in enumerate(sg_specs):
-        for j, p in enumerate(pending):
-            spread_match[s, j] = (
-                _namespace_of(p) == ns
-                and not p["metadata"].get("deletionTimestamp")
-                and memo.label_selector(sel, p)
+        m_cls = np.zeros(max(len(cls_reps), 1), dtype=bool)
+        for c, rp in enumerate(cls_reps):
+            m_cls[c] = (
+                _namespace_of(rp) == ns
+                and not rp["metadata"].get("deletionTimestamp")
+                and memo.label_selector(sel, rp)
             )
-        for n_i, ni in enumerate(node_infos):
-            cnt = 0
-            for ep in ni.pods:
-                if (
-                    _namespace_of(ep) == ns
-                    and not ep["metadata"].get("deletionTimestamp")
-                    and memo.label_selector(sel, ep)
-                ):
-                    cnt += 1
-            spread_counts0[s, n_i] = cnt
+        spread_match[s] = m_cls[pend_cls]
+        for n_i, ccnt in enumerate(node_cls_counts):
+            if ccnt:
+                spread_counts0[s, n_i] = sum(k for c, k in ccnt.items() if m_cls[c])
     pr.SG = SG
     pr.spread_match = spread_match
     pr.spread_counts0 = spread_counts0
@@ -771,19 +804,26 @@ def encode(
         if d < 0:
             continue
         (ip_anti0 if which == "anti" else ip_own0)[g, d] += w
+    # term matching per pod CLASS, expanded to pods/nodes by indexing
     if G:
-        for n_i, ni in enumerate(node_infos):
-            for ep in ni.pods:
-                for g, (term, owner_ns) in enumerate(g_terms):
-                    d = node_domain[g_key[g], n_i]
-                    if d >= 0 and memo.affinity_term(term, owner_ns, ep):
-                        ip_sel0[g, d] += 1
-
-    # term_match[g, j]: group g's term selects pending pod j.
-    term_match = np.zeros((max(G, 1), P), dtype=bool)
-    for g, (term, owner_ns) in enumerate(g_terms):
-        for j, p in enumerate(pending):
-            term_match[g, j] = memo.affinity_term(term, owner_ns, p)
+        tm_cls = np.zeros((G, max(len(cls_reps), 1)), dtype=bool)
+        for g, (term, owner_ns) in enumerate(g_terms):
+            for c, rp in enumerate(cls_reps):
+                tm_cls[g, c] = memo.affinity_term(term, owner_ns, rp)
+        for n_i, ccnt in enumerate(node_cls_counts):
+            if not ccnt:
+                continue
+            for g in range(G):
+                d = node_domain[g_key[g], n_i]
+                if d < 0:
+                    continue
+                total = sum(k for c, k in ccnt.items() if tm_cls[g, c])
+                if total:
+                    ip_sel0[g, d] += total
+        # term_match[g, j]: group g's term selects pending pod j.
+        term_match = tm_cls[:, pend_cls]
+    else:
+        term_match = np.zeros((1, P), dtype=bool)
 
     pr.G = G
     pr.term_match = term_match
