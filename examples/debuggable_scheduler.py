@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import json
 
-from examples.nodenumber import NodeNumber
+from examples.nodenumber import node_number_factory
 from kube_scheduler_simulator_tpu.pkg import debuggablescheduler
 from kube_scheduler_simulator_tpu.state.store import ClusterStore
 
@@ -50,7 +50,7 @@ def main() -> None:
     }
     scheduler, _result_store = debuggablescheduler.new_scheduler(
         store,
-        plugins={"NodeNumber": lambda args, handle: NodeNumber(args)},
+        plugins={"NodeNumber": node_number_factory},
         config=config,
     )
     scheduler.schedule_pending(max_rounds=1)

@@ -1,0 +1,151 @@
+"""Concurrency soak: the full simulator under concurrent API traffic.
+
+The design is thread-heavy — background scheduler loop, controller
+manager on the synchronous event bus, scenario-operator worker, HTTP
+threads mutating the store — and the review history shows races live
+here.  This soak drives them all at once for a bounded wall time and
+asserts liveness (no deadlock: operations keep completing) and
+invariants (no duplicate bindings, scheduler still functional, store
+consistent) at the end.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import urllib.request
+
+from kube_scheduler_simulator_tpu.server import DIContainer, SimulatorServer
+
+
+def _req(port, method, path, body=None, timeout=10):
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        data = resp.read()
+        return json.loads(data) if data else None
+
+
+def test_concurrent_api_traffic_soak():
+    di = DIContainer(use_batch="auto")
+    svc = di.scheduler_service()
+    svc.batch_min_work = 64
+    srv = SimulatorServer(di, port=0)
+    port = srv.start(background=True)
+    svc.start_background(poll_interval=0.05)
+
+    try:
+        for i in range(12):
+            _req(port, "POST", "/api/v1/resources/nodes", {
+                "metadata": {"name": f"node-{i}", "labels": {"kubernetes.io/hostname": f"node-{i}"}},
+                "status": {"allocatable": {"cpu": "16", "memory": "32Gi", "pods": "110"}},
+            })
+
+        stop = threading.Event()
+        errors: list[str] = []
+        op_counts = {"create": 0, "delete": 0, "deploy": 0, "read": 0}
+
+        def guard(fn):
+            def run():
+                rng = random.Random(threading.get_ident())
+                while not stop.is_set():
+                    try:
+                        fn(rng)
+                    except urllib.error.HTTPError as e:
+                        if e.code not in (404, 409):  # expected racy outcomes
+                            errors.append(f"{fn.__name__}: HTTP {e.code} {e.read()[:200]}")
+                            return
+                    except Exception as e:  # liveness failure or server bug
+                        errors.append(f"{fn.__name__}: {type(e).__name__}: {e}")
+                        return
+            return run
+
+        seq = {"n": 0, "lock": threading.Lock()}
+
+        def next_id():
+            with seq["lock"]:
+                seq["n"] += 1
+                return seq["n"]
+
+        @guard
+        def pod_creator(rng):
+            _req(port, "POST", "/api/v1/resources/pods", {
+                "metadata": {"name": f"soak-pod-{next_id()}", "namespace": "default",
+                             "labels": {"app": f"a{rng.randrange(3)}"}},
+                "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "50m"}}}]},
+            })
+            op_counts["create"] += 1
+
+        @guard
+        def pod_deleter(rng):
+            pods = _req(port, "GET", "/api/v1/resources/pods")["items"]
+            if pods:
+                victim = rng.choice(pods)["metadata"]["name"]
+                _req(port, "DELETE", f"/api/v1/resources/pods/{victim}?namespace=default")
+                op_counts["delete"] += 1
+
+        @guard
+        def deployer(rng):
+            name = f"soak-dep-{next_id()}"
+            _req(port, "POST", "/api/v1/resources/deployments", {
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {"replicas": rng.randrange(1, 4),
+                         "selector": {"matchLabels": {"dep": name}},
+                         "template": {"metadata": {"labels": {"dep": name}},
+                                      "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "25m"}}}]}}},
+            })
+            op_counts["deploy"] += 1
+
+        @guard
+        def reader(rng):
+            _req(port, "GET", "/api/v1/export")
+            _req(port, "GET", "/api/v1/schedulerconfiguration")
+            op_counts["read"] += 1
+
+        threads = [threading.Thread(target=t, daemon=True)
+                   for t in (pod_creator, pod_creator, pod_deleter, deployer, reader)]
+        import time
+
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(8.0)
+        finally:
+            stop.set()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive(), "worker thread hung (deadlock?)"
+        assert not errors, errors
+        # every op family actually exercised
+        assert all(c > 0 for c in op_counts.values()), op_counts
+
+        # liveness after the storm: the scheduler still schedules a new pod
+        _req(port, "POST", "/api/v1/resources/pods", {
+            "metadata": {"name": "post-soak-pod", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "50m"}}}]},
+        })
+        deadline = time.monotonic() + 30
+        bound = None
+        while time.monotonic() < deadline:
+            pod = _req(port, "GET", "/api/v1/resources/pods/post-soak-pod?namespace=default")
+            bound = (pod.get("spec") or {}).get("nodeName")
+            if bound:
+                break
+            time.sleep(0.1)
+        assert bound, "scheduler wedged after soak"
+
+        # invariants: bound pods reference existing nodes; no phantom objects
+        nodes = {n["metadata"]["name"] for n in _req(port, "GET", "/api/v1/resources/nodes")["items"]}
+        for p in _req(port, "GET", "/api/v1/resources/pods")["items"]:
+            nn = (p.get("spec") or {}).get("nodeName")
+            assert nn is None or nn in nodes, f"{p['metadata']['name']} bound to missing node {nn}"
+
+    finally:
+        # always tear down the background machinery — leaked daemon
+        # threads would keep mutating the store under later tests
+        srv.shutdown()
