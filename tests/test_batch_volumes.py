@@ -13,6 +13,8 @@ from __future__ import annotations
 
 from typing import Any
 
+import pytest
+
 from kube_scheduler_simulator_tpu.scheduler.batch_engine import BatchEngine
 from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
 from kube_scheduler_simulator_tpu.state.store import ClusterStore
@@ -291,16 +293,17 @@ def test_volume_workload_no_longer_forces_fallback():
     assert ok, why
 
 
-def test_mixed_everything_differential_full_default_profile():
+@pytest.mark.parametrize("seed", [4242, 7, 99])
+def test_mixed_everything_differential_full_default_profile(seed):
     """Cross-feature differential: one workload exercising EVERY kernel
     family at once — volumes (bound/WFC PVCs, gce conflicts, CSI limits),
     host ports, images, taints, node+inter-pod affinity, spread — through
     the FULL default profile with feasible-node sampling off, batch vs
-    sequential byte-identical annotations and placements."""
+    sequential byte-identical annotations and placements, across seeds."""
     import random
 
     def build_store():
-        rng = random.Random(4242)  # seeded per build: both stores identical
+        rng = random.Random(seed)  # seeded per build: both stores identical
         store = ClusterStore()
         store.create("storageclasses", mk_sc("wfc", binding_mode="WaitForFirstConsumer"))
         store.create(
