@@ -913,11 +913,11 @@ def test_large_scale_seeded_parity_sweep():
     assert scheduled == P, f"only {scheduled}/{P} scheduled"
 
 
-def test_batch_engine_mesh_sharded_parity():
-    """BatchEngine(mesh=...) — the productized multi-chip path — must
-    produce the identical selection to the single-device engine on a
-    virtual 8-device CPU mesh (node axis sharded; reductions become XLA
-    collectives)."""
+def run_single_vs_sharded(nodes, pods, filters, scores, volumes=None, **schedule_kw):
+    """Run BatchEngine single-device (pinned to one CPU device) and
+    mesh-sharded over 8 virtual CPU devices on the same snapshot; assert
+    identical selections + feasible counts.  Shared by the mesh parity
+    suites here and in test_batch_volumes."""
     import jax
     import numpy as np
     from jax.sharding import Mesh
@@ -925,7 +925,24 @@ def test_batch_engine_mesh_sharded_parity():
     devices = jax.local_devices(backend="cpu")
     assert len(devices) >= 8, "conftest forces 8 virtual CPU devices"
     mesh = Mesh(np.array(devices[:8]), ("nodes",))
+    with jax.default_device(devices[0]):
+        res1 = BatchEngine(filters=filters, scores=scores).schedule(
+            nodes, pods, pods, [], volumes=volumes, **schedule_kw
+        )
+    with mesh:
+        res2 = BatchEngine(filters=filters, scores=scores, mesh=mesh).schedule(
+            nodes, pods, pods, [], volumes=volumes, **schedule_kw
+        )
+    assert res1.selected_nodes == res2.selected_nodes
+    assert list(res1.feasible_count) == list(res2.feasible_count)
+    return res1, res2
 
+
+def test_batch_engine_mesh_sharded_parity():
+    """BatchEngine(mesh=...) — the productized multi-chip path — must
+    produce the identical selection to the single-device engine on a
+    virtual 8-device CPU mesh (node axis sharded; reductions become XLA
+    collectives)."""
     random.seed(21)
     nodes = [
         mk_node(
@@ -958,40 +975,16 @@ def test_batch_engine_mesh_sharded_parity():
     plugins = ["NodeResourcesFit", "TaintToleration", "PodTopologySpread"]
     scores = [("NodeResourcesFit", 1), ("TaintToleration", 3), ("PodTopologySpread", 2)]
 
-    # pin the single-device reference to a CPU device so both runs use
-    # identical float arithmetic even on TPU-attached hosts
-    with jax.default_device(devices[0]):
-        single = BatchEngine(filters=plugins, scores=scores)
-        res1 = single.schedule(nodes, pods, pods, [])
-
-    sharded = BatchEngine(filters=plugins, scores=scores, mesh=mesh)
-    with mesh:
-        res2 = sharded.schedule(nodes, pods, pods, [])
-
-    assert res1.selected_nodes == res2.selected_nodes
-    assert list(res1.feasible_count) == list(res2.feasible_count)
+    run_single_vs_sharded(nodes, pods, plugins, scores)
 
     # an UNEVEN node count must still work on the mesh (the node axis is
     # padded up to a multiple of the device count)
-    sharded9 = BatchEngine(filters=plugins, scores=scores, mesh=mesh)
-    with jax.default_device(devices[0]):
-        single9 = BatchEngine(filters=plugins, scores=scores)
-        res1b = single9.schedule(nodes[:9], pods, pods, [])
-    with mesh:
-        res2b = sharded9.schedule(nodes[:9], pods, pods, [])
-    assert res1b.selected_nodes == res2b.selected_nodes
+    run_single_vs_sharded(nodes[:9], pods, plugins, scores)
 
     # a nonzero rotation start compiles the SAMPLING kernel variant in —
     # its rotation-rank prefix sums are the most order-sensitive
     # cross-node reductions, so pin them under sharding too
-    with jax.default_device(devices[0]):
-        res1c = BatchEngine(filters=plugins, scores=scores).schedule(
-            nodes, pods, pods, [], start_index=5
-        )
-    sharded_rot = BatchEngine(filters=plugins, scores=scores, mesh=mesh)
-    with mesh:
-        res2c = sharded_rot.schedule(nodes, pods, pods, [], start_index=5)
-    assert res1c.selected_nodes == res2c.selected_nodes
+    run_single_vs_sharded(nodes, pods, plugins, scores, start_index=5)
 
 
 def test_imagelocality_kernel_parity():

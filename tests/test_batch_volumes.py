@@ -387,3 +387,57 @@ def test_mixed_everything_differential_full_default_profile(seed):
 
     svc = run_both_services(build_store, cfg={"percentageOfNodesToScore": 100})
     assert svc.stats["batch_pods"] > 0
+
+
+def test_volume_kernels_mesh_sharded_parity():
+    """The volume carries (restr_used / cloud_used / csi_attached /
+    csi_seed_used / csi_limit) are node-axis state — under a mesh they
+    shard like the resource carries, and the sharded engine must select
+    identically to the single-device one."""
+    from tests.test_batch_parity import run_single_vs_sharded
+
+    nodes = [
+        mk_node(f"node-{i}", 8000, 16384, labels={"zone": f"z{i % 2}", "kubernetes.io/hostname": f"node-{i}"})
+        for i in range(16)
+    ]
+    volumes = {
+        "storageclasses": [mk_sc("wfc", binding_mode="WaitForFirstConsumer")],
+        "persistentvolumes": [
+            mk_pv(
+                "pv-z1",
+                node_affinity={
+                    "nodeSelectorTerms": [
+                        {"matchExpressions": [{"key": "zone", "operator": "In", "values": ["z1"]}]}
+                    ]
+                },
+            )
+        ],
+        "persistentvolumeclaims": [
+            mk_pvc("claim-bound", volume_name="pv-z1"),
+            mk_pvc("claim-a", storage_class="wfc"),
+            mk_pvc("claim-b", storage_class="wfc"),
+        ],
+        "csinodes": [mk_csinode(f"node-{i}", "csi.example.com", 1) for i in range(16)],
+    }
+    pods = []
+    for i in range(12):
+        p = mk_pod(f"pod-{i}", cpu_m=300, mem_mi=256)
+        if i % 4 == 0:
+            p["spec"]["volumes"] = [pvc_volume("claim-bound")]
+        elif i % 4 == 1:
+            p["spec"]["volumes"] = [pvc_volume("claim-a" if i % 8 == 1 else "claim-b")]
+        elif i % 4 == 2:
+            p["spec"]["volumes"] = [{"name": "d", "gcePersistentDisk": {"pdName": f"disk-{i % 3}"}}]
+        pods.append(p)
+
+    filters = [
+        "NodeUnschedulable", "NodeResourcesFit", "VolumeRestrictions", "EBSLimits",
+        "GCEPDLimits", "NodeVolumeLimits", "AzureDiskLimits", "VolumeBinding", "VolumeZone",
+    ]
+    scores = [("NodeResourcesFit", 1)]
+
+    res1, _res2 = run_single_vs_sharded(nodes, pods, filters, scores, volumes=volumes)
+    # the volume constraints actually bit: bound-PV pods on z1 nodes only
+    for i in (0, 4, 8):
+        sel = res1.selected_nodes[i]
+        assert sel is not None and int(sel.split("-")[1]) % 2 == 1, (i, sel)
