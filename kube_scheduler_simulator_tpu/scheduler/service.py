@@ -72,6 +72,7 @@ class SchedulerService:
         self._out_of_tree: dict[str, Callable[[Obj | None, Any], Any]] = {}
         self._plugin_extenders: dict[str, Callable[[ResultStore], Any]] = {}
         self._current_cfg: "Obj | None" = None
+        self._profile_names: set[str] = {"default-scheduler"}
         self._initial_cfg: "Obj | None" = None
         self.framework: "Framework | None" = None
         self.result_store: "ResultStore | None" = None
@@ -112,6 +113,9 @@ class SchedulerService:
     def start_scheduler(self, cfg: "Obj | None" = None) -> None:
         """StartScheduler analog (reference scheduler.go:96-186)."""
         cfg = self._filter_allowed_changes(cfg)
+        self._profile_names = {
+            p.get("schedulerName") or "default-scheduler" for p in cfg.get("profiles") or [{}]
+        }
         self.framework = self._build_framework(cfg)
         self._batch_engine = None  # rebuilt lazily for the new profile
         self._current_cfg = cfg
@@ -264,11 +268,19 @@ class SchedulerService:
         # (the reference reads the informer cache the same way); at scale,
         # deep-copying annotation-laden pods dominates the round otherwise
         waiting = self.framework.waiting_pods if self.framework is not None else {}
+        # upstream schedules only pods whose spec.schedulerName matches a
+        # DECLARED profile (unset defaults to "default-scheduler") — pods
+        # claimed by an EXTERNAL scheduler are left alone, which is what
+        # lets one run against the kube-API port (the reference's
+        # two-scheduler story).  All declared names are honored (this
+        # build executes one framework for them — see _build_framework).
+        profiles = self._profile_names or {"default-scheduler"}
         return [
             p
             for p in self.cluster_store.list("pods", copy_objects=False)
             if not (p.get("spec") or {}).get("nodeName")
             and not p["metadata"].get("deletionTimestamp")
+            and ((p.get("spec") or {}).get("schedulerName") or "default-scheduler") in profiles
             and _pod_key(p) not in waiting
         ]
 

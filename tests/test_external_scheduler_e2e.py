@@ -1,0 +1,113 @@
+"""External-scheduler E2E across all three integration surfaces.
+
+The reference's story for external schedulers: watch the kube API for
+pending pods, consult a scheduler extender for filter/prioritize, commit
+with the Binding subresource.  This drives that loop against this build:
+kube-API port (watch + binding) + the TPU scorer endpoint (extenderv1
+wire) on the simulator port — a stand-in for a real kube-scheduler with
+an `extenders:` stanza pointed at the TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from kube_scheduler_simulator_tpu.server import DIContainer, SimulatorServer
+
+
+def _req(port, method, path, body=None):
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"},
+    )
+    # generous timeout: the tpuscorer's first call compiles its kernel
+    with urllib.request.urlopen(r, timeout=120) as resp:
+        data = resp.read()
+        return resp.status, (json.loads(data) if data else None)
+
+
+def test_external_scheduler_binds_via_kube_api_and_tpu_scorer():
+    di = DIContainer(use_batch="off")  # the EXTERNAL scheduler does the scheduling
+    srv = SimulatorServer(di, port=0, kube_api_port=0)
+    sim_port = srv.start(background=True)
+    kube_port = srv.kube_api_server.port
+    try:
+        # cluster: one full node, one free node
+        for i, cpu in enumerate(("100m", "8")):
+            _req(kube_port, "POST", "/api/v1/nodes", {
+                "metadata": {"name": f"node-{i}"},
+                "status": {"allocatable": {"cpu": cpu, "memory": "16Gi", "pods": "110"}},
+            })
+        _req(kube_port, "POST", "/api/v1/namespaces/default/pods", {
+            "metadata": {"name": "ext-pod", "namespace": "default"},
+            "spec": {"schedulerName": "tpu-external",
+                     "containers": [{"name": "c", "resources": {"requests": {"cpu": "2"}}}]},
+        })
+
+        # the in-process scheduler must LEAVE the pod alone: its
+        # spec.schedulerName names the external scheduler, not a profile
+        di.scheduler_service().schedule_pending(max_rounds=1)
+
+        # the external scheduler "watches" for pending pods (list is the
+        # degenerate watch here; the streaming path is covered in
+        # test_kubeapi) ...
+        _code, pods = _req(kube_port, "GET", "/api/v1/pods")
+        pending = [p for p in pods["items"] if not (p.get("spec") or {}).get("nodeName")]
+        assert [p["metadata"]["name"] for p in pending] == ["ext-pod"]
+        _code, nodes = _req(kube_port, "GET", "/api/v1/nodes")
+
+        # ... consults the TPU scorer in extenderv1 wire format ...
+        _code, fr = _req(sim_port, "POST", "/api/v1/tpuscorer/filter", {
+            "pod": pending[0], "nodes": nodes,
+        })
+        assert fr["error"] == ""
+        feasible = [n["metadata"]["name"] for n in (fr["nodes"] or {}).get("items") or []]
+        assert feasible == ["node-1"], fr  # node-0 can't fit 2 cpu
+        assert "node-0" in (fr["failedNodes"] or {}), fr
+        _code, prio = _req(sim_port, "POST", "/api/v1/tpuscorer/prioritize", {
+            "pod": pending[0], "nodes": nodes,
+        })
+        best = max((h for h in prio if h["host"] in feasible), key=lambda h: h["score"])
+
+        # ... and commits through the Binding subresource.
+        code, _ = _req(kube_port, "POST", "/api/v1/namespaces/default/pods/ext-pod/binding", {
+            "target": {"name": best["host"]},
+        })
+        assert code == 201
+        _code, bound = _req(kube_port, "GET", "/api/v1/namespaces/default/pods/ext-pod")
+        assert bound["spec"]["nodeName"] == "node-1"
+        # no kubelet in the simulator: bound pods stay Pending (reference
+        # behavior — the Binding subresource only sets spec.nodeName)
+        assert bound["status"]["phase"] == "Pending"
+    finally:
+        srv.shutdown()
+
+
+def test_declared_second_profile_name_still_scheduled():
+    """Pods naming ANY declared profile are scheduled (this build runs one
+    framework for all declared names); only undeclared (external)
+    schedulerNames are left alone."""
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+    store = ClusterStore()
+    store.create("nodes", {"metadata": {"name": "n0"},
+                           "status": {"allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"}}})
+    for name, sched in (("p-default", None), ("p-second", "second-scheduler"), ("p-ext", "external")):
+        pod = {"metadata": {"name": name, "namespace": "default"},
+               "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "100m"}}}]}}
+        if sched:
+            pod["spec"]["schedulerName"] = sched
+        store.create("pods", pod)
+    svc = SchedulerService(store, tie_break="first")
+    svc.start_scheduler({"profiles": [
+        {"schedulerName": "default-scheduler"},
+        {"schedulerName": "second-scheduler"},
+    ]})
+    svc.schedule_pending(max_rounds=1)
+    assert store.get("pods", "p-default")["spec"].get("nodeName")
+    assert store.get("pods", "p-second")["spec"].get("nodeName")
+    assert not store.get("pods", "p-ext")["spec"].get("nodeName")
