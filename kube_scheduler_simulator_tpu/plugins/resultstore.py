@@ -18,7 +18,30 @@ import threading
 from typing import Any
 
 from kube_scheduler_simulator_tpu.plugins import annotations as anno
-from kube_scheduler_simulator_tpu.utils.gojson import go_marshal
+from kube_scheduler_simulator_tpu.utils.gojson import RawJSON, go_marshal
+
+# Small flat result maps (plugin → status) repeat identically across
+# thousands of pods in a batch round — marshal each distinct map once.
+_MARSHAL_MEMO: dict = {}
+
+
+def _memo_marshal(d: Any) -> str:
+    if isinstance(d, RawJSON):
+        return str(d)
+    if isinstance(d, dict) and len(d) <= 32:
+        try:
+            # value types are part of the key: 1, True and 1.0 compare
+            # equal but marshal differently
+            key = tuple((k, v.__class__, v) for k, v in sorted(d.items()))
+            v = _MARSHAL_MEMO.get(key)
+        except TypeError:
+            return go_marshal(d)  # non-hashable values (nested maps)
+        if v is None:
+            if len(_MARSHAL_MEMO) > 4096:
+                _MARSHAL_MEMO.clear()
+            v = _MARSHAL_MEMO[key] = go_marshal(d)
+        return v
+    return go_marshal(d)
 
 Obj = dict[str, Any]
 
@@ -170,18 +193,18 @@ class ResultStore:
             if e is None:
                 return {}
             out = {
-                anno.PREFILTER_RESULT: go_marshal(e["preFilterResult"]),
-                anno.PREFILTER_STATUS_RESULT: go_marshal(e["preFilterStatus"]),
+                anno.PREFILTER_RESULT: _memo_marshal(e["preFilterResult"]),
+                anno.PREFILTER_STATUS_RESULT: _memo_marshal(e["preFilterStatus"]),
                 anno.FILTER_RESULT: go_marshal(e["filter"]),
-                anno.POSTFILTER_RESULT: go_marshal(e["postFilter"]),
-                anno.PRESCORE_RESULT: go_marshal(e["preScore"]),
+                anno.POSTFILTER_RESULT: _memo_marshal(e["postFilter"]),
+                anno.PRESCORE_RESULT: _memo_marshal(e["preScore"]),
                 anno.SCORE_RESULT: go_marshal(e["score"]),
                 anno.FINALSCORE_RESULT: go_marshal(e["finalScore"]),
-                anno.RESERVE_RESULT: go_marshal(e["reserve"]),
-                anno.PERMIT_TIMEOUT_RESULT: go_marshal(e["permitTimeout"]),
-                anno.PERMIT_STATUS_RESULT: go_marshal(e["permit"]),
-                anno.PREBIND_RESULT: go_marshal(e["prebind"]),
-                anno.BIND_RESULT: go_marshal(e["bind"]),
+                anno.RESERVE_RESULT: _memo_marshal(e["reserve"]),
+                anno.PERMIT_TIMEOUT_RESULT: _memo_marshal(e["permitTimeout"]),
+                anno.PERMIT_STATUS_RESULT: _memo_marshal(e["permit"]),
+                anno.PREBIND_RESULT: _memo_marshal(e["prebind"]),
+                anno.BIND_RESULT: _memo_marshal(e["bind"]),
             }
             for key, val in e["custom"].items():
                 out.setdefault(key, val)

@@ -17,7 +17,7 @@ from typing import Any
 
 from kube_scheduler_simulator_tpu.plugins import annotations as anno
 from kube_scheduler_simulator_tpu.plugins.resultstore import ResultStore
-from kube_scheduler_simulator_tpu.utils.gojson import go_marshal
+from kube_scheduler_simulator_tpu.utils.gojson import go_marshal, go_string, go_string_key
 from kube_scheduler_simulator_tpu.utils.retry import ConflictError, retry_on_conflict
 
 Obj = dict[str, Any]
@@ -31,12 +31,14 @@ class StoreReflector:
         self._stores: dict[str, Any] = {}
         self._in_flush: set[str] = set()
         self._pending: dict[str, Obj] = {}
-        # pod key → length of the result-history value this reflector
-        # last wrote.  Trust for the byte-splice append requires the
-        # CURRENT value to match that length: a user/import replacing the
-        # annotation (even with a shape-matching corrupt value) almost
-        # surely changes the length, dropping back to parse-validation.
-        self._history_written: dict[str, int] = {}
+        # pod key → (length, last-64-chars) of the result-history value
+        # this reflector last wrote.  Trust for the byte-splice append
+        # requires the CURRENT value to match both: a foreign write (user
+        # PUT, import) of even the same length would have to reproduce the
+        # exact tail of the last entry to be spliced onto unvalidated.
+        # Entries are dropped when the pod is deleted (a recreated pod
+        # must not inherit trust for an unrelated annotation value).
+        self._history_written: dict[str, tuple[int, str]] = {}
 
     def add_result_store(self, store: Any, key: str) -> None:
         self._stores[key] = store
@@ -58,6 +60,14 @@ class StoreReflector:
         the pod here and flushing from ``flush_all`` at cycle end.
         """
         cluster_store.on_update("pods", lambda old, new: self._on_pod_update(new))
+        cluster_store.subscribe(["pods"], self._on_pod_event)
+
+    def _on_pod_event(self, ev: Any) -> None:
+        if ev.type == "DELETED":
+            meta = ev.obj["metadata"]
+            key = f"{meta.get('namespace', 'default')}/{meta['name']}"
+            self._history_written.pop(key, None)
+            self._pending.pop(key, None)
 
     def _on_pod_update(self, pod: Obj) -> None:
         ns = pod["metadata"].get("namespace", "default")
@@ -118,15 +128,18 @@ class StoreReflector:
             annotations = dict(fresh["metadata"].get("annotations") or {})
             annotations.update(merged)
             existing = (fresh["metadata"].get("annotations") or {}).get(anno.RESULT_HISTORY)
-            new_history = _updated_history(
-                existing,
-                merged,
-                trusted=self._history_written.get(key) == len(existing or ""),
+            rec = self._history_written.get(key)
+            trusted = (
+                rec is not None
+                and existing is not None
+                and rec[0] == len(existing)
+                and existing[-64:] == rec[1]
             )
+            new_history = _updated_history(existing, merged, trusted=trusted)
             annotations[anno.RESULT_HISTORY] = new_history
             fresh["metadata"]["annotations"] = annotations
-            cluster_store.update("pods", fresh)
-            self._history_written[key] = len(new_history)
+            cluster_store.update("pods", fresh, owned=True)
+            self._history_written[key] = (len(new_history), new_history[-64:])
 
         self._in_flush.add(key)
         try:
@@ -137,21 +150,41 @@ class StoreReflector:
             self._in_flush.discard(key)
 
 
+# annotation keys repeat per pod — marshal each key fragment once
+_KEY_FRAGS: dict[str, str] = {}
+
+
+def _entry_json(new_results: dict[str, str]) -> str:
+    """go_marshal of the history entry, assembled from fragments: the
+    entry is a flat map whose VALUES are the (often megabyte) annotation
+    bodies just built — ``go_string`` escapes each with C-level replaces
+    instead of re-scanning everything through json.dumps."""
+    parts = []
+    for k in sorted(new_results):
+        if k == anno.RESULT_HISTORY:
+            continue
+        frag = _KEY_FRAGS.get(k)
+        if frag is None:
+            frag = _KEY_FRAGS[k] = go_string_key(k)
+        parts.append(frag + go_string(new_results[k]))
+    return "{" + ",".join(parts) + "}"
+
+
 def _updated_history(existing: "str | None", new_results: dict[str, str], trusted: bool = False) -> str:
     """updateResultHistory analog (storereflector.go:148-167): history is a
     JSON array of annotation maps, one per scheduling attempt.
 
     With ``trusted`` (the reflector wrote this pod's history itself since
-    boot), the new attempt is SPLICED onto the existing array bytes
-    instead of parse-append-re-marshal: prior attempts embed the full
-    (often megabyte-scale) annotation set, and re-escaping them on every
-    attempt makes history maintenance quadratic.  Splicing is
-    byte-identical because the existing string is this function's own
-    compact output.  Untrusted values (imported snapshots, foreign
-    annotations) are parse-validated; corrupt or non-array values reset
-    to a fresh single-entry history, as before."""
-    entry = {k: v for k, v in new_results.items() if k != anno.RESULT_HISTORY}
-    entry_json = go_marshal(entry)
+    boot and the stored value still carries its exact length + tail), the
+    new attempt is SPLICED onto the existing array bytes instead of
+    parse-append-re-marshal: prior attempts embed the full (often
+    megabyte-scale) annotation set, and re-escaping them on every attempt
+    makes history maintenance quadratic.  Splicing is byte-identical
+    because the existing string is this function's own compact output.
+    Untrusted values (imported snapshots, foreign annotations) are
+    parse-validated; corrupt or non-array values reset to a fresh
+    single-entry history, as before."""
+    entry_json = _entry_json(new_results)
     if existing:
         if trusted:
             if existing == "[]":
@@ -164,6 +197,8 @@ def _updated_history(existing: "str | None", new_results: dict[str, str], truste
             history = []
         if not isinstance(history, list):
             history = []
-        history.append(entry)
-        return go_marshal(history)
+        if not history:
+            return "[" + entry_json + "]"
+        # re-marshal the validated prior attempts, splice the new entry
+        return go_marshal(history)[:-1] + "," + entry_json + "]"
     return "[" + entry_json + "]"

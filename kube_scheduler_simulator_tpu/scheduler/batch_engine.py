@@ -38,6 +38,39 @@ from kube_scheduler_simulator_tpu.plugins.resultstore import PASSED_FILTER_MESSA
 
 Obj = dict[str, Any]
 
+_cache_enabled = False
+
+
+def enable_persistent_compilation_cache() -> None:
+    """Point XLA's persistent compilation cache at a per-user directory so
+    fresh simulator processes skip the multi-second first-compile of the
+    bucketed batch executables (set ``KSS_COMPILE_CACHE_DIR=0`` to
+    disable).  The reference has no compile step at all; this closes the
+    cold-start gap XLA otherwise adds on every boot."""
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    _cache_enabled = True
+    import os
+
+    d = os.environ.get("KSS_COMPILE_CACHE_DIR")
+    if d == "0":
+        return
+    if not d:
+        d = os.path.join(
+            os.path.expanduser("~"), ".cache", "kube-scheduler-simulator-tpu", "xla"
+        )
+    try:
+        import jax
+
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # pragma: no cover - unwritable home, old jax
+        pass
+
+
 KERNEL_FILTERS = set(B.FILTER_KERNELS)
 KERNEL_SCORES = set(B.SCORE_KERNELS)
 
@@ -450,6 +483,7 @@ class BatchEngine:
         # XLA trace viewable in TensorBoard/Perfetto.
         import os
 
+        enable_persistent_compilation_cache()
         self.profile_dir = profile_dir or os.environ.get("KSS_TPU_PROFILE_DIR") or None
         self.mesh = mesh
         self.cfg = B.BatchConfig(

@@ -16,6 +16,7 @@ byte-identical:
 from __future__ import annotations
 
 import json
+import re
 from typing import Any
 
 
@@ -53,3 +54,28 @@ def go_marshal(obj: Any) -> str:
 def go_string_key(s: str) -> str:
     """``"key":`` fragment exactly as go_marshal would emit it."""
     return _escape_html(json.dumps(s, ensure_ascii=False)) + ":"
+
+
+# characters the fast path below cannot handle with plain replaces:
+# JSON-mandatory \uXXXX control escapes (json.dumps would emit them)
+_CTRL_RE = re.compile("[\x00-\x1f\u2028\u2029]")
+
+
+def go_string(s: str) -> str:
+    """A JSON string literal (quotes included) exactly as go_marshal emits
+    it.  The history annotation re-encodes megabyte annotation VALUES as
+    JSON strings every scheduling attempt; ``json.dumps`` + the html
+    post-pass re-scan those bytes several times, while this fast path is
+    two C-level replaces for the JSON escapes plus three more that are
+    no-ops unless the raw character actually occurs."""
+    if _CTRL_RE.search(s):
+        return _escape_html(json.dumps(s, ensure_ascii=False))
+    return (
+        '"'
+        + s.replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("&", "\\u0026")
+        .replace("<", "\\u003c")
+        .replace(">", "\\u003e")
+        + '"'
+    )

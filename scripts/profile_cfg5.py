@@ -1,0 +1,73 @@
+"""Profile ONE cfg5 churn wave: 2000 pods x 5000 nodes, full default
+profile, trace on — where do the seconds go?
+
+Usage: python scripts/profile_cfg5.py [--pods 2000] [--nodes 5000] [--cprofile]
+"""
+
+from __future__ import annotations
+
+import argparse
+import cProfile
+import pstats
+import random
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from bench import mk_node, mk_pod  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=2000)
+    ap.add_argument("--nodes", type=int, default=5000)
+    ap.add_argument("--cprofile", action="store_true")
+    ap.add_argument("--waves", type=int, default=1)
+    args = ap.parse_args()
+
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+    rng = random.Random(7)
+    store = ClusterStore()
+    for i in range(args.nodes):
+        store.create("nodes", mk_node(i))
+    svc = SchedulerService(store, tie_break="first", use_batch="auto")
+    svc.start_scheduler(None)
+
+    # warmup wave (pays compile)
+    for i in range(256):
+        store.create("pods", mk_pod(10_000_000 + i, rng, spread=i % 3 == 0))
+    t0 = time.perf_counter()
+    svc.schedule_pending(max_rounds=1)
+    print(f"warmup wave (256 pods): {time.perf_counter() - t0:.2f}s", file=sys.stderr)
+
+    created = 0
+    for w in range(args.waves):
+        for _ in range(args.pods):
+            store.create("pods", mk_pod(created, rng, spread=created % 3 == 0))
+            created += 1
+        t0 = time.perf_counter()
+        if args.cprofile and w == args.waves - 1:
+            prof = cProfile.Profile()
+            prof.enable()
+            svc.schedule_pending(max_rounds=1)
+            prof.disable()
+            wall = time.perf_counter() - t0
+            st = pstats.Stats(prof)
+            st.sort_stats("cumulative")
+            st.print_stats(45)
+        else:
+            svc.schedule_pending(max_rounds=1)
+            wall = time.perf_counter() - t0
+        eng = svc._batch_engine
+        print(
+            f"wave {w}: {wall:.2f}s for {args.pods} pods "
+            f"({args.pods / wall:.0f} pods/s) timings={eng.last_timings if eng else {}}",
+            file=sys.stderr,
+        )
+
+
+if __name__ == "__main__":
+    main()
