@@ -1,0 +1,75 @@
+"""Native (C) hot paths, compiled on first import.
+
+The reference keeps its runtime in Go; this build keeps the TPU compute
+path in JAX and the host-side runtime hot loops (annotation-trail JSON
+assembly — the byte-contract surface) in C, compiled here from
+``fastjson.c`` with the toolchain baked into the image.  Everything has a
+pure-Python fallback: if no compiler is available the package works
+unchanged, just slower (``KSS_NO_NATIVE=1`` forces the fallback).
+
+The build is cached next to the source (one ``cc -O2 -shared`` ~0.5 s,
+re-run only when fastjson.c is newer than the cached .so).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+
+_dir = os.path.dirname(__file__)
+_src = os.path.join(_dir, "fastjson.c")
+_so = os.path.join(_dir, f"_kss_fastjson.{sys.implementation.cache_tag}.so")
+
+fastjson = None
+
+
+def _build() -> "str | None":
+    if os.path.exists(_so) and os.path.getmtime(_so) >= os.path.getmtime(_src):
+        return _so
+    cc = os.environ.get("CC", "cc")
+    # per-process temp name: concurrent first runs must not interleave
+    # compiler output on a shared path (os.replace is atomic either way)
+    tmp = f"{_so}.{os.getpid()}.tmp"
+    cmd = [
+        cc,
+        "-O2",
+        "-fPIC",
+        "-shared",
+        "-I",
+        sysconfig.get_paths()["include"],
+        _src,
+        "-o",
+        tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _so)
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    return _so
+
+
+def _load():
+    global fastjson
+    if os.environ.get("KSS_NO_NATIVE"):
+        return
+    try:
+        so = _build()
+        if so is None:
+            return
+        spec = importlib.util.spec_from_file_location("_kss_fastjson", so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        fastjson = mod
+    except Exception:  # pragma: no cover - no compiler / bad toolchain
+        fastjson = None
+
+
+_load()

@@ -1,0 +1,505 @@
+/* _kss_fastjson: C hot paths for the annotation-trail assembly.
+ *
+ * The simulator's contract is a byte-exact, Go-json.Marshal-identical
+ * annotation trail per scheduled pod (reference
+ * simulator/scheduler/plugin/resultstore/store.go:206-241).  At bench
+ * scale (10k pods x 5k nodes, full default profile) that trail is
+ * ~0.5 MB/pod of JSON: assembling it in Python costs tens of seconds per
+ * churn wave; these functions do the same byte-for-byte assembly at
+ * memcpy speed.  The Python implementations remain as fallbacks (see
+ * native/__init__.py) and the parity suites pin both to identical bytes.
+ *
+ * Exposed functions:
+ *   escape_string(s)            -> Go-style JSON string literal (quotes
+ *                                  included), identical to gojson.go_string
+ *   history_entry(keys, values) -> '{' k1 esc(v1) ',' ... '}' where keys
+ *                                  are pre-marshaled '"key":' fragments
+ *   score_json(keys, frags, rows, perm)
+ *                               -> '{' key[t] '{' frag[k] row[k][perm[t]] '"'
+ *                                  ... '}' ... '}' (score/finalScore maps)
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <string.h>
+
+/* ------------------------------------------------------------------ buf */
+
+typedef struct {
+    char *p;
+    Py_ssize_t len;
+    Py_ssize_t cap;
+} Buf;
+
+static int buf_init(Buf *b, Py_ssize_t cap) {
+    if (cap < 256) cap = 256;
+    b->p = (char *)PyMem_Malloc(cap);
+    if (!b->p) { PyErr_NoMemory(); return -1; }
+    b->len = 0;
+    b->cap = cap;
+    return 0;
+}
+
+static int buf_grow(Buf *b, Py_ssize_t need) {
+    Py_ssize_t cap = b->cap;
+    while (cap - b->len < need) cap += cap >> 1;
+    char *np = (char *)PyMem_Realloc(b->p, cap);
+    if (!np) { PyErr_NoMemory(); return -1; }
+    b->p = np;
+    b->cap = cap;
+    return 0;
+}
+
+static inline int buf_put(Buf *b, const char *s, Py_ssize_t n) {
+    if (b->cap - b->len < n && buf_grow(b, n) < 0) return -1;
+    memcpy(b->p + b->len, s, (size_t)n);
+    b->len += n;
+    return 0;
+}
+
+static inline int buf_putc(Buf *b, char c) {
+    if (b->cap - b->len < 1 && buf_grow(b, 1) < 0) return -1;
+    b->p[b->len++] = c;
+    return 0;
+}
+
+static PyObject *buf_take(Buf *b) {
+    PyObject *r = PyUnicode_DecodeUTF8(b->p, b->len, "strict");
+    PyMem_Free(b->p);
+    b->p = NULL;
+    return r;
+}
+
+/* --------------------------------------------------------------- escape */
+
+/* 1 = copy verbatim; 0 = needs an escape sequence.  Bytes >= 0x80 copy
+ * verbatim except the U+2028/U+2029 sequences (0xE2 0x80 0xA8/0xA9),
+ * handled inline.  Matches gojson.go_string / Go's encoder defaults. */
+static unsigned char plain[256];
+
+static void init_plain(void) {
+    int i;
+    for (i = 0; i < 256; i++) plain[i] = (i >= 0x20);
+    plain['"'] = 0;
+    plain['\\'] = 0;
+    plain['&'] = 0;
+    plain['<'] = 0;
+    plain['>'] = 0;
+    plain[0xE2] = 0; /* potential U+2028/29 lead byte */
+}
+
+static const char *HEX = "0123456789abcdef";
+
+/* append the escaped body (no quotes) of s[0..n) */
+static int escape_into(Buf *b, const char *s, Py_ssize_t n) {
+    Py_ssize_t i = 0;
+    while (i < n) {
+        Py_ssize_t j = i;
+        while (j < n && plain[(unsigned char)s[j]]) j++;
+        if (j > i && buf_put(b, s + i, j - i) < 0) return -1;
+        if (j >= n) break;
+        unsigned char c = (unsigned char)s[j];
+        switch (c) {
+        case '"':  if (buf_put(b, "\\\"", 2) < 0) return -1; break;
+        case '\\': if (buf_put(b, "\\\\", 2) < 0) return -1; break;
+        case '&':  if (buf_put(b, "\\u0026", 6) < 0) return -1; break;
+        case '<':  if (buf_put(b, "\\u003c", 6) < 0) return -1; break;
+        case '>':  if (buf_put(b, "\\u003e", 6) < 0) return -1; break;
+        case 0xE2:
+            if (j + 2 < n && (unsigned char)s[j + 1] == 0x80 &&
+                ((unsigned char)s[j + 2] == 0xA8 || (unsigned char)s[j + 2] == 0xA9)) {
+                if (buf_put(b, (unsigned char)s[j + 2] == 0xA8 ? "\\u2028" : "\\u2029", 6) < 0)
+                    return -1;
+                j += 2;
+            } else if (buf_putc(b, (char)c) < 0) return -1;
+            break;
+        default: { /* control chars < 0x20: json.dumps emits \b \t \n \f \r
+                      for the named ones, \u00XX otherwise */
+            char e[6] = {'\\', 'u', '0', '0', HEX[c >> 4], HEX[c & 15]};
+            switch (c) {
+            case '\b': if (buf_put(b, "\\b", 2) < 0) return -1; break;
+            case '\t': if (buf_put(b, "\\t", 2) < 0) return -1; break;
+            case '\n': if (buf_put(b, "\\n", 2) < 0) return -1; break;
+            case '\f': if (buf_put(b, "\\f", 2) < 0) return -1; break;
+            case '\r': if (buf_put(b, "\\r", 2) < 0) return -1; break;
+            default:   if (buf_put(b, e, 6) < 0) return -1; break;
+            }
+            break;
+        }
+        }
+        i = j + 1;
+    }
+    return 0;
+}
+
+static int escape_value(Buf *b, PyObject *v) {
+    Py_ssize_t n;
+    const char *s;
+    if (!PyUnicode_Check(v)) {
+        PyErr_SetString(PyExc_TypeError, "expected str");
+        return -1;
+    }
+    s = PyUnicode_AsUTF8AndSize(v, &n);
+    if (!s) return -1;
+    if (buf_putc(b, '"') < 0) return -1;
+    if (escape_into(b, s, n) < 0) return -1;
+    return buf_putc(b, '"');
+}
+
+static int put_str(Buf *b, PyObject *v) {
+    Py_ssize_t n;
+    const char *s;
+    if (!PyUnicode_Check(v)) {
+        PyErr_SetString(PyExc_TypeError, "expected str");
+        return -1;
+    }
+    s = PyUnicode_AsUTF8AndSize(v, &n);
+    if (!s) return -1;
+    return buf_put(b, s, n);
+}
+
+/* ------------------------------------------------------------ functions */
+
+static PyObject *py_escape_string(PyObject *self, PyObject *arg) {
+    Buf b;
+    Py_ssize_t n;
+    const char *s;
+    (void)self;
+    if (!PyUnicode_Check(arg)) {
+        PyErr_SetString(PyExc_TypeError, "escape_string() expects str");
+        return NULL;
+    }
+    s = PyUnicode_AsUTF8AndSize(arg, &n);
+    if (!s) return NULL;
+    if (buf_init(&b, n + (n >> 3) + 16) < 0) return NULL;
+    if (buf_putc(&b, '"') < 0 || escape_into(&b, s, n) < 0 || buf_putc(&b, '"') < 0) {
+        PyMem_Free(b.p);
+        return NULL;
+    }
+    return buf_take(&b);
+}
+
+static PyObject *py_escape_body(PyObject *self, PyObject *arg) {
+    Buf b;
+    Py_ssize_t n;
+    const char *s;
+    (void)self;
+    if (!PyUnicode_Check(arg)) {
+        PyErr_SetString(PyExc_TypeError, "escape_body() expects str");
+        return NULL;
+    }
+    s = PyUnicode_AsUTF8AndSize(arg, &n);
+    if (!s) return NULL;
+    if (buf_init(&b, n + (n >> 3) + 16) < 0) return NULL;
+    if (escape_into(&b, s, n) < 0) {
+        PyMem_Free(b.p);
+        return NULL;
+    }
+    return buf_take(&b);
+}
+
+/* history_entry(keys: list['"k":' fragments], values: list[str],
+ *               escs: list[str | None] | None)
+ * escs[i], when not None, is the PRE-ESCAPED body of values[i] (produced
+ * by the escaped-twin assembly below) and is copied verbatim. */
+static PyObject *py_history_entry(PyObject *self, PyObject *args) {
+    PyObject *keys, *values, *escs = Py_None;
+    Buf b;
+    Py_ssize_t i, n;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OO|O", &keys, &values, &escs)) return NULL;
+    if (!PyList_Check(keys) || !PyList_Check(values) ||
+        PyList_GET_SIZE(keys) != PyList_GET_SIZE(values) ||
+        (escs != Py_None &&
+         (!PyList_Check(escs) || PyList_GET_SIZE(escs) != PyList_GET_SIZE(keys)))) {
+        PyErr_SetString(PyExc_TypeError, "history_entry(keys, values[, escs]): equal-length lists");
+        return NULL;
+    }
+    n = PyList_GET_SIZE(keys);
+    /* size hint: sum of value lengths + overhead */
+    {
+        Py_ssize_t hint = 2 + n * 8;
+        for (i = 0; i < n; i++) {
+            PyObject *v = PyList_GET_ITEM(values, i);
+            if (escs != Py_None && PyList_GET_ITEM(escs, i) != Py_None)
+                v = PyList_GET_ITEM(escs, i);
+            if (PyUnicode_Check(v)) hint += PyUnicode_GET_LENGTH(v) + 32;
+        }
+        if (buf_init(&b, hint) < 0) return NULL;
+    }
+    if (buf_putc(&b, '{') < 0) goto fail;
+    for (i = 0; i < n; i++) {
+        PyObject *e = escs == Py_None ? Py_None : PyList_GET_ITEM(escs, i);
+        if (i && buf_putc(&b, ',') < 0) goto fail;
+        if (put_str(&b, PyList_GET_ITEM(keys, i)) < 0) goto fail;
+        if (e != Py_None) {
+            if (buf_putc(&b, '"') < 0) goto fail;
+            if (put_str(&b, e) < 0) goto fail;
+            if (buf_putc(&b, '"') < 0) goto fail;
+        } else if (escape_value(&b, PyList_GET_ITEM(values, i)) < 0) {
+            goto fail;
+        }
+    }
+    if (buf_putc(&b, '}') < 0) goto fail;
+    return buf_take(&b);
+fail:
+    PyMem_Free(b.p);
+    return NULL;
+}
+
+/* filter_json(pass_arr, pass_esc, order, start, proc, n_true,
+ *             fail_ids, fail_frags, fail_escs) -> (str, str)
+ *
+ * pass_arr[id] / pass_esc[id]: whole '"node":{...all passed...}' entry
+ * (and its escaped twin) per node id.  order: node ids in go_marshal key
+ * order (sorted names).  A node id is emitted iff its visit rank
+ * (id - start) mod n_true < proc.  fail_ids/fail_frags/fail_escs
+ * override the entries of failing nodes. */
+static PyObject *py_filter_json(PyObject *self, PyObject *args) {
+    PyObject *pass_arr, *pass_esc, *order, *fail_ids, *fail_frags, *fail_escs;
+    long start, proc, n_true;
+    Buf b, be;
+    PyObject **over = NULL, **over_esc = NULL;
+    PyObject *r1 = NULL, *r2 = NULL, *out = NULL;
+    Py_ssize_t t, T, first = 1;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OOOlllOOO", &pass_arr, &pass_esc, &order,
+                          &start, &proc, &n_true, &fail_ids, &fail_frags, &fail_escs))
+        return NULL;
+    if (!PyList_Check(pass_arr) || !PyList_Check(pass_esc) || !PyList_Check(order) ||
+        !PyList_Check(fail_ids) || !PyList_Check(fail_frags) || !PyList_Check(fail_escs) ||
+        PyList_GET_SIZE(fail_ids) != PyList_GET_SIZE(fail_frags) ||
+        PyList_GET_SIZE(fail_ids) != PyList_GET_SIZE(fail_escs) || n_true < 0) {
+        PyErr_SetString(PyExc_TypeError, "filter_json: bad arguments");
+        return NULL;
+    }
+    T = PyList_GET_SIZE(order);
+    if (PyList_GET_SIZE(pass_arr) < T || PyList_GET_SIZE(pass_esc) < T) {
+        PyErr_SetString(PyExc_ValueError, "filter_json: pass arrays shorter than order");
+        return NULL;
+    }
+    if (PyList_GET_SIZE(fail_ids) > 0) {
+        over = (PyObject **)PyMem_Calloc((size_t)(n_true > 0 ? n_true : 1), sizeof(PyObject *));
+        over_esc = (PyObject **)PyMem_Calloc((size_t)(n_true > 0 ? n_true : 1), sizeof(PyObject *));
+        if (!over || !over_esc) {
+            PyMem_Free(over);
+            PyMem_Free(over_esc);
+            return PyErr_NoMemory();
+        }
+        for (t = 0; t < PyList_GET_SIZE(fail_ids); t++) {
+            long id = PyLong_AsLong(PyList_GET_ITEM(fail_ids, t));
+            if (id < 0 || id >= n_true) {
+                PyErr_SetString(PyExc_IndexError, "filter_json: fail id out of range");
+                goto done;
+            }
+            over[id] = PyList_GET_ITEM(fail_frags, t);
+            over_esc[id] = PyList_GET_ITEM(fail_escs, t);
+        }
+    }
+    if (buf_init(&b, 256 + T * 32) < 0) goto done_nobuf;
+    if (buf_init(&be, 256 + T * 32) < 0) {
+        PyMem_Free(b.p);
+        goto done_nobuf;
+    }
+    if (buf_putc(&b, '{') < 0 || buf_putc(&be, '{') < 0) goto fail;
+    for (t = 0; t < T; t++) {
+        long id = PyLong_AsLong(PyList_GET_ITEM(order, t));
+        long rank;
+        if (id < 0 && PyErr_Occurred()) goto fail;
+        if (id < 0 || id >= n_true) continue;
+        rank = id - start;
+        if (rank < 0) rank += n_true;
+        if (rank >= proc) continue;
+        if (!first && (buf_putc(&b, ',') < 0 || buf_putc(&be, ',') < 0)) goto fail;
+        first = 0;
+        if (over && over[id]) {
+            if (put_str(&b, over[id]) < 0 || put_str(&be, over_esc[id]) < 0) goto fail;
+        } else {
+            if (put_str(&b, PyList_GET_ITEM(pass_arr, (Py_ssize_t)id)) < 0 ||
+                put_str(&be, PyList_GET_ITEM(pass_esc, (Py_ssize_t)id)) < 0)
+                goto fail;
+        }
+    }
+    if (buf_putc(&b, '}') < 0 || buf_putc(&be, '}') < 0) goto fail;
+    r1 = buf_take(&b);
+    r2 = buf_take(&be);
+    if (r1 && r2) out = PyTuple_Pack(2, r1, r2);
+    Py_XDECREF(r1);
+    Py_XDECREF(r2);
+    goto done;
+fail:
+    PyMem_Free(b.p);
+    PyMem_Free(be.p);
+done_nobuf:
+done:
+    PyMem_Free(over);
+    PyMem_Free(over_esc);
+    return out;
+}
+
+/* score_json(keys: list[str], frags: list[str], rows: list[list[str]],
+ *            perm: list[int])
+ * keys[t] are pre-marshaled '"node":' fragments aligned with perm;
+ * rows[k][perm[t]] are pre-rendered numeric strings; frags[k] are
+ * '"Plugin":"' fragments.  Emits
+ *   {key0{frag0 v00 " , frag1 v10 " ...} , key1{...} ...}
+ */
+static PyObject *py_score_json(PyObject *self, PyObject *args) {
+    PyObject *keys, *frags, *rows, *perm;
+    Buf b;
+    Py_ssize_t t, k, T, K;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OOOO", &keys, &frags, &rows, &perm)) return NULL;
+    if (!PyList_Check(keys) || !PyList_Check(frags) || !PyList_Check(rows) ||
+        !PyList_Check(perm)) {
+        PyErr_SetString(PyExc_TypeError, "score_json expects lists");
+        return NULL;
+    }
+    T = PyList_GET_SIZE(keys);
+    K = PyList_GET_SIZE(frags);
+    if (PyList_GET_SIZE(perm) != T || PyList_GET_SIZE(rows) != K) {
+        PyErr_SetString(PyExc_ValueError, "score_json: length mismatch");
+        return NULL;
+    }
+    for (k = 0; k < K; k++) {
+        if (!PyList_Check(PyList_GET_ITEM(rows, k))) {
+            PyErr_SetString(PyExc_TypeError, "score_json: rows must be lists");
+            return NULL;
+        }
+    }
+    if (buf_init(&b, 2 + T * (24 + K * 24)) < 0) return NULL;
+    if (buf_putc(&b, '{') < 0) goto fail;
+    for (t = 0; t < T; t++) {
+        Py_ssize_t j = PyLong_AsSsize_t(PyList_GET_ITEM(perm, t));
+        if (j < 0) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_IndexError, "score_json: perm out of range");
+            goto fail;
+        }
+        if (t && buf_putc(&b, ',') < 0) goto fail;
+        if (put_str(&b, PyList_GET_ITEM(keys, t)) < 0) goto fail;
+        if (buf_putc(&b, '{') < 0) goto fail;
+        for (k = 0; k < K; k++) {
+            PyObject *row = PyList_GET_ITEM(rows, k);
+            if (j >= PyList_GET_SIZE(row)) {
+                PyErr_SetString(PyExc_IndexError, "score_json: perm out of range");
+                goto fail;
+            }
+            if (k && buf_putc(&b, ',') < 0) goto fail;
+            if (put_str(&b, PyList_GET_ITEM(frags, k)) < 0) goto fail;
+            if (put_str(&b, PyList_GET_ITEM(row, j)) < 0) goto fail;
+            if (buf_putc(&b, '"') < 0) goto fail;
+        }
+        if (buf_putc(&b, '}') < 0) goto fail;
+    }
+    if (buf_putc(&b, '}') < 0) goto fail;
+    return buf_take(&b);
+fail:
+    PyMem_Free(b.p);
+    return NULL;
+}
+
+/* score_json_pair(keys, keys_esc, frags, frags_esc, rows, perm)
+ * -> (str, str): like score_json, but also emits the escaped twin from
+ * pre-escaped key/plugin fragments (score values are numeric strings —
+ * identical in both outputs). */
+static PyObject *py_score_json_pair(PyObject *self, PyObject *args) {
+    PyObject *keys, *keys_esc, *frags, *frags_esc, *rows, *perm;
+    Buf b, be;
+    PyObject *r1 = NULL, *r2 = NULL, *out = NULL;
+    Py_ssize_t t, k, T, K;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OOOOOO", &keys, &keys_esc, &frags, &frags_esc, &rows, &perm))
+        return NULL;
+    if (!PyList_Check(keys) || !PyList_Check(keys_esc) || !PyList_Check(frags) ||
+        !PyList_Check(frags_esc) || !PyList_Check(rows) || !PyList_Check(perm)) {
+        PyErr_SetString(PyExc_TypeError, "score_json_pair expects lists");
+        return NULL;
+    }
+    T = PyList_GET_SIZE(keys);
+    K = PyList_GET_SIZE(frags);
+    if (PyList_GET_SIZE(perm) != T || PyList_GET_SIZE(rows) != K ||
+        PyList_GET_SIZE(keys_esc) != T || PyList_GET_SIZE(frags_esc) != K) {
+        PyErr_SetString(PyExc_ValueError, "score_json_pair: length mismatch");
+        return NULL;
+    }
+    for (k = 0; k < K; k++) {
+        if (!PyList_Check(PyList_GET_ITEM(rows, k))) {
+            PyErr_SetString(PyExc_TypeError, "score_json_pair: rows must be lists");
+            return NULL;
+        }
+    }
+    if (buf_init(&b, 2 + T * (24 + K * 24)) < 0) return NULL;
+    if (buf_init(&be, 2 + T * (24 + K * 24)) < 0) {
+        PyMem_Free(b.p);
+        return NULL;
+    }
+    if (buf_putc(&b, '{') < 0 || buf_putc(&be, '{') < 0) goto fail;
+    for (t = 0; t < T; t++) {
+        Py_ssize_t j = PyLong_AsSsize_t(PyList_GET_ITEM(perm, t));
+        if (j < 0) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_IndexError, "score_json_pair: perm out of range");
+            goto fail;
+        }
+        if (t && (buf_putc(&b, ',') < 0 || buf_putc(&be, ',') < 0)) goto fail;
+        if (put_str(&b, PyList_GET_ITEM(keys, t)) < 0 ||
+            put_str(&be, PyList_GET_ITEM(keys_esc, t)) < 0)
+            goto fail;
+        if (buf_putc(&b, '{') < 0 || buf_putc(&be, '{') < 0) goto fail;
+        for (k = 0; k < K; k++) {
+            PyObject *row = PyList_GET_ITEM(rows, k);
+            PyObject *v;
+            if (j >= PyList_GET_SIZE(row)) {
+                PyErr_SetString(PyExc_IndexError, "score_json_pair: perm out of range");
+                goto fail;
+            }
+            v = PyList_GET_ITEM(row, j);
+            if (k && (buf_putc(&b, ',') < 0 || buf_putc(&be, ',') < 0)) goto fail;
+            if (put_str(&b, PyList_GET_ITEM(frags, k)) < 0 ||
+                put_str(&be, PyList_GET_ITEM(frags_esc, k)) < 0)
+                goto fail;
+            if (put_str(&b, v) < 0 || put_str(&be, v) < 0) goto fail;
+            /* numeric value closes with `"` — escaped twin uses \" */
+            if (buf_putc(&b, '"') < 0 || buf_put(&be, "\\\"", 2) < 0) goto fail;
+        }
+        if (buf_putc(&b, '}') < 0 || buf_putc(&be, '}') < 0) goto fail;
+    }
+    if (buf_putc(&b, '}') < 0 || buf_putc(&be, '}') < 0) goto fail;
+    r1 = buf_take(&b);
+    r2 = buf_take(&be);
+    if (r1 && r2) out = PyTuple_Pack(2, r1, r2);
+    Py_XDECREF(r1);
+    Py_XDECREF(r2);
+    return out;
+fail:
+    PyMem_Free(b.p);
+    PyMem_Free(be.p);
+    return NULL;
+}
+
+static PyMethodDef methods[] = {
+    {"escape_string", py_escape_string, METH_O,
+     "Go-json string literal for s (gojson.go_string fast path)"},
+    {"escape_body", py_escape_body, METH_O,
+     "escaped body of s, no surrounding quotes"},
+    {"history_entry", py_history_entry, METH_VARARGS,
+     "history entry JSON from ('\"k\":' fragment, value[, escaped]) lists"},
+    {"score_json", py_score_json, METH_VARARGS,
+     "score/finalScore annotation JSON from fragments"},
+    {"score_json_pair", py_score_json_pair, METH_VARARGS,
+     "score annotation JSON plus its escaped twin"},
+    {"filter_json", py_filter_json, METH_VARARGS,
+     "filter annotation JSON plus its escaped twin, from per-node entries"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_kss_fastjson",
+    "C hot paths for Go-identical annotation JSON assembly", -1, methods,
+    NULL, NULL, NULL, NULL,
+};
+
+PyMODINIT_FUNC PyInit__kss_fastjson(void) {
+    init_plain();
+    return PyModule_Create(&moduledef);
+}

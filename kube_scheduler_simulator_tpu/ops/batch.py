@@ -98,6 +98,51 @@ SCORE_KERNELS = (
     "ImageLocality",
 )
 
+# How each score kernel's NormalizeScore relates raw → normalized; drives
+# the trace-fetch plan (build_compact_fn): "identity" plugins fetch ONE
+# int8 plane that serves as both raw and norm; "default"/"default_reverse"
+# /"minmax" fetch raw only and the host recomputes norm with exact integer
+# arithmetic (equal to the kernel's float path for |raw| < 2^15 — the
+# dtype chooser falls back to fetching norm beyond that); "custom"
+# (PodTopologySpread's mx+mn-raw form needs the ignored-node mask the
+# trace doesn't carry) fetches both.
+NORMALIZE_KIND = {
+    "NodeResourcesFit": "identity",
+    "NodeResourcesBalancedAllocation": "identity",
+    "ImageLocality": "identity",
+    "TaintToleration": "default_reverse",
+    "NodeAffinity": "default",
+    "InterPodAffinity": "minmax",
+    "PodTopologySpread": "custom",
+}
+
+
+def raw_dtype_for(mn: int, mx: int) -> str:
+    """Minimal fetch dtype for a raw-score plane, with headroom so the
+    choice (part of the compact-executable cache key) stays stable as the
+    cluster fills."""
+    if -100 <= mn and mx <= 100:
+        return "int8"
+    if -30000 <= mn and mx <= 30000:
+        return "int16"
+    return "int32"
+
+
+def trace_fetch_plan(cfg: "BatchConfig", raw_dtypes: "tuple[str, ...]"):
+    """Per score plugin: (fetch_raw, fetch_norm, host_norm_kind | None)."""
+    plan = []
+    for k, (s, _w) in enumerate(cfg.scores):
+        kind = NORMALIZE_KIND.get(s, "custom")
+        if kind == "identity":
+            plan.append((False, True, None))
+        elif kind == "custom" or raw_dtypes[k] == "int32":
+            # int32 raws: the host's integer normalize is no longer
+            # provably equal to the kernel's float path — fetch norm too
+            plan.append((True, True, None))
+        else:
+            plan.append((True, False, kind))
+    return tuple(plan)
+
 
 class DeviceProblem(NamedTuple):
     """BatchProblem lowered to device arrays (a pytree, jit-traceable)."""
@@ -473,7 +518,7 @@ def shard_device_problem(dp: "DeviceProblem", mesh, axis_name: str = "nodes") ->
     return jax.device_put(dp, shardings)
 
 
-def build_compact_fn(cfg: BatchConfig, dims: dict, W: int, WS: int):
+def build_compact_fn(cfg: BatchConfig, dims: dict, W: int, WS: int, raw_dtypes: "tuple[str, ...] | None" = None):
     """Build the trace-compaction function: reduce the [P,N] trace arrays
     to exactly what the annotation writer reads, and nothing more —
     through a tunneled TPU (~10 MB/s D2H) the fetch volume IS the trace
@@ -490,19 +535,52 @@ def build_compact_fn(cfg: BatchConfig, dims: dict, W: int, WS: int):
       deterministic from (sample_start, sample_processed, n_true), and
       the host reproduces the ascending-index column order with
       arithmetic (BatchResult._visited_ids).
+    - The feasible ids are NOT fetched either when filters are present:
+      a visited node is feasible iff its fail_plug is -1, so the host
+      derives them (reconstruct_trace) instead of moving [P,WS] int32.
+    - Per-plugin score planes move at minimal dtype (``raw_dtypes``, from
+      the kernel's raw_minmax), and only the planes the fetch plan needs
+      (trace_fetch_plan): identity-normalized plugins move one int8
+      plane; host-normalizable plugins move raw only.
 
-    Outputs (exact integers by kernel construction; casts lossless):
-      fail_plug [P,W]    int8   index into cfg.filters of the first
-                                failing filter per visited node (-1 none),
-                                columns in ascending node-index order
-      fail_code [P,W]    int16  that filter's reason code (int32 when the
-                                Fit bitmask needs >15 bits)
-      sids      [P,WS]   int32  feasible node ids (-1 pad), ascending
-      raw       [S,P,WS] int32  raw scores at feasible nodes
-      norm      [S,P,WS] int8   normalized scores (0..MAX_NODE_SCORE)
+    Every output plane is bitcast to uint8 and concatenated into ONE flat
+    blob: through the tunnel, each fetched array pays a full roundtrip's
+    latency on top of its bytes, so a dozen per-plane fetches cost more
+    than the data itself.  The host unpacks by the (name, dtype, shape)
+    manifest this builder returns alongside the jitted function.
+
+    Planes (exact integers by kernel construction; casts lossless):
+      fail      [P,W]  uint16     (plug+1)<<8 | code, columns in ascending
+                                  node-index order; (plug, code) planes
+                                  stay separate when the Fit bitmask
+                                  needs >8 bits
+      sids      [P,WS] int32      only when cfg.filters is empty
+      raw:k     [P,WS] raw_dtypes[k]  where the plan fetches raw
+      norm:k    [P,WS] int8       where the plan fetches norm
     """
     P, N = dims["P"], dims["N"]
-    code_dtype = jnp.int16 if dims["R"] + 1 <= 15 else jnp.int32
+    R = dims["R"]
+    pack_fail = R + 1 <= 8
+    code_dtype_name = "int16" if R + 1 <= 15 else "int32"
+    code_dtype = getattr(jnp, code_dtype_name)
+    raw_dtypes = raw_dtypes or tuple("int32" for _ in cfg.scores)
+    plan = trace_fetch_plan(cfg, raw_dtypes)
+
+    manifest: "list[tuple[str, str, tuple]]" = []
+    if cfg.filters:
+        if pack_fail:
+            manifest.append(("fail", "uint16", (P, W)))
+        else:
+            manifest.append(("fail_plug", "int8", (P, W)))
+            manifest.append(("fail_code", code_dtype_name, (P, W)))
+    else:
+        manifest.append(("sids", "int32", (P, WS)))
+    for k, (_s, _w) in enumerate(cfg.scores):
+        fetch_raw, fetch_norm, _host = plan[k]
+        if fetch_raw:
+            manifest.append((f"raw:{k}", raw_dtypes[k], (P, WS)))
+        if fetch_norm:
+            manifest.append((f"norm:{k}", "int8", (P, WS)))
 
     def run(out: dict, n_true):
         idx = jnp.arange(N, dtype=jnp.int32)[None, :]
@@ -511,30 +589,153 @@ def build_compact_fn(cfg: BatchConfig, dims: dict, W: int, WS: int):
         # padded node columns can alias into the rank window when the
         # rotation start is nonzero — they were never really visited
         visited = (rank < out["sample_processed"][:, None]) & (idx < n_true)
-        order = jnp.argsort(jnp.where(visited, idx, N + idx), axis=1)[:, :W]
-        take = lambda a: jnp.take_along_axis(a, order, axis=1)
-        valid = take(visited)
         res = {}
         if cfg.filters:
+            order = jnp.argsort(jnp.where(visited, idx, N + idx), axis=1)[:, :W]
+            take = lambda a: jnp.take_along_axis(a, order, axis=1)
+            valid = take(visited)
             # the step already tracked (first failing filter, code) planes
-            res["fail_plug"] = jnp.where(valid, take(out["fail_plug"]), -1).astype(jnp.int8)
-            res["fail_code"] = jnp.where(valid, take(out["fail_code"]), 0).astype(code_dtype)
+            plug = jnp.where(valid, take(out["fail_plug"]), -1)
+            code = jnp.where(valid, take(out["fail_code"]), 0)
+            if pack_fail:
+                res["fail"] = (
+                    ((plug + 1).astype(jnp.uint16) << 8)
+                    | code.astype(jnp.uint16)
+                )
+            else:
+                res["fail_plug"] = plug.astype(jnp.int8)
+                res["fail_code"] = code.astype(code_dtype)
         feas = out["feasible"]
         sorder = jnp.argsort(jnp.where(feas, idx, N + idx), axis=1)[:, :WS]
         stake = lambda a: jnp.take_along_axis(a, sorder, axis=1)
         svalid = stake(feas)
-        res["sids"] = jnp.where(svalid, sorder, -1).astype(jnp.int32)
-        if cfg.scores:
-            stakem = lambda a: jnp.where(svalid, stake(a), 0)
-            res["raw"] = jnp.stack(
-                [stakem(out[f"raw:{s}"]).astype(jnp.int32) for s, _w in cfg.scores]
-            )
-            res["norm"] = jnp.stack(
-                [stakem(out[f"norm:{s}"]).astype(jnp.int8) for s, _w in cfg.scores]
-            )
-        return res
+        if not cfg.filters:
+            res["sids"] = jnp.where(svalid, sorder, -1).astype(jnp.int32)
+        stakem = lambda a: jnp.where(svalid, stake(a), 0)
+        for k, (s, _w) in enumerate(cfg.scores):
+            fetch_raw, fetch_norm, _host = plan[k]
+            if fetch_raw:
+                res[f"raw:{k}"] = stakem(out[f"raw:{s}"]).astype(getattr(jnp, raw_dtypes[k]))
+            if fetch_norm:
+                res[f"norm:{k}"] = stakem(out[f"norm:{s}"]).astype(jnp.int8)
+        parts = [
+            lax.bitcast_convert_type(res[name], jnp.uint8).reshape(-1)
+            for name, _dt, _shape in manifest
+        ]
+        return jnp.concatenate(parts)
 
-    return jax.jit(run)
+    return jax.jit(run), manifest
+
+
+def unpack_compact_blob(blob: np.ndarray, manifest: "list[tuple[str, str, tuple]]") -> dict:
+    """Slice the single fetched uint8 blob back into named planes (host
+    views, no copies beyond the one D2H transfer)."""
+    out: dict = {}
+    off = 0
+    for name, dt, shape in manifest:
+        n = int(np.prod(shape)) * np.dtype(dt).itemsize
+        out[name] = blob[off : off + n].view(dt).reshape(shape)
+        off += n
+    if "fail" in out:
+        packed = out.pop("fail")
+        out["fail_plug"] = ((packed >> 8).astype(np.int16) - 1).astype(np.int8)
+        out["fail_code"] = (packed & 0xFF).astype(np.uint8)
+    return out
+
+
+def _host_default_normalize(raw: np.ndarray, valid: np.ndarray, reverse: bool) -> np.ndarray:
+    """helper.DefaultNormalizeScore recomputed on host over the compacted
+    feasible window — integer arithmetic, equal to the kernel's float
+    path for the int8/int16 raws the fetch plan routes here."""
+    r = np.where(valid, raw, 0).astype(np.int64)
+    mx = r.max(axis=1)
+    q = (r * int(MAX_NODE_SCORE)) // np.maximum(mx, 1)[:, None]
+    out = int(MAX_NODE_SCORE) - q if reverse else q
+    out = np.where(mx[:, None] == 0, int(MAX_NODE_SCORE) if reverse else 0, out)
+    return np.where(valid, out, 0).astype(np.int8)
+
+
+def _host_minmax_normalize(raw: np.ndarray, valid: np.ndarray) -> np.ndarray:
+    """InterPodAffinity's MAX*(v-min)/(max-min) on host (see above)."""
+    r = raw.astype(np.int64)
+    big = np.int64(1) << 40
+    mn = np.where(valid, r, big).min(axis=1)
+    mx = np.where(valid, r, -big).max(axis=1)
+    diff = mx - mn
+    q = ((r - mn[:, None]) * int(MAX_NODE_SCORE)) // np.maximum(diff, 1)[:, None]
+    out = np.where(diff[:, None] > 0, q, 0)
+    return np.where(valid, out, 0).astype(np.int8)
+
+
+def reconstruct_trace(
+    cfg: BatchConfig,
+    fetched: "dict[str, np.ndarray]",
+    sample_start: np.ndarray,
+    sample_processed: np.ndarray,
+    n_true: int,
+    feasible_count: np.ndarray,
+    raw_dtypes: "tuple[str, ...]",
+    p_true: int,
+    WS: int,
+) -> dict:
+    """Expand the minimal fetch back to the trace interface the annotation
+    writer reads (sids [P,WS] int32, raw [S,P,WS] int32, norm [S,P,WS]
+    int8, fail planes) — all host-side numpy, no further D2H.
+
+    Rows ≥ ``p_true`` are shape padding (pod_active=False in the kernel):
+    their planes are left empty — no consumer reads them."""
+    P = len(sample_start)
+    fp = fetched.get("fail_plug")
+    out: dict = {}
+    if fp is not None:
+        out["fail_plug"] = fp
+        out["fail_code"] = fetched["fail_code"]
+        W = fp.shape[1]
+        r = np.arange(W, dtype=np.int64)[None, :]
+        proc = np.minimum(sample_processed.astype(np.int64), n_true)[:, None]
+        ids = (sample_start.astype(np.int64)[:, None] + r) % max(n_true, 1)
+        # ascending-id column order (invalid columns pushed past the end),
+        # matching the compact planes' argsort
+        ids = np.sort(np.where(r < proc, ids, n_true + r), axis=1)
+        in_window = np.arange(W, dtype=np.int64)[None, :] < proc
+        in_window[p_true:] = False
+        feas = in_window & (fp < 0)
+        pos = np.cumsum(feas, axis=1) - 1
+        take = feas & (pos < WS)
+        sids = np.full((P, WS), -1, dtype=np.int32)
+        rows = np.broadcast_to(np.arange(P)[:, None], (P, W))
+        sids[rows[take], pos[take]] = ids[take].astype(np.int32)
+        counts = feas.sum(axis=1)
+        if not np.array_equal(counts[:p_true], feasible_count[:p_true]):
+            raise RuntimeError(
+                "derived feasible ids disagree with the kernel's feasible counts"
+            )
+        out["sids"] = sids
+    else:
+        out["sids"] = fetched["sids"]
+    if cfg.scores:
+        valid = out["sids"] >= 0
+        S = len(cfg.scores)
+        raw = np.zeros((S, P, WS), dtype=np.int32)
+        norm = np.zeros((S, P, WS), dtype=np.int8)
+        plan = trace_fetch_plan(cfg, raw_dtypes)
+        for k in range(S):
+            fetch_raw, fetch_norm, host = plan[k]
+            if fetch_raw:
+                raw[k] = fetched[f"raw:{k}"]
+            if fetch_norm:
+                norm[k] = fetched[f"norm:{k}"]
+                if not fetch_raw:
+                    raw[k] = norm[k]  # identity-normalized plugin
+            elif host == "default":
+                norm[k] = _host_default_normalize(raw[k], valid, reverse=False)
+            elif host == "default_reverse":
+                norm[k] = _host_default_normalize(raw[k], valid, reverse=True)
+            elif host == "minmax":
+                norm[k] = _host_minmax_normalize(raw[k], valid)
+        out["raw"] = raw
+        out["norm"] = norm
+    return out
 
 
 def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
@@ -1024,6 +1225,22 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
                 jnp.broadcast_to(ys["final_start"], (P,)).astype(jnp.int32),
             ]
         )
+        if cfg.trace and cfg.scores:
+            # [S,2] feasible-window raw extrema: the host picks each score
+            # plane's fetch dtype from these (raw_dtype_for) before
+            # building the compact executable
+            feas = ys["feasible"]
+            ys["raw_minmax"] = jnp.stack(
+                [
+                    jnp.stack(
+                        [
+                            jnp.min(jnp.where(feas, ys[f"raw:{s}"], 0)).astype(jnp.int32),
+                            jnp.max(jnp.where(feas, ys[f"raw:{s}"], 0)).astype(jnp.int32),
+                        ]
+                    )
+                    for s, _w in cfg.scores
+                ]
+            )
         return carry, ys
 
     CARRY0_FIELDS = (

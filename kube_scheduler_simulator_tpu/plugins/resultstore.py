@@ -27,7 +27,7 @@ _MARSHAL_MEMO: dict = {}
 
 def _memo_marshal(d: Any) -> str:
     if isinstance(d, RawJSON):
-        return str(d)
+        return d
     if isinstance(d, dict) and len(d) <= 32:
         try:
             # value types are part of the key: 1, True and 1.0 compare

@@ -157,17 +157,39 @@ _KEY_FRAGS: dict[str, str] = {}
 def _entry_json(new_results: dict[str, str]) -> str:
     """go_marshal of the history entry, assembled from fragments: the
     entry is a flat map whose VALUES are the (often megabyte) annotation
-    bodies just built — ``go_string`` escapes each with C-level replaces
-    instead of re-scanning everything through json.dumps."""
-    parts = []
-    for k in sorted(new_results):
-        if k == anno.RESULT_HISTORY:
-            continue
+    bodies just built — the native single-pass escape (or ``go_string``'s
+    replace chain) avoids re-scanning everything through json.dumps, and
+    values that carry their pre-escaped twin (EscapedJSON, from the batch
+    engine's C assembly) are embedded without any scan at all."""
+    from kube_scheduler_simulator_tpu import native
+    from kube_scheduler_simulator_tpu.utils.gojson import EscapedJSON
+
+    keys = sorted(k for k in new_results if k != anno.RESULT_HISTORY)
+    frags = []
+    for k in keys:
         frag = _KEY_FRAGS.get(k)
         if frag is None:
             frag = _KEY_FRAGS[k] = go_string_key(k)
-        parts.append(frag + go_string(new_results[k]))
-    return "{" + ",".join(parts) + "}"
+        frags.append(frag)
+    vals = [new_results[k] for k in keys]
+    escs = [getattr(v, "escaped", None) for v in vals]
+    entry = None
+    if native.fastjson is not None:
+        try:
+            entry = native.fastjson.history_entry(frags, vals, escs)
+        except UnicodeEncodeError:  # lone surrogates: take the Python path
+            entry = None
+    if entry is None:
+        entry = "{" + ",".join(
+            frag + ('"' + e + '"' if e is not None else go_string(v))
+            for frag, v, e in zip(frags, vals, escs)
+        ) + "}"
+    # the escaped twin served its one purpose — release the bytes (the
+    # value object lives on in the pod's annotations)
+    for v in vals:
+        if isinstance(v, EscapedJSON):
+            v.escaped = None
+    return entry
 
 
 def _updated_history(existing: "str | None", new_results: dict[str, str], trusted: bool = False) -> str:

@@ -304,32 +304,120 @@ class BatchResult:
             splugins = sorted(s for s, _w in self._engine.cfg.scores)
             key = [go_string_key(nm) for nm in names]
             passed = go_marshal(tr["passed_entry"])
+            order_by_name = np.array(
+                sorted(range(len(names)), key=names.__getitem__), dtype=np.int64
+            )
+            rank_by_name = np.empty(len(names), dtype=np.int64)
+            rank_by_name[order_by_name] = np.arange(len(names))
+            pass_list = [k + passed for k in key]
             tr["frags"] = {
                 "key": key,
+                "key_arr": np.array(key, dtype=object),
                 "passed": passed,
                 "splug": [(go_string_key(s) + '"', s) for s in splugins],
                 # go_marshal key order = sorted node names; precomputed
-                # once so per-pod assembly never sorts
-                "order_by_name": np.array(
-                    sorted(range(len(names)), key=names.__getitem__), dtype=np.int64
-                ),
+                # once so per-pod assembly never sorts strings
+                "order_by_name": order_by_name,
+                "rank_by_name": rank_by_name,
                 # whole all-passed entries, ready to select + join
-                "pass_arr": np.array([k + passed for k in key], dtype=object),
+                "pass_arr": np.array(pass_list, dtype=object),
             }
+            from kube_scheduler_simulator_tpu import native
+
+            if native.fastjson is not None:
+                # escaped twins of every per-round fragment: the C
+                # assembly emits (annotation, history-escaped) pairs in
+                # one pass from these.  Lone surrogates (UTF-8-unencodable
+                # node names from permissive JSON input) skip the native
+                # path for the round.
+                try:
+                    eb = native.fastjson.escape_body
+                    key_esc = [eb(k) for k in key]
+                    tr["frags"].update(
+                        pass_list=pass_list,
+                        pass_esc=[eb(p) for p in pass_list],
+                        key_esc=key_esc,
+                        key_esc_arr=np.array(key_esc, dtype=object),
+                        splug_esc=[eb(f) for f, _s in tr["frags"]["splug"]],
+                        order_list=order_by_name.tolist(),
+                    )
+                except UnicodeEncodeError:
+                    pass
         return tr["frags"]
 
     def filter_annotation_json(self, i: int) -> "str":
         """go_marshal(filter_annotation(i)) assembled from fragments.
 
-        Vectorized: the visited set becomes a node mask, the name-sorted
-        visited ids come from one precomputed order array (no per-pod
-        sort), and the dominant all-passed entries are selected out of a
-        prebuilt object array — Python-level work only happens at the
-        (rare) failing nodes."""
-        from kube_scheduler_simulator_tpu.utils.gojson import RawJSON, go_marshal
+        With the native extension, one C pass walks the name-ordered node
+        ids, window-tests each against the pod's visit rotation, and
+        emits the annotation AND its history-escaped twin (EscapedJSON)
+        from the per-round fragment arrays; Python-level work only
+        happens at the (rare) failing nodes.  The fallback below is the
+        byte-identical vectorized-numpy path."""
+        from kube_scheduler_simulator_tpu import native
 
         tr = self._tr()
         fr = self._fr()
+        fj = native.fastjson
+        if fj is not None and "pass_list" in fr and self._prefilter_node_set(i) is None:
+            try:
+                return self._filter_annotation_json_native(i, tr, fr, fj)
+            except UnicodeEncodeError:
+                pass  # lone surrogates in a message: Python path below
+        return self._filter_annotation_json_py(i, tr, fr)
+
+    def _filter_annotation_json_native(self, i: int, tr: dict, fr: dict, fj) -> "str":
+        from kube_scheduler_simulator_tpu.utils.gojson import EscapedJSON, go_marshal
+
+        start = int(self.out["sample_start"][i])
+        proc = int(self.out["sample_processed"][i])
+        n_true = self.problem.N_true
+        fail_ids: list = []
+        fail_frags: list = []
+        fail_escs: list = []
+        fp_all = tr["fail_plug"]
+        if fp_all is not None and tr["fail_any_row"][i]:
+            ids = self._visited_ids(i)
+            fp = fp_all[i]
+            fc = tr["fail_code"][i]
+            cols = np.nonzero(fp[: len(ids)] >= 0)[0]
+            entry_memo = tr.setdefault("entry_memo_esc", {})
+            cfg_filters = self._engine.cfg.filters
+            filters = self._engine.filters
+            fail_pos = tr["fail_pos"]
+            key_frag = fr["key"]
+            key_esc = fr["key_esc"]
+            for t in cols:
+                n = int(ids[t])
+                k = int(fp[t])
+                plugin = cfg_filters[k]
+                msg = self._msg(i, n, plugin, int(fc[t]))
+                ek = (k, msg)
+                pair = entry_memo.get(ek)
+                if pair is None:
+                    entry = {p: PASSED_FILTER_MESSAGE for p in filters[: fail_pos[k]]}
+                    entry[plugin] = msg
+                    frag = go_marshal(entry)
+                    pair = entry_memo[ek] = (frag, fj.escape_body(frag))
+                fail_ids.append(n)
+                fail_frags.append(key_frag[n] + pair[0])
+                fail_escs.append(key_esc[n] + pair[1])
+        s, esc = fj.filter_json(
+            fr["pass_list"],
+            fr["pass_esc"],
+            fr["order_list"],
+            start,
+            proc,
+            n_true,
+            fail_ids,
+            fail_frags,
+            fail_escs,
+        )
+        return EscapedJSON(s, esc)
+
+    def _filter_annotation_json_py(self, i: int, tr: dict, fr: dict) -> "str":
+        from kube_scheduler_simulator_tpu.utils.gojson import RawJSON, go_marshal
+
         ids = self._visited_ids(i)
         narrowed = self._prefilter_node_set(i)
         n_true = self.problem.N_true
@@ -379,31 +467,57 @@ class BatchResult:
 
     def score_annotations_json(self, i: int) -> "tuple[str, str]":
         """(score, finalScore) annotation JSON assembled from fragments.
-        Score values are numeric strings — no escaping needed."""
+        Score values are numeric strings — no escaping needed.  The node
+        ordering comes from one vectorized rank argsort, and the byte
+        assembly runs in C when the native extension is available (the
+        Python loop below is the byte-identical fallback —
+        tests/test_native.py)."""
+        from kube_scheduler_simulator_tpu import native
         from kube_scheduler_simulator_tpu.utils.gojson import RawJSON
 
         tr = self._tr()
         fr = self._fr()
-        sids = tr["sids"][i]
-        names = self.problem.node_names
-        key_frag = fr["key"]
+        sids_row = tr["sids"][i]
+        js = np.nonzero(sids_row >= 0)[0]
+        if js.size == 0:
+            return RawJSON("{}"), RawJSON("{}")
+        ns = sids_row[js]
+        order = np.argsort(fr["rank_by_name"][ns], kind="stable")
+        js = js[order]
+        ns = ns[order]
+        keys = fr["key_arr"][ns].tolist()
+        perm = js.tolist()
         splug = fr["splug"]
-        raw_rows = [(frag, tr["raw_s"][s][i]) for frag, s in splug]
-        fin_rows = [(frag, tr["final_s"][s][i]) for frag, s in splug]
-        feas_nodes = [(j, int(n)) for j, n in enumerate(sids) if n >= 0]
-        feas_nodes.sort(key=lambda t: names[t[1]])
+        frags = [frag for frag, _s in splug]
+        raw_rows = [tr["raw_s"][s][i] for _f, s in splug]
+        fin_rows = [tr["final_s"][s][i] for _f, s in splug]
+        if native.fastjson is not None and "key_esc_arr" in fr:
+            from kube_scheduler_simulator_tpu.utils.gojson import EscapedJSON
+
+            keys_esc = fr["key_esc_arr"][ns].tolist()
+            frags_esc = fr["splug_esc"]
+            try:
+                return (
+                    EscapedJSON(
+                        *native.fastjson.score_json_pair(keys, keys_esc, frags, frags_esc, raw_rows, perm)
+                    ),
+                    EscapedJSON(
+                        *native.fastjson.score_json_pair(keys, keys_esc, frags, frags_esc, fin_rows, perm)
+                    ),
+                )
+            except UnicodeEncodeError:
+                pass  # lone surrogates: Python loop below
         # list comprehensions, not genexprs: at bench scale these two inner
         # joins run ~8M times per wave and the generator frame overhead is
         # measurable (~2 s/wave)
         s_parts = []
         f_parts = []
-        for j, n in feas_nodes:
-            kf = key_frag[n]
+        for kf, j in zip(keys, perm):
             s_parts.append(
-                kf + "{" + ",".join([frag + row[j] + '"' for frag, row in raw_rows]) + "}"
+                kf + "{" + ",".join([frag + row[j] + '"' for frag, row in zip(frags, raw_rows)]) + "}"
             )
             f_parts.append(
-                kf + "{" + ",".join([frag + row[j] + '"' for frag, row in fin_rows]) + "}"
+                kf + "{" + ",".join([frag + row[j] + '"' for frag, row in zip(frags, fin_rows)]) + "}"
             )
         return (
             RawJSON("{" + ",".join(s_parts) + "}"),
@@ -801,20 +915,30 @@ class BatchEngine:
             # Compact the [P,N] trace on device to the annotation writer's
             # minimal reads — one (first-fail plugin, code) plane over the
             # visited width, scores over the (much narrower) feasible
-            # width — then fetch; the tunnel D2H path is ~10 MB/s, so
-            # fetch volume is the trace cost (see build_compact_fn).
+            # width at per-plugin minimal dtypes — then fetch and expand
+            # host-side (reconstruct_trace); the tunnel D2H path is
+            # ~10 MB/s, so fetch volume is the trace cost.
             max_processed = int(packed[3].max()) if packed.shape[1] else 1
             W = min(dims["N"], E._bucket(max(max_processed, 1)))
             max_feasible = int(packed[1].max()) if packed.shape[1] else 1
             WS = min(dims["N"], E._bucket(max(max_feasible, 1)))
-            ckey = (key, W, WS)
-            cfn = self._compact_cache.get(ckey)
-            if cfn is None:
-                cfn = B.build_compact_fn(cfg, dims, W, WS)
-                self._compact_cache[ckey] = cfn
+            if cfg.scores:
+                mm = np.asarray(out_dev["raw_minmax"])
+                raw_dtypes = tuple(
+                    B.raw_dtype_for(int(mm[k, 0]), int(mm[k, 1]))
+                    for k in range(len(cfg.scores))
+                )
+            else:
+                raw_dtypes = ()
+            ckey = (key, W, WS, raw_dtypes)
+            entry = self._compact_cache.get(ckey)
+            if entry is None:
+                entry = B.build_compact_fn(cfg, dims, W, WS, raw_dtypes)
+                self._compact_cache[ckey] = entry
                 self.compiles += 1
+            cfn, manifest = entry
             tr_keys = ("sample_start", "sample_processed", "feasible", "fail_plug", "fail_code")
-            cout = cfn(
+            blob = cfn(
                 {
                     k: v
                     for k, v in out_dev.items()
@@ -822,7 +946,19 @@ class BatchEngine:
                 },
                 dp.n_true,
             )
-            out["trace"] = {k: np.asarray(v) for k, v in cout.items()}
+            # ONE D2H transfer for the whole compacted trace
+            fetched = B.unpack_compact_blob(np.asarray(blob), manifest)
+            out["trace"] = B.reconstruct_trace(
+                cfg,
+                fetched,
+                out["sample_start"],
+                out["sample_processed"],
+                pr.N_true,
+                out["feasible_count"],
+                raw_dtypes,
+                len(pending),
+                WS,
+            )
         t3 = time.perf_counter()
         self.last_timings = {
             "encode_s": t1 - t0,

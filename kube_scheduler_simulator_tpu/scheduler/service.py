@@ -16,6 +16,7 @@ gives the reference's always-on behavior driven by store events.
 from __future__ import annotations
 
 import copy
+import gc
 import threading
 from typing import Any, Callable
 
@@ -331,23 +332,35 @@ class SchedulerService:
         nodes whichever path a round takes."""
         assert self.framework is not None, "scheduler not started"
         results: dict[str, ScheduleResult] = {}
-        for _ in range(max_rounds):
-            round_results: "dict[str, ScheduleResult] | None" = None
-            if self.use_batch in ("auto", "force"):
-                round_results = self._schedule_pending_batch()
-            if round_results is None:
-                pending = self.framework.sort_pods(self.pending_pods())
-                if not pending:
+        # Big rounds allocate millions of short-lived strings (annotation
+        # assembly) next to a store holding millions of live ones —
+        # generational GC scans cost ~10 s/round at bench scale for zero
+        # reclaim (refcounting already frees the garbage; cycles are not
+        # created here).  Pause collection for the round.
+        gc_was_enabled = gc.isenabled()
+        if gc_was_enabled:
+            gc.disable()
+        try:
+            for _ in range(max_rounds):
+                round_results: "dict[str, ScheduleResult] | None" = None
+                if self.use_batch in ("auto", "force"):
+                    round_results = self._schedule_pending_batch()
+                if round_results is None:
+                    pending = self.framework.sort_pods(self.pending_pods())
+                    if not pending:
+                        break
+                    snapshot = self.build_snapshot()
+                    round_results = {}
+                    for pod in pending:
+                        round_results[_pod_key(pod)] = self.schedule_one(pod, snapshot)
+                if not round_results:
                     break
-                snapshot = self.build_snapshot()
-                round_results = {}
-                for pod in pending:
-                    round_results[_pod_key(pod)] = self.schedule_one(pod, snapshot)
-            if not round_results:
-                break
-            results.update(round_results)
-            if not any(r.success or r.nominated_node or r.waiting_on for r in round_results.values()):
-                break
+                results.update(round_results)
+                if not any(r.success or r.nominated_node or r.waiting_on for r in round_results.values()):
+                    break
+        finally:
+            if gc_was_enabled:
+                gc.enable()
         return results
 
     def allow_waiting_pod(self, namespace: str, name: str, plugin: str) -> "ScheduleResult | None":

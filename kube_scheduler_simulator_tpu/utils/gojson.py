@@ -40,10 +40,25 @@ class RawJSON(str):
     __slots__ = ()
 
 
+class EscapedJSON(RawJSON):
+    """RawJSON that also carries its own JSON-string-escaped body
+    (``escaped``, no surrounding quotes) — the batch engine's C assembly
+    emits both twins in one pass, and the result-history writer embeds
+    the escaped body instead of re-scanning megabytes per attempt.  The
+    reflector clears ``escaped`` once the history entry is written."""
+
+    __slots__ = ("escaped",)
+
+    def __new__(cls, s: str, escaped: "str | None" = None):
+        o = str.__new__(cls, s)
+        o.escaped = escaped
+        return o
+
+
 def go_marshal(obj: Any) -> str:
     """Serialize ``obj`` the way Go's ``json.Marshal`` would."""
     if isinstance(obj, RawJSON):
-        return str(obj)
+        return obj
     raw = json.dumps(obj, sort_keys=True, separators=(",", ":"), ensure_ascii=False)
     # json.dumps never emits raw & < > outside of string literals, so a
     # post-pass escape over the whole document only touches string contents
@@ -61,13 +76,7 @@ def go_string_key(s: str) -> str:
 _CTRL_RE = re.compile("[\x00-\x1f\u2028\u2029]")
 
 
-def go_string(s: str) -> str:
-    """A JSON string literal (quotes included) exactly as go_marshal emits
-    it.  The history annotation re-encodes megabyte annotation VALUES as
-    JSON strings every scheduling attempt; ``json.dumps`` + the html
-    post-pass re-scan those bytes several times, while this fast path is
-    two C-level replaces for the JSON escapes plus three more that are
-    no-ops unless the raw character actually occurs."""
+def _go_string_py(s: str) -> str:
     if _CTRL_RE.search(s):
         return _escape_html(json.dumps(s, ensure_ascii=False))
     return (
@@ -79,3 +88,21 @@ def go_string(s: str) -> str:
         .replace(">", "\\u003e")
         + '"'
     )
+
+
+def go_string(s: str) -> str:
+    """A JSON string literal (quotes included) exactly as go_marshal emits
+    it.  The history annotation re-encodes megabyte annotation VALUES as
+    JSON strings every scheduling attempt; the native single-pass escape
+    (native/fastjson.c) does it at memcpy speed, the Python fallback with
+    C-level str.replace passes (tests/test_native.py pins equality).
+    Strings UTF-8 can't encode (lone surrogates from permissive JSON
+    input) take the Python path, which preserves them like json.dumps."""
+    from kube_scheduler_simulator_tpu import native
+
+    if native.fastjson is not None:
+        try:
+            return native.fastjson.escape_string(s)
+        except UnicodeEncodeError:
+            pass
+    return _go_string_py(s)

@@ -1,0 +1,146 @@
+"""The native (C) annotation-assembly paths must be byte-identical to the
+pure-Python implementations they accelerate (utils/gojson, the batch
+engine's fragment assembly) — the annotation trail is a byte contract."""
+
+import json
+import random
+import string
+
+import pytest
+
+from kube_scheduler_simulator_tpu import native
+from kube_scheduler_simulator_tpu.utils import gojson
+
+pytestmark = pytest.mark.skipif(
+    native.fastjson is None, reason="native extension unavailable (no compiler)"
+)
+
+
+def py_go_string(s: str) -> str:
+    return gojson._escape_html(json.dumps(s, ensure_ascii=False))
+
+
+def test_escape_string_explicit_cases():
+    cases = [
+        "",
+        "plain",
+        'quo"te',
+        "back\\slash",
+        "html & <b> > ok",
+        "ctrl\x00\x01\x1f",
+        "named\b\t\n\f\r",
+        "line sep   and   end",
+        " ",
+        "\xe2 lone e-circumflex-ish",
+        "caf\xe9 中文 \U0001d11e",
+        "mixed \\\" & < > \n   \U0001d11e tail",
+    ]
+    for s in cases:
+        assert native.fastjson.escape_string(s) == py_go_string(s), repr(s)
+
+
+def test_escape_string_fuzz():
+    rng = random.Random(42)
+    pool = (
+        string.ascii_letters
+        + string.digits
+        + '"\\&<>{}[]:,'
+        + "".join(chr(c) for c in range(0x20))
+        + "  \xe9中\U0001d11e\xe2"
+    )
+    for _ in range(5000):
+        s = "".join(rng.choice(pool) for _ in range(rng.randrange(0, 60)))
+        assert native.fastjson.escape_string(s) == py_go_string(s), repr(s)
+
+
+def test_go_string_uses_native_and_matches():
+    # go_string routes through the native path when available
+    for s in ["x", 'a"b', "&", " ", "ctl\x02"]:
+        assert gojson.go_string(s) == py_go_string(s)
+
+
+def test_history_entry_matches_python_assembly():
+    keys = [gojson.go_string_key(k) for k in ["a", 'we"ird', "z&"]]
+    values = ['{"j":"son"}', "plain & <value>", "ctl\n "]
+    want = (
+        "{" + ",".join(k + py_go_string(v) for k, v in zip(keys, values)) + "}"
+    )
+    assert native.fastjson.history_entry(keys, values) == want
+    # and the whole thing parses back to the original map
+    parsed = json.loads(native.fastjson.history_entry(keys, values))
+    assert parsed == {"a": values[0], 'we"ird': values[1], "z&": values[2]}
+
+
+def test_score_json_matches_python_assembly():
+    keys = ['"n1":', '"n0":', '"n2":']
+    frags = ['"P1":"', '"P2":"']
+    rows = [["10", "20", "30", "40"], ["1", "2", "3", "4"]]
+    perm = [3, 0, 2]
+    got = native.fastjson.score_json(keys, frags, rows, perm)
+    want = "{" + ",".join(
+        k + "{" + ",".join(f + r[j] + '"' for f, r in zip(frags, rows)) + "}"
+        for k, j in zip(keys, perm)
+    ) + "}"
+    assert got == want
+    assert json.loads(got) == {
+        "n1": {"P1": "40", "P2": "4"},
+        "n0": {"P1": "10", "P2": "1"},
+        "n2": {"P1": "30", "P2": "3"},
+    }
+
+
+def test_score_json_empty():
+    assert native.fastjson.score_json([], ['"P":"'], [["1"]], []) == "{}"
+
+
+def test_escape_body_matches_quoted_form():
+    for s in ["", 'a"b\\c', "x & <y> \n  ", 'node-1":{"P":"passed"}']:
+        assert '"' + native.fastjson.escape_body(s) + '"' == py_go_string(s)
+
+
+def test_history_entry_with_pre_escaped_values():
+    keys = ['"k1":', '"k2":']
+    vals = ['{"a":"b"}', "plain"]
+    escs = [native.fastjson.escape_body(vals[0]), None]
+    got = native.fastjson.history_entry(keys, vals, escs)
+    want = native.fastjson.history_entry(keys, vals)
+    assert got == want
+
+
+def test_filter_json_twins():
+    pass_arr = [f'"n{i}":{{"P":"passed"}}' for i in range(6)]
+    pass_esc = [native.fastjson.escape_body(x) for x in pass_arr]
+    # name order for n0..n5 is already sorted
+    order = [0, 1, 2, 3, 4, 5]
+    # window: start=4, proc=3 over n_true=6 -> visits 4,5,0
+    fail_frags = ['"n5":{"P":"nope & <bad>"}']
+    fail_escs = [native.fastjson.escape_body(fail_frags[0])]
+    s, esc = native.fastjson.filter_json(
+        pass_arr, pass_esc, order, 4, 3, 6, [5], fail_frags, fail_escs
+    )
+    assert s == "{" + pass_arr[0] + "," + pass_arr[4] + "," + fail_frags[0] + "}"
+    assert '"' + esc + '"' == py_go_string(s)
+    # full coverage, no failures
+    s2, esc2 = native.fastjson.filter_json(pass_arr, pass_esc, order, 0, 6, 6, [], [], [])
+    assert s2 == "{" + ",".join(pass_arr) + "}"
+    assert '"' + esc2 + '"' == py_go_string(s2)
+
+
+def test_score_json_pair_twins():
+    keys = ['"n1":', '"n0":']
+    keys_esc = [native.fastjson.escape_body(k) for k in keys]
+    frags = ['"P1":"', '"P2":"']
+    frags_esc = [native.fastjson.escape_body(f) for f in frags]
+    rows = [["10", "20"], ["1", "2"]]
+    s, esc = native.fastjson.score_json_pair(keys, keys_esc, frags, frags_esc, rows, [1, 0])
+    assert s == native.fastjson.score_json(keys, frags, rows, [1, 0])
+    assert '"' + esc + '"' == py_go_string(s)
+
+
+def test_error_paths():
+    with pytest.raises(TypeError):
+        native.fastjson.escape_string(b"bytes")
+    with pytest.raises(TypeError):
+        native.fastjson.history_entry(["k"], "notalist")
+    with pytest.raises((IndexError, ValueError)):
+        native.fastjson.score_json(['"n":'], ['"P":"'], [["1"]], [5])
