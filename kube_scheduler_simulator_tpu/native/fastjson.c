@@ -28,6 +28,7 @@ typedef struct {
     char *p;
     Py_ssize_t len;
     Py_ssize_t cap;
+    int nonascii; /* any byte >= 0x80 written (tracked per source str) */
 } Buf;
 
 static int buf_init(Buf *b, Py_ssize_t cap) {
@@ -36,6 +37,7 @@ static int buf_init(Buf *b, Py_ssize_t cap) {
     if (!b->p) { PyErr_NoMemory(); return -1; }
     b->len = 0;
     b->cap = cap;
+    b->nonascii = 0;
     return 0;
 }
 
@@ -63,7 +65,15 @@ static inline int buf_putc(Buf *b, char c) {
 }
 
 static PyObject *buf_take(Buf *b) {
-    PyObject *r = PyUnicode_DecodeUTF8(b->p, b->len, "strict");
+    PyObject *r;
+    if (!b->nonascii) {
+        /* pure-ASCII output (the overwhelming case): build the str by
+         * memcpy instead of a validating UTF-8 decode pass */
+        r = PyUnicode_New(b->len, 127);
+        if (r) memcpy(PyUnicode_DATA(r), b->p, (size_t)b->len);
+    } else {
+        r = PyUnicode_DecodeUTF8(b->p, b->len, "strict");
+    }
     PyMem_Free(b->p);
     b->p = NULL;
     return r;
@@ -140,6 +150,7 @@ static int escape_value(Buf *b, PyObject *v) {
     }
     s = PyUnicode_AsUTF8AndSize(v, &n);
     if (!s) return -1;
+    if (!PyUnicode_IS_ASCII(v)) b->nonascii = 1;
     if (buf_putc(b, '"') < 0) return -1;
     if (escape_into(b, s, n) < 0) return -1;
     return buf_putc(b, '"');
@@ -154,6 +165,7 @@ static int put_str(Buf *b, PyObject *v) {
     }
     s = PyUnicode_AsUTF8AndSize(v, &n);
     if (!s) return -1;
+    if (!PyUnicode_IS_ASCII(v)) b->nonascii = 1;
     return buf_put(b, s, n);
 }
 
@@ -171,6 +183,7 @@ static PyObject *py_escape_string(PyObject *self, PyObject *arg) {
     s = PyUnicode_AsUTF8AndSize(arg, &n);
     if (!s) return NULL;
     if (buf_init(&b, n + (n >> 3) + 16) < 0) return NULL;
+    if (!PyUnicode_IS_ASCII(arg)) b.nonascii = 1;
     if (buf_putc(&b, '"') < 0 || escape_into(&b, s, n) < 0 || buf_putc(&b, '"') < 0) {
         PyMem_Free(b.p);
         return NULL;
@@ -190,6 +203,7 @@ static PyObject *py_escape_body(PyObject *self, PyObject *arg) {
     s = PyUnicode_AsUTF8AndSize(arg, &n);
     if (!s) return NULL;
     if (buf_init(&b, n + (n >> 3) + 16) < 0) return NULL;
+    if (!PyUnicode_IS_ASCII(arg)) b.nonascii = 1;
     if (escape_into(&b, s, n) < 0) {
         PyMem_Free(b.p);
         return NULL;
@@ -246,73 +260,113 @@ fail:
     return NULL;
 }
 
-/* filter_json(pass_arr, pass_esc, order, start, proc, n_true,
- *             fail_ids, fail_frags, fail_escs) -> (str, str)
+/* filter_json(pass_arr, pass_esc, key_frags, key_escs,
+ *             order: int64 buffer, start, proc, n_true,
+ *             fail_ids: int64 buffer | None, fail_uidx: int64 buffer | None,
+ *             ftable, etable) -> (str, str)
  *
  * pass_arr[id] / pass_esc[id]: whole '"node":{...all passed...}' entry
  * (and its escaped twin) per node id.  order: node ids in go_marshal key
  * order (sorted names).  A node id is emitted iff its visit rank
- * (id - start) mod n_true < proc.  fail_ids/fail_frags/fail_escs
- * override the entries of failing nodes. */
+ * (id - start) mod n_true < proc.  Failing nodes emit
+ * key_frags[id] + ftable[fail_uidx[t]] (and the escaped twins) instead —
+ * the distinct-entry tables come from the caller's vectorized
+ * (plugin, code) dedup, so Python never builds per-node strings. */
+static int get_i64(PyObject *obj, Py_buffer *view, const long long **data, Py_ssize_t *n) {
+    if (obj == Py_None) {
+        *data = NULL;
+        *n = 0;
+        view->obj = NULL;
+        return 0;
+    }
+    if (PyObject_GetBuffer(obj, view, PyBUF_CONTIG_RO) < 0) return -1;
+    if (view->len % 8 != 0 || (view->itemsize != 8 && view->itemsize != 1)) {
+        PyBuffer_Release(view);
+        view->obj = NULL;
+        PyErr_SetString(PyExc_TypeError, "expected contiguous int64 buffer");
+        return -1;
+    }
+    *data = (const long long *)view->buf;
+    *n = view->len / 8;
+    return 0;
+}
+
 static PyObject *py_filter_json(PyObject *self, PyObject *args) {
-    PyObject *pass_arr, *pass_esc, *order, *fail_ids, *fail_frags, *fail_escs;
+    PyObject *pass_arr, *pass_esc, *key_frags, *key_escs, *order_o, *fail_ids_o,
+        *fail_uidx_o, *ftable, *etable;
     long start, proc, n_true;
     Buf b, be;
-    PyObject **over = NULL, **over_esc = NULL;
+    int have_bufs = 0;
+    int *over_idx = NULL;
+    Py_buffer order_v = {0}, ids_v = {0}, uidx_v = {0};
+    const long long *order = NULL, *fail_ids = NULL, *fail_uidx = NULL;
+    Py_ssize_t T = 0, NF = 0, NF2 = 0, TBL = 0;
     PyObject *r1 = NULL, *r2 = NULL, *out = NULL;
-    Py_ssize_t t, T, first = 1;
+    Py_ssize_t t, first = 1;
     (void)self;
-    if (!PyArg_ParseTuple(args, "OOOlllOOO", &pass_arr, &pass_esc, &order,
-                          &start, &proc, &n_true, &fail_ids, &fail_frags, &fail_escs))
+    if (!PyArg_ParseTuple(args, "OOOOOlllOOOO", &pass_arr, &pass_esc, &key_frags,
+                          &key_escs, &order_o, &start, &proc, &n_true, &fail_ids_o,
+                          &fail_uidx_o, &ftable, &etable))
         return NULL;
-    if (!PyList_Check(pass_arr) || !PyList_Check(pass_esc) || !PyList_Check(order) ||
-        !PyList_Check(fail_ids) || !PyList_Check(fail_frags) || !PyList_Check(fail_escs) ||
-        PyList_GET_SIZE(fail_ids) != PyList_GET_SIZE(fail_frags) ||
-        PyList_GET_SIZE(fail_ids) != PyList_GET_SIZE(fail_escs) || n_true < 0) {
+    if (!PyList_Check(pass_arr) || !PyList_Check(pass_esc) || !PyList_Check(key_frags) ||
+        !PyList_Check(key_escs) || !PyList_Check(ftable) || !PyList_Check(etable) ||
+        PyList_GET_SIZE(ftable) != PyList_GET_SIZE(etable) || n_true < 0) {
         PyErr_SetString(PyExc_TypeError, "filter_json: bad arguments");
         return NULL;
     }
-    T = PyList_GET_SIZE(order);
-    if (PyList_GET_SIZE(pass_arr) < T || PyList_GET_SIZE(pass_esc) < T) {
-        PyErr_SetString(PyExc_ValueError, "filter_json: pass arrays shorter than order");
-        return NULL;
+    if (get_i64(order_o, &order_v, &order, &T) < 0) return NULL;
+    have_bufs = 1;
+    if (get_i64(fail_ids_o, &ids_v, &fail_ids, &NF) < 0) goto done;
+    if (get_i64(fail_uidx_o, &uidx_v, &fail_uidx, &NF2) < 0) goto done;
+    TBL = PyList_GET_SIZE(ftable);
+    if (NF != NF2) {
+        PyErr_SetString(PyExc_ValueError, "filter_json: fail_ids/fail_uidx length mismatch");
+        goto done;
     }
-    if (PyList_GET_SIZE(fail_ids) > 0) {
-        over = (PyObject **)PyMem_Calloc((size_t)(n_true > 0 ? n_true : 1), sizeof(PyObject *));
-        over_esc = (PyObject **)PyMem_Calloc((size_t)(n_true > 0 ? n_true : 1), sizeof(PyObject *));
-        if (!over || !over_esc) {
-            PyMem_Free(over);
-            PyMem_Free(over_esc);
-            return PyErr_NoMemory();
+    if (PyList_GET_SIZE(pass_arr) < n_true || PyList_GET_SIZE(pass_esc) < n_true ||
+        PyList_GET_SIZE(key_frags) < n_true || PyList_GET_SIZE(key_escs) < n_true) {
+        PyErr_SetString(PyExc_ValueError, "filter_json: fragment lists shorter than n_true");
+        goto done;
+    }
+    if (NF > 0) {
+        over_idx = (int *)PyMem_Malloc(sizeof(int) * (size_t)(n_true > 0 ? n_true : 1));
+        if (!over_idx) {
+            PyErr_NoMemory();
+            goto done;
         }
-        for (t = 0; t < PyList_GET_SIZE(fail_ids); t++) {
-            long id = PyLong_AsLong(PyList_GET_ITEM(fail_ids, t));
-            if (id < 0 || id >= n_true) {
-                PyErr_SetString(PyExc_IndexError, "filter_json: fail id out of range");
+        memset(over_idx, 0xFF, sizeof(int) * (size_t)(n_true > 0 ? n_true : 1));
+        for (t = 0; t < NF; t++) {
+            long long id = fail_ids[t];
+            long long u = fail_uidx[t];
+            if (id < 0 || id >= n_true || u < 0 || u >= TBL) {
+                PyErr_SetString(PyExc_IndexError, "filter_json: fail id/index out of range");
                 goto done;
             }
-            over[id] = PyList_GET_ITEM(fail_frags, t);
-            over_esc[id] = PyList_GET_ITEM(fail_escs, t);
+            over_idx[id] = (int)u;
         }
     }
-    if (buf_init(&b, 256 + T * 32) < 0) goto done_nobuf;
+    if (buf_init(&b, 256 + T * 32) < 0) goto done;
     if (buf_init(&be, 256 + T * 32) < 0) {
         PyMem_Free(b.p);
-        goto done_nobuf;
+        goto done;
     }
     if (buf_putc(&b, '{') < 0 || buf_putc(&be, '{') < 0) goto fail;
     for (t = 0; t < T; t++) {
-        long id = PyLong_AsLong(PyList_GET_ITEM(order, t));
-        long rank;
-        if (id < 0 && PyErr_Occurred()) goto fail;
+        long long id = order[t];
+        long long rank;
         if (id < 0 || id >= n_true) continue;
         rank = id - start;
         if (rank < 0) rank += n_true;
         if (rank >= proc) continue;
         if (!first && (buf_putc(&b, ',') < 0 || buf_putc(&be, ',') < 0)) goto fail;
         first = 0;
-        if (over && over[id]) {
-            if (put_str(&b, over[id]) < 0 || put_str(&be, over_esc[id]) < 0) goto fail;
+        if (over_idx && over_idx[id] >= 0) {
+            int u = over_idx[id];
+            if (put_str(&b, PyList_GET_ITEM(key_frags, (Py_ssize_t)id)) < 0 ||
+                put_str(&b, PyList_GET_ITEM(ftable, u)) < 0 ||
+                put_str(&be, PyList_GET_ITEM(key_escs, (Py_ssize_t)id)) < 0 ||
+                put_str(&be, PyList_GET_ITEM(etable, u)) < 0)
+                goto fail;
         } else {
             if (put_str(&b, PyList_GET_ITEM(pass_arr, (Py_ssize_t)id)) < 0 ||
                 put_str(&be, PyList_GET_ITEM(pass_esc, (Py_ssize_t)id)) < 0)
@@ -329,10 +383,11 @@ static PyObject *py_filter_json(PyObject *self, PyObject *args) {
 fail:
     PyMem_Free(b.p);
     PyMem_Free(be.p);
-done_nobuf:
 done:
-    PyMem_Free(over);
-    PyMem_Free(over_esc);
+    PyMem_Free(over_idx);
+    if (have_bufs && order_v.obj) PyBuffer_Release(&order_v);
+    if (ids_v.obj) PyBuffer_Release(&ids_v);
+    if (uidx_v.obj) PyBuffer_Release(&uidx_v);
     return out;
 }
 

@@ -117,6 +117,19 @@ NORMALIZE_KIND = {
 }
 
 
+def fail_pack_mode(code_max: int, n_filters: int) -> int:
+    """How the (first-fail plugin, code) planes travel: 0 = one uint8
+    nibble pair, 1 = one uint16 byte pair, 2/3 = separate planes with
+    int16/int32 codes.  Both the compact-fn builder and the engine's
+    executable cache key derive from THIS function — the packing decision
+    determines the blob manifest, so the two must never disagree."""
+    if code_max <= 15 and n_filters + 1 <= 15:
+        return 0
+    if code_max <= 255 and n_filters + 1 <= 255:
+        return 1
+    return 2 if code_max <= 0x7FFF else 3
+
+
 def raw_dtype_for(mn: int, mx: int) -> str:
     """Minimal fetch dtype for a raw-score plane, with headroom so the
     choice (part of the compact-executable cache key) stays stable as the
@@ -518,7 +531,14 @@ def shard_device_problem(dp: "DeviceProblem", mesh, axis_name: str = "nodes") ->
     return jax.device_put(dp, shardings)
 
 
-def build_compact_fn(cfg: BatchConfig, dims: dict, W: int, WS: int, raw_dtypes: "tuple[str, ...] | None" = None):
+def build_compact_fn(
+    cfg: BatchConfig,
+    dims: dict,
+    W: int,
+    WS: int,
+    raw_dtypes: "tuple[str, ...] | None" = None,
+    code_max: int = 1 << 30,
+):
     """Build the trace-compaction function: reduce the [P,N] trace arrays
     to exactly what the annotation writer reads, and nothing more —
     through a tunneled TPU (~10 MB/s D2H) the fetch volume IS the trace
@@ -550,25 +570,28 @@ def build_compact_fn(cfg: BatchConfig, dims: dict, W: int, WS: int, raw_dtypes: 
     manifest this builder returns alongside the jitted function.
 
     Planes (exact integers by kernel construction; casts lossless):
-      fail      [P,W]  uint16     (plug+1)<<8 | code, columns in ascending
-                                  node-index order; (plug, code) planes
-                                  stay separate when the Fit bitmask
-                                  needs >8 bits
+      fail8     [P,W]  uint8      (plug+1)<<4 | code when every failure
+                                  code fits 4 bits (``code_max``)
+      fail      [P,W]  uint16     (plug+1)<<8 | code when codes fit 8 bits
+      fail_plug/fail_code separate planes otherwise
       sids      [P,WS] int32      only when cfg.filters is empty
       raw:k     [P,WS] raw_dtypes[k]  where the plan fetches raw
       norm:k    [P,WS] int8       where the plan fetches norm
     """
     P, N = dims["P"], dims["N"]
-    R = dims["R"]
-    pack_fail = R + 1 <= 8
-    code_dtype_name = "int16" if R + 1 <= 15 else "int32"
+    mode = fail_pack_mode(code_max, len(cfg.filters))
+    pack8 = mode == 0
+    pack16 = mode == 1
+    code_dtype_name = "int16" if mode == 2 else "int32"
     code_dtype = getattr(jnp, code_dtype_name)
     raw_dtypes = raw_dtypes or tuple("int32" for _ in cfg.scores)
     plan = trace_fetch_plan(cfg, raw_dtypes)
 
     manifest: "list[tuple[str, str, tuple]]" = []
     if cfg.filters:
-        if pack_fail:
+        if pack8:
+            manifest.append(("fail8", "uint8", (P, W)))
+        elif pack16:
             manifest.append(("fail", "uint16", (P, W)))
         else:
             manifest.append(("fail_plug", "int8", (P, W)))
@@ -597,7 +620,11 @@ def build_compact_fn(cfg: BatchConfig, dims: dict, W: int, WS: int, raw_dtypes: 
             # the step already tracked (first failing filter, code) planes
             plug = jnp.where(valid, take(out["fail_plug"]), -1)
             code = jnp.where(valid, take(out["fail_code"]), 0)
-            if pack_fail:
+            if pack8:
+                res["fail8"] = (
+                    ((plug + 1).astype(jnp.uint8) << 4) | code.astype(jnp.uint8)
+                )
+            elif pack16:
                 res["fail"] = (
                     ((plug + 1).astype(jnp.uint16) << 8)
                     | code.astype(jnp.uint16)
@@ -636,7 +663,11 @@ def unpack_compact_blob(blob: np.ndarray, manifest: "list[tuple[str, str, tuple]
         n = int(np.prod(shape)) * np.dtype(dt).itemsize
         out[name] = blob[off : off + n].view(dt).reshape(shape)
         off += n
-    if "fail" in out:
+    if "fail8" in out:
+        packed = out.pop("fail8")
+        out["fail_plug"] = ((packed >> 4).astype(np.int16) - 1).astype(np.int8)
+        out["fail_code"] = (packed & 0xF).astype(np.uint8)
+    elif "fail" in out:
         packed = out.pop("fail")
         out["fail_plug"] = ((packed >> 8).astype(np.int16) - 1).astype(np.int8)
         out["fail_code"] = (packed & 0xFF).astype(np.uint8)
@@ -1225,22 +1256,27 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
                 jnp.broadcast_to(ys["final_start"], (P,)).astype(jnp.int32),
             ]
         )
-        if cfg.trace and cfg.scores:
-            # [S,2] feasible-window raw extrema: the host picks each score
-            # plane's fetch dtype from these (raw_dtype_for) before
-            # building the compact executable
-            feas = ys["feasible"]
-            ys["raw_minmax"] = jnp.stack(
-                [
-                    jnp.stack(
-                        [
-                            jnp.min(jnp.where(feas, ys[f"raw:{s}"], 0)).astype(jnp.int32),
-                            jnp.max(jnp.where(feas, ys[f"raw:{s}"], 0)).astype(jnp.int32),
-                        ]
-                    )
-                    for s, _w in cfg.scores
-                ]
+        if cfg.trace:
+            # [S+1,2] trace meta, one tiny fetch: per-score-plugin
+            # feasible-window raw extrema (drives raw_dtype_for) plus the
+            # global max filter-failure code (drives fail-plane packing)
+            feas = ys.get("feasible")
+            rows = [
+                jnp.stack(
+                    [
+                        jnp.min(jnp.where(feas, ys[f"raw:{s}"], 0)).astype(jnp.int32),
+                        jnp.max(jnp.where(feas, ys[f"raw:{s}"], 0)).astype(jnp.int32),
+                    ]
+                )
+                for s, _w in cfg.scores
+            ]
+            code_max = (
+                jnp.max(ys["fail_code"]).astype(jnp.int32)
+                if cfg.filters
+                else jnp.int32(0)
             )
+            rows.append(jnp.stack([jnp.int32(0), code_max]))
+            ys["trace_meta"] = jnp.stack(rows)
         return carry, ys
 
     CARRY0_FIELDS = (

@@ -15,6 +15,7 @@ from __future__ import annotations
 import json
 from typing import Any
 
+from kube_scheduler_simulator_tpu.native import fastjson as _fastjson
 from kube_scheduler_simulator_tpu.plugins import annotations as anno
 from kube_scheduler_simulator_tpu.plugins.resultstore import ResultStore
 from kube_scheduler_simulator_tpu.utils.gojson import go_marshal, go_string, go_string_key
@@ -161,7 +162,6 @@ def _entry_json(new_results: dict[str, str]) -> str:
     replace chain) avoids re-scanning everything through json.dumps, and
     values that carry their pre-escaped twin (EscapedJSON, from the batch
     engine's C assembly) are embedded without any scan at all."""
-    from kube_scheduler_simulator_tpu import native
     from kube_scheduler_simulator_tpu.utils.gojson import EscapedJSON
 
     keys = sorted(k for k in new_results if k != anno.RESULT_HISTORY)
@@ -174,9 +174,9 @@ def _entry_json(new_results: dict[str, str]) -> str:
     vals = [new_results[k] for k in keys]
     escs = [getattr(v, "escaped", None) for v in vals]
     entry = None
-    if native.fastjson is not None:
+    if _fastjson is not None:
         try:
-            entry = native.fastjson.history_entry(frags, vals, escs)
+            entry = _fastjson.history_entry(frags, vals, escs)
         except UnicodeEncodeError:  # lone surrogates: take the Python path
             entry = None
     if entry is None:

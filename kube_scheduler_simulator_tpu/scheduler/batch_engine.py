@@ -39,6 +39,34 @@ from kube_scheduler_simulator_tpu.plugins.resultstore import PASSED_FILTER_MESSA
 Obj = dict[str, Any]
 
 _cache_enabled = False
+_malloc_tuned = False
+
+
+def tune_malloc() -> None:
+    """Raise glibc's mmap threshold so the multi-hundred-KB annotation
+    strings the trace contract produces are served from the heap arena
+    instead of per-allocation mmap/munmap (whose page faults throttle the
+    assembly path; the arena runs at memcpy speed).  Called when the hot
+    path starts (BatchEngine construction), not at import — light users
+    of the package keep untouched allocator behavior.  Set
+    ``KSS_NO_MALLOPT=1`` to leave the allocator alone entirely."""
+    global _malloc_tuned
+    if _malloc_tuned:
+        return
+    _malloc_tuned = True
+    import os
+
+    if os.environ.get("KSS_NO_MALLOPT"):
+        return
+    try:
+        import ctypes
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        M_TRIM_THRESHOLD, M_MMAP_THRESHOLD = -1, -3
+        libc.mallopt(M_MMAP_THRESHOLD, 64 * 1024 * 1024)
+        libc.mallopt(M_TRIM_THRESHOLD, 256 * 1024 * 1024)
+    except Exception:  # pragma: no cover - non-glibc platforms
+        pass
 
 
 def enable_persistent_compilation_cache() -> None:
@@ -168,6 +196,11 @@ class BatchResult:
                 # records "passed" for every enabled plugin BEFORE the
                 # first failure, in profile order
                 "fail_pos": [self._engine.filters.index(f) for f in cfg.filters],
+                "taint_k": (
+                    cfg.filters.index("TaintToleration")
+                    if "TaintToleration" in cfg.filters
+                    else -1
+                ),
                 "norm_int": {s: tr["norm"][k] for k, (s, _w) in enumerate(cfg.scores)},
                 "raw_s": {s: strs(tr["raw"][k]) for k, (s, _w) in enumerate(cfg.scores)},
                 "final_s": {
@@ -339,7 +372,7 @@ class BatchResult:
                         key_esc=key_esc,
                         key_esc_arr=np.array(key_esc, dtype=object),
                         splug_esc=[eb(f) for f, _s in tr["frags"]["splug"]],
-                        order_list=order_by_name.tolist(),
+                        order_i64=np.ascontiguousarray(order_by_name, dtype=np.int64),
                     )
                 except UnicodeEncodeError:
                     pass
@@ -372,26 +405,36 @@ class BatchResult:
         start = int(self.out["sample_start"][i])
         proc = int(self.out["sample_processed"][i])
         n_true = self.problem.N_true
-        fail_ids: list = []
-        fail_frags: list = []
-        fail_escs: list = []
+        fail_ids = None
+        fail_uidx = None
+        ftable: list = []
+        etable: list = []
         fp_all = tr["fail_plug"]
         if fp_all is not None and tr["fail_any_row"][i]:
             ids = self._visited_ids(i)
-            fp = fp_all[i]
-            fc = tr["fail_code"][i]
-            cols = np.nonzero(fp[: len(ids)] >= 0)[0]
+            fp = fp_all[i][: len(ids)]
+            cols = np.nonzero(fp >= 0)[0]
+            fpc = fp[cols].astype(np.int64)
+            fcc = tr["fail_code"][i][cols].astype(np.int64)
+            idsc = ids[cols]
+            # distinct-failure dedup: entries depend on (plugin, code)
+            # only — except TaintToleration, whose message names the
+            # node's taint, so its key also carries the node id
+            taint_k = tr["taint_k"]
+            if taint_k >= 0:
+                extra = np.where(fpc == taint_k, idsc + 1, 0)
+            else:
+                extra = 0
+            ucode = (fpc << 40) | (extra << 16) | fcc
+            uniq, first, inv = np.unique(ucode, return_index=True, return_inverse=True)
             entry_memo = tr.setdefault("entry_memo_esc", {})
             cfg_filters = self._engine.cfg.filters
             filters = self._engine.filters
             fail_pos = tr["fail_pos"]
-            key_frag = fr["key"]
-            key_esc = fr["key_esc"]
-            for t in cols:
-                n = int(ids[t])
-                k = int(fp[t])
+            for t0, u in zip(first, uniq):
+                k = int(u >> 40)
                 plugin = cfg_filters[k]
-                msg = self._msg(i, n, plugin, int(fc[t]))
+                msg = self._msg(i, int(idsc[t0]), plugin, int(fcc[t0]))
                 ek = (k, msg)
                 pair = entry_memo.get(ek)
                 if pair is None:
@@ -399,19 +442,23 @@ class BatchResult:
                     entry[plugin] = msg
                     frag = go_marshal(entry)
                     pair = entry_memo[ek] = (frag, fj.escape_body(frag))
-                fail_ids.append(n)
-                fail_frags.append(key_frag[n] + pair[0])
-                fail_escs.append(key_esc[n] + pair[1])
+                ftable.append(pair[0])
+                etable.append(pair[1])
+            fail_ids = idsc
+            fail_uidx = inv.astype(np.int64)
         s, esc = fj.filter_json(
             fr["pass_list"],
             fr["pass_esc"],
-            fr["order_list"],
+            fr["key"],
+            fr["key_esc"],
+            fr["order_i64"],
             start,
             proc,
             n_true,
             fail_ids,
-            fail_frags,
-            fail_escs,
+            fail_uidx,
+            ftable,
+            etable,
         )
         return EscapedJSON(s, esc)
 
@@ -598,6 +645,7 @@ class BatchEngine:
         import os
 
         enable_persistent_compilation_cache()
+        tune_malloc()
         self.profile_dir = profile_dir or os.environ.get("KSS_TPU_PROFILE_DIR") or None
         self.mesh = mesh
         self.cfg = B.BatchConfig(
@@ -922,18 +970,17 @@ class BatchEngine:
             W = min(dims["N"], E._bucket(max(max_processed, 1)))
             max_feasible = int(packed[1].max()) if packed.shape[1] else 1
             WS = min(dims["N"], E._bucket(max(max_feasible, 1)))
-            if cfg.scores:
-                mm = np.asarray(out_dev["raw_minmax"])
-                raw_dtypes = tuple(
-                    B.raw_dtype_for(int(mm[k, 0]), int(mm[k, 1]))
-                    for k in range(len(cfg.scores))
-                )
-            else:
-                raw_dtypes = ()
-            ckey = (key, W, WS, raw_dtypes)
+            mm = np.asarray(out_dev["trace_meta"])
+            raw_dtypes = tuple(
+                B.raw_dtype_for(int(mm[k, 0]), int(mm[k, 1]))
+                for k in range(len(cfg.scores))
+            )
+            code_max = int(mm[-1, 1])
+            pack_mode = B.fail_pack_mode(code_max, len(cfg.filters))
+            ckey = (key, W, WS, raw_dtypes, pack_mode)
             entry = self._compact_cache.get(ckey)
             if entry is None:
-                entry = B.build_compact_fn(cfg, dims, W, WS, raw_dtypes)
+                entry = B.build_compact_fn(cfg, dims, W, WS, raw_dtypes, code_max)
                 self._compact_cache[ckey] = entry
                 self.compiles += 1
             cfn, manifest = entry

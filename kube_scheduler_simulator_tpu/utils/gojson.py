@@ -98,11 +98,14 @@ def go_string(s: str) -> str:
     C-level str.replace passes (tests/test_native.py pins equality).
     Strings UTF-8 can't encode (lone surrogates from permissive JSON
     input) take the Python path, which preserves them like json.dumps."""
-    from kube_scheduler_simulator_tpu import native
-
-    if native.fastjson is not None:
+    if _fastjson is not None:
         try:
-            return native.fastjson.escape_string(s)
+            return _fastjson.escape_string(s)
         except UnicodeEncodeError:
             pass
     return _go_string_py(s)
+
+
+# resolved once: the native module imports only stdlib (no circularity),
+# and go_string runs millions of times per wave
+from kube_scheduler_simulator_tpu.native import fastjson as _fastjson  # noqa: E402

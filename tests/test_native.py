@@ -108,20 +108,27 @@ def test_history_entry_with_pre_escaped_values():
 
 
 def test_filter_json_twins():
-    pass_arr = [f'"n{i}":{{"P":"passed"}}' for i in range(6)]
+    import numpy as np
+
+    keys = [f'"n{i}":' for i in range(6)]
+    keys_esc = [native.fastjson.escape_body(k) for k in keys]
+    pass_arr = [k + '{"P":"passed"}' for k in keys]
     pass_esc = [native.fastjson.escape_body(x) for x in pass_arr]
     # name order for n0..n5 is already sorted
-    order = [0, 1, 2, 3, 4, 5]
-    # window: start=4, proc=3 over n_true=6 -> visits 4,5,0
-    fail_frags = ['"n5":{"P":"nope & <bad>"}']
-    fail_escs = [native.fastjson.escape_body(fail_frags[0])]
+    order = np.arange(6, dtype=np.int64)
+    # window: start=4, proc=3 over n_true=6 -> visits 4,5,0; node 5 fails
+    ftable = ['{"P":"nope & <bad>"}']
+    etable = [native.fastjson.escape_body(ftable[0])]
     s, esc = native.fastjson.filter_json(
-        pass_arr, pass_esc, order, 4, 3, 6, [5], fail_frags, fail_escs
+        pass_arr, pass_esc, keys, keys_esc, order, 4, 3, 6,
+        np.array([5], dtype=np.int64), np.array([0], dtype=np.int64), ftable, etable,
     )
-    assert s == "{" + pass_arr[0] + "," + pass_arr[4] + "," + fail_frags[0] + "}"
+    assert s == "{" + pass_arr[0] + "," + pass_arr[4] + "," + keys[5] + ftable[0] + "}"
     assert '"' + esc + '"' == py_go_string(s)
     # full coverage, no failures
-    s2, esc2 = native.fastjson.filter_json(pass_arr, pass_esc, order, 0, 6, 6, [], [], [])
+    s2, esc2 = native.fastjson.filter_json(
+        pass_arr, pass_esc, keys, keys_esc, order, 0, 6, 6, None, None, [], []
+    )
     assert s2 == "{" + ",".join(pass_arr) + "}"
     assert '"' + esc2 + '"' == py_go_string(s2)
 
