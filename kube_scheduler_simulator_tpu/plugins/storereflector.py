@@ -44,6 +44,11 @@ class StoreReflector:
     def add_result_store(self, store: Any, key: str) -> None:
         self._stores[key] = store
 
+    def remove_result_store(self, key: str) -> None:
+        """Drop a registered store (scheduler restarts rebuild per-profile
+        stores; stale ones must not keep merging results)."""
+        self._stores.pop(key, None)
+
     def get_result_store(self, key: str) -> "Any | None":
         return self._stores.get(key)
 

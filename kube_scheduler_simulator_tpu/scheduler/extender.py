@@ -129,7 +129,9 @@ class ExtenderResultStore:
 
     @staticmethod
     def _pod_key(pod: Obj) -> str:
-        return f"{pod['metadata'].get('namespace', 'default')}/{pod['metadata']['name']}"
+        from kube_scheduler_simulator_tpu.utils.keys import pod_key
+
+        return pod_key(pod)
 
     def _entry(self, pod: Obj) -> dict[str, dict[str, Any]]:
         k = self._pod_key(pod)

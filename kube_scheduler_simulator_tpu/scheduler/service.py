@@ -35,8 +35,7 @@ from kube_scheduler_simulator_tpu.scheduler.framework_runner import (
 Obj = dict[str, Any]
 
 
-def _pod_key(pod: Obj) -> str:
-    return f"{pod['metadata'].get('namespace', 'default')}/{pod['metadata']['name']}"
+from kube_scheduler_simulator_tpu.utils.keys import pod_key as _pod_key  # noqa: E402
 
 
 class SchedulerService:
@@ -48,6 +47,7 @@ class SchedulerService:
         use_batch: str = "off",
         batch_min_work: int = 2048,
         batch_max_restarts: int = 8,
+        clock: "Callable[[], float] | None" = None,
     ):
         """``use_batch``: "off" = sequential cycle only; "auto" = run whole
         pending rounds through the TPU batch engine when the profile ×
@@ -70,17 +70,36 @@ class SchedulerService:
         self.batch_max_restarts = batch_max_restarts
         self.reflector = StoreReflector()
         self.reflector.register_to_cluster_store(cluster_store)
+        # Upstream-shaped scheduling queue (activeQ/backoffQ/unschedulableQ
+        # with event-driven requeue) — scheduler/queue.py.  Subscribed for
+        # the service's whole lifetime: events classify synchronously.
+        from kube_scheduler_simulator_tpu.scheduler.queue import SchedulingQueue
+
+        self.queue = SchedulingQueue(clock=clock)
+        cluster_store.subscribe(["pods", "nodes"], self.queue.note_event)
+        # move_seq snapshot taken when a scheduling attempt starts
+        self._attempt_move_seq: "int | None" = None
         self._out_of_tree: dict[str, Callable[[Obj | None, Any], Any]] = {}
         self._plugin_extenders: dict[str, Callable[[ResultStore], Any]] = {}
         self._current_cfg: "Obj | None" = None
         self._profile_names: set[str] = {"default-scheduler"}
         self._initial_cfg: "Obj | None" = None
+        # One Framework per KubeSchedulerConfiguration profile, keyed by
+        # schedulerName (upstream runs every profile; the reference's own
+        # resultstore only honors profiles[0] weights — reference
+        # plugin/plugins.go:287 "multiple profiles isn't supported" — this
+        # build gives each profile its own store and weights).
+        # ``framework`` stays the default profile's Framework for the
+        # overwhelmingly common single-profile callers.
+        self.frameworks: dict[str, Framework] = {}
         self.framework: "Framework | None" = None
         self.result_store: "ResultStore | None" = None
+        self._result_store_keys: list[str] = []
         self._bg_thread: "threading.Thread | None" = None
         self._bg_stop = threading.Event()
         self._wakeup = threading.Event()
         self._batch_engine: Any = None
+        self._batch_engines: dict[str, Any] = {}
         self.extender_service: Any = None  # set by _build_framework
         # Observability counters (exposed by the metrics endpoint):
         # batch_commits = rounds committed via the TPU batch engine;
@@ -112,13 +131,39 @@ class SchedulerService:
     # ------------------------------------------------------------ lifecycle
 
     def start_scheduler(self, cfg: "Obj | None" = None) -> None:
-        """StartScheduler analog (reference scheduler.go:96-186)."""
+        """StartScheduler analog (reference scheduler.go:96-186): every
+        profile in the configuration gets its own Framework, keyed by
+        schedulerName (reference scheduler.go:212-244 converts each;
+        upstream scheduler.New builds one framework per profile)."""
         cfg = self._filter_allowed_changes(cfg)
-        self._profile_names = {
-            p.get("schedulerName") or "default-scheduler" for p in cfg.get("profiles") or [{}]
-        }
-        self.framework = self._build_framework(cfg)
-        self._batch_engine = None  # rebuilt lazily for the new profile
+        profiles = cfg.get("profiles") or [{}]
+        names = [p.get("schedulerName") or "default-scheduler" for p in profiles]
+        if len(set(names)) != len(names):
+            # upstream validation: duplicate profiles are rejected
+            raise ValueError(f"duplicated profile schedulerName in {names}")
+        self._profile_names = set(names)
+
+        # drop the previous build's stores before registering new ones
+        for key in self._result_store_keys:
+            self.reflector.remove_result_store(key)
+        self._result_store_keys = []
+
+        from kube_scheduler_simulator_tpu.scheduler.extender import ExtenderService
+
+        extender_service = ExtenderService(cfg.get("extenders"), self.reflector)
+        frameworks: dict[str, Framework] = {}
+        for idx, (name, profile) in enumerate(zip(names, profiles)):
+            store_key = RESULT_STORE_KEY if idx == 0 else f"{RESULT_STORE_KEY}/{name}"
+            fw = self._build_framework(cfg, profile, store_key)
+            fw.extender_service = extender_service
+            self._result_store_keys.append(store_key)
+            frameworks[name] = fw
+        self.frameworks = frameworks
+        self.framework = frameworks.get("default-scheduler") or frameworks[names[0]]
+        self.result_store = self.framework.result_store
+        self.extender_service = extender_service
+        self._batch_engine = None  # rebuilt lazily for the new profiles
+        self._batch_engines = {}
         self._current_cfg = cfg
         if self._initial_cfg is None:
             self._initial_cfg = copy.deepcopy(cfg)
@@ -140,6 +185,7 @@ class SchedulerService:
     def shutdown_scheduler(self) -> None:
         self.stop_background()
         self.framework = None
+        self.frameworks = {}
 
     def get_scheduler_config(self) -> Obj:
         assert self._current_cfg is not None, "scheduler not started"
@@ -161,8 +207,35 @@ class SchedulerService:
             base["percentageOfNodesToScore"] = cfg["percentageOfNodesToScore"]
         return base
 
-    def _build_framework(self, cfg: Obj) -> Framework:
-        profile = (cfg.get("profiles") or [{}])[0]
+    def framework_for(self, pod: Obj) -> Framework:
+        """The Framework owning ``pod`` by its spec.schedulerName (unset
+        defaults to "default-scheduler", upstream defaulting)."""
+        name = (pod.get("spec") or {}).get("schedulerName") or "default-scheduler"
+        fw = self.frameworks.get(name)
+        if fw is None:
+            fw = self.framework
+        assert fw is not None, "scheduler not started"
+        return fw
+
+    def _all_waiting_keys(self) -> set[str]:
+        keys: set[str] = set()
+        for fw in self.frameworks.values():
+            keys.update(fw.waiting_pods)
+        return keys
+
+    def _sync_rotation(self, src: Framework) -> None:
+        """Upstream keeps ONE rotating start index and attempt counter per
+        scheduler process, shared by all profiles (genericScheduler
+        nextStartNodeIndex) — mirror the source framework's counters onto
+        the rest after it schedules."""
+        for fw in self.frameworks.values():
+            if fw is not src:
+                fw.next_start_node_index = src.next_start_node_index
+                fw.sched_counter = src.sched_counter
+
+    def _build_framework(self, cfg: Obj, profile: "Obj | None" = None, store_key: str = RESULT_STORE_KEY) -> Framework:
+        if profile is None:
+            profile = (cfg.get("profiles") or [{}])[0]
         registry = in_tree_registry()
         registry.update(self._out_of_tree)
 
@@ -216,8 +289,7 @@ class SchedulerService:
             original_name(p["name"]): int(p.get("weight") or 0) or 1 for p in per_point["score"]
         }
         result_store = ResultStore(score_plugin_weight=score_weights)
-        self.result_store = result_store
-        self.reflector.add_result_store(result_store, RESULT_STORE_KEY)
+        self.reflector.add_result_store(result_store, store_key)
 
         wrapped_cache: dict[str, WrappedPlugin] = {}
 
@@ -254,12 +326,11 @@ class SchedulerService:
             profile_name=profile.get("schedulerName") or "default-scheduler",
             tie_break=self.tie_break,
         )
-        # Extender webhook proxy (reference scheduler.go:120-126 wires the
-        # extender service + its result store before the scheduler starts).
-        from kube_scheduler_simulator_tpu.scheduler.extender import ExtenderService
-
-        self.extender_service = ExtenderService(cfg.get("extenders"), self.reflector)
-        fw.extender_service = self.extender_service
+        # each profile records into ITS OWN result store (per-profile
+        # plugin sets and weights); the shared reflector merges per pod.
+        # The extender webhook proxy is config-level and shared — wired by
+        # start_scheduler (reference scheduler.go:120-126).
+        fw.result_store = result_store
         return fw
 
     # ------------------------------------------------------------- run loop
@@ -268,13 +339,13 @@ class SchedulerService:
         # copy_objects=False: the scheduling paths only read pod specs
         # (the reference reads the informer cache the same way); at scale,
         # deep-copying annotation-laden pods dominates the round otherwise
-        waiting = self.framework.waiting_pods if self.framework is not None else {}
+        waiting = self._all_waiting_keys()
         # upstream schedules only pods whose spec.schedulerName matches a
         # DECLARED profile (unset defaults to "default-scheduler") — pods
         # claimed by an EXTERNAL scheduler are left alone, which is what
         # lets one run against the kube-API port (the reference's
-        # two-scheduler story).  All declared names are honored (this
-        # build executes one framework for them — see _build_framework).
+        # two-scheduler story).  Each declared name routes to its own
+        # profile's Framework (framework_for).
         profiles = self._profile_names or {"default-scheduler"}
         return [
             p
@@ -285,6 +356,18 @@ class SchedulerService:
             and _pod_key(p) not in waiting
         ]
 
+    def _ready_pending(self, respect_backoff: bool = False) -> list[Obj]:
+        """The store-pending pods the queue allows a round to attempt:
+        activeQ plus expired backoff; with ``respect_backoff=False`` (the
+        deterministic synchronous drain) backoffQ pods run immediately
+        once an event has moved them out of unschedulableQ."""
+        cands = self.pending_pods()
+        q = self.queue
+        for p in cands:
+            q.ensure_tracked(_pod_key(p))
+        ready = q.ready(ignore_backoff=not respect_backoff)
+        return [p for p in cands if _pod_key(p) in ready]
+
     def build_snapshot(self) -> Snapshot:
         snap = Snapshot(
             self.cluster_store.list("nodes", copy_objects=False),
@@ -294,8 +377,8 @@ class SchedulerService:
         # pods parked at Permit hold their reservation (upstream keeps
         # assumed pods in the scheduler cache until bound) — without this,
         # later rounds would schedule other pods into the same capacity
-        if self.framework is not None:
-            for w in self.framework.waiting_pods.values():
+        for fw in self.frameworks.values():
+            for w in fw.waiting_pods.values():
                 snap.assume(w.pod, w.node_name)
         return snap
 
@@ -303,10 +386,11 @@ class SchedulerService:
         """Store pods with waiting pods shown as bound to their reserved
         node (for the batch encoder's node-usage seeding)."""
         pods = self.cluster_store.list("pods", copy_objects=False)
-        fw = self.framework
-        if fw is None or not fw.waiting_pods:
+        waiting: dict[str, Any] = {}
+        for fw in self.frameworks.values():
+            waiting.update(fw.waiting_pods)
+        if not waiting:
             return pods
-        waiting = {key: w for key, w in fw.waiting_pods.items()}
         out = []
         for p in pods:
             w = waiting.get(_pod_key(p))
@@ -316,9 +400,13 @@ class SchedulerService:
                 out.append(p)
         return out
 
-    def schedule_pending(self, max_rounds: int = 3) -> dict[str, ScheduleResult]:
+    def schedule_pending(self, max_rounds: int = 3, respect_backoff: bool = False) -> dict[str, ScheduleResult]:
         """Drain the pending queue: sort by QueueSort, schedule each pod in
-        order; preemption-nominated pods get retried in later rounds.
+        order; preemption-nominated pods get retried in later rounds (the
+        victims' delete events move them through the scheduling queue).
+        ``respect_backoff=True`` (the background loop) enforces the
+        queue's real exponential backoff instead of the deterministic
+        drain semantics.
 
         With use_batch enabled, each round runs through the TPU batch
         engine when possible, with identical outcomes to the sequential
@@ -341,12 +429,16 @@ class SchedulerService:
         if gc_was_enabled:
             gc.disable()
         try:
+            # deadline-driven permit expiry in SYNC mode too: a parked pod
+            # whose permit deadline passed must release its reservation
+            # before this drain, not only when the background loop ticks
+            self.process_waiting_pods()
             for _ in range(max_rounds):
                 round_results: "dict[str, ScheduleResult] | None" = None
                 if self.use_batch in ("auto", "force"):
-                    round_results = self._schedule_pending_batch()
+                    round_results = self._schedule_pending_batch(respect_backoff)
                 if round_results is None:
-                    pending = self.framework.sort_pods(self.pending_pods())
+                    pending = self.framework.sort_pods(self._ready_pending(respect_backoff))
                     if not pending:
                         break
                     snapshot = self.build_snapshot()
@@ -368,50 +460,58 @@ class SchedulerService:
         last pending permit plugin, the bind cycle runs and the full
         result set (including the recorded Wait) flushes to annotations."""
         assert self.framework is not None, "scheduler not started"
-        res = self.framework.allow_waiting_pod(namespace, name, plugin)
-        if res is not None:
-            if not res.success:
-                # the deferred bind cycle failed (e.g. binder webhook down)
-                # — record it like any scheduling failure
-                try:
-                    self._record_failure(self.cluster_store.get("pods", name, namespace), res)
-                except KeyError:
-                    pass
-            self.reflector.flush_all(self.cluster_store, skip_keys=set(self.framework.waiting_pods))
-        return res
+        for fw in self.frameworks.values():
+            res = fw.allow_waiting_pod(namespace, name, plugin)
+            if res is not None:
+                self._attempt_move_seq = self.queue.move_seq
+                if not res.success:
+                    # the deferred bind cycle failed (e.g. binder webhook
+                    # down) — record it like any scheduling failure
+                    try:
+                        self._record_failure(self.cluster_store.get("pods", name, namespace), res)
+                    except KeyError:
+                        pass
+                self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
+                return res
+        return None
 
     def reject_waiting_pod(self, namespace: str, name: str, message: str = "rejected") -> "ScheduleResult | None":
         assert self.framework is not None, "scheduler not started"
-        res = self.framework.reject_waiting_pod(namespace, name, message)
-        if res is not None:
-            try:
-                pod = self.cluster_store.get("pods", name, namespace)
-                self._record_failure(pod, res)
-            except KeyError:
-                pass
-            self.reflector.flush_all(self.cluster_store, skip_keys=set(self.framework.waiting_pods))
-        return res
+        for fw in self.frameworks.values():
+            res = fw.reject_waiting_pod(namespace, name, message)
+            if res is not None:
+                self._attempt_move_seq = self.queue.move_seq
+                try:
+                    pod = self.cluster_store.get("pods", name, namespace)
+                    self._record_failure(pod, res)
+                except KeyError:
+                    pass
+                self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
+                return res
+        return None
 
     def process_waiting_pods(self, now: "float | None" = None) -> dict[str, ScheduleResult]:
         """Expire waiting pods whose permit deadline passed, recording the
-        rejection like any scheduling failure (the background loop calls
-        this each tick; tests drive it with an explicit ``now``)."""
-        fw = self.framework
-        if fw is None or not fw.waiting_pods:
-            return {}
-        by_key = {}
-        for key, w in list(fw.waiting_pods.items()):
-            by_key[key] = w.pod
-        expired = fw.expire_waiting_pods(now)
-        for key, res in expired.items():
-            self._record_failure(by_key[key], res)
+        rejection like any scheduling failure (schedule_pending and the
+        background loop call this; tests drive it with an explicit
+        ``now``)."""
+        expired: dict[str, ScheduleResult] = {}
+        self._attempt_move_seq = self.queue.move_seq
+        for fw in self.frameworks.values():
+            if not fw.waiting_pods:
+                continue
+            by_key = {key: w.pod for key, w in fw.waiting_pods.items()}
+            fw_expired = fw.expire_waiting_pods(now)
+            for key, res in fw_expired.items():
+                self._record_failure(by_key[key], res)
+            expired.update(fw_expired)
         if expired:
-            self.reflector.flush_all(self.cluster_store, skip_keys=set(fw.waiting_pods))
+            self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
         return expired
 
     # ------------------------------------------------------------ batch path
 
-    def _schedule_pending_batch(self) -> "dict[str, ScheduleResult] | None":
+    def _schedule_pending_batch(self, respect_backoff: bool = False) -> "dict[str, ScheduleResult] | None":
         """One round on the TPU batch engine (scheduler/batch_engine).
 
         Returns None when the whole round must run sequentially instead
@@ -428,7 +528,14 @@ class SchedulerService:
 
         fw = self.framework
         assert fw is not None
-        pending = fw.sort_pods(self.pending_pods())
+        if len(self.frameworks) > 1:
+            # Multi-profile rounds take the sequential cycle: each pod is
+            # scheduled and traced by its OWNING profile's framework
+            # (framework_for), which the per-profile batch engines don't
+            # interleave yet.  The reference has no batch path at all.
+            self._count_fallback("multiple scheduler profiles")
+            return None
+        pending = fw.sort_pods(self._ready_pending(respect_backoff))
         if not pending:
             return {}
         nodes = self.cluster_store.list("nodes", copy_objects=False)
@@ -454,6 +561,7 @@ class SchedulerService:
         restarts = 0
         while i < len(pending):
             tail = pending[i:]
+            self._attempt_move_seq = self.queue.move_seq
             result = eng.schedule(
                 nodes,
                 self._pods_with_waiting_assumed(),
@@ -497,7 +605,7 @@ class SchedulerService:
                     results[_pod_key(pod)] = self.schedule_one(pod, snapshot)
                 break
         self.stats["batch_commits"] += 1
-        self.reflector.flush_all(self.cluster_store, skip_keys=set(fw.waiting_pods))
+        self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
         return results
 
     def _count_fallback(self, reason: str) -> None:
@@ -519,6 +627,7 @@ class SchedulerService:
             "batch_restarts": self.stats["batch_restarts"],
             "sequential_pods": self.stats["sequential_pods"],
             "batch_fallbacks": fallbacks,
+            **self.queue.stats(),
             "engine_rounds": eng.rounds if eng else 0,
             "engine_compiles": eng.compiles if eng else 0,
             "engine_cache_entries": len(eng._fn_cache) if eng else 0,
@@ -546,6 +655,11 @@ class SchedulerService:
         fw = self.framework
         assert fw is not None and self.result_store is not None
         rs = self.result_store
+        # this pod's attempt effectively starts at ITS commit (earlier
+        # commits in the round are replayed as in the sequential cycle),
+        # so failure classification snapshots move_seq here — matching
+        # schedule_one's per-pod snapshot
+        self._attempt_move_seq = self.queue.move_seq
         if point_names is None:
             point_names = {
                 p: [wp.original.name for wp in fw.plugins[p]]
@@ -605,14 +719,17 @@ class SchedulerService:
         assert self.framework is not None, "scheduler not started"
         if snapshot is None:
             snapshot = self.build_snapshot()
-        result = self.framework.schedule_one(pod, snapshot)
+        fw = self.framework_for(pod)
+        self._attempt_move_seq = self.queue.move_seq
+        result = fw.schedule_one(pod, snapshot)
+        self._sync_rotation(fw)
         self.stats["sequential_pods"] += 1
         if not result.success and not result.waiting_on:
             self._record_failure(pod, result)
         # The reference's informer flushes results asynchronously after the
         # cycle; flush the queued pods now that all results are recorded.
         # Waiting pods keep their results queued until permit resolves.
-        self.reflector.flush_all(self.cluster_store, skip_keys=set(self.framework.waiting_pods))
+        self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
         return result
 
     def _record_failure(self, pod: Obj, result: ScheduleResult) -> None:
@@ -621,6 +738,12 @@ class SchedulerService:
         triggers the reflector's annotation flush."""
         ns = pod["metadata"].get("namespace", "default")
         name = pod["metadata"]["name"]
+        # the failed pod enters unschedulableQ with its backoff advanced —
+        # it will NOT be re-attempted until an event moves it (or the
+        # stuck-flush timeout); events fired DURING its attempt (its own
+        # preemption's victim deletes) route it to backoffQ instead.  Its
+        # own status patch below is scheduling-irrelevant and moves nothing.
+        self.queue.on_failure(f"{ns}/{name}", self._attempt_move_seq)
         message = self._failure_message(result)
         patch: Obj = {
             "status": {
@@ -682,15 +805,23 @@ class SchedulerService:
 
         def loop() -> None:
             while not self._bg_stop.is_set():
-                self._wakeup.wait(timeout=poll_interval)
+                # wake for the earliest backoff expiry when one is sooner
+                # than the poll tick
+                wake_in = self.queue.next_wakeup_in()
+                timeout = poll_interval if wake_in is None else min(poll_interval, wake_in)
+                self._wakeup.wait(timeout=max(timeout, 0.01))
                 self._wakeup.clear()
                 if self._bg_stop.is_set():
                     break
                 try:
                     if self.framework is not None:
                         self.process_waiting_pods()
+                        self.queue.flush_stuck()
                         if self.pending_pods():
-                            self.schedule_pending(max_rounds=1)
+                            # real backoff semantics: persistently
+                            # unschedulable pods are NOT re-filtered on
+                            # every event — they wait in unschedulableQ
+                            self.schedule_pending(max_rounds=1, respect_backoff=True)
                 except Exception:  # pragma: no cover - keep the loop alive
                     pass
 

@@ -41,6 +41,10 @@ from kube_scheduler_simulator_tpu.state.store import (
     NAMESPACED_KINDS,
     NotFoundError,
 )
+from kube_scheduler_simulator_tpu.utils.k8s_selectors import (
+    SelectorError,
+    compile_selectors,
+)
 
 Obj = dict[str, Any]
 
@@ -270,15 +274,28 @@ def _make_handler(server: KubeAPIServer):
                 return
             try:
                 if rt.name is None:
+                    # labelSelector / fieldSelector, exactly as the
+                    # reference's real kube-apiserver serves them to
+                    # client-go informers and external schedulers
+                    try:
+                        sel = compile_selectors(
+                            (q.get("labelSelector") or [None])[0],
+                            (q.get("fieldSelector") or [None])[0],
+                        )
+                    except SelectorError as e:
+                        self._status_err(400, "BadRequest", str(e))
+                        return
                     if (q.get("watch") or ["false"])[0] == "true":
                         try:
                             rv = int((q.get("resourceVersion") or ["0"])[0] or 0)
                         except ValueError:
                             self._status_err(400, "BadRequest", "resourceVersion must be an integer")
                             return
-                        self._watch(rt, rv)
+                        self._watch(rt, rv, sel)
                     else:
                         items = store.list(rt.store_kind, rt.namespace)
+                        if sel is not None:
+                            items = [o for o in items if sel(o)]
                         self._send_json(
                             200,
                             {
@@ -294,8 +311,31 @@ def _make_handler(server: KubeAPIServer):
             except NotFoundError as e:
                 self._status_err(404, "NotFound", str(e))
 
-        def _watch(self, rt: "_Route", rv: int) -> None:
-            """Chunked kube watch stream: {"type": ..., "object": ...}."""
+        def _watch(self, rt: "_Route", rv: int, sel=None) -> None:
+            """Chunked kube watch stream: {"type": ..., "object": ...}.
+
+            With a selector, transitions are synthesized the way the real
+            apiserver does: an update that starts matching streams ADDED,
+            one that stops matching streams DELETED (client-go informers
+            watching ``spec.nodeName=`` depend on this to drop pods the
+            scheduler binds)."""
+
+            def sel_event(ev) -> "tuple[str, Obj] | None":
+                if sel is None:
+                    return ev.type, ev.obj
+                matches = sel(ev.obj)
+                if ev.type == "MODIFIED":
+                    old = ev.old_obj
+                    old_matches = sel(old) if old is not None else matches
+                    if matches and old_matches:
+                        return "MODIFIED", ev.obj
+                    if matches:
+                        return "ADDED", ev.obj
+                    if old_matches:
+                        return "DELETED", ev.obj
+                    return None
+                return (ev.type, ev.obj) if matches else None
+
             events: "queue.Queue" = queue.Queue()
             unsubscribe = store.subscribe([rt.store_kind], events.put)
             try:
@@ -321,7 +361,8 @@ def _make_handler(server: KubeAPIServer):
                         items = store.list(rt.store_kind, rt.namespace)
                         rv = store.resource_version
                     for o in items:
-                        write_event("ADDED", o)
+                        if sel is None or sel(o):
+                            write_event("ADDED", o)
                 else:
                     # resume: replay the missed backlog from the event log
                     # (410 Gone when it was compacted away, kube-style)
@@ -350,7 +391,9 @@ def _make_handler(server: KubeAPIServer):
                     for ev in backlog:
                         if rt.namespace and (ev.obj["metadata"].get("namespace") or "default") != rt.namespace:
                             continue
-                        write_event(ev.type, ev.obj)
+                        mapped = sel_event(ev)
+                        if mapped is not None:
+                            write_event(*mapped)
                         rv = max(rv, ev.resource_version)
                 while not server._stop.is_set():
                     try:
@@ -361,7 +404,9 @@ def _make_handler(server: KubeAPIServer):
                         continue
                     if ev.resource_version <= rv:
                         continue
-                    write_event(ev.type, ev.obj)
+                    mapped = sel_event(ev)
+                    if mapped is not None:
+                        write_event(*mapped)
             except (BrokenPipeError, ConnectionResetError):
                 pass
             finally:

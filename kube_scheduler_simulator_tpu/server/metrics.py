@@ -57,6 +57,12 @@ def render_metrics(di: Any) -> str:
             0,
             {"reason": "none"},
         )
+    # scheduling-queue state (activeQ/backoffQ/unschedulableQ)
+    counter("queue_pods", "Pods tracked by the scheduling queue, by state.", m["queue_active"], {"state": "active"}, typ="gauge")
+    counter("queue_pods", "Pods tracked by the scheduling queue, by state.", m["queue_backoff"], {"state": "backoff"}, typ="gauge")
+    counter("queue_pods", "Pods tracked by the scheduling queue, by state.", m["queue_unschedulable"], {"state": "unschedulable"}, typ="gauge")
+    counter("queue_moves_total", "Unschedulable-queue moves triggered by cluster events.", m["queue_moves"])
+    counter("queue_flushes_total", "Stuck unschedulable pods flushed by timeout.", m["queue_flushes"])
     counter("batch_compiles_total", "XLA compilations of the batch kernel (jit cache misses).", m["engine_compiles"])
     counter("batch_executable_cache_entries", "Compiled batch executables held in the jit cache.", m["engine_cache_entries"], typ="gauge")
     for phase, secs in sorted(m["engine_cum_timings"].items()):

@@ -103,13 +103,23 @@ class ResourceExpiredError(Exception):
 
 
 class Event:
-    __slots__ = ("kind", "type", "obj", "resource_version")
+    __slots__ = ("kind", "type", "obj", "resource_version", "old_obj")
 
-    def __init__(self, kind: str, type_: str, obj: Obj, resource_version: int):
+    def __init__(
+        self,
+        kind: str,
+        type_: str,
+        obj: Obj,
+        resource_version: int,
+        old_obj: "Obj | None" = None,
+    ):
         self.kind = kind
         self.type = type_
         self.obj = obj
         self.resource_version = resource_version
+        # prior state on MODIFIED (shared read-only snapshot) — selector
+        # watches need it to synthesize ADDED/DELETED on transitions
+        self.old_obj = old_obj
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return f"Event({self.kind}, {self.type}, {_key(self.obj)}, rv={self.resource_version})"
@@ -205,7 +215,7 @@ class ClusterStore:
         # event log, exactly as mutating an informer-cache object would).
         # ``old`` is the replaced object the store no longer references,
         # so it needs no copy at all.
-        ev = Event(kind, type_, _clone(obj), int(obj["metadata"]["resourceVersion"]))
+        ev = Event(kind, type_, _clone(obj), int(obj["metadata"]["resourceVersion"]), old_obj=old)
         log = self._event_log[kind]
         if log.maxlen is not None and len(log) == log.maxlen:
             self._evicted_rv[kind] = log[0].resource_version

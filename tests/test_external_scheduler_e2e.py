@@ -86,6 +86,64 @@ def test_external_scheduler_binds_via_kube_api_and_tpu_scorer():
         srv.shutdown()
 
 
+def test_external_scheduler_driven_by_field_selector_watch():
+    """The real client-go flow: the external scheduler WATCHES
+    ``spec.schedulerName=<its name>,spec.nodeName=`` (what a second
+    kube-scheduler's informers send to the reference's apiserver), binds
+    each pod the stream hands it, and relies on the selector watch
+    synthesizing DELETED once the bind moves the pod out of scope."""
+    import http.client
+    import urllib.parse
+
+    di = DIContainer(use_batch="off")
+    srv = SimulatorServer(di, port=0, kube_api_port=0)
+    srv.start(background=True)
+    kube_port = srv.kube_api_server.port
+    try:
+        _req(kube_port, "POST", "/api/v1/nodes", {
+            "metadata": {"name": "node-0"},
+            "status": {"allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"}},
+        })
+        sel = urllib.parse.quote("spec.schedulerName=tpu-external,spec.nodeName=")
+        conn = http.client.HTTPConnection("127.0.0.1", kube_port, timeout=30)
+        conn.request("GET", f"/api/v1/pods?watch=true&fieldSelector={sel}")
+        resp = conn.getresponse()
+        assert resp.status == 200
+
+        # two pods for the external scheduler, one for the simulator's own
+        for name, sched in (("w-1", "tpu-external"), ("mine", None), ("w-2", "tpu-external")):
+            body = {"metadata": {"name": name, "namespace": "default"},
+                    "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "1"}}}]}}
+            if sched:
+                body["spec"]["schedulerName"] = sched
+            _req(kube_port, "POST", "/api/v1/namespaces/default/pods", body)
+
+        scheduled = []
+        deleted = []
+        # drive the loop: bind every ADDED pod, stop when both binds have
+        # been confirmed back as synthetic DELETEDs
+        while len(deleted) < 2:
+            ev = json.loads(resp.readline())
+            name = ev["object"]["metadata"]["name"]
+            assert name != "mine", "selector watch leaked another scheduler's pod"
+            if ev["type"] == "ADDED":
+                code, _ = _req(kube_port, "POST",
+                               f"/api/v1/namespaces/default/pods/{name}/binding",
+                               {"target": {"name": "node-0"}})
+                assert code == 201
+                scheduled.append(name)
+            elif ev["type"] == "DELETED":
+                deleted.append(name)
+        assert sorted(scheduled) == ["w-1", "w-2"]
+        assert sorted(deleted) == ["w-1", "w-2"]
+        for name in ("w-1", "w-2"):
+            _code, pod = _req(kube_port, "GET", f"/api/v1/namespaces/default/pods/{name}")
+            assert pod["spec"]["nodeName"] == "node-0"
+        conn.close()
+    finally:
+        srv.shutdown()
+
+
 def test_declared_second_profile_name_still_scheduled():
     """Pods naming ANY declared profile are scheduled (this build runs one
     framework for all declared names); only undeclared (external)

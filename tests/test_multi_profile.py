@@ -1,0 +1,152 @@
+"""True multi-profile scheduling: every profile in
+KubeSchedulerConfiguration.Profiles runs with its own plugin set and
+weights, keyed by spec.schedulerName (upstream semantics via reference
+scheduler.go:212-244; the reference's own resultstore only honors
+profiles[0] weights — plugin/plugins.go:287 — which this build exceeds)."""
+
+import json
+
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+
+def mk_node(name, cpu="8000m"):
+    return {
+        "metadata": {
+            "name": name,
+            "labels": {"kubernetes.io/hostname": name, "disk": "ssd" if name.endswith(("0", "2")) else "hdd"},
+        },
+        "status": {"allocatable": {"cpu": cpu, "memory": "16Gi", "pods": "20"}},
+    }
+
+
+def mk_pod(name, scheduler_name=None, cpu="100m"):
+    spec = {"containers": [{"name": "c", "resources": {"requests": {"cpu": cpu, "memory": "64Mi"}}}]}
+    if scheduler_name:
+        spec["schedulerName"] = scheduler_name
+    return {"metadata": {"name": name, "labels": {"app": "x"}}, "spec": spec}
+
+
+TWO_PROFILES = {
+    "profiles": [
+        {
+            "schedulerName": "default-scheduler",
+            "plugins": {
+                "multiPoint": {
+                    "enabled": [
+                        {"name": "PrioritySort"},
+                        {"name": "NodeResourcesFit", "weight": 1},
+                        {"name": "NodeAffinity", "weight": 2},
+                        {"name": "DefaultBinder"},
+                    ],
+                    "disabled": [{"name": "*"}],
+                }
+            },
+        },
+        {
+            "schedulerName": "second-scheduler",
+            "plugins": {
+                "multiPoint": {
+                    "enabled": [
+                        {"name": "PrioritySort"},
+                        {"name": "NodeResourcesFit", "weight": 5},
+                        {"name": "TaintToleration", "weight": 3},
+                        {"name": "DefaultBinder"},
+                    ],
+                    "disabled": [{"name": "*"}],
+                }
+            },
+        },
+    ]
+}
+
+
+def _mk_service(use_batch="off"):
+    store = ClusterStore()
+    for i in range(4):
+        store.create("nodes", mk_node(f"node-{i}"))
+    svc = SchedulerService(store, tie_break="first", use_batch=use_batch, batch_min_work=0)
+    svc.start_scheduler(TWO_PROFILES)
+    return store, svc
+
+
+def test_each_profile_gets_its_own_framework():
+    _store, svc = _mk_service()
+    assert set(svc.frameworks) == {"default-scheduler", "second-scheduler"}
+    fw1 = svc.frameworks["default-scheduler"]
+    fw2 = svc.frameworks["second-scheduler"]
+    assert [wp.original.name for wp in fw1.plugins["filter"]] != [
+        wp.original.name for wp in fw2.plugins["filter"]
+    ]
+    assert fw1.score_weights["NodeAffinity"] == 2
+    assert fw2.score_weights["NodeResourcesFit"] == 5
+    assert fw2.score_weights["TaintToleration"] == 3
+    # per-profile result stores registered with the shared reflector
+    assert fw1.result_store is not fw2.result_store
+
+
+def test_pods_route_and_trace_by_their_profile():
+    store, svc = _mk_service()
+    store.create("pods", mk_pod("pod-default"))
+    store.create("pods", mk_pod("pod-second", "second-scheduler"))
+    store.create("pods", mk_pod("pod-foreign", "some-external-scheduler"))
+    svc.schedule_pending(max_rounds=1)
+
+    p1 = store.get("pods", "pod-default")
+    p2 = store.get("pods", "pod-second")
+    p3 = store.get("pods", "pod-foreign")
+    # both declared profiles scheduled their pod; the foreign pod is untouched
+    assert p1["spec"].get("nodeName")
+    assert p2["spec"].get("nodeName")
+    assert not (p3.get("spec") or {}).get("nodeName")
+    assert "annotations" not in p3["metadata"]
+
+    a1 = p1["metadata"]["annotations"]
+    a2 = p2["metadata"]["annotations"]
+    f1 = json.loads(a1["scheduler-simulator/filter-result"])
+    f2 = json.loads(a2["scheduler-simulator/filter-result"])
+    # traced with the OWNING profile's filter plugin set
+    assert set(f1["node-0"]) == {"NodeResourcesFit", "NodeAffinity"}
+    assert set(f2["node-0"]) == {"NodeResourcesFit", "TaintToleration"}
+    # finalScore applies the owning profile's weights
+    s2 = json.loads(a2["scheduler-simulator/score-result"])
+    fin2 = json.loads(a2["scheduler-simulator/finalscore-result"])
+    for node, plugs in s2.items():
+        assert int(fin2[node]["NodeResourcesFit"]) == int(plugs["NodeResourcesFit"]) * 5
+    s1 = json.loads(a1["scheduler-simulator/score-result"])
+    fin1 = json.loads(a1["scheduler-simulator/finalscore-result"])
+    for node, plugs in s1.items():
+        assert int(fin1[node]["NodeAffinity"]) == int(plugs["NodeAffinity"]) * 2
+
+
+def test_multi_profile_batch_mode_falls_back_to_exact_sequential():
+    store, svc = _mk_service(use_batch="auto")
+    for i in range(6):
+        store.create("pods", mk_pod(f"p{i}", "second-scheduler" if i % 2 else None))
+    svc.schedule_pending(max_rounds=1)
+    assert all((store.get("pods", f"p{i}")["spec"].get("nodeName")) for i in range(6))
+    assert "multiple scheduler profiles" in svc.stats["batch_fallbacks"]
+    # traces still come from the right profile
+    a = store.get("pods", "p1")["metadata"]["annotations"]
+    assert "TaintToleration" in json.loads(a["scheduler-simulator/filter-result"])["node-0"]
+
+
+def test_duplicate_profile_names_rejected():
+    store = ClusterStore()
+    svc = SchedulerService(store)
+    import pytest
+
+    with pytest.raises(ValueError):
+        svc.start_scheduler(
+            {"profiles": [{"schedulerName": "a"}, {"schedulerName": "a"}]}
+        )
+
+
+def test_restart_drops_stale_profile_stores():
+    _store, svc = _mk_service()
+    keys_before = list(svc._result_store_keys)
+    assert len(keys_before) == 2
+    svc.restart_scheduler({"profiles": [{"schedulerName": "only-one"}]})
+    assert len(svc._result_store_keys) == 1
+    # the second profile's store is no longer registered
+    assert svc.reflector.get_result_store(keys_before[1]) is None

@@ -1,0 +1,168 @@
+"""Upstream-shaped scheduling queue (scheduler/queue.py): exponential
+per-pod backoff, event-driven requeue, stuck-pod flush — the semantics the
+reference inherits from kube-scheduler's activeQ/backoffQ/unschedulableQ
+(its own scheduler/queue/queue.go is an empty scaffold)."""
+
+from __future__ import annotations
+
+from kube_scheduler_simulator_tpu.scheduler.queue import SchedulingQueue
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def mk_node(name, cpu="4000m"):
+    return {
+        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}},
+        "status": {"allocatable": {"cpu": cpu, "memory": "8Gi", "pods": "10"}},
+    }
+
+
+def mk_pod(name, cpu="100m"):
+    return {
+        "metadata": {"name": name},
+        "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": cpu, "memory": "64Mi"}}}]},
+    }
+
+
+# ------------------------------------------------------------- unit level
+
+
+def test_backoff_grows_exponentially_and_caps():
+    q = SchedulingQueue(initial_backoff_s=1.0, max_backoff_s=10.0)
+    assert [q.backoff_for(n) for n in (1, 2, 3, 4, 5, 6)] == [1.0, 2.0, 4.0, 8.0, 10.0, 10.0]
+    # huge attempt counts must not overflow the float pow
+    assert q.backoff_for(5000) == 10.0
+
+
+def test_on_failure_ignores_untracked_pods():
+    q = SchedulingQueue()
+    q.on_failure("default/deleted-mid-attempt")
+    assert q.stats()["queue_unschedulable"] == 0
+    # pods created already bound are never tracked via events
+    class Ev:
+        kind, type = "pods", "ADDED"
+        obj = {"metadata": {"name": "x"}, "spec": {"nodeName": "n"}}
+        old_obj = None
+    q.note_event(Ev())
+    assert q.stats()["queue_active"] == 0
+
+
+def test_failure_waits_for_event_then_backoff_gates_retry():
+    clock = FakeClock()
+    q = SchedulingQueue(clock=clock)
+    q.ensure_tracked("default/p")
+    assert q.ready() == {"default/p"}
+    q.on_failure("default/p")
+    # no event: NOT ready, no matter how much time passes
+    clock.t = 100.0
+    assert q.ready() == set()
+    # an event moves it to backoffQ; a fresh failure's backoff is 1s…
+    q.on_failure("default/p")  # attempts=2 → 2s backoff from t=100
+    q.move_all()
+    assert q.ready() == set()  # still backing off
+    clock.t = 101.9
+    assert q.ready() == set()
+    clock.t = 102.1
+    assert q.ready() == {"default/p"}  # backoff expired → active
+
+
+def test_move_request_during_attempt_goes_to_backoff():
+    clock = FakeClock()
+    q = SchedulingQueue(clock=clock)
+    q.ensure_tracked("default/p")
+    seq = q.move_seq
+    q.move_all()  # a move request fires while the attempt is in flight
+    q.on_failure("default/p", attempt_move_seq=seq)
+    # backoffQ, not unschedulableQ: expires by time alone
+    clock.t = 1.1
+    assert q.ready() == {"default/p"}
+
+
+def test_flush_stuck_moves_without_events():
+    clock = FakeClock()
+    q = SchedulingQueue(clock=clock, unschedulable_timeout_s=60.0)
+    q.ensure_tracked("default/p")
+    q.on_failure("default/p")
+    clock.t = 59.0
+    q.flush_stuck()
+    assert q.ready() == set()
+    clock.t = 61.0
+    q.flush_stuck()
+    assert q.ready() == {"default/p"}  # backoff long expired
+
+
+# ---------------------------------------------------------- service level
+
+
+def test_persistently_unschedulable_pod_not_refiltered_every_event():
+    """The round-2 churn cliff: a pod that can never fit must NOT be
+    re-filtered on every wakeup/event once it sits in unschedulableQ."""
+    clock = FakeClock()
+    store = ClusterStore()
+    store.create("nodes", mk_node("n0", cpu="1000m"))
+    svc = SchedulerService(store, tie_break="first", clock=clock)
+    svc.start_scheduler(None)
+    store.create("pods", mk_pod("huge", cpu="64000m"))
+    svc.schedule_pending(max_rounds=3, respect_backoff=True)
+    attempts_after_first = svc.stats["sequential_pods"]
+    assert attempts_after_first == 1  # filtered exactly once
+    # its own failure-status patch emitted an event; repeated drains must
+    # not re-attempt it
+    for _ in range(5):
+        svc.schedule_pending(max_rounds=3, respect_backoff=True)
+    assert svc.stats["sequential_pods"] == attempts_after_first
+    assert svc.metrics()["queue_unschedulable"] == 1
+
+
+def test_node_event_requeues_after_backoff():
+    clock = FakeClock()
+    store = ClusterStore()
+    store.create("nodes", mk_node("n0", cpu="1000m"))
+    svc = SchedulerService(store, tie_break="first", clock=clock)
+    svc.start_scheduler(None)
+    store.create("pods", mk_pod("big", cpu="8000m"))
+    svc.schedule_pending(max_rounds=1, respect_backoff=True)
+    assert not store.get("pods", "big")["spec"].get("nodeName")
+    # a big-enough node arrives: the event moves the pod to backoffQ…
+    store.create("nodes", mk_node("n1", cpu="16000m"))
+    svc.schedule_pending(max_rounds=1, respect_backoff=True)
+    assert not store.get("pods", "big")["spec"].get("nodeName")  # still backing off
+    # …and it schedules once the backoff expires
+    clock.t = 1.5
+    svc.schedule_pending(max_rounds=1, respect_backoff=True)
+    assert store.get("pods", "big")["spec"].get("nodeName") == "n1"
+
+
+def test_sync_drain_keeps_deterministic_retry_semantics():
+    """The deterministic drain (scenario replay) retries event-moved pods
+    immediately — backoff only gates the background mode."""
+    store = ClusterStore()
+    store.create("nodes", mk_node("n0", cpu="1000m"))
+    svc = SchedulerService(store, tie_break="first")
+    svc.start_scheduler(None)
+    store.create("pods", mk_pod("big", cpu="8000m"))
+    svc.schedule_pending(max_rounds=1)
+    store.create("nodes", mk_node("n1", cpu="16000m"))
+    svc.schedule_pending(max_rounds=1)  # no clock advance needed
+    assert store.get("pods", "big")["spec"].get("nodeName") == "n1"
+
+
+def test_deleted_pod_is_forgotten():
+    clock = FakeClock()
+    store = ClusterStore()
+    store.create("nodes", mk_node("n0", cpu="1000m"))
+    svc = SchedulerService(store, tie_break="first", clock=clock)
+    svc.start_scheduler(None)
+    store.create("pods", mk_pod("gone", cpu="9000m"))
+    svc.schedule_pending(max_rounds=1, respect_backoff=True)
+    assert svc.metrics()["queue_unschedulable"] == 1
+    store.delete("pods", "gone")
+    assert svc.metrics()["queue_unschedulable"] == 0

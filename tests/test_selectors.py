@@ -1,0 +1,167 @@
+"""labelSelector/fieldSelector on the kube-API port (list + watch) — what
+client-go informers and external schedulers send to the reference's real
+kube-apiserver (reference simulator/k8sapiserver/k8sapiserver.go:34-88)."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import urllib.parse
+import urllib.request
+from typing import Any
+
+import pytest
+
+from kube_scheduler_simulator_tpu.server import DIContainer, SimulatorServer
+from kube_scheduler_simulator_tpu.utils.k8s_selectors import (
+    SelectorError,
+    compile_selectors,
+    parse_field_selector,
+    parse_label_selector,
+)
+
+Obj = dict[str, Any]
+
+
+# ------------------------------------------------------------------ parser
+
+
+def test_label_selector_grammar():
+    sel = parse_label_selector("app=web")
+    assert sel({"app": "web"}) and not sel({"app": "db"}) and not sel({})
+    sel = parse_label_selector("app==web,tier!=db")
+    assert sel({"app": "web", "tier": "fe"})
+    assert not sel({"app": "web", "tier": "db"})
+    # != matches when the key is absent (apimachinery semantics)
+    assert sel({"app": "web"})
+    sel = parse_label_selector("env in (a, b),app notin (x)")
+    assert sel({"env": "a", "app": "y"})
+    assert not sel({"env": "c", "app": "y"})
+    assert not sel({"env": "b", "app": "x"})
+    # notin matches absent keys
+    assert sel({"env": "b"})
+    sel = parse_label_selector("gpu")
+    assert sel({"gpu": ""}) and not sel({})
+    sel = parse_label_selector("!gpu")
+    assert sel({}) and not sel({"gpu": "1"})
+
+
+def test_field_selector_grammar():
+    pod = {"metadata": {"name": "p", "namespace": "ns"}, "spec": {"nodeName": "n1"}, "status": {"phase": "Running"}}
+    assert parse_field_selector("spec.nodeName=n1")(pod)
+    assert not parse_field_selector("spec.nodeName=")(pod)
+    assert parse_field_selector("spec.nodeName!=")(pod)
+    assert parse_field_selector("metadata.name=p,status.phase=Running")(pod)
+    # unset schedulerName defaults to default-scheduler, as the apiserver's
+    # pod field selector does
+    assert parse_field_selector("spec.schedulerName=default-scheduler")(pod)
+    with pytest.raises(SelectorError):
+        parse_field_selector("spec.doesNotExist=1")
+    with pytest.raises(SelectorError):
+        parse_field_selector("bogus")
+
+
+def test_compile_selectors_combined():
+    sel = compile_selectors("app=web", "spec.nodeName=")
+    pending = {"metadata": {"labels": {"app": "web"}}, "spec": {}}
+    bound = {"metadata": {"labels": {"app": "web"}}, "spec": {"nodeName": "n"}}
+    assert sel(pending) and not sel(bound)
+    assert compile_selectors(None, None) is None
+
+
+# ------------------------------------------------------------- HTTP layer
+
+
+@pytest.fixture()
+def server():
+    di = DIContainer(use_batch="off")
+    srv = SimulatorServer(di, port=0, kube_api_port=0)
+    srv.start(background=True)
+    yield srv, di
+    srv.shutdown()
+
+
+def _get(port: int, path: str):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_list_with_selectors(server):
+    srv, di = server
+    p = srv.kube_api_port
+    store = di.cluster_store
+    # schedulerName pins the pods to an EXTERNAL scheduler so the
+    # simulator's own loop leaves them alone (deterministic events)
+    for i in range(4):
+        store.create("pods", {
+            "metadata": {"name": f"p{i}", "labels": {"app": "web" if i % 2 else "db", "idx": str(i)}},
+            "spec": {"schedulerName": "external-x", **({"nodeName": "n1"} if i < 2 else {})},
+        })
+    code, lst = _get(p, "/api/v1/pods?labelSelector=" + urllib.parse.quote("app=web"))
+    assert code == 200 and {o["metadata"]["name"] for o in lst["items"]} == {"p1", "p3"}
+    code, lst = _get(p, "/api/v1/pods?fieldSelector=" + urllib.parse.quote("spec.nodeName="))
+    assert {o["metadata"]["name"] for o in lst["items"]} == {"p2", "p3"}
+    code, lst = _get(
+        p,
+        "/api/v1/pods?labelSelector=" + urllib.parse.quote("app in (web)")
+        + "&fieldSelector=" + urllib.parse.quote("spec.nodeName!="),
+    )
+    assert {o["metadata"]["name"] for o in lst["items"]} == {"p1"}
+    code, err = _get(p, "/api/v1/pods?fieldSelector=" + urllib.parse.quote("nope=1"))
+    assert code == 400 and "field label not supported" in err["message"]
+
+
+def test_watch_with_field_selector_synthesizes_transitions(server):
+    """A watch on spec.nodeName= (unassigned pods) must stream DELETED when
+    the scheduler binds a pod — exactly what client-go informers expect."""
+    srv, di = server
+    p = srv.kube_api_port
+    store = di.cluster_store
+    store.create("pods", {"metadata": {"name": "pending-1"}, "spec": {"schedulerName": "external-x"}})
+    store.create("pods", {"metadata": {"name": "bound-1"}, "spec": {"schedulerName": "external-x", "nodeName": "nX"}})
+
+    conn = http.client.HTTPConnection("127.0.0.1", p, timeout=10)
+    conn.request(
+        "GET", "/api/v1/pods?watch=true&fieldSelector=" + urllib.parse.quote("spec.nodeName=")
+    )
+    resp = conn.getresponse()
+    assert resp.status == 200
+    ev = json.loads(resp.readline())
+    assert ev["type"] == "ADDED" and ev["object"]["metadata"]["name"] == "pending-1"
+
+    # a new matching pod streams ADDED
+    store.create("pods", {"metadata": {"name": "pending-2"}, "spec": {"schedulerName": "external-x"}})
+    ev = json.loads(resp.readline())
+    assert ev["type"] == "ADDED" and ev["object"]["metadata"]["name"] == "pending-2"
+
+    # binding it moves it OUT of the selector: synthetic DELETED
+    store.bind_pod("default", "pending-2", "nX")
+    ev = json.loads(resp.readline())
+    assert ev["type"] == "DELETED" and ev["object"]["metadata"]["name"] == "pending-2"
+    assert ev["object"]["spec"]["nodeName"] == "nX"  # final state, kube-style
+
+    # updates to a non-matching pod stay invisible
+    store.patch("pods", "bound-1", {"metadata": {"labels": {"x": "1"}}})
+    # a label change on the still-matching pod streams MODIFIED
+    store.patch("pods", "pending-1", {"metadata": {"labels": {"y": "2"}}})
+    ev = json.loads(resp.readline())
+    assert ev["type"] == "MODIFIED" and ev["object"]["metadata"]["name"] == "pending-1"
+    conn.close()
+
+
+def test_watch_label_selector_add_on_transition_in(server):
+    srv, di = server
+    p = srv.kube_api_port
+    store = di.cluster_store
+    store.create("pods", {"metadata": {"name": "plain"}, "spec": {"schedulerName": "external-x"}})
+    conn = http.client.HTTPConnection("127.0.0.1", p, timeout=10)
+    conn.request("GET", "/api/v1/pods?watch=true&labelSelector=" + urllib.parse.quote("team=a"))
+    resp = conn.getresponse()
+    # labeling the pod INTO the selector streams ADDED (not MODIFIED)
+    store.patch("pods", "plain", {"metadata": {"labels": {"team": "a"}}})
+    ev = json.loads(resp.readline())
+    assert ev["type"] == "ADDED" and ev["object"]["metadata"]["name"] == "plain"
+    conn.close()
