@@ -57,7 +57,13 @@ class ScenarioOperator:
 
     def start(self) -> None:
         if self._thread is not None:
-            return
+            if self._thread.is_alive():
+                return
+            # a previous stop() timed out mid-run and the worker has since
+            # exited at its sentinel — reap it so the operator can revive
+            # (otherwise later scenarios are silently never reconciled)
+            self._thread.join(timeout=0)
+            self._thread = None
         self._unsubscribe = self.store.subscribe(["scenarios"], self._on_event)
         self._thread = threading.Thread(target=self._worker, name="scenario-operator", daemon=True)
         self._thread.start()

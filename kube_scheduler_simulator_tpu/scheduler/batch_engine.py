@@ -105,6 +105,29 @@ KERNEL_SCORES = set(B.SCORE_KERNELS)
 # the resource kinds the volume kernels resolve on the host
 VOLUME_KINDS = ("persistentvolumeclaims", "persistentvolumes", "storageclasses", "csinodes")
 
+# Which kernel filter failures upstream statuses as
+# UnschedulableAndUnresolvable (DefaultPreemption skips those nodes).
+# Mirrors the oracle plugins' Status.unresolvable sites; None = every
+# failure code of that plugin, else the specific codes.
+UNRESOLVABLE_CODES: "dict[str, set | None]" = {
+    "NodeName": None,
+    "NodeUnschedulable": None,
+    "NodeAffinity": None,
+    "TaintToleration": None,
+    "VolumeBinding": None,
+    "VolumeZone": None,
+    # code 1 = missing topology label (unresolvable); code 2 = skew
+    "PodTopologySpread": {1},
+}
+
+
+def is_unresolvable_failure(plugin: str, code: int) -> bool:
+    codes = UNRESOLVABLE_CODES.get(plugin, False)
+    if codes is False:
+        return False
+    return codes is None or code in codes
+
+
 FILTER_MESSAGES = {
     "NodeUnschedulable": {1: nb.NODE_UNSCHEDULABLE_ERR},
     "NodeName": {1: nb.NODE_NAME_ERR},
@@ -316,8 +339,15 @@ class BatchResult:
             if narrowed is not None and n not in narrowed:
                 continue
             plugin = cfg_filters[int(fp[i][j])]
-            msg = self._msg(i, n, plugin, int(fc[j]))
-            diag[self.problem.node_names[n]] = Status.unschedulable(msg)
+            code = int(fc[j])
+            msg = self._msg(i, n, plugin, code)
+            # carry upstream's UnschedulableAndUnresolvable so preemption
+            # (which skips unresolvable nodes) sees the sequential
+            # oracle's exact classification under use_batch="force"
+            if is_unresolvable_failure(plugin, code):
+                diag[self.problem.node_names[n]] = Status.unresolvable(msg)
+            else:
+                diag[self.problem.node_names[n]] = Status.unschedulable(msg)
         return diag
 
     # ------------------------------------------------- pre-marshaled JSON

@@ -34,8 +34,21 @@ from typing import Any
 
 Obj = dict[str, Any]
 
-# Upstream plugins whose Filter failures are UnschedulableAndUnresolvable.
-_UNRESOLVABLE_PLUGINS = {"NodeName", "NodeAffinity", "NodeUnschedulable"}
+# Upstream plugins whose Filter failures are UnschedulableAndUnresolvable
+# (the calling kube-scheduler's preemption skips those nodes).  Shared
+# with the batch engine's diagnosis classification so both bridge paths
+# and the batch path agree.
+def _is_unresolvable(plugin: str, message: str) -> bool:
+    from kube_scheduler_simulator_tpu.plugins.intree import podtopologyspread as pts
+    from kube_scheduler_simulator_tpu.scheduler.batch_engine import UNRESOLVABLE_CODES
+
+    codes = UNRESOLVABLE_CODES.get(plugin, False)
+    if codes is False:
+        return False
+    if codes is None:  # every failure of this plugin
+        return True
+    # code-specific plugins: PodTopologySpread's missing-label failure
+    return plugin == "PodTopologySpread" and message == pts.ERR_REASON_LABEL
 
 
 class TPUScorerBridge:
@@ -134,7 +147,7 @@ class TPUScorerBridge:
                     )
                     if bad is None:
                         passed.append(n)
-                    elif bad[0] in _UNRESOLVABLE_PLUGINS:
+                    elif _is_unresolvable(bad[0], bad[1]):
                         unresolvable[nm] = bad[1]
                     else:
                         failed[nm] = bad[1]
@@ -188,16 +201,20 @@ class TPUScorerBridge:
         state = CycleState()
         self._oracle_pre_filter(fw, state, pod)
         passed, failed, unresolvable = [], {}, {}
+        from kube_scheduler_simulator_tpu.models.framework import Code
+
         for ni in node_infos:
             bad = None
             for wp in fw.plugins["filter"]:
                 status = wp.original.filter(state, pod, ni)
                 if status is not None and not status.is_success():
-                    bad = (wp.original.name, status.message())
+                    # the oracle's own status carries the exact
+                    # resolvability classification
+                    bad = (status.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE, status.message())
                     break
             if bad is None:
                 passed.append(ni.node)
-            elif bad[0] in _UNRESOLVABLE_PLUGINS:
+            elif bad[0]:
                 unresolvable[ni.name] = bad[1]
             else:
                 failed[ni.name] = bad[1]

@@ -77,8 +77,10 @@ class SchedulerService:
 
         self.queue = SchedulingQueue(clock=clock)
         cluster_store.subscribe(["pods", "nodes"], self.queue.note_event)
-        # move_seq snapshot taken when a scheduling attempt starts
-        self._attempt_move_seq: "int | None" = None
+        # move_seq snapshot captured when a pod PARKS at Permit: its
+        # "attempt" spans the whole wait, so events during the wait must
+        # count when the wait ends in failure (moveRequestCycle semantics)
+        self._wait_move_seq: dict[str, int] = {}
         self._out_of_tree: dict[str, Callable[[Obj | None, Any], Any]] = {}
         self._plugin_extenders: dict[str, Callable[[ResultStore], Any]] = {}
         self._current_cfg: "Obj | None" = None
@@ -165,6 +167,10 @@ class SchedulerService:
         self._batch_engine = None  # rebuilt lazily for the new profiles
         self._batch_engines = {}
         self._current_cfg = cfg
+        # a scheduler (re)build is a scheduling-relevant event: pods that
+        # were unschedulable under the OLD config must be re-attempted
+        # under the new one
+        self.queue.move_all()
         if self._initial_cfg is None:
             self._initial_cfg = copy.deepcopy(cfg)
 
@@ -463,12 +469,12 @@ class SchedulerService:
         for fw in self.frameworks.values():
             res = fw.allow_waiting_pod(namespace, name, plugin)
             if res is not None:
-                self._attempt_move_seq = self.queue.move_seq
+                seq = self._wait_move_seq.pop(f"{namespace}/{name}", None)
                 if not res.success:
                     # the deferred bind cycle failed (e.g. binder webhook
                     # down) — record it like any scheduling failure
                     try:
-                        self._record_failure(self.cluster_store.get("pods", name, namespace), res)
+                        self._record_failure(self.cluster_store.get("pods", name, namespace), res, seq)
                     except KeyError:
                         pass
                 self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
@@ -480,10 +486,10 @@ class SchedulerService:
         for fw in self.frameworks.values():
             res = fw.reject_waiting_pod(namespace, name, message)
             if res is not None:
-                self._attempt_move_seq = self.queue.move_seq
+                seq = self._wait_move_seq.pop(f"{namespace}/{name}", None)
                 try:
                     pod = self.cluster_store.get("pods", name, namespace)
-                    self._record_failure(pod, res)
+                    self._record_failure(pod, res, seq)
                 except KeyError:
                     pass
                 self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
@@ -496,14 +502,13 @@ class SchedulerService:
         background loop call this; tests drive it with an explicit
         ``now``)."""
         expired: dict[str, ScheduleResult] = {}
-        self._attempt_move_seq = self.queue.move_seq
         for fw in self.frameworks.values():
             if not fw.waiting_pods:
                 continue
             by_key = {key: w.pod for key, w in fw.waiting_pods.items()}
             fw_expired = fw.expire_waiting_pods(now)
             for key, res in fw_expired.items():
-                self._record_failure(by_key[key], res)
+                self._record_failure(by_key[key], res, self._wait_move_seq.pop(key, None))
             expired.update(fw_expired)
         if expired:
             self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
@@ -561,7 +566,6 @@ class SchedulerService:
         restarts = 0
         while i < len(pending):
             tail = pending[i:]
-            self._attempt_move_seq = self.queue.move_seq
             result = eng.schedule(
                 nodes,
                 self._pods_with_waiting_assumed(),
@@ -659,7 +663,7 @@ class SchedulerService:
         # commits in the round are replayed as in the sequential cycle),
         # so failure classification snapshots move_seq here — matching
         # schedule_one's per-pod snapshot
-        self._attempt_move_seq = self.queue.move_seq
+        attempt_move_seq = self.queue.move_seq
         if point_names is None:
             point_names = {
                 p: [wp.original.name for wp in fw.plugins[p]]
@@ -712,7 +716,7 @@ class SchedulerService:
             diagnosis=diagnosis,
             status=Status.unschedulable(f"0/{result.problem.N_true} nodes are available"),
         )
-        self._record_failure(pod, res)
+        self._record_failure(pod, res, attempt_move_seq)
         return res
 
     def schedule_one(self, pod: Obj, snapshot: "Snapshot | None" = None) -> ScheduleResult:
@@ -720,19 +724,23 @@ class SchedulerService:
         if snapshot is None:
             snapshot = self.build_snapshot()
         fw = self.framework_for(pod)
-        self._attempt_move_seq = self.queue.move_seq
+        attempt_move_seq = self.queue.move_seq
         result = fw.schedule_one(pod, snapshot)
         self._sync_rotation(fw)
         self.stats["sequential_pods"] += 1
-        if not result.success and not result.waiting_on:
-            self._record_failure(pod, result)
+        if result.waiting_on:
+            # the attempt continues through the Permit wait: events fired
+            # while parked must count if the wait ends in failure
+            self._wait_move_seq[_pod_key(pod)] = attempt_move_seq
+        elif not result.success:
+            self._record_failure(pod, result, attempt_move_seq)
         # The reference's informer flushes results asynchronously after the
         # cycle; flush the queued pods now that all results are recorded.
         # Waiting pods keep their results queued until permit resolves.
         self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
         return result
 
-    def _record_failure(self, pod: Obj, result: ScheduleResult) -> None:
+    def _record_failure(self, pod: Obj, result: ScheduleResult, attempt_move_seq: "int | None" = None) -> None:
         """Update pod status like upstream's failure handler: PodScheduled
         condition + optional nominatedNodeName; the status update event then
         triggers the reflector's annotation flush."""
@@ -743,7 +751,7 @@ class SchedulerService:
         # stuck-flush timeout); events fired DURING its attempt (its own
         # preemption's victim deletes) route it to backoffQ instead.  Its
         # own status patch below is scheduling-irrelevant and moves nothing.
-        self.queue.on_failure(f"{ns}/{name}", self._attempt_move_seq)
+        self.queue.on_failure(f"{ns}/{name}", attempt_move_seq)
         message = self._failure_message(result)
         patch: Obj = {
             "status": {

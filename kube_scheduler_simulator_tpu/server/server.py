@@ -136,6 +136,14 @@ def _make_handler(server: SimulatorServer):
             self.end_headers()
             self.wfile.write(data)
 
+        def _send_bytes(self, content_type: str, data: bytes, code: int = 200) -> None:
+            """Raw asset response (the UI page and its JS)."""
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def _send_yaml(self, code: int, obj: Any, raw: bool = False) -> None:
             """YAML response (``?format=yaml`` / templates) — the
             reference UI's editors and templates speak YAML."""
@@ -191,12 +199,11 @@ def _make_handler(server: SimulatorServer):
                 if url.path in ("/", "/index.html"):
                     from kube_scheduler_simulator_tpu.server.webui import HTML
 
-                    data = HTML.encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/html; charset=utf-8")
-                    self.send_header("Content-Length", str(len(data)))
-                    self.end_headers()
-                    self.wfile.write(data)
+                    self._send_bytes("text/html; charset=utf-8", HTML.encode())
+                elif url.path == "/webui.js":
+                    from kube_scheduler_simulator_tpu.server.webui import JS
+
+                    self._send_bytes("application/javascript; charset=utf-8", JS.encode())
                 elif url.path == "/api/v1/schedulerconfiguration":
                     self._send_json(200, di.scheduler_service().get_scheduler_config())
                 elif url.path in ("/api/v1/metrics", "/metrics"):

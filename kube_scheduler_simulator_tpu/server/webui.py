@@ -73,8 +73,15 @@ HTML = r"""<!doctype html>
   <div class="panel"><div id="tables"></div></div>
 </main>
 <dialog id="dlg"><div id="dlgbody"></div><p style="text-align:right"><button onclick="dlg.close()">Close</button></p></dialog>
-<script>
-const KINDS = ["pods","nodes","persistentvolumes","persistentvolumeclaims","storageclasses","priorityclasses","namespaces","deployments","replicasets","scenarios"];
+<script src="/webui.js"></script>
+
+</body>
+</html>
+"""
+
+# The UI behavior, served as its own asset at /webui.js (kept out of
+# the inline page so the server tests can assert on it directly).
+JS = r"""const KINDS = ["pods","nodes","persistentvolumes","persistentvolumeclaims","storageclasses","priorityclasses","namespaces","deployments","replicasets","scenarios"];
 const state = Object.fromEntries(KINDS.map(k=>[k,{}]));
 const dlg = document.getElementById("dlg");
 const key = o => (o.metadata.namespace? o.metadata.namespace+"/" : "") + o.metadata.name;
@@ -297,7 +304,10 @@ async function editObject(kind, o) {
   // the reference's monaco editor role, no client-side YAML lib needed
   const ns = (o.metadata||{}).namespace;
   const path = `/api/v1/resources/${kind}/${o.metadata.name}` + (ns?`?namespace=${ns}`:"");
-  const yamlText = await api("GET", path + (ns?"&":"?") + "format=yaml");
+  let yamlText;
+  try {
+    yamlText = await api("GET", path + (ns?"&":"?") + "format=yaml");
+  } catch (e) { alert(e.message); return; }
   const body = document.getElementById("dlgbody");
   body.innerHTML = `<h2>Edit ${esc(kind)} / ${esc(key(o))} (YAML)</h2>`;
   const ta = document.createElement("textarea");
@@ -435,10 +445,8 @@ async function pollWorkloads() {
 }
 
 refreshAll().then(() => { watchLoop(); pollWorkloads(); });
-</script>
-</body>
-</html>
 """
+
 
 # YAML creation templates per store kind, served at /api/v1/templates/{kind}
 # (the role of the reference's web/components/lib/templates/*.yaml files).

@@ -10,9 +10,14 @@ k8s.io/apimachinery/pkg/labels.Parse and fields.ParseSelector.
 
 from __future__ import annotations
 
+import re
 from typing import Any, Callable, Mapping
 
 Obj = Mapping[str, Any]
+
+# ``key in (a,b)`` / ``key notin (a,b)`` — apimachinery's lexer treats
+# "(" as a delimiter, so the space before the paren is optional
+_SET_RE = re.compile(r"^(?P<key>.+?)\s+(?P<op>in|notin)\s*\((?P<vals>[^()]*)\)$")
 
 
 class SelectorError(ValueError):
@@ -46,24 +51,15 @@ def parse_label_selector(s: str) -> Callable[[Mapping[str, str]], bool]:
     exists)."""
     reqs: list[Callable[[Mapping[str, str]], bool]] = []
     for r in _split_requirements(s):
-        low = r.lower()
-        if " notin " in low:
-            idx = low.index(" notin ")
-            key = r[:idx].strip()
-            rest = r[idx + 7 :].strip()
-            if not (rest.startswith("(") and rest.endswith(")")):
-                raise SelectorError(f"bad 'notin' requirement: {r!r}")
-            values = {v.strip() for v in rest[1:-1].split(",") if v.strip()}
-            # apimachinery: notin matches when the key is absent too
-            reqs.append(lambda lbl, k=key, vs=values: lbl.get(k) not in vs)
-        elif " in " in low:
-            idx = low.index(" in ")
-            key = r[:idx].strip()
-            rest = r[idx + 4 :].strip()
-            if not (rest.startswith("(") and rest.endswith(")")):
-                raise SelectorError(f"bad 'in' requirement: {r!r}")
-            values = {v.strip() for v in rest[1:-1].split(",") if v.strip()}
-            reqs.append(lambda lbl, k=key, vs=values: lbl.get(k) in vs)
+        m = _SET_RE.match(r)
+        if m is not None:
+            key = m.group("key").strip()
+            values = {v.strip() for v in m.group("vals").split(",") if v.strip()}
+            if m.group("op") == "notin":
+                # apimachinery: notin matches when the key is absent too
+                reqs.append(lambda lbl, k=key, vs=values: lbl.get(k) not in vs)
+            else:
+                reqs.append(lambda lbl, k=key, vs=values: lbl.get(k) in vs)
         elif "!=" in r:
             key, _, val = r.partition("!=")
             reqs.append(lambda lbl, k=key.strip(), v=val.strip(): lbl.get(k) != v)

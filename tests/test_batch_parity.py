@@ -913,11 +913,13 @@ def test_large_scale_seeded_parity_sweep():
     assert scheduled == P, f"only {scheduled}/{P} scheduled"
 
 
-def run_single_vs_sharded(nodes, pods, filters, scores, volumes=None, **schedule_kw):
+def run_single_vs_sharded(nodes, pods, filters, scores, volumes=None, trace=False, **schedule_kw):
     """Run BatchEngine single-device (pinned to one CPU device) and
     mesh-sharded over 8 virtual CPU devices on the same snapshot; assert
-    identical selections + feasible counts.  Shared by the mesh parity
-    suites here and in test_batch_volumes."""
+    identical selections + feasible counts — and, with ``trace=True``,
+    byte-identical filter/score annotation JSON (the compact-trace path
+    production runs).  Shared by the mesh parity suites here and in
+    test_batch_volumes."""
     import jax
     import numpy as np
     from jax.sharding import Mesh
@@ -926,15 +928,26 @@ def run_single_vs_sharded(nodes, pods, filters, scores, volumes=None, **schedule
     assert len(devices) >= 8, "conftest forces 8 virtual CPU devices"
     mesh = Mesh(np.array(devices[:8]), ("nodes",))
     with jax.default_device(devices[0]):
-        res1 = BatchEngine(filters=filters, scores=scores).schedule(
+        res1 = BatchEngine(filters=filters, scores=scores, trace=trace).schedule(
             nodes, pods, pods, [], volumes=volumes, **schedule_kw
         )
     with mesh:
-        res2 = BatchEngine(filters=filters, scores=scores, mesh=mesh).schedule(
+        res2 = BatchEngine(filters=filters, scores=scores, trace=trace, mesh=mesh).schedule(
             nodes, pods, pods, [], volumes=volumes, **schedule_kw
         )
     assert res1.selected_nodes == res2.selected_nodes
     assert list(res1.feasible_count) == list(res2.feasible_count)
+    if trace:
+        for i in range(len(pods)):
+            assert str(res1.filter_annotation_json(i)) == str(res2.filter_annotation_json(i)), (
+                f"pod {i}: filter annotation diverges under sharding"
+            )
+            s1, f1 = res1.score_annotations_json(i)
+            s2, f2 = res2.score_annotations_json(i)
+            assert str(s1) == str(s2) and str(f1) == str(f2), (
+                f"pod {i}: score annotations diverge under sharding"
+            )
+            assert res1.diagnosis(i).keys() == res2.diagnosis(i).keys()
     return res1, res2
 
 
@@ -985,6 +998,58 @@ def test_batch_engine_mesh_sharded_parity():
     # its rotation-rank prefix sums are the most order-sensitive
     # cross-node reductions, so pin them under sharding too
     run_single_vs_sharded(nodes, pods, plugins, scores, start_index=5)
+
+
+def test_batch_engine_mesh_sharded_trace_parity():
+    """TRACE mode under sharding: the compact-trace path (per-plugin
+    dtypes, blob fetch, host reconstruction, C assembly) must emit
+    byte-identical annotation JSON whether the node axis is sharded over
+    the mesh or not — this is the path production runs."""
+    random.seed(22)
+    nodes = [
+        mk_node(
+            f"node-{i}",
+            cpu_m=random.choice([4000, 8000]),
+            mem_mi=16384,
+            labels={
+                "kubernetes.io/hostname": f"node-{i}",
+                "topology.kubernetes.io/zone": f"z{i % 4}",
+                "disk": "ssd" if i % 2 else "hdd",
+            },
+        )
+        for i in range(32)
+    ]
+    pods = []
+    for i in range(24):
+        p = mk_pod(
+            f"pod-{i}",
+            cpu_m=random.choice([200, 400, 800]),
+            mem_mi=256,
+            labels={"app": f"a{i % 3}"},
+        )
+        if i % 4 == 0:  # filter failures on half the nodes
+            p["spec"]["nodeSelector"] = {"disk": "ssd"}
+        if i % 2 == 0:
+            p["spec"]["topologySpreadConstraints"] = [
+                {
+                    "maxSkew": 2,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": f"a{i % 3}"}},
+                }
+            ]
+        pods.append(p)
+    plugins = ["NodeResourcesFit", "TaintToleration", "NodeAffinity", "PodTopologySpread"]
+    scores = [
+        ("NodeResourcesFit", 1),
+        ("TaintToleration", 3),
+        ("NodeAffinity", 2),
+        ("PodTopologySpread", 2),
+    ]
+    run_single_vs_sharded(nodes, pods, plugins, scores, trace=True)
+    # uneven node count (mesh pads) and rotated start, traced
+    run_single_vs_sharded(nodes[:9], pods, plugins, scores, trace=True)
+    run_single_vs_sharded(nodes, pods, plugins, scores, trace=True, start_index=7)
 
 
 def test_imagelocality_kernel_parity():

@@ -1,0 +1,174 @@
+"""Golden fixtures transcribed from the reference's Go test suites.
+
+The table inputs and expected bytes below are carried over from
+- /root/reference/simulator/scheduler/plugin/resultstore/store_test.go
+  (TestStore_GetStoredResult:584-834, TestStore_AddScoreResult:284-447,
+  TestStore_AddNormalizedScoreResult:448-583)
+- /root/reference/simulator/scheduler/storereflector/storereflector_test.go
+  (Test_updateResultHistory:81-160)
+- /root/reference/simulator/scheduler/extender/resultstore/resultstore_test.go
+  (TestStore_GetStoredResult:16-180)
+
+as literal expected strings (Go's ``encoding/json.Marshal`` of maps is
+compact with sorted keys — deterministic, so the bytes can be written
+down).  Unlike the parity suites, nothing here consults the Python
+oracle: if the Python result store and the kernel ever shared a
+misreading of upstream, these pins would still catch it.
+"""
+
+from __future__ import annotations
+
+import json
+
+from kube_scheduler_simulator_tpu.models.framework import PreFilterResult
+from kube_scheduler_simulator_tpu.plugins import annotations as anno
+from kube_scheduler_simulator_tpu.plugins.resultstore import (
+    PASSED_FILTER_MESSAGE,
+    POST_FILTER_NOMINATED_MESSAGE,
+    ResultStore,
+)
+from kube_scheduler_simulator_tpu.plugins.storereflector import _updated_history
+
+POD = {"metadata": {"name": "pod1", "namespace": "default"}}
+
+
+def test_get_stored_result_golden_bytes():
+    """store_test.go TestStore_GetStoredResult "success" (lines 595-760):
+    the full result state marshals to these exact annotation bytes."""
+    rs = ResultStore(score_plugin_weight={"plugin1": 2})
+    ns, pod = "default", "pod1"
+    rs.add_selected_node(ns, pod, "node")
+    rs.add_pre_score_result(ns, pod, "plugin1", "preScore")
+    rs.add_pre_filter_result(
+        ns, pod, "plugin1", "preFilterStatus", PreFilterResult(["node1", "node2"])
+    )
+    rs.add_permit_result(ns, pod, "plugin1", "permit", 1.0)
+    rs.add_reserve_result(ns, pod, "plugin1", "reserve")
+    rs.add_pre_bind_result(ns, pod, "plugin1", "prebind")
+    rs.add_bind_result(ns, pod, "plugin1", "bind")
+    for node in ("node0", "node1"):
+        rs.add_filter_result(ns, pod, node, "plugin1", PASSED_FILTER_MESSAGE)
+        rs.add_score_result(ns, pod, node, "plugin1", 10)
+    rs.add_post_filter_result(ns, pod, "node0", "plugin1", ["node0", "node1"])
+
+    got = rs.get_stored_result(POD)
+    want = {
+        anno.SELECTED_NODE: "node",
+        anno.PRESCORE_RESULT: '{"plugin1":"preScore"}',
+        anno.PREFILTER_RESULT: '{"plugin1":["node1","node2"]}',
+        anno.PREFILTER_STATUS_RESULT: '{"plugin1":"preFilterStatus"}',
+        anno.PERMIT_STATUS_RESULT: '{"plugin1":"permit"}',
+        anno.PERMIT_TIMEOUT_RESULT: '{"plugin1":"1s"}',
+        anno.RESERVE_RESULT: '{"plugin1":"reserve"}',
+        anno.PREBIND_RESULT: '{"plugin1":"prebind"}',
+        anno.BIND_RESULT: '{"plugin1":"bind"}',
+        anno.FILTER_RESULT: '{"node0":{"plugin1":"passed"},"node1":{"plugin1":"passed"}}',
+        anno.SCORE_RESULT: '{"node0":{"plugin1":"10"},"node1":{"plugin1":"10"}}',
+        anno.FINALSCORE_RESULT: '{"node0":{"plugin1":"20"},"node1":{"plugin1":"20"}}',
+        anno.POSTFILTER_RESULT: '{"node0":{"plugin1":"preemption victim"},"node1":{}}',
+    }
+    for key, expected in want.items():
+        assert got[key] == expected, (key, got[key])
+    assert POST_FILTER_NOMINATED_MESSAGE == "preemption victim"
+
+
+def test_add_score_result_applies_weight_golden():
+    """store_test.go TestStore_AddScoreResult (lines 284-447): the raw
+    score lands in ``score`` and weight×score in ``finalScore``."""
+    # "success with empty result": weight 2, score 10 -> "10"/"20"
+    rs = ResultStore(score_plugin_weight={"plugin1": 2})
+    rs.add_score_result("default", "pod1", "node1", "plugin1", 10)
+    got = rs.get_stored_result(POD)
+    assert got[anno.SCORE_RESULT] == '{"node1":{"plugin1":"10"}}'
+    assert got[anno.FINALSCORE_RESULT] == '{"node1":{"plugin1":"20"}}'
+
+    # "success with non-empty filter map for the node": plugin2 (weight 2)
+    # merges next to plugin1's existing 10/30
+    rs2 = ResultStore(score_plugin_weight={"plugin1": 3, "plugin2": 2})
+    rs2.add_score_result("default", "pod1", "node1", "plugin1", 10)  # final 30
+    rs2.add_score_result("default", "pod1", "node1", "plugin2", 10)  # final 20
+    got = rs2.get_stored_result(POD)
+    assert got[anno.SCORE_RESULT] == '{"node1":{"plugin1":"10","plugin2":"10"}}'
+    assert got[anno.FINALSCORE_RESULT] == '{"node1":{"plugin1":"30","plugin2":"20"}}'
+
+    # "success when no map for the node": a second node joins the maps
+    rs3 = ResultStore(score_plugin_weight={"plugin1": 2})
+    rs3.add_score_result("default", "pod1", "node0", "plugin1", 10)
+    rs3.add_score_result("default", "pod1", "node1", "plugin1", 10)
+    got = rs3.get_stored_result(POD)
+    assert got[anno.SCORE_RESULT] == '{"node0":{"plugin1":"10"},"node1":{"plugin1":"10"}}'
+    assert got[anno.FINALSCORE_RESULT] == '{"node0":{"plugin1":"20"},"node1":{"plugin1":"20"}}'
+
+
+def test_add_normalized_score_result_golden():
+    """store_test.go TestStore_AddNormalizedScoreResult (448-583): the
+    normalized score × weight OVERWRITES finalScore and leaves the raw
+    ``score`` map untouched."""
+    rs = ResultStore(score_plugin_weight={"plugin1": 2})
+    rs.add_score_result("default", "pod1", "node1", "plugin1", 10)
+    rs.add_normalized_score_result("default", "pod1", "node1", "plugin1", 100)
+    got = rs.get_stored_result(POD)
+    assert got[anno.SCORE_RESULT] == '{"node1":{"plugin1":"10"}}'
+    assert got[anno.FINALSCORE_RESULT] == '{"node1":{"plugin1":"200"}}'
+
+
+def test_update_result_history_golden():
+    """storereflector_test.go Test_updateResultHistory (81-160): the two
+    success cases' expected annotation values, VERBATIM."""
+    m1 = {"result1": "fuga", "result2": "hoge"}
+    # "success: Pod doesn't have annotation yet"
+    assert _updated_history(None, m1) == '[{"result1":"fuga","result2":"hoge"}]'
+    # "success: Pod already has annotation" (parse-append path: untrusted)
+    existing = '[{"result1":"fuga","result2":"hoge"}]'
+    m2 = {"result1": "fuga2", "result2": "hoge2"}
+    assert (
+        _updated_history(existing, m2, trusted=False)
+        == '[{"result1":"fuga","result2":"hoge"},{"result1":"fuga2","result2":"hoge2"}]'
+    )
+    # and the byte-splice fast path must produce the same bytes
+    assert (
+        _updated_history(existing, m2, trusted=True)
+        == '[{"result1":"fuga","result2":"hoge"},{"result1":"fuga2","result2":"hoge2"}]'
+    )
+    # "fail: Pod has broken value on annotation": Go returns an error and
+    # drops the whole flush; this build deviates deliberately — a corrupt
+    # foreign value resets to a fresh, valid single-entry history instead
+    # of wedging annotation writes forever.
+    out = _updated_history("broken", m2)
+    assert json.loads(out) == [m2]
+
+
+def test_extender_resultstore_golden():
+    """extender/resultstore_test.go TestStore_GetStoredResult (16-180):
+    prioritize and bind annotations pin Go's exact bytes (their structs'
+    sorted field names coincide with declaration order); the filter
+    annotation is pinned semantically — this build emits ITS map with
+    sorted keys, where Go emits ExtenderFilterResult fields in struct
+    declaration order."""
+    from kube_scheduler_simulator_tpu.scheduler.extender import ExtenderResultStore
+
+    store = ExtenderResultStore()
+    args = {"pod": {"metadata": {"name": "pod1", "namespace": "default"}}}
+    store.add_filter_result(
+        args,
+        {
+            "nodes": {"items": [{"metadata": {"name": "nodename"}}]},
+            "nodenames": ["node1"],
+            "failedNodes": {"foo": "bar"},
+            "failedAndUnresolvableNodes": {"baz": "qux"},
+            "error": "myerror",
+        },
+        "node0",
+    )
+    store.add_prioritize_result(args, [{"host": "node1", "score": 1}], "node0")
+    store.add_bind_result(
+        {"podNamespace": "default", "podName": "pod1"}, {"error": "myerror"}, "node0"
+    )
+    got = store.get_stored_result(POD)
+    assert got[anno.EXTENDER_PRIORITIZE_RESULT] == '{"node0":[{"host":"node1","score":1}]}'
+    assert got[anno.EXTENDER_BIND_RESULT] == '{"node0":{"error":"myerror"}}'
+    f = json.loads(got[anno.EXTENDER_FILTER_RESULT])
+    assert f["node0"]["failedNodes"] == {"foo": "bar"}
+    assert f["node0"]["failedAndUnresolvableNodes"] == {"baz": "qux"}
+    assert f["node0"]["error"] == "myerror"
+    assert f["node0"]["nodenames"] == ["node1"]

@@ -77,11 +77,13 @@ def test_filter_splits_failures(server):
     assert out["error"] == ""
     passed = {n["metadata"]["name"] for n in out["nodes"]["items"]}
     assert passed == {"node-free"}
-    # Fit failure is resolvable, taint (NoSchedule) failure is resolvable
-    assert set(out["failedNodes"]) == {"node-tight", "node-tainted"}
+    # Fit failure is resolvable (preemption can free cpu); a NoSchedule
+    # taint is UnschedulableAndUnresolvable upstream (tainttoleration
+    # Filter) — preemption cannot remove a taint
+    assert set(out["failedNodes"]) == {"node-tight"}
     assert "Insufficient cpu" in out["failedNodes"]["node-tight"]
-    assert "untolerated taint" in out["failedNodes"]["node-tainted"]
-    assert out["failedAndUnresolvableNodes"] == {}
+    assert set(out["failedAndUnresolvableNodes"]) == {"node-tainted"}
+    assert "untolerated taint" in out["failedAndUnresolvableNodes"]["node-tainted"]
 
 
 def test_filter_unresolvable_and_nodenames_mode(server):

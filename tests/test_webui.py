@@ -40,10 +40,15 @@ def test_page_served_with_ui_features(server):
     code, body = _req(srv, "GET", "/")
     html = body.decode()
     assert code == 200
-    # the feature hooks the page ships: tables view, result-history
+    # the page loads its behavior from the served JS asset
+    assert '<script src="/webui.js">' in html
+    import urllib.request
+
+    js = urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/webui.js", timeout=10).read().decode()
+    # the feature hooks the UI ships: tables view, result-history
     # viewer, JSON editing, watch loop
     for marker in ("renderTables", "historyViewer", "editObject", "listwatchresources", "TABLE_COLS"):
-        assert marker in html, marker
+        assert marker in js, marker
 
 
 def test_create_schedule_result_dialog_reset_flow(server):
@@ -100,3 +105,34 @@ def test_create_schedule_result_dialog_reset_flow(server):
     assert code == 202
     _c, lst = _req(srv, "GET", "/api/v1/resources/pods")
     assert lst["items"] == []
+
+
+def test_webui_js_served_and_consistent(server):
+    """The UI's JS is its own asset: every handler the HTML references
+    must be defined, every element id the JS touches must exist in the
+    HTML, and the script must be structurally balanced — a typo in the
+    script can no longer ship a blank page with green tests."""
+    import re
+    import urllib.request
+
+    srv, _di = server
+    html = urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/", timeout=10).read().decode()
+    resp = urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/webui.js", timeout=10)
+    assert resp.headers["Content-Type"].startswith("application/javascript")
+    js = resp.read().decode()
+    assert '<script src="/webui.js">' in html
+
+    # every onclick handler referenced by the HTML is defined in the JS
+    for fn in set(re.findall(r'onclick="(\w+)\(', html)):
+        assert re.search(rf"function {fn}\b", js), f"handler {fn} missing from webui.js"
+    # every getElementById target in the JS exists in the HTML or is
+    # created by the JS itself
+    created = set(re.findall(r'\.id\s*=\s*"([\w-]+)"', js))
+    created |= set(re.findall(r'id=\\?"([\w-]+)', js))  # innerHTML templates
+    for el in set(re.findall(r'getElementById\("([\w-]+)"\)', js)):
+        assert f'id="{el}"' in html or el in created, f"element #{el} missing"
+    # structural balance (cheap syntax smoke without a JS engine)
+    stripped = re.sub(r"//[^\n]*", "", js)
+    stripped = re.sub(r'"(?:\\.|[^"\\])*"|\'(?:\\.|[^\'\\])*\'|`(?:\\.|[^`\\])*`', "", stripped, flags=re.S)
+    for o, c in (("{", "}"), ("(", ")"), ("[", "]")):
+        assert stripped.count(o) == stripped.count(c), f"unbalanced {o}{c}"
