@@ -722,13 +722,13 @@ def reconstruct_trace(
         out["fail_plug"] = fp
         out["fail_code"] = fetched["fail_code"]
         W = fp.shape[1]
-        r = np.arange(W, dtype=np.int64)[None, :]
-        proc = np.minimum(sample_processed.astype(np.int64), n_true)[:, None]
-        ids = (sample_start.astype(np.int64)[:, None] + r) % max(n_true, 1)
+        r = np.arange(W, dtype=np.int32)[None, :]
+        proc = np.minimum(sample_processed.astype(np.int32), n_true)[:, None]
+        ids = (sample_start.astype(np.int32)[:, None] + r) % max(n_true, 1)
         # ascending-id column order (invalid columns pushed past the end),
         # matching the compact planes' argsort
         ids = np.sort(np.where(r < proc, ids, n_true + r), axis=1)
-        in_window = np.arange(W, dtype=np.int64)[None, :] < proc
+        in_window = r < proc
         in_window[p_true:] = False
         feas = in_window & (fp < 0)
         pos = np.cumsum(feas, axis=1) - 1

@@ -160,15 +160,10 @@ class StoreReflector:
 _KEY_FRAGS: dict[str, str] = {}
 
 
-def _entry_json(new_results: dict[str, str]) -> str:
-    """go_marshal of the history entry, assembled from fragments: the
-    entry is a flat map whose VALUES are the (often megabyte) annotation
-    bodies just built — the native single-pass escape (or ``go_string``'s
-    replace chain) avoids re-scanning everything through json.dumps, and
-    values that carry their pre-escaped twin (EscapedJSON, from the batch
-    engine's C assembly) are embedded without any scan at all."""
-    from kube_scheduler_simulator_tpu.utils.gojson import EscapedJSON
-
+def _entry_parts(new_results: dict[str, str]):
+    """(key fragments, values, escaped twins) for a history entry, in
+    go_marshal key order — the ONE place that decides which keys enter
+    the entry and how escaped twins are surfaced."""
     keys = sorted(k for k in new_results if k != anno.RESULT_HISTORY)
     frags = []
     for k in keys:
@@ -178,6 +173,27 @@ def _entry_json(new_results: dict[str, str]) -> str:
         frags.append(frag)
     vals = [new_results[k] for k in keys]
     escs = [getattr(v, "escaped", None) for v in vals]
+    return frags, vals, escs
+
+
+def _release_escaped(vals: list) -> None:
+    """The escaped twins served their one purpose (the history entry) —
+    release the bytes; the value objects live on in pod annotations."""
+    from kube_scheduler_simulator_tpu.utils.gojson import EscapedJSON
+
+    for v in vals:
+        if isinstance(v, EscapedJSON):
+            v.escaped = None
+
+
+def _entry_json(new_results: dict[str, str]) -> str:
+    """go_marshal of the history entry, assembled from fragments: the
+    entry is a flat map whose VALUES are the (often megabyte) annotation
+    bodies just built — the native single-pass escape (or ``go_string``'s
+    replace chain) avoids re-scanning everything through json.dumps, and
+    values that carry their pre-escaped twin (EscapedJSON, from the batch
+    engine's C assembly) are embedded without any scan at all."""
+    frags, vals, escs = _entry_parts(new_results)
     entry = None
     if _fastjson is not None:
         try:
@@ -189,11 +205,7 @@ def _entry_json(new_results: dict[str, str]) -> str:
             frag + ('"' + e + '"' if e is not None else go_string(v))
             for frag, v, e in zip(frags, vals, escs)
         ) + "}"
-    # the escaped twin served its one purpose — release the bytes (the
-    # value object lives on in the pod's annotations)
-    for v in vals:
-        if isinstance(v, EscapedJSON):
-            v.escaped = None
+    _release_escaped(vals)
     return entry
 
 
@@ -211,6 +223,20 @@ def _updated_history(existing: "str | None", new_results: dict[str, str], truste
     Untrusted values (imported snapshots, foreign annotations) are
     parse-validated; corrupt or non-array values reset to a fresh
     single-entry history, as before."""
+    if _fastjson is not None and (
+        not existing
+        or (trusted and (existing == "[]" or (existing.startswith("[{") and existing.endswith("}]"))))
+    ):
+        # one C buffer builds splice + entry together (no intermediate
+        # entry string, no Python concat of the megabyte history)
+        frags, vals, escs = _entry_parts(new_results)
+        try:
+            out = _fastjson.history_append(existing or None, frags, vals, escs)
+        except UnicodeEncodeError:
+            out = None
+        if out is not None:
+            _release_escaped(vals)
+            return out
     entry_json = _entry_json(new_results)
     if existing:
         if trusted:

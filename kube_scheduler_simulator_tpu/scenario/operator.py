@@ -48,24 +48,31 @@ class ScenarioOperator:
     def __init__(self, cluster_store: Any, scheduler_service: Any, controller_manager: Any = None):
         self.store = cluster_store
         self.engine = ScenarioEngine(cluster_store, scheduler_service, controller_manager)
-        self._queue: "queue.Queue[tuple[str, str] | None]" = queue.Queue()
+        self._queue: "queue.Queue[tuple[str, str] | tuple[None, int]]" = queue.Queue()
         self._thread: "threading.Thread | None" = None
         self._unsubscribe = None
+        # start-generation counter: stop() enqueues a generation-tagged
+        # sentinel, and a worker only honors sentinels of ITS OWN (or a
+        # later) generation — a stale sentinel left by a timed-out or
+        # repeated stop() can never kill a freshly started worker
+        self._gen = 0
         self.runs = 0  # observability: completed reconciles since start
 
     # ---------------------------------------------------------------- wiring
 
     def start(self) -> None:
-        if self._thread is not None:
-            if self._thread.is_alive():
-                return
-            # a previous stop() timed out mid-run and the worker has since
-            # exited at its sentinel — reap it so the operator can revive
-            # (otherwise later scenarios are silently never reconciled)
-            self._thread.join(timeout=0)
-            self._thread = None
-        self._unsubscribe = self.store.subscribe(["scenarios"], self._on_event)
-        self._thread = threading.Thread(target=self._worker, name="scenario-operator", daemon=True)
+        if self._thread is not None and self._thread.is_alive() and self._unsubscribe is not None:
+            return  # already running and subscribed
+        # a previous stop() may have timed out mid-run (worker still
+        # draining) or the worker may have exited at its sentinel — either
+        # way a NEW generation takes over; the old worker (if any) ignores
+        # everything once it sees a sentinel of its own generation
+        self._gen += 1
+        if self._unsubscribe is None:
+            self._unsubscribe = self.store.subscribe(["scenarios"], self._on_event)
+        self._thread = threading.Thread(
+            target=self._worker, args=(self._gen,), name="scenario-operator", daemon=True
+        )
         self._thread.start()
         # adopt scenarios that existed before the operator started
         for obj in self.store.list("scenarios", copy_objects=False):
@@ -77,12 +84,13 @@ class ScenarioOperator:
             self._unsubscribe()
             self._unsubscribe = None
         if self._thread is not None:
-            self._queue.put(None)
+            self._queue.put((None, self._gen))
             self._thread.join(timeout=10)
             if self._thread.is_alive():
                 # a long scenario replay is still in flight: keep the
-                # thread reference so start() cannot spawn a duplicate
-                # worker; this one exits at the sentinel when the run ends
+                # thread reference; this worker exits at the sentinel when
+                # the run ends, and a later start() begins a new
+                # generation whose worker ignores stale sentinels
                 return
             self._thread = None
 
@@ -112,12 +120,14 @@ class ScenarioOperator:
         meta = obj["metadata"]
         self._queue.put((meta.get("namespace", "default"), meta["name"]))
 
-    def _worker(self) -> None:
+    def _worker(self, gen: int) -> None:
         while True:
             item = self._queue.get()
             try:
-                if item is None:
-                    return
+                if item[0] is None:
+                    if item[1] >= gen:
+                        return
+                    continue  # stale sentinel from an older generation
                 ns, name = item
                 try:
                     obj = self.store.get("scenarios", name, ns)

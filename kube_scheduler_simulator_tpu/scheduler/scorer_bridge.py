@@ -39,16 +39,21 @@ Obj = dict[str, Any]
 # with the batch engine's diagnosis classification so both bridge paths
 # and the batch path agree.
 def _is_unresolvable(plugin: str, message: str) -> bool:
-    from kube_scheduler_simulator_tpu.plugins.intree import podtopologyspread as pts
-    from kube_scheduler_simulator_tpu.scheduler.batch_engine import UNRESOLVABLE_CODES
+    from kube_scheduler_simulator_tpu.scheduler.batch_engine import (
+        FILTER_MESSAGES,
+        UNRESOLVABLE_CODES,
+    )
 
     codes = UNRESOLVABLE_CODES.get(plugin, False)
     if codes is False:
         return False
     if codes is None:  # every failure of this plugin
         return True
-    # code-specific plugins: PodTopologySpread's missing-label failure
-    return plugin == "PodTopologySpread" and message == pts.ERR_REASON_LABEL
+    # code-specific plugins: derive the unresolvable MESSAGES from the
+    # same tables the batch engine's diagnosis uses, so the two paths
+    # cannot diverge when the code set grows
+    msgs = FILTER_MESSAGES.get(plugin, {})
+    return message in {msgs[c] for c in codes if c in msgs}
 
 
 class TPUScorerBridge:

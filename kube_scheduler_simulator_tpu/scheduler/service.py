@@ -162,6 +162,9 @@ class SchedulerService:
             frameworks[name] = fw
         self.frameworks = frameworks
         self.framework = frameworks.get("default-scheduler") or frameworks[names[0]]
+        # parked waiting pods do not survive a framework rebuild — neither
+        # do their wait-start snapshots
+        self._wait_move_seq.clear()
         self.result_store = self.framework.result_store
         self.extender_service = extender_service
         self._batch_engine = None  # rebuilt lazily for the new profiles

@@ -70,10 +70,25 @@ def parse_label_selector(s: str) -> Callable[[Mapping[str, str]], bool]:
             key, _, val = r.partition("=")
             reqs.append(lambda lbl, k=key.strip(), v=val.strip(): lbl.get(k) == v)
         elif r.startswith("!"):
-            reqs.append(lambda lbl, k=r[1:].strip(): k not in lbl)
+            key = r[1:].strip()
+            _require_label_key(key, r)
+            reqs.append(lambda lbl, k=key: k not in lbl)
         else:
+            # exists-requirement: the token must be a plausible label key —
+            # a malformed set requirement ('env in prod', 'env IN (x)')
+            # must 400, not silently match nothing (apimachinery rejects
+            # them too)
+            _require_label_key(r, r)
             reqs.append(lambda lbl, k=r: k in lbl)
     return lambda labels: all(req(labels) for req in reqs)
+
+
+_KEY_RE = re.compile(r"[A-Za-z0-9._/-]+\Z")
+
+
+def _require_label_key(key: str, requirement: str) -> None:
+    if not key or not _KEY_RE.match(key):
+        raise SelectorError(f"invalid label selector requirement: {requirement!r}")
 
 
 # The field paths the real apiserver supports for the kinds external
