@@ -691,6 +691,10 @@ class BatchEngine:
         # trace-compaction executables, keyed by (scan key, visited-width
         # bucket) — kept apart so _fn_cache counts scan executables only
         self._compact_cache: dict = {}
+        # sticky per-plugin raw fetch dtypes: scores GROW as the cluster
+        # fills (inter-pod counts, spread skews), and a dtype narrowing
+        # back mid-run would churn compact executables — only widen
+        self._raw_dtypes: dict[int, str] = {}
         self.last_timings: dict[str, float] = {}
         # Cumulative observability counters (surfaced by /api/v1/metrics):
         # rounds = schedule() calls, compiles = jit-cache misses,
@@ -1001,10 +1005,16 @@ class BatchEngine:
             max_feasible = int(packed[1].max()) if packed.shape[1] else 1
             WS = min(dims["N"], E._bucket(max(max_feasible, 1)))
             mm = np.asarray(out_dev["trace_meta"])
-            raw_dtypes = tuple(
-                B.raw_dtype_for(int(mm[k, 0]), int(mm[k, 1]))
-                for k in range(len(cfg.scores))
-            )
+            widths = {"int8": 0, "int16": 1, "int32": 2}
+            raw_dtypes = []
+            for k in range(len(cfg.scores)):
+                dt = B.raw_dtype_for(int(mm[k, 0]), int(mm[k, 1]))
+                prev = self._raw_dtypes.get(k)
+                if prev is not None and widths[prev] > widths[dt]:
+                    dt = prev
+                self._raw_dtypes[k] = dt
+                raw_dtypes.append(dt)
+            raw_dtypes = tuple(raw_dtypes)
             code_max = int(mm[-1, 1])
             pack_mode = B.fail_pack_mode(code_max, len(cfg.filters))
             ckey = (key, W, WS, raw_dtypes, pack_mode)
