@@ -52,7 +52,9 @@ HTML = r"""<!doctype html>
 <body>
 <header>
   <h1>kube-scheduler-simulator <span class="muted" style="color:#cfe0ff">TPU-native</span></h1>
+  <input id="search" type="search" placeholder="filter…" style="border:none;border-radius:4px;padding:5px 8px;min-width:140px" oninput="onSearch()">
   <button id="viewtoggle" onclick="toggleView()">Tables</button>
+  <button onclick="openMetrics()">Metrics</button>
   <button onclick="newResource()">+ Create</button>
   <button onclick="openSchedConfig()">Scheduler&nbsp;Config</button>
   <button onclick="doExport()">Export</button>
@@ -108,6 +110,23 @@ async function refreshAll() {
   render();
 }
 
+let filterText = "";
+let searchTimer = null;
+function onSearch() {
+  // debounced: at benchmark scale a per-keystroke full re-render of
+  // thousands of DOM nodes would freeze the tab
+  clearTimeout(searchTimer);
+  searchTimer = setTimeout(() => {
+    filterText = document.getElementById("search").value.toLowerCase();
+    render();
+  }, 150);
+}
+function matchesFilter(o) {
+  if (!filterText) return true;
+  const hay = key(o).toLowerCase() + " " + JSON.stringify(o.metadata.labels || {}).toLowerCase();
+  return hay.includes(filterText);
+}
+
 function render() {
   if (tablesMode) { renderTables(); return; }
   const nodesDiv = document.getElementById("nodes");
@@ -115,6 +134,7 @@ function render() {
   const buckets = {"(unscheduled)": []};
   for (const n of Object.values(state.nodes)) buckets[n.metadata.name] = [];
   for (const p of Object.values(state.pods)) {
+    if (!matchesFilter(p)) continue;
     const nn = (p.spec||{}).nodeName;
     (buckets[nn] || buckets["(unscheduled)"]).push(p);
   }
@@ -125,7 +145,7 @@ function render() {
     const node = state.nodes[nodeName];
     const h = document.createElement("h3");
     h.textContent = nodeName + (node ? `  —  cpu ${((node.status||{}).allocatable||{}).cpu||"?"} / mem ${((node.status||{}).allocatable||{}).memory||"?"}` : "");
-    if (node) { h.style.cursor = "pointer"; h.onclick = () => showObject("nodes", node); }
+    if (node) { h.style.cursor = "pointer"; h.onclick = () => showNode(node); }
     div.appendChild(h);
     for (const p of pods) {
       const s = document.createElement("span");
@@ -144,6 +164,7 @@ function render() {
     row.className = "kindrow";
     row.innerHTML = `<b>${k}</b>`;
     for (const o of Object.values(state[k])) {
+      if (!matchesFilter(o)) continue;
       const s = document.createElement("span");
       s.className = "item";
       s.textContent = key(o);
@@ -152,6 +173,90 @@ function render() {
     }
     others.appendChild(row);
   }
+}
+
+
+// ---- node detail: capacity vs requested, with usage bars ----------------
+
+function parseCpu(v) {
+  if (v === undefined || v === null || v === "") return 0;
+  v = String(v);
+  return v.endsWith("m") ? parseFloat(v) / 1000 : parseFloat(v);
+}
+function parseMem(v) {
+  if (!v) return 0;
+  // kube resource.Quantity suffixes: binary Ki..Ei, decimal k/M/G/T/P/E,
+  // and milli (m)
+  const m = String(v).match(/^([0-9.]+)(Ki|Mi|Gi|Ti|Pi|Ei|k|M|G|T|P|E|m)?$/);
+  if (!m) return parseFloat(v) || 0;
+  const mult = {Ki: 2**10, Mi: 2**20, Gi: 2**30, Ti: 2**40, Pi: 2**50, Ei: 2**60,
+                k: 1e3, M: 1e6, G: 1e9, T: 1e12, P: 1e15, E: 1e18, m: 1e-3}[m[2]] || 1;
+  return parseFloat(m[1]) * mult;
+}
+function bar(frac, label) {
+  const pct = Math.min(100, Math.round(frac * 100));
+  const color = pct > 90 ? "#d93025" : pct > 70 ? "#f9ab00" : "#1e8e3e";
+  return `<div style="margin:4px 0"><span class="muted">${esc(label)} — ${pct}%</span>
+    <div style="background:#eee;border-radius:4px;height:10px"><div style="width:${pct}%;background:${color};height:10px;border-radius:4px"></div></div></div>`;
+}
+
+function showNode(node) {
+  const name = node.metadata.name;
+  const alloc = (node.status||{}).allocatable || {};
+  const pods = Object.values(state.pods).filter(p => (p.spec||{}).nodeName === name);
+  let cpuReq = 0, memReq = 0;
+  for (const p of pods) {
+    for (const c of (p.spec||{}).containers || []) {
+      const r = ((c.resources||{}).requests) || {};
+      cpuReq += parseCpu(r.cpu); memReq += parseMem(r.memory);
+    }
+  }
+  const cpuCap = parseCpu(alloc.cpu), memCap = parseMem(alloc.memory);
+  const body = document.getElementById("dlgbody");
+  body.innerHTML = `<h2>Node / ${esc(name)}</h2>` +
+    bar(cpuCap ? cpuReq / cpuCap : 0, `cpu ${cpuReq.toFixed(2)} / ${esc(alloc.cpu||"?")}`) +
+    bar(memCap ? memReq / memCap : 0, `memory ${(memReq/2**30).toFixed(2)}Gi / ${esc(alloc.memory||"?")}`) +
+    bar((parseFloat(alloc.pods)||0) ? pods.length / parseFloat(alloc.pods) : 0,
+        `pods ${pods.length} / ${esc(alloc.pods||"?")}`) +
+    `<p class="muted">taints: ${esc((((node.spec||{}).taints)||[]).map(t=>`${t.key}=${t.value}:${t.effect}`).join(", ") || "none")}</p>`;
+  const list = document.createElement("div");
+  for (const p of pods) {
+    const sp = document.createElement("span");
+    sp.className = "pod"; sp.textContent = key(p); sp.onclick = () => showPod(p);
+    list.appendChild(sp);
+  }
+  body.appendChild(list);
+  body.appendChild(editButton("nodes", node));
+  const raw = document.createElement("pre");
+  raw.textContent = JSON.stringify(node, null, 2);
+  body.appendChild(raw);
+  dlg.showModal();
+}
+
+// ---- metrics panel -------------------------------------------------------
+
+async function openMetrics() {
+  let text = "";
+  try { text = await api("GET", "/api/v1/metrics"); }
+  catch (e) { alert(e.message); return; }
+  const rows = [];
+  for (const line of text.split("\n")) {
+    if (!line || line.startsWith("#")) continue;
+    const sp = line.lastIndexOf(" ");
+    rows.push([line.slice(0, sp), line.slice(sp + 1)]);
+  }
+  const body = document.getElementById("dlgbody");
+  body.innerHTML = `<h2>Metrics</h2>`;
+  const tbl = document.createElement("table");
+  tbl.className = "kv";
+  for (const [k, v] of rows) {
+    const tr = document.createElement("tr");
+    const td1 = document.createElement("td"); td1.textContent = k;
+    const td2 = document.createElement("td"); td2.textContent = v;
+    tr.appendChild(td1); tr.appendChild(td2); tbl.appendChild(tr);
+  }
+  body.appendChild(tbl);
+  dlg.showModal();
 }
 
 function esc(s){ return String(s).replace(/&/g,"&amp;").replace(/</g,"&lt;"); }
@@ -196,7 +301,7 @@ function renderTables() {
   root.innerHTML = "";
   for (const k of KINDS) {
     const cols = TABLE_COLS[k] || [["name", o=>o.metadata.name]];
-    const objs = Object.values(state[k]);
+    const objs = Object.values(state[k]).filter(matchesFilter);
     const h = document.createElement("h2");
     h.textContent = `${k} (${objs.length})`;
     root.appendChild(h);

@@ -47,7 +47,8 @@ def test_page_served_with_ui_features(server):
     js = urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/webui.js", timeout=10).read().decode()
     # the feature hooks the UI ships: tables view, result-history
     # viewer, JSON editing, watch loop
-    for marker in ("renderTables", "historyViewer", "editObject", "listwatchresources", "TABLE_COLS"):
+    for marker in ("renderTables", "historyViewer", "editObject", "listwatchresources", "TABLE_COLS",
+                   "showNode", "openMetrics", "matchesFilter"):
         assert marker in js, marker
 
 
