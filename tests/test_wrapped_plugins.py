@@ -1,0 +1,229 @@
+"""Wrapped-plugin delegation, recording, and extender short-circuits —
+fake plugins at every extension point, mirroring the reference's
+wrappedplugin_test.go (its largest suite, 1,970 LoC of fakeFilterPlugin /
+fakeScorePlugin tables asserting that wrapping (a) delegates to the
+original, (b) records the right store entries, (c) honors Before/After
+extender hooks including non-success short-circuits)."""
+
+from __future__ import annotations
+
+import json
+
+from kube_scheduler_simulator_tpu.models.framework import CycleState, Status
+from kube_scheduler_simulator_tpu.models.wrapped import (
+    WrappedPlugin,
+    original_name,
+    plugin_name,
+)
+from kube_scheduler_simulator_tpu.plugins.resultstore import (
+    PASSED_FILTER_MESSAGE,
+    SUCCESS_MESSAGE,
+    ResultStore,
+)
+
+POD = {"metadata": {"name": "pod1", "namespace": "default"}}
+
+
+class FakeNodeInfo:
+    def __init__(self, name: str):
+        self.name = name
+
+
+class FakePlugin:
+    """Implements every extension point, records its own call log."""
+
+    name = "FakePlugin"
+
+    def __init__(self):
+        self.calls: list = []
+        self.filter_status: "Status | None" = None
+        self.score_value = 42
+
+    def pre_filter(self, state, pod):
+        self.calls.append("pre_filter")
+        return None, None
+
+    def filter(self, state, pod, node_info):
+        self.calls.append(("filter", node_info.name))
+        return self.filter_status
+
+    def post_filter(self, state, pod, status_map):
+        self.calls.append("post_filter")
+        # nominate the first failed node (the store records the
+        # "preemption victim" message on the NOMINATED node only)
+        return sorted(status_map)[0], Status.success()
+
+    def pre_score(self, state, pod, nodes):
+        self.calls.append("pre_score")
+        return None
+
+    def score(self, state, pod, node_info):
+        self.calls.append(("score", node_info.name))
+        return self.score_value, None
+
+    def normalize_scores(self, state, pod, scores):
+        self.calls.append("normalize")
+        for k in scores:
+            scores[k] = scores[k] // 2
+        return None
+
+    def reserve(self, state, pod, node_name):
+        self.calls.append(("reserve", node_name))
+        return None
+
+    def unreserve(self, state, pod, node_name):
+        self.calls.append(("unreserve", node_name))
+
+    def permit(self, state, pod, node_name):
+        self.calls.append("permit")
+        return None, 0.0
+
+    def pre_bind(self, state, pod, node_name):
+        self.calls.append("pre_bind")
+        return None
+
+    def bind(self, state, pod, node_name):
+        self.calls.append(("bind", node_name))
+        return None
+
+    def post_bind(self, state, pod, node_name):
+        self.calls.append(("post_bind", node_name))
+
+
+def mk() -> "tuple[ResultStore, FakePlugin, WrappedPlugin]":
+    store = ResultStore(score_plugin_weight={"FakePlugin": 2})
+    orig = FakePlugin()
+    return store, orig, WrappedPlugin(store, orig)
+
+
+def test_names_and_capability_probes():
+    _store, orig, wp = mk()
+    assert wp.name == "FakePluginWrapped"
+    assert plugin_name("X") == "XWrapped" and original_name("XWrapped") == "X"
+    assert original_name("PlainName") == "PlainName"
+    assert wp.implements("filter") and wp.implements("permit")
+
+
+def test_every_point_delegates_and_records():
+    store, orig, wp = mk()
+    st = CycleState()
+    ni = FakeNodeInfo("node1")
+
+    wp.pre_filter(st, POD)
+    assert wp.filter(st, POD, ni) is None
+    wp.post_filter(st, POD, {"node1": Status.unschedulable("x")})
+    wp.pre_score(st, POD, [])
+    score, _ = wp.score(st, POD, ni)
+    assert score == 42
+    scores = {"node1": score}
+    wp.normalize_scores(st, POD, scores)
+    assert scores == {"node1": 21}  # original's normalize ran
+    wp.reserve(st, POD, "node1")
+    wp.permit(st, POD, "node1")
+    wp.pre_bind(st, POD, "node1")
+    wp.bind(st, POD, "node1")
+    wp.post_bind(st, POD, "node1")
+    wp.unreserve(st, POD, "node1")
+
+    # the original saw every call
+    assert "pre_filter" in orig.calls and ("filter", "node1") in orig.calls
+    assert ("score", "node1") in orig.calls and "normalize" in orig.calls
+    assert ("bind", "node1") in orig.calls and ("post_bind", "node1") in orig.calls
+    assert ("unreserve", "node1") in orig.calls
+
+    # and the store recorded the annotation categories with the exact bytes
+    got = store.get_stored_result(POD)
+    assert json.loads(got["scheduler-simulator/filter-result"]) == {
+        "node1": {"FakePlugin": PASSED_FILTER_MESSAGE}
+    }
+    assert json.loads(got["scheduler-simulator/score-result"]) == {
+        "node1": {"FakePlugin": "42"}
+    }
+    # finalScore = normalized (21) x weight (2)
+    assert json.loads(got["scheduler-simulator/finalscore-result"]) == {
+        "node1": {"FakePlugin": "42"}
+    }
+    assert json.loads(got["scheduler-simulator/postfilter-result"]) == {
+        "node1": {"FakePlugin": "preemption victim"}
+    }
+    assert got["scheduler-simulator/selected-node"] == "node1"
+    for key in ("prescore", "reserve", "permit", "prebind", "bind"):
+        cat = json.loads(got[f"scheduler-simulator/{key}-result"])
+        assert cat == {"FakePlugin": SUCCESS_MESSAGE}, (key, cat)
+
+
+def test_filter_failure_records_message_not_passed():
+    store, orig, wp = mk()
+    orig.filter_status = Status.unschedulable("too small")
+    st = wp.filter(CycleState(), POD, FakeNodeInfo("n0"))
+    assert not st.is_success()
+    got = store.get_stored_result(POD)
+    assert json.loads(got["scheduler-simulator/filter-result"]) == {
+        "n0": {"FakePlugin": "too small"}
+    }
+
+
+class ShortCircuitExtender:
+    """before_filter rejects; the original must NOT run."""
+
+    def __init__(self):
+        self.after_seen = False
+
+    def before_filter(self, state, pod, node_info):
+        return Status.unschedulable("extender says no")
+
+    def after_filter(self, state, pod, node_info, status):
+        self.after_seen = True
+        return status
+
+
+def test_before_extender_short_circuits_original():
+    store = ResultStore()
+    orig = FakePlugin()
+    ext = ShortCircuitExtender()
+    wp = WrappedPlugin(store, orig, ext)
+    st = wp.filter(CycleState(), POD, FakeNodeInfo("n0"))
+    assert st.message() == "extender says no"
+    assert orig.calls == []  # the original never ran
+    assert not ext.after_seen  # neither did the after hook
+    # and nothing was recorded (the reference short-circuits before the
+    # store write too, wrappedplugin.go Filter)
+    assert store.get_stored_result(POD).get("scheduler-simulator/filter-result", "{}") == "{}"
+
+
+class RewritingExtender:
+    """after_score rewrites the original's score."""
+
+    def before_score(self, state, pod, node_name):
+        return 0, None
+
+    def after_score(self, state, pod, node_name, score, status):
+        return score + 58, status
+
+
+def test_after_extender_rewrites_outcome():
+    store = ResultStore(score_plugin_weight={"FakePlugin": 1})
+    orig = FakePlugin()
+    wp = WrappedPlugin(store, orig, RewritingExtender())
+    score, _st = wp.score(CycleState(), POD, FakeNodeInfo("n1"))
+    assert score == 100  # 42 + 58
+    # the STORE records the original's score (the reference records inside
+    # the wrapped call before the after hook rewrites the return value)
+    got = store.get_stored_result(POD)
+    assert json.loads(got["scheduler-simulator/score-result"]) == {
+        "n1": {"FakePlugin": "42"}
+    }
+
+
+def test_reserve_failure_skips_selected_node():
+    store = ResultStore()
+
+    class FailingReserve(FakePlugin):
+        def reserve(self, state, pod, node_name):
+            return Status.error("boom")
+
+    wp = WrappedPlugin(store, FailingReserve())
+    wp.reserve(CycleState(), POD, "n1")
+    got = store.get_stored_result(POD)
+    assert got["scheduler-simulator/selected-node"] == ""
+    assert json.loads(got["scheduler-simulator/reserve-result"]) == {"FakePlugin": "boom"}
