@@ -519,6 +519,19 @@ class SchedulerService:
 
     # ------------------------------------------------------------ batch path
 
+    def _engine_for(self, fw: Framework):
+        """The (lazily built) batch engine for a profile's framework —
+        one per profile, each with its own jit caches and trace config."""
+        from kube_scheduler_simulator_tpu.scheduler.batch_engine import BatchEngine
+
+        eng = self._batch_engines.get(fw.profile_name)
+        if eng is None:
+            eng = BatchEngine.from_framework(fw, trace=True)
+            self._batch_engines[fw.profile_name] = eng
+            if fw is self.framework:
+                self._batch_engine = eng  # metrics/back-compat handle
+        return eng
+
     def _schedule_pending_batch(self, respect_backoff: bool = False) -> "dict[str, ScheduleResult] | None":
         """One round on the TPU batch engine (scheduler/batch_engine).
 
@@ -531,40 +544,80 @@ class SchedulerService:
         pods must see the freed resources — so the kernel re-runs on the
         remaining tail from the updated cluster state; failed pods whose
         preemption found no candidates (or profiles with no PostFilter at
-        all) leave the state untouched and the replay continues."""
-        from kube_scheduler_simulator_tpu.scheduler.batch_engine import BatchEngine
+        all) leave the state untouched and the replay continues.
 
-        fw = self.framework
-        assert fw is not None
-        if len(self.frameworks) > 1:
-            # Multi-profile rounds take the sequential cycle: each pod is
-            # scheduled and traced by its OWNING profile's framework
-            # (framework_for), which the per-profile batch engines don't
-            # interleave yet.  The reference has no batch path at all.
-            self._count_fallback("multiple scheduler profiles")
-            return None
-        pending = fw.sort_pods(self._ready_pending(respect_backoff))
-        if not pending:
+        Multi-profile rounds run as SEGMENTS: maximal queue-order runs of
+        same-profile pods, each on its profile's own engine (per-profile
+        plugin sets and weights), with the rotation/attempt counters
+        synced across profiles after each segment exactly as the
+        sequential path does per pod."""
+        fw0 = self.framework
+        assert fw0 is not None
+        pending_all = fw0.sort_pods(self._ready_pending(respect_backoff))
+        if not pending_all:
             return {}
         nodes = self.cluster_store.list("nodes", copy_objects=False)
-        if self.use_batch == "auto" and len(pending) * max(len(nodes), 1) < self.batch_min_work:
+        if self.use_batch == "auto" and len(pending_all) * max(len(nodes), 1) < self.batch_min_work:
             self._count_fallback("below batch_min_work")
             return None
-        if self._batch_engine is None:
-            self._batch_engine = BatchEngine.from_framework(fw, trace=True)
-        eng = self._batch_engine
-        volumes = eng._volumes()  # one store listing serves check + encode
-        ok, why = eng.supported(pending, nodes, volumes=volumes)
-        if not ok:
-            self._count_fallback(why)
-            return None
 
+        # maximal same-profile runs, preserving queue order
+        segments: list[tuple[Framework, list[Obj]]] = []
+        for pod in pending_all:
+            fw = self.framework_for(pod)
+            if segments and segments[-1][0] is fw:
+                segments[-1][1].append(pod)
+            else:
+                segments.append((fw, [pod]))
+
+        results: dict[str, ScheduleResult] = {}
+        any_batched = False
+        for fw, pending in segments:
+            eng = self._engine_for(fw)
+            volumes = eng._volumes()
+            ok, why = eng.supported(pending, nodes, volumes=volumes)
+            if ok and len(segments) > 1 and self.use_batch == "auto" and (
+                len(pending) * max(len(nodes), 1) < self.batch_min_work
+            ):
+                # interleaved schedulerNames can shatter a round into tiny
+                # segments — those are cheaper on the sequential cycle
+                # than on a kernel dispatch each
+                ok, why = False, "segment below batch_min_work"
+            if not ok:
+                if len(segments) == 1:
+                    # the common single-profile round: fall back to the
+                    # all-sequential round (exact, as before)
+                    self._count_fallback(why)
+                    return None
+                # exact sequential cycle for just this segment
+                # (schedule_one syncs rotation per pod)
+                self._count_fallback(f"{why} [profile {fw.profile_name}]")
+                snapshot = self.build_snapshot()
+                for pod in pending:
+                    results[_pod_key(pod)] = self.schedule_one(pod, snapshot)
+            else:
+                self._run_segment_batch(fw, eng, pending, nodes, volumes, results)
+                any_batched = True
+                self._sync_rotation(fw)
+        if any_batched:
+            self.stats["batch_commits"] += 1
+        self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
+        return results
+
+    def _run_segment_batch(
+        self,
+        fw: Framework,
+        eng: Any,
+        pending: list[Obj],
+        nodes: list[Obj],
+        volumes: "dict[str, list[Obj]]",
+        results: dict,
+    ) -> None:
         seq_failures = bool(fw.plugins["post_filter"]) and self.use_batch != "force"
         point_names = {
             p: [wp.original.name for wp in fw.plugins[p]]
             for p in ("pre_filter", "pre_score", "reserve", "pre_bind", "bind")
         }
-        results: dict[str, ScheduleResult] = {}
         i = 0  # index of the tail's first pod within `pending`
         restarts = 0
         while i < len(pending):
@@ -584,7 +637,7 @@ class SchedulerService:
             for j, pod in enumerate(tail):
                 key = _pod_key(pod)
                 if int(result.selected[j]) >= 0 or not seq_failures:
-                    results[key] = self._commit_batch_pod(result, j, pod, snapshot, point_names)
+                    results[key] = self._commit_batch_pod(result, j, pod, snapshot, point_names, fw)
                     fw.sched_counter += 1
                     self.stats["batch_pods"] += 1
                 else:
@@ -611,9 +664,6 @@ class SchedulerService:
                 for pod in pending[i:]:
                     results[_pod_key(pod)] = self.schedule_one(pod, snapshot)
                 break
-        self.stats["batch_commits"] += 1
-        self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
-        return results
 
     def _count_fallback(self, reason: str) -> None:
         with self._stats_lock:
@@ -651,6 +701,7 @@ class SchedulerService:
         pod: Obj,
         snapshot: "Snapshot | None" = None,
         point_names: "dict[str, list[str]] | None" = None,
+        fw: "Framework | None" = None,
     ) -> ScheduleResult:
         """Write one pod's batch trace into the result store (the same
         categories the wrapped plugins record, models/wrapped.py) and bind
@@ -659,9 +710,10 @@ class SchedulerService:
         does in the all-sequential path)."""
         from kube_scheduler_simulator_tpu.plugins.resultstore import SUCCESS_MESSAGE
 
-        fw = self.framework
-        assert fw is not None and self.result_store is not None
-        rs = self.result_store
+        if fw is None:
+            fw = self.framework
+        assert fw is not None
+        rs = fw.result_store  # the OWNING profile's store and weights
         # this pod's attempt effectively starts at ITS commit (earlier
         # commits in the round are replayed as in the sequential cycle),
         # so failure classification snapshots move_seq here — matching

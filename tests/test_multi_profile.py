@@ -119,14 +119,24 @@ def test_pods_route_and_trace_by_their_profile():
         assert int(fin1[node]["NodeAffinity"]) == int(plugs["NodeAffinity"]) * 2
 
 
-def test_multi_profile_batch_mode_falls_back_to_exact_sequential():
-    store, svc = _mk_service(use_batch="auto")
-    for i in range(6):
-        store.create("pods", mk_pod(f"p{i}", "second-scheduler" if i % 2 else None))
+def test_multi_profile_batch_runs_per_profile_segments():
+    """Multi-profile rounds batch as queue-ordered same-profile segments,
+    each on its profile's own engine — byte-identical to the sequential
+    cycle per profile."""
+    store, svc = _mk_service(use_batch="force")
+    store2, svc2 = _mk_service(use_batch="off")
+    for s in (store, store2):
+        for i in range(6):
+            s.create("pods", mk_pod(f"p{i}", "second-scheduler" if i % 2 else None))
     svc.schedule_pending(max_rounds=1)
-    assert all((store.get("pods", f"p{i}")["spec"].get("nodeName")) for i in range(6))
-    assert "multiple scheduler profiles" in svc.stats["batch_fallbacks"]
-    # traces still come from the right profile
+    svc2.schedule_pending(max_rounds=1)
+    assert svc.stats["batch_pods"] == 6, svc.stats
+    for i in range(6):
+        pb = store.get("pods", f"p{i}")
+        ps = store2.get("pods", f"p{i}")
+        assert pb["spec"].get("nodeName") == ps["spec"].get("nodeName"), f"p{i}"
+        assert pb["metadata"]["annotations"] == ps["metadata"]["annotations"], f"p{i}"
+    # traces come from the owning profile's plugin set
     a = store.get("pods", "p1")["metadata"]["annotations"]
     assert "TaintToleration" in json.loads(a["scheduler-simulator/filter-result"])["node-0"]
 
