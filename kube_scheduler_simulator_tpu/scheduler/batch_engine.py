@@ -38,7 +38,7 @@ from kube_scheduler_simulator_tpu.plugins.resultstore import PASSED_FILTER_MESSA
 
 Obj = dict[str, Any]
 
-_cache_enabled = False
+_cache_dir_applied: "str | None" = None
 _malloc_tuned = False
 
 
@@ -75,10 +75,7 @@ def enable_persistent_compilation_cache() -> None:
     bucketed batch executables (set ``KSS_COMPILE_CACHE_DIR=0`` to
     disable).  The reference has no compile step at all; this closes the
     cold-start gap XLA otherwise adds on every boot."""
-    global _cache_enabled
-    if _cache_enabled:
-        return
-    _cache_enabled = True
+    global _cache_dir_applied
     import os
 
     d = os.environ.get("KSS_COMPILE_CACHE_DIR")
@@ -89,12 +86,47 @@ def enable_persistent_compilation_cache() -> None:
             os.path.expanduser("~"), ".cache", "kube-scheduler-simulator-tpu", "xla"
         )
     try:
+        # CPU AOT cache entries record exact machine features, and XLA
+        # warns reloading them across hosts can SIGILL — so CPU-pinned
+        # processes (the test suite, the multichip dryrun) use a cache
+        # subdirectory keyed by THIS host's CPU fingerprint: warm compiles
+        # on the same machine, never a stale executable from another one.
+        # The env pins are checked first: a process whose backends
+        # initialized on the accelerator can still be pinned to CPU.
+        on_cpu = (
+            os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+            or os.environ.get("JAX_PLATFORM_NAME", "") == "cpu"
+        )
         import jax
 
+        if not on_cpu and jax.default_backend() == "cpu":
+            on_cpu = True
+        if on_cpu:
+            import hashlib
+            import platform
+
+            ident = ""
+            try:
+                with open("/proc/cpuinfo") as f:
+                    ident = next(
+                        (ln for ln in f if ln.startswith(("flags", "Features"))), ""
+                    )
+            except OSError:
+                pass
+            if not ident:  # non-Linux / exotic cpuinfo: coarser identity
+                ident = f"{platform.machine()}|{platform.processor()}|{platform.platform()}"
+            d = os.path.join(d, "cpu-" + hashlib.sha1(ident.encode()).hexdigest()[:12])
+        # the jax cache dir is process-global — re-point it whenever an
+        # engine's platform implies a different directory (e.g. a CPU
+        # dryrun engine after accelerator engines), so CPU AOT artifacts
+        # never land in (or load from) the shared accelerator dir
+        if d == _cache_dir_applied:
+            return
         os.makedirs(d, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", d)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _cache_dir_applied = d
     except Exception:  # pragma: no cover - unwritable home, old jax
         pass
 

@@ -12,6 +12,25 @@ import os
 # JAX_PLATFORM_NAME; set both so tests run on the virtual CPU mesh either way.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+# The axon plugin registers itself from sitecustomize at interpreter
+# start (jax is ALREADY imported before this conftest runs) and its
+# backend factory dials the TPU tunnel even in CPU-pinned processes —
+# when the tunnel is down, every jax call hangs.  Tests never touch the
+# TPU: deregister the factory and re-pin the (already-read) platform
+# config so the suite is immune to tunnel health.
+try:
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+    try:
+        _jax.config.update("jax_platform_name", "cpu")
+    except Exception:
+        pass
+except Exception:  # pragma: no cover - plugin absent / jax internals moved
+    pass
 # x64 gives the batch kernels bit-exact integer semantics on CPU, which is
 # what the parity suites assert; the TPU bench path runs float32 (kept
 # near-exact by the encoder's GCD scaling) and reports max |Δscore|.
