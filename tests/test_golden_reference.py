@@ -138,6 +138,56 @@ def test_update_result_history_golden():
     assert json.loads(out) == [m2]
 
 
+def test_add_filter_result_merge_golden():
+    """store_test.go TestStore_AddFilterResult (18-152): per-node maps
+    merge plugin entries, and a new node joins the map alongside
+    existing ones."""
+    # "success with empty result"
+    rs = ResultStore()
+    rs.add_filter_result("default", "pod1", "node1", "plugin1", PASSED_FILTER_MESSAGE)
+    assert rs.get_stored_result(POD)[anno.FILTER_RESULT] == '{"node1":{"plugin1":"passed"}}'
+    # "success with non-empty filter map for the node"
+    rs.add_filter_result("default", "pod1", "node1", "plugin2", PASSED_FILTER_MESSAGE)
+    assert (
+        rs.get_stored_result(POD)[anno.FILTER_RESULT]
+        == '{"node1":{"plugin1":"passed","plugin2":"passed"}}'
+    )
+    # "success when no map for the node"
+    rs2 = ResultStore()
+    rs2.add_filter_result("default", "pod1", "node0", "plugin1", PASSED_FILTER_MESSAGE)
+    rs2.add_filter_result("default", "pod1", "node1", "plugin1", PASSED_FILTER_MESSAGE)
+    assert (
+        rs2.get_stored_result(POD)[anno.FILTER_RESULT]
+        == '{"node0":{"plugin1":"passed"},"node1":{"plugin1":"passed"}}'
+    )
+
+
+def test_add_post_filter_result_golden():
+    """store_test.go TestStore_AddPostFilterResult (153-283): every node
+    in the list gains an (empty) entry; only the nominated node carries
+    the preemption-victim message."""
+    rs = ResultStore()
+    rs.add_post_filter_result("default", "pod1", "node1", "plugin1", ["node0", "node1", "node2"])
+    assert (
+        rs.get_stored_result(POD)[anno.POSTFILTER_RESULT]
+        == '{"node0":{},"node1":{"plugin1":"preemption victim"},"node2":{}}'
+    )
+
+
+def test_delete_data_golden():
+    """store_test.go TestStore_DeleteData (1144-1200): deleting a pod's
+    data removes it wholesale; other pods' results are untouched."""
+    rs = ResultStore()
+    rs.add_filter_result("default", "pod1", "node1", "plugin1", PASSED_FILTER_MESSAGE)
+    rs.add_filter_result("default", "pod2", "node1", "plugin1", PASSED_FILTER_MESSAGE)
+    rs.delete_data(POD)
+    assert not rs.has_result(POD)
+    pod2 = {"metadata": {"name": "pod2", "namespace": "default"}}
+    assert rs.has_result(pod2)
+    assert rs.get_stored_result(POD) == {}
+    assert rs.get_stored_result(pod2)[anno.FILTER_RESULT] == '{"node1":{"plugin1":"passed"}}'
+
+
 def test_extender_resultstore_golden():
     """extender/resultstore_test.go TestStore_GetStoredResult (16-180):
     prioritize and bind annotations pin Go's exact bytes (their structs'
