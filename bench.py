@@ -317,36 +317,10 @@ def _mean_annotation_bytes(store) -> int:
     return round(total / n) if n else 0
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--quick", action="store_true", help="small sweep (CI/dev)")
-    args = ap.parse_args()
+RESULTS: list = []  # accumulated config rows (watchdog reads them)
 
-    if args.quick:
-        configs = [
-            ("cfg1-fit", 100, 10, ["NodeResourcesFit"], False, False, 100),
-        ]
-    else:
-        # The BASELINE.md config table — the default sweep IS the mandate.
-        configs = [
-            ("cfg1-fit", 100, 10, ["NodeResourcesFit"], False, False, 100),
-            ("cfg2-fit-taint-aff", 1000, 500, ["NodeResourcesFit", "TaintToleration", "NodeAffinity"], False, False, 200),
-            ("cfg3-spread", 5000, 2000, ["NodeResourcesFit", "PodTopologySpread"], True, False, 100),
-            ("cfg4-interpod", 10000, 5000, ["NodeResourcesFit", "InterPodAffinity"], False, True, 50),
-        ]
 
-    results = []
-    for cfg in configs:
-        try:
-            results.append(run_config(*cfg))
-        except Exception as e:  # keep the bench line printable on partial failure
-            results.append({"config": cfg[0], "error": f"{type(e).__name__}: {e}"})
-    if not args.quick:
-        try:
-            results.append(run_churn())
-        except Exception as e:
-            results.append({"config": "cfg5-churn-default-profile", "error": f"{type(e).__name__}: {e}"})
-
+def _emit_line(results: list) -> None:
     headline = next((r for r in results if r.get("config") == "cfg4-interpod" and "wall_s" in r), None)
     if headline is None:
         headline = next((r for r in reversed(results) if "pods_nodes_per_s" in r), {})
@@ -365,7 +339,56 @@ def main() -> None:
         },
         "configs": results,
     }
-    print(json.dumps(line))
+    print(json.dumps(line), flush=True)
+
+
+def _start_watchdog(limit_s: float = 900.0) -> None:
+    """The TPU tunnel can wedge hard (even device enumeration hangs); if
+    the sweep exceeds the limit, print whatever completed as the one
+    JSON line and exit instead of hanging the driver silently."""
+    import threading
+
+    def bite() -> None:
+        RESULTS.append({"config": "watchdog", "error": f"bench exceeded {limit_s}s (TPU tunnel wedged?)"})
+        _emit_line(RESULTS)
+        os._exit(0)
+
+    t = threading.Timer(limit_s, bite)
+    t.daemon = True
+    t.start()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="small sweep (CI/dev)")
+    args = ap.parse_args()
+    _start_watchdog()
+
+    if args.quick:
+        configs = [
+            ("cfg1-fit", 100, 10, ["NodeResourcesFit"], False, False, 100),
+        ]
+    else:
+        # The BASELINE.md config table — the default sweep IS the mandate.
+        configs = [
+            ("cfg1-fit", 100, 10, ["NodeResourcesFit"], False, False, 100),
+            ("cfg2-fit-taint-aff", 1000, 500, ["NodeResourcesFit", "TaintToleration", "NodeAffinity"], False, False, 200),
+            ("cfg3-spread", 5000, 2000, ["NodeResourcesFit", "PodTopologySpread"], True, False, 100),
+            ("cfg4-interpod", 10000, 5000, ["NodeResourcesFit", "InterPodAffinity"], False, True, 50),
+        ]
+
+    results = RESULTS
+    for cfg in configs:
+        try:
+            results.append(run_config(*cfg))
+        except Exception as e:  # keep the bench line printable on partial failure
+            results.append({"config": cfg[0], "error": f"{type(e).__name__}: {e}"})
+    if not args.quick:
+        try:
+            results.append(run_churn())
+        except Exception as e:
+            results.append({"config": "cfg5-churn-default-profile", "error": f"{type(e).__name__}: {e}"})
+    _emit_line(results)
 
 
 if __name__ == "__main__":
