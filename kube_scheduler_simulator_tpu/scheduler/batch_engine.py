@@ -87,12 +87,11 @@ def enable_persistent_compilation_cache() -> None:
         )
     try:
         # CPU AOT cache entries record exact machine features, and XLA
-        # warns reloading them across hosts can SIGILL — so CPU-pinned
-        # processes (the test suite, the multichip dryrun) use a cache
-        # subdirectory keyed by THIS host's CPU fingerprint: warm compiles
-        # on the same machine, never a stale executable from another one.
-        # The env pins are checked first: a process whose backends
-        # initialized on the accelerator can still be pinned to CPU.
+        # reloads them across hosts anyway with only a SIGILL warning — so
+        # CPU-pinned processes (the test suite, the multichip dryrun) get
+        # NO persistent cache unless explicitly opted in (below).  The env
+        # pins are checked first: a process whose backends initialized on
+        # the accelerator can still be pinned to CPU.
         on_cpu = (
             os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
             or os.environ.get("JAX_PLATFORM_NAME", "") == "cpu"
@@ -102,24 +101,32 @@ def enable_persistent_compilation_cache() -> None:
         if not on_cpu and jax.default_backend() == "cpu":
             on_cpu = True
         if on_cpu:
-            import hashlib
-            import platform
+            # CPU AOT entries bake in LLVM's *detected* host features, which
+            # go beyond anything /proc/cpuinfo shows — e.g. prefer-no-gather
+            # is derived from microcode-level mitigation state, so two hosts
+            # with byte-identical cpuinfo flags lines can still produce
+            # incompatible executables (observed across round hosts: XLA
+            # loads the foreign entry anyway and warns about SIGILL).  No
+            # host fingerprint we can compute from userspace is sound, so
+            # CPU persistence is opt-in for single-host setups only.  If an
+            # earlier accelerator engine already pointed the process-global
+            # cache dir somewhere, un-point it — otherwise this CPU-pinned
+            # engine would silently read/write the shared accelerator dir.
+            if os.environ.get("KSS_COMPILE_CACHE_CPU") != "1":
+                if _cache_dir_applied is not None:
+                    jax.config.update("jax_compilation_cache_dir", None)
+                    _cache_dir_applied = None
+                return
+            # opted in: still key by hostname so two hosts sharing $HOME
+            # (driver fleets) never exchange CPU AOT entries
+            import socket
 
-            ident = ""
-            try:
-                with open("/proc/cpuinfo") as f:
-                    ident = next(
-                        (ln for ln in f if ln.startswith(("flags", "Features"))), ""
-                    )
-            except OSError:
-                pass
-            if not ident:  # non-Linux / exotic cpuinfo: coarser identity
-                ident = f"{platform.machine()}|{platform.processor()}|{platform.platform()}"
-            d = os.path.join(d, "cpu-" + hashlib.sha1(ident.encode()).hexdigest()[:12])
+            d = os.path.join(d, "cpu-" + (socket.gethostname() or "localhost"))
         # the jax cache dir is process-global — re-point it whenever an
         # engine's platform implies a different directory (e.g. a CPU
-        # dryrun engine after accelerator engines), so CPU AOT artifacts
-        # never land in (or load from) the shared accelerator dir
+        # dryrun engine after accelerator engines), so opted-in CPU AOT
+        # artifacts land in the hostname-keyed subdir, never the shared
+        # accelerator dir
         if d == _cache_dir_applied:
             return
         os.makedirs(d, exist_ok=True)
