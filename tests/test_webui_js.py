@@ -1,0 +1,84 @@
+"""Execution-level checks for the web UI's JavaScript (VERDICT r3 #5).
+
+The image has no JS engine, so ``utils/jscheck`` implements the grammar:
+a tokenizer + recursive-descent parser + scope resolver for the ES2017
+subset the UI uses.  These tests parse the REAL served asset — a syntax
+error or a misspelled identifier anywhere in it fails the suite — and
+prove the checker's teeth by asserting that deliberately injected typos
+turn it red (the round-3 verdict's done-condition).
+
+The reference gets this guarantee from its Nuxt/TS build pipeline
+(reference web/package.json:8-16); this is the no-toolchain analog.
+"""
+
+from __future__ import annotations
+
+import re
+
+import pytest
+
+from kube_scheduler_simulator_tpu.server.webui import HTML, JS
+from kube_scheduler_simulator_tpu.utils import jscheck
+from kube_scheduler_simulator_tpu.utils.jscheck import JSError
+
+
+def test_served_js_parses_and_resolves():
+    # full parse + scope resolution: any syntax error or undeclared
+    # identifier (typo'd function/variable/global) raises
+    jscheck.check(JS)
+
+
+def test_inline_html_handlers_resolve_against_js():
+    """Every onclick/oninput/onchange snippet in the page (static HTML and
+    the HTML fragments the JS itself injects) must parse and reference only
+    names the JS declares at top level (or ids the page defines)."""
+    top = jscheck.top_level_names(JS)
+    # DOM elements with ids are window globals in browsers (the Close
+    # button uses `dlg.close()`)
+    ids = set(re.findall(r'id="([a-zA-Z_$][\w$]*)"', HTML) + re.findall(r'id="([a-zA-Z_$][\w$]*)"', JS))
+    handlers = re.findall(r'on(?:click|input|change|submit)="([^"]+)"', HTML)
+    handlers += re.findall(r'on(?:click|input|change|submit)="([^"]+)"', JS)
+    assert len(handlers) >= 10, "expected the UI's toolbar handlers to be found"
+    for snippet in handlers:
+        jscheck.check(snippet, extra_globals=top | ids | {"this"})
+
+
+@pytest.mark.parametrize(
+    "name,mutate",
+    [
+        ("missing-paren", lambda js: js.replace("function render() {", "function render( {", 1)),
+        ("unterminated-string", lambda js: js.replace('"(unscheduled)"', '"(unscheduled)', 1)),
+        ("identifier-typo", lambda js: js.replace("renderTables();", "renderTable();", 1)),
+        ("misspelled-global", lambda js: js.replace("document.getElementById", "documnet.getElementById", 1)),
+        ("stray-brace", lambda js: js + "\n}"),
+        ("broken-template", lambda js: js.replace("`/api/v1/resources/${k}`", "`/api/v1/resources/${k`", 1)),
+        ("assign-to-undeclared", lambda js: js.replace("filterText = document", "filterTxt = document", 1)),
+    ],
+)
+def test_injected_typo_turns_suite_red(name, mutate):
+    broken = mutate(JS)
+    assert broken != JS, f"{name}: mutation did not apply — marker moved?"
+    with pytest.raises(JSError):
+        jscheck.check(broken)
+
+
+def test_checker_grammar_corners():
+    """The constructs the UI leans on parse and resolve as a unit."""
+    jscheck.check(
+        """
+        const K = [1, 2].map(x => x ** 2);
+        async function f(a, b) {
+          const {m, n} = await g(`t ${a} ${b.map(t=>`${t.k}=${t.v}`).join(",")}`);
+          try { return m.replace(/&/g, "&amp;"); } catch (e) { return n || null; }
+        }
+        function g(s) { return {m: s, n: ""}; }
+        for (const [k, v] of Object.entries({a: 1})) if (k) g(v);
+        let x = 0;
+        do { x += 1; } while (x < 3);
+        switch (x) { case 3: break; default: x = 1; }
+        """
+    )
+    with pytest.raises(JSError):
+        jscheck.check("const a = ;")
+    with pytest.raises(JSError):
+        jscheck.check("function f( { return 1; }")
