@@ -20,6 +20,7 @@
  */
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <stdint.h>
 #include <string.h>
 
 /* ------------------------------------------------------------------ buf */
@@ -29,16 +30,69 @@ typedef struct {
     Py_ssize_t len;
     Py_ssize_t cap;
     int nonascii; /* any byte >= 0x80 written (tracked per source str) */
+    int slot;     /* scratch-pool slot, or -1 for a plain malloc */
 } Buf;
 
+/* Grow-only scratch arenas reused across calls (GIL-serialized; at most
+ * two Bufs are live at once — the *_pair functions).  At churn-bench
+ * scale every call otherwise mallocs and frees a megabyte-class temp
+ * buffer interleaved with the long-lived result strings, and glibc's
+ * large-bin management of that mix turns each allocation into a
+ * list-walk with multi-ms tails (measured 30-100 ms worst-case per
+ * history_append at 2000-pod waves).  Reusing hot buffers removes the
+ * churn entirely; only the final PyUnicode results touch malloc. */
+#define POOL_N 4
+static char *pool_p[POOL_N];
+static Py_ssize_t pool_cap[POOL_N];
+static unsigned char pool_used[POOL_N];
+
 static int buf_init(Buf *b, Py_ssize_t cap) {
+    int i;
     if (cap < 256) cap = 256;
+    b->len = 0;
+    b->nonascii = 0;
+    for (i = 0; i < POOL_N; i++) {
+        if (!pool_used[i] && pool_p[i] && pool_cap[i] >= cap) {
+            pool_used[i] = 1;
+            b->p = pool_p[i];
+            b->cap = pool_cap[i];
+            b->slot = i;
+            return 0;
+        }
+    }
+    for (i = 0; i < POOL_N; i++) {
+        if (!pool_used[i]) {
+            char *np = pool_p[i] ? (char *)PyMem_Realloc(pool_p[i], cap)
+                                 : (char *)PyMem_Malloc(cap);
+            if (!np) { PyErr_NoMemory(); return -1; }
+            pool_p[i] = np;
+            pool_cap[i] = cap;
+            pool_used[i] = 1;
+            b->p = np;
+            b->cap = cap;
+            b->slot = i;
+            return 0;
+        }
+    }
     b->p = (char *)PyMem_Malloc(cap);
     if (!b->p) { PyErr_NoMemory(); return -1; }
-    b->len = 0;
     b->cap = cap;
-    b->nonascii = 0;
+    b->slot = -1;
     return 0;
+}
+
+static void buf_release(Buf *b) {
+    if (!b->p) return;
+    if (b->slot >= 0) {
+        /* hand the (possibly grown) buffer back to its slot */
+        pool_p[b->slot] = b->p;
+        pool_cap[b->slot] = b->cap;
+        pool_used[b->slot] = 0;
+    } else {
+        PyMem_Free(b->p);
+    }
+    b->p = NULL;
+    b->slot = -1;
 }
 
 static int buf_grow(Buf *b, Py_ssize_t need) {
@@ -74,8 +128,7 @@ static PyObject *buf_take(Buf *b) {
     } else {
         r = PyUnicode_DecodeUTF8(b->p, b->len, "strict");
     }
-    PyMem_Free(b->p);
-    b->p = NULL;
+    buf_release(b);
     return r;
 }
 
@@ -99,11 +152,41 @@ static void init_plain(void) {
 
 static const char *HEX = "0123456789abcdef";
 
+/* any byte in w that needs escaping: < 0x20, one of " \ & < >, or the
+ * 0xE2 lead byte (potential U+2028/29)?  SWAR zero-byte tests; bytes
+ * >= 0x80 are never flagged by the <0x20 test (top bit excluded via ~w)
+ * and only match the explicit 0xE2 compare. */
+static inline uint64_t swar_special(uint64_t w) {
+    const uint64_t ones = 0x0101010101010101ULL;
+    const uint64_t high = 0x8080808080808080ULL;
+    uint64_t special = (w - ones * 0x20) & ~w & high; /* bytes < 0x20 */
+    uint64_t t;
+#define SWAR_EQ(c) (t = w ^ (ones * (unsigned char)(c)), special |= (t - ones) & ~t & high)
+    SWAR_EQ('"');
+    SWAR_EQ('\\');
+    SWAR_EQ('&');
+    SWAR_EQ('<');
+    SWAR_EQ('>');
+    SWAR_EQ(0xE2);
+#undef SWAR_EQ
+    return special;
+}
+
 /* append the escaped body (no quotes) of s[0..n) */
 static int escape_into(Buf *b, const char *s, Py_ssize_t n) {
     Py_ssize_t i = 0;
     while (i < n) {
         Py_ssize_t j = i;
+        /* wide scan: almost all annotation bytes are plain, and the
+         * byte-at-a-time table loop is latency-bound on cold (megabyte)
+         * values — 8-byte word tests keep multiple cache misses in
+         * flight (measured ~8x on the churn bench's history writes) */
+        while (j + 8 <= n) {
+            uint64_t w;
+            memcpy(&w, s + j, 8);
+            if (swar_special(w)) break;
+            j += 8;
+        }
         while (j < n && plain[(unsigned char)s[j]]) j++;
         if (j > i && buf_put(b, s + i, j - i) < 0) return -1;
         if (j >= n) break;
@@ -185,7 +268,7 @@ static PyObject *py_escape_string(PyObject *self, PyObject *arg) {
     if (buf_init(&b, n + (n >> 3) + 16) < 0) return NULL;
     if (!PyUnicode_IS_ASCII(arg)) b.nonascii = 1;
     if (buf_putc(&b, '"') < 0 || escape_into(&b, s, n) < 0 || buf_putc(&b, '"') < 0) {
-        PyMem_Free(b.p);
+        buf_release(&b);
         return NULL;
     }
     return buf_take(&b);
@@ -205,7 +288,7 @@ static PyObject *py_escape_body(PyObject *self, PyObject *arg) {
     if (buf_init(&b, n + (n >> 3) + 16) < 0) return NULL;
     if (!PyUnicode_IS_ASCII(arg)) b.nonascii = 1;
     if (escape_into(&b, s, n) < 0) {
-        PyMem_Free(b.p);
+        buf_release(&b);
         return NULL;
     }
     return buf_take(&b);
@@ -256,7 +339,7 @@ static PyObject *py_history_entry(PyObject *self, PyObject *args) {
     if (buf_putc(&b, '}') < 0) goto fail;
     return buf_take(&b);
 fail:
-    PyMem_Free(b.p);
+    buf_release(&b);
     return NULL;
 }
 
@@ -304,13 +387,20 @@ static PyObject *py_filter_json(PyObject *self, PyObject *args) {
     PyObject *r1 = NULL, *r2 = NULL, *out = NULL;
     Py_ssize_t t, first = 1;
     (void)self;
+    int pair;
     if (!PyArg_ParseTuple(args, "OOOOOlllOOOO", &pass_arr, &pass_esc, &key_frags,
                           &key_escs, &order_o, &start, &proc, &n_true, &fail_ids_o,
                           &fail_uidx_o, &ftable, &etable))
         return NULL;
-    if (!PyList_Check(pass_arr) || !PyList_Check(pass_esc) || !PyList_Check(key_frags) ||
-        !PyList_Check(key_escs) || !PyList_Check(ftable) || !PyList_Check(etable) ||
-        PyList_GET_SIZE(ftable) != PyList_GET_SIZE(etable) || n_true < 0) {
+    /* pass_esc=None selects plain-only mode (no escaped-twin output and
+     * no twin bytes materialized): returns a single str instead of a
+     * (plain, escaped) tuple */
+    pair = pass_esc != Py_None;
+    if (!PyList_Check(pass_arr) || !PyList_Check(key_frags) ||
+        !PyList_Check(ftable) || n_true < 0 ||
+        (pair && (!PyList_Check(pass_esc) || !PyList_Check(key_escs) ||
+                  !PyList_Check(etable) ||
+                  PyList_GET_SIZE(ftable) != PyList_GET_SIZE(etable)))) {
         PyErr_SetString(PyExc_TypeError, "filter_json: bad arguments");
         return NULL;
     }
@@ -323,8 +413,8 @@ static PyObject *py_filter_json(PyObject *self, PyObject *args) {
         PyErr_SetString(PyExc_ValueError, "filter_json: fail_ids/fail_uidx length mismatch");
         goto done;
     }
-    if (PyList_GET_SIZE(pass_arr) < n_true || PyList_GET_SIZE(pass_esc) < n_true ||
-        PyList_GET_SIZE(key_frags) < n_true || PyList_GET_SIZE(key_escs) < n_true) {
+    if (PyList_GET_SIZE(pass_arr) < n_true || PyList_GET_SIZE(key_frags) < n_true ||
+        (pair && (PyList_GET_SIZE(pass_esc) < n_true || PyList_GET_SIZE(key_escs) < n_true))) {
         PyErr_SetString(PyExc_ValueError, "filter_json: fragment lists shorter than n_true");
         goto done;
     }
@@ -346,11 +436,13 @@ static PyObject *py_filter_json(PyObject *self, PyObject *args) {
         }
     }
     if (buf_init(&b, 256 + T * 32) < 0) goto done;
-    if (buf_init(&be, 256 + T * 32) < 0) {
-        PyMem_Free(b.p);
+    be.p = NULL;
+    be.slot = -1;
+    if (pair && buf_init(&be, 256 + T * 32) < 0) {
+        buf_release(&b);
         goto done;
     }
-    if (buf_putc(&b, '{') < 0 || buf_putc(&be, '{') < 0) goto fail;
+    if (buf_putc(&b, '{') < 0 || (pair && buf_putc(&be, '{') < 0)) goto fail;
     for (t = 0; t < T; t++) {
         long long id = order[t];
         long long rank;
@@ -358,22 +450,29 @@ static PyObject *py_filter_json(PyObject *self, PyObject *args) {
         rank = id - start;
         if (rank < 0) rank += n_true;
         if (rank >= proc) continue;
-        if (!first && (buf_putc(&b, ',') < 0 || buf_putc(&be, ',') < 0)) goto fail;
+        if (!first && (buf_putc(&b, ',') < 0 || (pair && buf_putc(&be, ',') < 0))) goto fail;
         first = 0;
         if (over_idx && over_idx[id] >= 0) {
             int u = over_idx[id];
             if (put_str(&b, PyList_GET_ITEM(key_frags, (Py_ssize_t)id)) < 0 ||
-                put_str(&b, PyList_GET_ITEM(ftable, u)) < 0 ||
-                put_str(&be, PyList_GET_ITEM(key_escs, (Py_ssize_t)id)) < 0 ||
-                put_str(&be, PyList_GET_ITEM(etable, u)) < 0)
+                put_str(&b, PyList_GET_ITEM(ftable, u)) < 0)
+                goto fail;
+            if (pair &&
+                (put_str(&be, PyList_GET_ITEM(key_escs, (Py_ssize_t)id)) < 0 ||
+                 put_str(&be, PyList_GET_ITEM(etable, u)) < 0))
                 goto fail;
         } else {
-            if (put_str(&b, PyList_GET_ITEM(pass_arr, (Py_ssize_t)id)) < 0 ||
-                put_str(&be, PyList_GET_ITEM(pass_esc, (Py_ssize_t)id)) < 0)
+            if (put_str(&b, PyList_GET_ITEM(pass_arr, (Py_ssize_t)id)) < 0)
+                goto fail;
+            if (pair && put_str(&be, PyList_GET_ITEM(pass_esc, (Py_ssize_t)id)) < 0)
                 goto fail;
         }
     }
-    if (buf_putc(&b, '}') < 0 || buf_putc(&be, '}') < 0) goto fail;
+    if (buf_putc(&b, '}') < 0 || (pair && buf_putc(&be, '}') < 0)) goto fail;
+    if (!pair) {
+        out = buf_take(&b);
+        goto done;
+    }
     r1 = buf_take(&b);
     r2 = buf_take(&be);
     if (r1 && r2) out = PyTuple_Pack(2, r1, r2);
@@ -381,8 +480,8 @@ static PyObject *py_filter_json(PyObject *self, PyObject *args) {
     Py_XDECREF(r2);
     goto done;
 fail:
-    PyMem_Free(b.p);
-    PyMem_Free(be.p);
+    buf_release(&b);
+    buf_release(&be);
 done:
     PyMem_Free(over_idx);
     if (have_bufs && order_v.obj) PyBuffer_Release(&order_v);
@@ -449,7 +548,7 @@ static PyObject *py_score_json(PyObject *self, PyObject *args) {
     if (buf_putc(&b, '}') < 0) goto fail;
     return buf_take(&b);
 fail:
-    PyMem_Free(b.p);
+    buf_release(&b);
     return NULL;
 }
 
@@ -520,7 +619,7 @@ static PyObject *py_history_append(PyObject *self, PyObject *args) {
     if (buf_put(&b, "}]", 2) < 0) goto fail;
     return buf_take(&b);
 fail:
-    PyMem_Free(b.p);
+    buf_release(&b);
     return NULL;
 }
 
@@ -556,7 +655,7 @@ static PyObject *py_score_json_pair(PyObject *self, PyObject *args) {
     }
     if (buf_init(&b, 2 + T * (24 + K * 24)) < 0) return NULL;
     if (buf_init(&be, 2 + T * (24 + K * 24)) < 0) {
-        PyMem_Free(b.p);
+        buf_release(&b);
         return NULL;
     }
     if (buf_putc(&b, '{') < 0 || buf_putc(&be, '{') < 0) goto fail;
@@ -598,8 +697,8 @@ static PyObject *py_score_json_pair(PyObject *self, PyObject *args) {
     Py_XDECREF(r2);
     return out;
 fail:
-    PyMem_Free(b.p);
-    PyMem_Free(be.p);
+    buf_release(&b);
+    buf_release(&be);
     return NULL;
 }
 

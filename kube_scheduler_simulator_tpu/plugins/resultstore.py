@@ -25,6 +25,17 @@ from kube_scheduler_simulator_tpu.utils.gojson import RawJSON, go_marshal
 _MARSHAL_MEMO: dict = {}
 
 
+def _pre_or_marshal(v: Any) -> str:
+    """Filter/score/finalScore values: ``add_batch_results`` stores the
+    pre-marshaled annotation document as a plain ``str`` or a
+    ``(plain, history_escaped)`` pair (megabyte-scale; a marker-subclass
+    wrapper would copy it), the sequential wrapped-plugin path stores
+    dicts that marshal here."""
+    if isinstance(v, tuple):
+        return v[0]
+    return v if isinstance(v, str) else go_marshal(v)
+
+
 def _memo_marshal(d: Any) -> str:
     if isinstance(d, RawJSON):
         return d
@@ -171,7 +182,11 @@ class ResultStore:
 
     def add_batch_results(self, namespace: str, pod_name: str, **categories: dict) -> None:
         """Bulk-merge whole category maps (used by the TPU batch engine to
-        avoid per-(node,plugin) lock round-trips)."""
+        avoid per-(node,plugin) lock round-trips).  A value may be a
+        pre-marshaled ``str`` or a ``(plain, history_escaped)`` pair —
+        the escaped twin rides along so the result-history writer embeds
+        it by memcpy instead of re-escaping megabytes of quote-dense
+        JSON (see ``get_stored_escs``)."""
         with self._mu:
             e = self._entry(namespace, pod_name)
             for cat, data in categories.items():
@@ -195,11 +210,11 @@ class ResultStore:
             out = {
                 anno.PREFILTER_RESULT: _memo_marshal(e["preFilterResult"]),
                 anno.PREFILTER_STATUS_RESULT: _memo_marshal(e["preFilterStatus"]),
-                anno.FILTER_RESULT: go_marshal(e["filter"]),
+                anno.FILTER_RESULT: _pre_or_marshal(e["filter"]),
                 anno.POSTFILTER_RESULT: _memo_marshal(e["postFilter"]),
                 anno.PRESCORE_RESULT: _memo_marshal(e["preScore"]),
-                anno.SCORE_RESULT: go_marshal(e["score"]),
-                anno.FINALSCORE_RESULT: go_marshal(e["finalScore"]),
+                anno.SCORE_RESULT: _pre_or_marshal(e["score"]),
+                anno.FINALSCORE_RESULT: _pre_or_marshal(e["finalScore"]),
                 anno.RESERVE_RESULT: _memo_marshal(e["reserve"]),
                 anno.PERMIT_TIMEOUT_RESULT: _memo_marshal(e["permitTimeout"]),
                 anno.PERMIT_STATUS_RESULT: _memo_marshal(e["permit"]),
@@ -209,6 +224,26 @@ class ResultStore:
             for key, val in e["custom"].items():
                 out.setdefault(key, val)
             out[anno.SELECTED_NODE] = e["selectedNode"]
+            return out
+
+    def get_stored_escs(self, pod: Obj) -> dict[str, str]:
+        """History-escaped twins for the (pair-form) batch categories of
+        this pod, keyed like ``get_stored_result`` — collected by the
+        reflector right before the history write."""
+        with self._mu:
+            k = self._key(pod["metadata"].get("namespace", "default"), pod["metadata"]["name"])
+            e = self._results.get(k)
+            if e is None:
+                return {}
+            out = {}
+            for cat, key in (
+                ("filter", anno.FILTER_RESULT),
+                ("score", anno.SCORE_RESULT),
+                ("finalScore", anno.FINALSCORE_RESULT),
+            ):
+                v = e[cat]
+                if isinstance(v, tuple) and v[1] is not None:
+                    out[key] = v[1]
             return out
 
     def has_result(self, pod: Obj) -> bool:

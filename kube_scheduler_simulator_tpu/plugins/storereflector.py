@@ -113,6 +113,7 @@ class StoreReflector:
             return
 
         merged: dict[str, str] = {}
+        escs: dict[str, str] = {}
         had_any = False
         for store in self._stores.values():
             if not store.has_result(pod):
@@ -121,6 +122,9 @@ class StoreReflector:
             if result:
                 had_any = True
                 merged.update(result)
+                getter = getattr(store, "get_stored_escs", None)
+                if getter is not None:
+                    escs.update(getter(pod))
         if not had_any:
             return
         for store in self._stores.values():
@@ -141,7 +145,7 @@ class StoreReflector:
                 and rec[0] == len(existing)
                 and existing[-64:] == rec[1]
             )
-            new_history = _updated_history(existing, merged, trusted=trusted)
+            new_history = _updated_history(existing, merged, trusted=trusted, escs=escs)
             annotations[anno.RESULT_HISTORY] = new_history
             fresh["metadata"]["annotations"] = annotations
             cluster_store.update("pods", fresh, owned=True)
@@ -160,10 +164,13 @@ class StoreReflector:
 _KEY_FRAGS: dict[str, str] = {}
 
 
-def _entry_parts(new_results: dict[str, str]):
+def _entry_parts(new_results: dict[str, str], escs: "dict[str, str] | None" = None):
     """(key fragments, values, escaped twins) for a history entry, in
     go_marshal key order — the ONE place that decides which keys enter
-    the entry and how escaped twins are surfaced."""
+    the entry.  ``escs`` maps annotation keys to pre-escaped bodies (the
+    batch engine emits them alongside the plain values; escaping the
+    quote-dense megabyte documents at this point would cost more than
+    the whole splice)."""
     keys = sorted(k for k in new_results if k != anno.RESULT_HISTORY)
     frags = []
     for k in keys:
@@ -172,44 +179,37 @@ def _entry_parts(new_results: dict[str, str]):
             frag = _KEY_FRAGS[k] = go_string_key(k)
         frags.append(frag)
     vals = [new_results[k] for k in keys]
-    escs = [getattr(v, "escaped", None) for v in vals]
-    return frags, vals, escs
+    esc_list = [escs.get(k) if escs else None for k in keys]
+    return frags, vals, esc_list
 
 
-def _release_escaped(vals: list) -> None:
-    """The escaped twins served their one purpose (the history entry) —
-    release the bytes; the value objects live on in pod annotations."""
-    from kube_scheduler_simulator_tpu.utils.gojson import EscapedJSON
-
-    for v in vals:
-        if isinstance(v, EscapedJSON):
-            v.escaped = None
-
-
-def _entry_json(new_results: dict[str, str]) -> str:
+def _entry_json(new_results: dict[str, str], escs: "dict[str, str] | None" = None) -> str:
     """go_marshal of the history entry, assembled from fragments: the
     entry is a flat map whose VALUES are the (often megabyte) annotation
     bodies just built — the native single-pass escape (or ``go_string``'s
     replace chain) avoids re-scanning everything through json.dumps, and
-    values that carry their pre-escaped twin (EscapedJSON, from the batch
-    engine's C assembly) are embedded without any scan at all."""
-    frags, vals, escs = _entry_parts(new_results)
+    pre-escaped twins (``escs``) embed without any scan at all."""
+    frags, vals, esc_list = _entry_parts(new_results, escs)
     entry = None
     if _fastjson is not None:
         try:
-            entry = _fastjson.history_entry(frags, vals, escs)
+            entry = _fastjson.history_entry(frags, vals, esc_list)
         except UnicodeEncodeError:  # lone surrogates: take the Python path
             entry = None
     if entry is None:
         entry = "{" + ",".join(
             frag + ('"' + e + '"' if e is not None else go_string(v))
-            for frag, v, e in zip(frags, vals, escs)
+            for frag, v, e in zip(frags, vals, esc_list)
         ) + "}"
-    _release_escaped(vals)
     return entry
 
 
-def _updated_history(existing: "str | None", new_results: dict[str, str], trusted: bool = False) -> str:
+def _updated_history(
+    existing: "str | None",
+    new_results: dict[str, str],
+    trusted: bool = False,
+    escs: "dict[str, str] | None" = None,
+) -> str:
     """updateResultHistory analog (storereflector.go:148-167): history is a
     JSON array of annotation maps, one per scheduling attempt.
 
@@ -228,16 +228,16 @@ def _updated_history(existing: "str | None", new_results: dict[str, str], truste
         or (trusted and (existing == "[]" or (existing.startswith("[{") and existing.endswith("}]"))))
     ):
         # one C buffer builds splice + entry together (no intermediate
-        # entry string, no Python concat of the megabyte history)
-        frags, vals, escs = _entry_parts(new_results)
+        # entry string, no Python concat of the megabyte history); the
+        # megabyte values embed from their pre-escaped twins by memcpy
+        frags, vals, esc_list = _entry_parts(new_results, escs)
         try:
-            out = _fastjson.history_append(existing or None, frags, vals, escs)
+            out = _fastjson.history_append(existing or None, frags, vals, esc_list)
         except UnicodeEncodeError:
             out = None
         if out is not None:
-            _release_escaped(vals)
             return out
-    entry_json = _entry_json(new_results)
+    entry_json = _entry_json(new_results, escs)
     if existing:
         if trusted:
             if existing == "[]":

@@ -429,9 +429,13 @@ class BatchResult:
             if native.fastjson is not None:
                 # escaped twins of every per-round fragment: the C
                 # assembly emits (annotation, history-escaped) pairs in
-                # one pass from these.  Lone surrogates (UTF-8-unencodable
-                # node names from permissive JSON input) skip the native
-                # path for the round.
+                # one pass from these.  The twin is NOT optional at this
+                # scale — annotation JSON is quote-dense, so escaping it
+                # at history-write time runs ~5-10x slower than emitting
+                # the pre-escaped bytes alongside the plain ones while
+                # the fragments are cache-hot.  Lone surrogates
+                # (UTF-8-unencodable node names from permissive JSON
+                # input) skip the native path for the round.
                 try:
                     eb = native.fastjson.escape_body
                     key_esc = [eb(k) for k in key]
@@ -452,24 +456,34 @@ class BatchResult:
 
         With the native extension, one C pass walks the name-ordered node
         ids, window-tests each against the pod's visit rotation, and
-        emits the annotation AND its history-escaped twin (EscapedJSON)
-        from the per-round fragment arrays; Python-level work only
-        happens at the (rare) failing nodes.  The fallback below is the
-        byte-identical vectorized-numpy path."""
+        emits the annotation from the per-round fragment arrays;
+        Python-level work only happens at the (rare) failing nodes.  The
+        fallback below is the byte-identical vectorized-numpy path."""
+        return self.filter_annotation_pair(i, want_esc=False)[0]
+
+    def filter_annotation_pair(self, i: int, want_esc: bool = True) -> "tuple[str, str | None]":
+        """(annotation, history-escaped twin or None) — the pair is what
+        the batch commit hands the result store; the twin rides along so
+        the history write embeds it by memcpy instead of re-escaping a
+        quote-dense megabyte document.  ``want_esc=False`` (standalone
+        annotation readers) uses the C plain-only mode and skips the twin
+        bytes entirely."""
         from kube_scheduler_simulator_tpu import native
 
         tr = self._tr()
         fr = self._fr()
         fj = native.fastjson
-        if fj is not None and "pass_list" in fr and self._prefilter_node_set(i) is None:
+        if fj is not None and "pass_esc" in fr and self._prefilter_node_set(i) is None:
             try:
-                return self._filter_annotation_json_native(i, tr, fr, fj)
+                return self._filter_annotation_native(i, tr, fr, fj, want_esc)
             except UnicodeEncodeError:
                 pass  # lone surrogates in a message: Python path below
-        return self._filter_annotation_json_py(i, tr, fr)
+        return self._filter_annotation_json_py(i, tr, fr), None
 
-    def _filter_annotation_json_native(self, i: int, tr: dict, fr: dict, fj) -> "str":
-        from kube_scheduler_simulator_tpu.utils.gojson import EscapedJSON, go_marshal
+    def _filter_annotation_native(
+        self, i: int, tr: dict, fr: dict, fj, want_esc: bool
+    ) -> "tuple[str, str | None]":
+        from kube_scheduler_simulator_tpu.utils.gojson import go_marshal
 
         start = int(self.out["sample_start"][i])
         proc = int(self.out["sample_processed"][i])
@@ -515,7 +529,16 @@ class BatchResult:
                 etable.append(pair[1])
             fail_ids = idsc
             fail_uidx = inv.astype(np.int64)
-        s, esc = fj.filter_json(
+        if not want_esc:
+            # plain-only C mode: no twin bytes materialized at all
+            s = fj.filter_json(
+                fr["pass_list"], None, fr["key"], None, fr["order_i64"],
+                start, proc, n_true, fail_ids, fail_uidx, ftable, None,
+            )
+            return s, None
+        # pair mode: (plain, escaped) as two true str objects from one C
+        # pass — no wrapper copy on either
+        return fj.filter_json(
             fr["pass_list"],
             fr["pass_esc"],
             fr["key"],
@@ -529,10 +552,9 @@ class BatchResult:
             ftable,
             etable,
         )
-        return EscapedJSON(s, esc)
 
     def _filter_annotation_json_py(self, i: int, tr: dict, fr: dict) -> "str":
-        from kube_scheduler_simulator_tpu.utils.gojson import RawJSON, go_marshal
+        from kube_scheduler_simulator_tpu.utils.gojson import go_marshal
 
         ids = self._visited_ids(i)
         narrowed = self._prefilter_node_set(i)
@@ -547,7 +569,7 @@ class BatchResult:
         sel = order[mask[order]]  # visited ids in go_marshal key order
         fp = tr["fail_plug"]
         if fp is None or not tr["fail_any_row"][i]:
-            return RawJSON("{" + ",".join(fr["pass_arr"][sel]) + "}")
+            return "{" + ",".join(fr["pass_arr"][sel]) + "}"
         # column of each node in the compact planes (ascending-id order)
         col_of = np.empty(n_true, dtype=np.int64)
         col_of[ids] = np.arange(len(ids))
@@ -579,24 +601,31 @@ class BatchResult:
                     frag = go_marshal(entry)
                     entry_memo[ek] = frag
                 parts[t] = key_frag[n] + frag
-        return RawJSON("{" + ",".join(parts) + "}")
+        return "{" + ",".join(parts) + "}"
 
     def score_annotations_json(self, i: int) -> "tuple[str, str]":
-        """(score, finalScore) annotation JSON assembled from fragments.
-        Score values are numeric strings — no escaping needed.  The node
-        ordering comes from one vectorized rank argsort, and the byte
-        assembly runs in C when the native extension is available (the
-        Python loop below is the byte-identical fallback —
-        tests/test_native.py)."""
+        """(score, finalScore) annotation JSON (plain strings)."""
+        (s, _se), (f, _fe) = self.score_annotations_pairs(i)
+        return s, f
+
+    def score_annotations_pairs(
+        self, i: int
+    ) -> "tuple[tuple[str, str | None], tuple[str, str | None]]":
+        """((score, esc), (finalScore, esc)) annotation JSON assembled
+        from fragments; the escaped twins feed the history write (None on
+        the fallback paths).  Score values are numeric strings — no
+        escaping needed.  The node ordering comes from one vectorized
+        rank argsort, and the byte assembly runs in C when the native
+        extension is available (the Python loop below is the
+        byte-identical fallback — tests/test_native.py)."""
         from kube_scheduler_simulator_tpu import native
-        from kube_scheduler_simulator_tpu.utils.gojson import RawJSON
 
         tr = self._tr()
         fr = self._fr()
         sids_row = tr["sids"][i]
         js = np.nonzero(sids_row >= 0)[0]
         if js.size == 0:
-            return RawJSON("{}"), RawJSON("{}")
+            return ("{}", "{}"), ("{}", "{}")
         ns = sids_row[js]
         order = np.argsort(fr["rank_by_name"][ns], kind="stable")
         js = js[order]
@@ -608,18 +637,12 @@ class BatchResult:
         raw_rows = [tr["raw_s"][s][i] for _f, s in splug]
         fin_rows = [tr["final_s"][s][i] for _f, s in splug]
         if native.fastjson is not None and "key_esc_arr" in fr:
-            from kube_scheduler_simulator_tpu.utils.gojson import EscapedJSON
-
             keys_esc = fr["key_esc_arr"][ns].tolist()
             frags_esc = fr["splug_esc"]
             try:
                 return (
-                    EscapedJSON(
-                        *native.fastjson.score_json_pair(keys, keys_esc, frags, frags_esc, raw_rows, perm)
-                    ),
-                    EscapedJSON(
-                        *native.fastjson.score_json_pair(keys, keys_esc, frags, frags_esc, fin_rows, perm)
-                    ),
+                    native.fastjson.score_json_pair(keys, keys_esc, frags, frags_esc, raw_rows, perm),
+                    native.fastjson.score_json_pair(keys, keys_esc, frags, frags_esc, fin_rows, perm),
                 )
             except UnicodeEncodeError:
                 pass  # lone surrogates: Python loop below
@@ -636,8 +659,8 @@ class BatchResult:
                 kf + "{" + ",".join([frag + row[j] + '"' for frag, row in zip(frags, fin_rows)]) + "}"
             )
         return (
-            RawJSON("{" + ",".join(s_parts) + "}"),
-            RawJSON("{" + ",".join(f_parts) + "}"),
+            ("{" + ",".join(s_parts) + "}", None),
+            ("{" + ",".join(f_parts) + "}", None),
         )
 
     def totals_map(self, i: int) -> dict[int, int]:

@@ -738,14 +738,15 @@ class SchedulerService:
 
                     narrowed = PreFilterResult(names)
             rs.add_pre_filter_result(ns, name, pn, SUCCESS_MESSAGE, narrowed)
-        # pre-marshaled fragments (RawJSON) — byte-identical to marshaling
-        # the dict forms, without the json.dumps cost per pod
-        rs.add_batch_results(ns, name, filter=result.filter_annotation_json(i))
+        # pre-marshaled (plain, history-escaped) pairs — byte-identical to
+        # marshaling the dict forms, without the json.dumps cost per pod;
+        # the escaped twin rides to the history write untouched
+        rs.add_batch_results(ns, name, filter=result.filter_annotation_pair(i))
         if feasible_count > 1:
             for pn in point_names["pre_score"]:
                 rs.add_pre_score_result(ns, name, pn, SUCCESS_MESSAGE)
-            score, final = result.score_annotations_json(i)
-            rs.add_batch_results(ns, name, score=score, finalScore=final)
+            score_pair, final_pair = result.score_annotations_pairs(i)
+            rs.add_batch_results(ns, name, score=score_pair, finalScore=final_pair)
 
         if sel >= 0:
             node_name = result.node_names[sel]
@@ -763,6 +764,12 @@ class SchedulerService:
             self.cluster_store.bind_pod(ns, name, node_name)
             if snapshot is not None:
                 snapshot.assume(pod, node_name)
+            # flush THIS pod's results now, while its megabyte annotation
+            # strings are still cache-hot — the round-end flush_all would
+            # re-read them cold, which at churn scale costs more than the
+            # whole history splice (the sequential path flushes per
+            # attempt already)
+            self.reflector.flush_pod(self.cluster_store, pod)
             return ScheduleResult(selected_node=node_name)
         diagnosis = result.diagnosis(i)
         from kube_scheduler_simulator_tpu.models.framework import Status
@@ -772,6 +779,7 @@ class SchedulerService:
             status=Status.unschedulable(f"0/{result.problem.N_true} nodes are available"),
         )
         self._record_failure(pod, res, attempt_move_seq)
+        self.reflector.flush_pod(self.cluster_store, pod)
         return res
 
     def schedule_one(self, pod: Obj, snapshot: "Snapshot | None" = None) -> ScheduleResult:

@@ -40,21 +40,6 @@ class RawJSON(str):
     __slots__ = ()
 
 
-class EscapedJSON(RawJSON):
-    """RawJSON that also carries its own JSON-string-escaped body
-    (``escaped``, no surrounding quotes) — the batch engine's C assembly
-    emits both twins in one pass, and the result-history writer embeds
-    the escaped body instead of re-scanning megabytes per attempt.  The
-    reflector clears ``escaped`` once the history entry is written."""
-
-    __slots__ = ("escaped",)
-
-    def __new__(cls, s: str, escaped: "str | None" = None):
-        o = str.__new__(cls, s)
-        o.escaped = escaped
-        return o
-
-
 def go_marshal(obj: Any) -> str:
     """Serialize ``obj`` the way Go's ``json.Marshal`` would."""
     if isinstance(obj, RawJSON):

@@ -131,6 +131,16 @@ def test_filter_json_twins():
     )
     assert s2 == "{" + ",".join(pass_arr) + "}"
     assert '"' + esc2 + '"' == py_go_string(s2)
+    # plain-only mode (esc args None): same plain bytes, single-str return
+    s3 = native.fastjson.filter_json(
+        pass_arr, None, keys, None, order, 4, 3, 6,
+        np.array([5], dtype=np.int64), np.array([0], dtype=np.int64), ftable, None,
+    )
+    assert s3 == s and isinstance(s3, str)
+    s4 = native.fastjson.filter_json(
+        pass_arr, None, keys, None, order, 0, 6, 6, None, None, [], None
+    )
+    assert s4 == s2
 
 
 def test_score_json_pair_twins():
