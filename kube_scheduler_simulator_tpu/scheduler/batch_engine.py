@@ -771,7 +771,7 @@ class BatchEngine:
     # ------------------------------------------------------------ factory
 
     @classmethod
-    def from_framework(cls, framework: Any, trace: bool = False, dtype=None) -> "BatchEngine":
+    def from_framework(cls, framework: Any, trace: bool = False, dtype=None, mesh=None) -> "BatchEngine":
         """Build from a scheduler Framework (same plugin set/weights/args
         the sequential path uses — guarantees config consistency)."""
         filters = [wp.original.name for wp in framework.plugins["filter"]]
@@ -834,6 +834,7 @@ class BatchEngine:
             dtype=dtype,
             tie_break=framework.tie_break,
             seed=framework.seed,
+            mesh=mesh,
         )
         eng._unsupported_config = unsupported
         eng._framework = framework

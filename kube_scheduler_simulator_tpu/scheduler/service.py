@@ -48,6 +48,7 @@ class SchedulerService:
         batch_min_work: int = 2048,
         batch_max_restarts: int = 8,
         clock: "Callable[[], float] | None" = None,
+        mesh: Any = None,
     ):
         """``use_batch``: "off" = sequential cycle only; "auto" = run whole
         pending rounds through the TPU batch engine when the profile ×
@@ -63,6 +64,9 @@ class SchedulerService:
         self.seed = seed
         self.tie_break = tie_break
         self.use_batch = use_batch
+        # jax.sharding.Mesh for multi-chip rounds: every profile engine
+        # shards its node axis over it (SURVEY §2.5 scaling axis)
+        self.mesh = mesh
         self.batch_min_work = batch_min_work
         # Successful preemptions free resources mid-round, forcing a kernel
         # re-run on the remaining tail; past this many re-runs the round
@@ -526,7 +530,7 @@ class SchedulerService:
 
         eng = self._batch_engines.get(fw.profile_name)
         if eng is None:
-            eng = BatchEngine.from_framework(fw, trace=True)
+            eng = BatchEngine.from_framework(fw, trace=True, mesh=self.mesh)
             self._batch_engines[fw.profile_name] = eng
             if fw is self.framework:
                 self._batch_engine = eng  # metrics/back-compat handle

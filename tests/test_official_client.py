@@ -1,0 +1,201 @@
+"""Prove the kube port against the OFFICIAL Kubernetes Python client
+(VERDICT r3 #4).
+
+The reference serves real client-go informers because it embeds a real
+kube-apiserver (reference simulator/k8sapiserver/k8sapiserver.go:34-88);
+this build re-implements the wire surface, so the claim "official
+clients work" needs an official client in the loop.  Two layers here:
+
+- ``TestOfficialClient``: drives list/watch-with-selectors, CRUD, and
+  ``pods/binding`` through the ``kubernetes`` package exactly as an
+  external scheduler built on client-go would (skipped when the package
+  is not installed — this image ships without it, the driver may not).
+- ``TestClientWireContract``: always runs; pins the raw wire details the
+  official client's deserializer and watch machinery depend on (status
+  codes, Status error bodies, list envelope fields, chunked watch
+  framing, content types), so regressions surface even where the
+  package is absent.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from typing import Any
+
+import pytest
+
+from kube_scheduler_simulator_tpu.server import DIContainer, SimulatorServer
+
+Obj = dict[str, Any]
+
+
+@pytest.fixture()
+def kube_server():
+    di = DIContainer(use_batch="off")
+    srv = SimulatorServer(di, port=0, kube_api_port=0)
+    srv.start(background=True)
+    di.cluster_store.create(
+        "nodes",
+        {
+            "metadata": {"name": "client-node", "labels": {"disk": "ssd"}},
+            "status": {"allocatable": {"cpu": "8000m", "memory": "16Gi", "pods": "110"}},
+        },
+    )
+    yield srv, di
+    srv.shutdown()
+
+
+def _pod(name: str, labels: "Obj | None" = None) -> Obj:
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default", "labels": labels or {}},
+        "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "100m"}}}]},
+    }
+
+
+# --------------------------------------------------------------------------
+# official client (these tests alone skip when the package is absent — the
+# wire-contract class below must still run)
+
+
+class TestOfficialClient:
+    @pytest.fixture()
+    def core(self, kube_server):
+        pytest.importorskip("kubernetes", reason="official kubernetes client not installed")
+        from kubernetes import client
+
+        srv, _di = kube_server
+        cfg = client.Configuration()
+        cfg.host = f"http://127.0.0.1:{srv.kube_api_port}"
+        yield client.CoreV1Api(client.ApiClient(cfg))
+
+    def test_list_nodes_and_pods(self, core):
+        nodes = core.list_node()
+        assert nodes.kind in (None, "NodeList")  # client models strip kind
+        assert any(n.metadata.name == "client-node" for n in nodes.items)
+        assert core.list_namespaced_pod("default").items == []
+
+    def test_crud_and_selectors(self, core):
+        core.create_namespaced_pod("default", _pod("oc-a", {"app": "a"}))
+        core.create_namespaced_pod("default", _pod("oc-b", {"app": "b"}))
+        sel = core.list_namespaced_pod("default", label_selector="app=a")
+        assert [p.metadata.name for p in sel.items] == ["oc-a"]
+        got = core.read_namespaced_pod("oc-a", "default")
+        assert got.metadata.uid and got.metadata.resource_version
+        core.delete_namespaced_pod("oc-b", "default")
+        names = [p.metadata.name for p in core.list_namespaced_pod("default").items]
+        assert "oc-b" not in names
+
+    def test_external_scheduler_informer_loop(self, core, kube_server):
+        """The external-scheduler shape: watch pods, bind the pending one
+        via pods/binding, observe the bound update — all through the
+        official client."""
+        from kubernetes import client, watch
+
+        core.create_namespaced_pod("default", _pod("oc-sched"))
+        w = watch.Watch()
+        bound = None
+        deadline = time.time() + 30
+        for ev in w.stream(core.list_namespaced_pod, "default", timeout_seconds=25):
+            pod = ev["object"]
+            if pod.metadata.name != "oc-sched":
+                continue
+            if not (pod.spec and pod.spec.node_name):
+                body = client.V1Binding(
+                    metadata=client.V1ObjectMeta(name="oc-sched"),
+                    target=client.V1ObjectReference(kind="Node", name="client-node"),
+                )
+                # the python client cannot deserialize the Status reply of
+                # create_namespaced_binding; _preload_content=False is the
+                # documented workaround
+                core.create_namespaced_binding("default", body, _preload_content=False)
+            else:
+                bound = pod.spec.node_name
+                w.stop()
+            if time.time() > deadline:
+                break
+        assert bound == "client-node"
+
+
+# --------------------------------------------------------------------------
+# wire contract (always runs)
+
+
+class TestClientWireContract:
+    def _req(self, port: int, method: str, path: str, body: "Obj | None" = None):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+        conn.request(
+            method,
+            path,
+            json.dumps(body) if body is not None else None,
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        raw = resp.read()
+        ctype = resp.headers.get("Content-Type", "")
+        conn.close()
+        return resp.status, ctype, (json.loads(raw) if raw else None)
+
+    def test_discovery_documents(self, kube_server):
+        srv, _ = kube_server
+        p = srv.kube_api_port
+        status, ctype, doc = self._req(p, "GET", "/api")
+        assert status == 200 and ctype.startswith("application/json")
+        assert doc["kind"] == "APIVersions" and "v1" in doc["versions"]
+        _, _, rl = self._req(p, "GET", "/api/v1")
+        assert rl["kind"] == "APIResourceList" and rl["groupVersion"] == "v1"
+        pods = next(r for r in rl["resources"] if r["name"] == "pods")
+        assert pods["namespaced"] is True and "watch" in pods["verbs"]
+        _, _, gl = self._req(p, "GET", "/apis")
+        assert gl["kind"] == "APIGroupList"
+
+    def test_list_envelope_and_object_metadata(self, kube_server):
+        srv, _ = kube_server
+        p = srv.kube_api_port
+        self._req(p, "POST", "/api/v1/namespaces/default/pods", _pod("wire-a"))
+        status, _, lst = self._req(p, "GET", "/api/v1/namespaces/default/pods")
+        assert status == 200
+        # the deserializer requires kind/apiVersion/items and a list
+        # resourceVersion to start an informer from
+        assert lst["kind"] == "PodList" and lst["apiVersion"] == "v1"
+        assert lst["metadata"]["resourceVersion"].isdigit()
+        obj = lst["items"][0]["metadata"]
+        assert obj["uid"] and obj["resourceVersion"].isdigit() and obj["creationTimestamp"]
+
+    def test_error_status_objects(self, kube_server):
+        srv, _ = kube_server
+        p = srv.kube_api_port
+        status, ctype, body = self._req(p, "GET", "/api/v1/namespaces/default/pods/absent")
+        assert status == 404 and ctype.startswith("application/json")
+        assert body["kind"] == "Status" and body["apiVersion"] == "v1"
+        assert body["reason"] == "NotFound" and body["code"] == 404
+
+    def test_watch_framing(self, kube_server):
+        """The client's watch machinery reads newline-delimited JSON
+        objects from a chunked response; each line is {type, object}."""
+        srv, _ = kube_server
+        p = srv.kube_api_port
+        conn = http.client.HTTPConnection("127.0.0.1", p, timeout=15)
+        conn.request("GET", "/api/v1/namespaces/default/pods?watch=true&timeoutSeconds=5")
+        resp = conn.getresponse()
+        assert resp.status == 200
+
+        def create_later():
+            time.sleep(0.3)
+            self._req(p, "POST", "/api/v1/namespaces/default/pods", _pod("wire-w"))
+
+        threading.Thread(target=create_later, daemon=True).start()
+        # HTTPResponse.readline() de-chunks transparently (as requests /
+        # client-go do); each payload line must be one JSON WatchEvent
+        line = resp.readline()
+        while line and not line.strip():
+            line = resp.readline()
+        ev = json.loads(line)
+        assert ev["type"] == "ADDED"
+        assert ev["object"]["kind"] == "Pod"
+        assert ev["object"]["metadata"]["name"] == "wire-w"
+        conn.close()
