@@ -60,16 +60,53 @@ _REGEX_PRECEDING = {
 
 
 class Tok:
-    __slots__ = ("kind", "value", "line", "parts")
+    __slots__ = ("kind", "value", "line", "parts", "texts")
 
-    def __init__(self, kind: str, value, line: int, parts=None):
+    def __init__(self, kind: str, value, line: int, parts=None, texts=None):
         self.kind = kind  # id kw num str regex punct template eof
         self.value = value
         self.line = line
         self.parts = parts  # template: list of sub-token streams
+        self.texts = texts  # template: literal text between interpolations
 
     def __repr__(self):  # pragma: no cover - debugging aid
         return f"Tok({self.kind},{self.value!r},l{self.line})"
+
+
+_SIMPLE_ESCAPES = {"n": "\n", "t": "\t", "r": "\r", "b": "\b", "f": "\f", "v": "\v", "0": "\0"}
+
+
+def decode_escape(src: str, j: int) -> "tuple[str, int]":
+    """Decode the escape sequence starting at the backslash ``src[j]``;
+    returns (character, index past the sequence)."""
+    e = src[j + 1] if j + 1 < len(src) else ""
+    if e in _SIMPLE_ESCAPES:
+        return _SIMPLE_ESCAPES[e], j + 2
+    if e == "x" and j + 3 < len(src):
+        try:
+            return chr(int(src[j + 2 : j + 4], 16)), j + 4
+        except ValueError:
+            pass
+    if e == "u" and j + 5 < len(src):
+        try:
+            return chr(int(src[j + 2 : j + 6], 16)), j + 6
+        except ValueError:
+            pass
+    return e, j + 2  # \" \' \` \\ \$ and any other char: the char itself
+
+
+def decode_template_text(raw: str) -> str:
+    """Process escape sequences in a template literal text segment."""
+    out = []
+    i = 0
+    while i < len(raw):
+        if raw[i] == "\\":
+            ch, i = decode_escape(raw, i)
+            out.append(ch)
+        else:
+            out.append(raw[i])
+            i += 1
+    return "".join(out)
 
 
 def _is_id_start(c: str) -> bool:
@@ -114,7 +151,8 @@ def tokenize(src: str, line0: int = 1) -> list[Tok]:
                 if src[j] == "\n":
                     raise JSError(f"line {line}: unterminated string")
                 if src[j] == "\\":
-                    j += 2
+                    ch, j = decode_escape(src, j)
+                    buf.append(ch)
                     continue
                 buf.append(src[j])
                 j += 1
@@ -194,10 +232,13 @@ def _scan_regex(src: str, i: int, line: int, toks: list[Tok]):
 
 def _scan_template(src: str, i: int, line: int, toks: list[Tok]):
     """Scan a template literal; interpolations are tokenized recursively and
-    stored as sub-streams on the token."""
+    stored as sub-streams on the token, with the literal text segments
+    between them kept for evaluation."""
     j = i + 1
     n = len(src)
     parts: list[list[Tok]] = []
+    texts: list[str] = []
+    seg_start = j
     start_line = line
     while j < n:
         ch = src[j]
@@ -209,9 +250,11 @@ def _scan_template(src: str, i: int, line: int, toks: list[Tok]):
             j += 1
             continue
         if ch == "`":
-            toks.append(Tok("template", src[i : j + 1], start_line, parts))
+            texts.append(src[seg_start:j])
+            toks.append(Tok("template", src[i : j + 1], start_line, parts, texts))
             return j + 1, line
         if src.startswith("${", j):
+            texts.append(src[seg_start:j])
             # find the matching close brace (brace/str/template aware)
             depth = 1
             k = j + 2
@@ -260,6 +303,7 @@ def _scan_template(src: str, i: int, line: int, toks: list[Tok]):
             parts.append(tokenize(src[j + 2 : k], line))
             line = k_line
             j = k + 1
+            seg_start = j
             continue
         j += 1
     raise JSError(f"line {start_line}: unterminated template literal")
@@ -389,29 +433,34 @@ class _P:
         kind = self.next().value
         decls = []
         while True:
-            name = self.binding_name()
+            pattern = self.binding_pattern()
             init = None
             if self.eat("punct", "="):
                 init = self.assignment()
-            decls.append((name, init))
+            decls.append((pattern, init))
             if not self.eat("punct", ","):
                 break
         return ("vardecl", kind, decls)
 
-    def binding_name(self):
-        # destructuring: const [a, b] = ..., const {a, b} = ...
+    def binding_pattern(self):
+        """Structure-preserving binding pattern: ("pid", name, line) |
+        ("parr", [patterns]) | ("pobj", [(key, pattern, default)])."""
         if self.at("punct", "["):
             self.next()
-            names = []
+            pats = []
             while not self.at("punct", "]"):
-                if self.eat("punct", ","):
+                if self.at("punct", ","):  # elision hole: [, fn] keeps position
+                    self.next()
+                    pats.append(None)
                     continue
-                names.extend(self.binding_name())
+                pats.append(self.binding_pattern())
+                if not self.at("punct", "]"):
+                    self.expect("punct", ",")
             self.next()
-            return names
+            return ("parr", pats)
         if self.at("punct", "{"):
             self.next()
-            names = []
+            props = []
             while not self.at("punct", "}"):
                 if self.eat("punct", ","):
                     continue
@@ -419,15 +468,17 @@ class _P:
                 if key.kind not in ("id", "kw", "str", "num"):
                     raise JSError(f"line {key.line}: bad destructuring key {key.value!r}")
                 if self.eat("punct", ":"):
-                    names.extend(self.binding_name())
+                    pat = self.binding_pattern()
                 else:
-                    names.append((key.value, key.line))
-                    if self.eat("punct", "="):
-                        self.assignment()  # default value: parsed, names only
+                    if key.kind not in ("id", "kw"):
+                        raise JSError(f"line {key.line}: shorthand key must be an identifier")
+                    pat = ("pid", key.value, key.line)
+                default = self.assignment() if self.eat("punct", "=") else None
+                props.append((key.value, pat, default))
             self.next()
-            return names
+            return ("pobj", props)
         t = self.expect("id")
-        return [(t.value, t.line)]
+        return ("pid", t.value, t.line)
 
     def function_decl(self, is_async: bool = False):
         self.expect("kw", "function")
@@ -442,11 +493,13 @@ class _P:
         while not self.at("punct", ")"):
             if self.eat("punct", ","):
                 continue
-            if self.eat("punct", "..."):
-                pass
-            params.extend(self.binding_name())
-            if self.eat("punct", "="):
-                params_default = self.assignment()  # noqa: F841 - parsed for syntax
+            if self.at("punct", "..."):
+                # a silently-dropped rest param would miscompile in the
+                # evaluator (first-arg instead of array) — refuse loudly
+                raise JSError(f"line {self.peek().line}: rest parameters unsupported")
+            pat = self.binding_pattern()
+            default = self.assignment() if self.eat("punct", "=") else None
+            params.append((pat, default))
         self.next()
         return params
 
@@ -465,22 +518,19 @@ class _P:
         self.expect("kw", "for")
         self.expect("punct", "(")
         init = None
-        decl_names = []
         if self.at("kw", "const") or self.at("kw", "let") or self.at("kw", "var"):
             kind = self.next().value
-            names = self.binding_name()
-            decl_names = names
+            pat = self.binding_pattern()
             if self.at("kw", "of") or self.at("kw", "in"):
-                self.next()
+                mode = self.next().value
                 it = self.expression()
                 self.expect("punct", ")")
-                return ("forof", names, it, self.statement())
-            init_parts = [(names, self.assignment() if self.eat("punct", "=") else None)]
+                return ("forof", pat, it, self.statement(), mode)
+            init_parts = [(pat, self.assignment() if self.eat("punct", "=") else None)]
             while self.eat("punct", ","):
-                more = self.binding_name()
-                decl_names = decl_names + more
+                more = self.binding_pattern()
                 init_parts.append((more, self.assignment() if self.eat("punct", "=") else None))
-            init = ("vardecl", kind, [(n, e) for n, e in init_parts])
+            init = ("vardecl", kind, init_parts)
         elif not self.at("punct", ";"):
             init = ("expr", self.expression())
             if self.at("kw", "of") or self.at("kw", "in"):
@@ -498,9 +548,9 @@ class _P:
         handler = None
         final = None
         if self.eat("kw", "catch"):
-            param = []
+            param = None
             if self.eat("punct", "("):
-                param = self.binding_name()
+                param = self.binding_pattern()
                 self.expect("punct", ")")
             handler = (param, self.block())
         if self.eat("kw", "finally"):
@@ -563,7 +613,7 @@ class _P:
         if self.at("id") and self.peek(1).kind == "punct" and self.peek(1).value == "=>":
             name = self.next()
             self.next()  # =>
-            return ("arrow", [(name.value, name.line)], self.arrow_body(), is_async)
+            return ("arrow", [(("pid", name.value, name.line), None)], self.arrow_body(), is_async)
         if self.at("punct", "("):
             # scan to the matching paren; arrow iff the next token is =>
             depth = 0
@@ -639,7 +689,7 @@ class _P:
             return ("unary", t.value, self.unary())
         if t.kind == "punct" and t.value in ("++", "--"):
             self.next()
-            return ("update", t.value, self.unary())
+            return ("update", t.value, self.unary(), "pre")
         if t.kind == "kw" and t.value in ("typeof", "delete", "void", "await"):
             self.next()
             return ("unary", t.value, self.unary())
@@ -650,7 +700,7 @@ class _P:
         t = self.peek()
         if t.kind == "punct" and t.value in ("++", "--"):
             self.next()
-            return ("update", t.value, e)
+            return ("update", t.value, e, "post")
         return e
 
     def call_member(self):
@@ -676,8 +726,8 @@ class _P:
                 while not self.at("punct", ")"):
                     if self.eat("punct", ","):
                         continue
-                    if self.eat("punct", "..."):
-                        pass
+                    if self.at("punct", "..."):
+                        raise JSError(f"line {self.peek().line}: spread arguments unsupported")
                     args.append(self.assignment())
                 self.next()
                 e = ("call", e, args)
@@ -695,7 +745,11 @@ class _P:
         if t.kind == "regex":
             return ("regex", t.value)
         if t.kind == "template":
-            return ("template", [_parse_substream(p, t.line) for p in t.parts or []])
+            return (
+                "template",
+                [_parse_substream(p, t.line) for p in t.parts or []],
+                list(t.texts) if t.texts else [""],
+            )
         if t.kind == "id":
             return ("id", t.value, t.line)
         if t.kind == "kw":
@@ -721,8 +775,8 @@ class _P:
                 while not self.at("punct", "]"):
                     if self.eat("punct", ","):
                         continue
-                    if self.eat("punct", "..."):
-                        pass
+                    if self.at("punct", "..."):
+                        raise JSError(f"line {self.peek().line}: array spread unsupported")
                     items.append(self.assignment())
                 self.next()
                 return ("array", items)
@@ -785,6 +839,27 @@ BROWSER_GLOBALS = {
 }
 
 
+def pattern_names(pat) -> "list[tuple[str, int]]":
+    """Flatten a binding pattern to its (name, line) bindings."""
+    if pat is None:
+        return []
+    tag = pat[0]
+    if tag == "pid":
+        return [(pat[1], pat[2])]
+    if tag == "parr":
+        out = []
+        for p in pat[1]:
+            if p is not None:
+                out.extend(pattern_names(p))
+        return out
+    if tag == "pobj":
+        out = []
+        for _key, p, _default in pat[1]:
+            out.extend(pattern_names(p))
+        return out
+    raise AssertionError(f"unknown pattern {tag}")
+
+
 class _Scope:
     def __init__(self, parent=None, is_function=False):
         self.parent = parent
@@ -819,9 +894,30 @@ def _hoist(stmts, scope: _Scope):
         if tag == "funcdecl":
             scope.declare(st[1])
         elif tag == "vardecl":
-            for names, _init in st[2]:
-                for nm, _ln in names:
+            for pat, _init in st[2]:
+                for nm, _ln in pattern_names(pat):
                     (scope.declare_var if st[1] == "var" else scope.declare)(nm)
+
+
+def _declare_params(params, scope: _Scope, errors: list[str]):
+    for pat, default in params:
+        for nm, _ln in pattern_names(pat):
+            scope.declare(nm)
+        if default is not None:
+            _resolve_expr(default, scope, errors)
+
+
+def _resolve_pattern_defaults(pat, scope: _Scope, errors: list[str]):
+    if pat is None:
+        return
+    if pat[0] == "parr":
+        for p in pat[1]:
+            _resolve_pattern_defaults(p, scope, errors)
+    elif pat[0] == "pobj":
+        for _key, p, default in pat[1]:
+            _resolve_pattern_defaults(p, scope, errors)
+            if default is not None:
+                _resolve_expr(default, scope, errors)
 
 
 def _resolve_stmts(stmts, scope: _Scope, errors: list[str]):
@@ -839,14 +935,14 @@ def _resolve_stmt(st, scope: _Scope, errors: list[str]):
     elif tag == "block":
         _resolve_stmts(st[1], _Scope(scope), errors)
     elif tag == "vardecl":
-        for _names, init in st[2]:
+        for pat, init in st[2]:
             if init is not None:
                 _resolve_expr(init, scope, errors)
+            _resolve_pattern_defaults(pat, scope, errors)
         # names were hoisted
     elif tag == "funcdecl":
         fs = _Scope(scope, is_function=True)
-        for nm, _ln in st[3]:
-            fs.declare(nm)
+        _declare_params(st[3], fs, errors)
         body = st[4]
         _resolve_stmts(body[1], fs, errors)
     elif tag == "expr":
@@ -864,7 +960,7 @@ def _resolve_stmt(st, scope: _Scope, errors: list[str]):
         _resolve_expr(st[2], scope, errors)
     elif tag == "forof":
         s = _Scope(scope)
-        for nm, _ln in st[1]:
+        for nm, _ln in pattern_names(st[1]):
             s.declare(nm)
         _resolve_expr(st[2], s, errors)
         _resolve_stmt(st[3], s, errors)
@@ -887,7 +983,7 @@ def _resolve_stmt(st, scope: _Scope, errors: list[str]):
         _resolve_stmt(st[1], scope, errors)
         if st[2] is not None:
             s = _Scope(scope)
-            for nm, _ln in st[2][0]:
+            for nm, _ln in pattern_names(st[2][0]):
                 s.declare(nm)
             _resolve_stmts(st[2][1][1], s, errors)
         if st[3] is not None:
@@ -928,8 +1024,7 @@ def _resolve_expr(e, scope: _Scope, errors: list[str]):
         _resolve_expr(e[3], scope, errors)
     elif tag == "arrow":
         s = _Scope(scope, is_function=True)
-        for nm, _ln in e[1]:
-            s.declare(nm)
+        _declare_params(e[1], s, errors)
         body = e[2]
         if body[0] == "block":
             _resolve_stmts(body[1], s, errors)
@@ -939,8 +1034,7 @@ def _resolve_expr(e, scope: _Scope, errors: list[str]):
         s = _Scope(scope, is_function=True)
         if e[1]:
             s.declare(e[1])
-        for nm, _ln in e[2]:
-            s.declare(nm)
+        _declare_params(e[2], s, errors)
         _resolve_stmts(e[3][1], s, errors)
     elif tag == "cond":
         _resolve_expr(e[1], scope, errors)
@@ -949,8 +1043,10 @@ def _resolve_expr(e, scope: _Scope, errors: list[str]):
     elif tag == "bin":
         _resolve_expr(e[2], scope, errors)
         _resolve_expr(e[3], scope, errors)
-    elif tag in ("unary", "update", "new"):
-        _resolve_expr(e[-1], scope, errors)
+    elif tag in ("unary", "update"):
+        _resolve_expr(e[2], scope, errors)
+    elif tag == "new":
+        _resolve_expr(e[1], scope, errors)
     elif tag == "member":
         _resolve_expr(e[1], scope, errors)
         # property name is not a reference
@@ -978,8 +1074,7 @@ def _resolve_expr(e, scope: _Scope, errors: list[str]):
                 _resolve_expr(p[1], scope, errors)
             elif p[0] == "method":
                 s = _Scope(scope, is_function=True)
-                for nm, _ln in p[2]:
-                    s.declare(nm)
+                _declare_params(p[2], s, errors)
                 _resolve_stmts(p[3][1], s, errors)
     else:  # pragma: no cover - parser emits a closed set
         raise AssertionError(f"unknown expr {tag}")
