@@ -204,6 +204,17 @@ def _make_handler(server: SimulatorServer):
                     from kube_scheduler_simulator_tpu.server.webui import JS
 
                     self._send_bytes("application/javascript; charset=utf-8", JS.encode())
+                elif url.path.startswith("/webui/"):
+                    # individual component assets (the page loads the
+                    # concatenated /webui.js; these serve component-level
+                    # inspection and tests)
+                    from kube_scheduler_simulator_tpu.server.webui import MODULES
+
+                    mod = MODULES.get(url.path[len("/webui/") :])
+                    if mod is None:
+                        self._send_json(404, {"message": "no such UI module"})
+                    else:
+                        self._send_bytes("application/javascript; charset=utf-8", mod.encode())
                 elif url.path == "/api/v1/schedulerconfiguration":
                     self._send_json(200, di.scheduler_service().get_scheduler_config())
                 elif url.path in ("/api/v1/metrics", "/metrics"):

@@ -47,6 +47,15 @@ HTML = r"""<!doctype html>
   .kv td:first-child { white-space:nowrap; color:#555; }
   .muted { color:#777; font-size:12px; }
   h2 { font-size:14px; margin:4px 0 8px; }
+  .yamleditor { display:flex; gap:0; border:1px solid var(--line); border-radius:6px; overflow:hidden; max-height:380px; }
+  .yamleditor .gutter { margin:0; padding:6px 8px; background:#f4f6fa; color:#99a; text-align:right; user-select:none; min-width:34px; overflow:hidden; }
+  .yamleditor .highlight { margin:0; padding:6px 8px; flex:1; overflow:auto; white-space:pre; }
+  .yamleditor textarea { flex:1; border:none; outline:none; resize:vertical; min-height:280px; padding:6px 8px; }
+  .y-k { color:#1a56b0; font-weight:600; } .y-s { color:#188038; } .y-c { color:#999; font-style:italic; } .y-n { color:#b3261e; }
+  .errline { background:#fdecea; color:#b3261e; font-weight:700; border-radius:3px; padding:0 2px; }
+  .errmsg { color:#b3261e; display:inline-block; margin-left:10px; }
+  .util { float:right; font-size:11px; border-radius:9px; padding:1px 8px; color:#fff; }
+  .util.cool { background:#1e8e3e; } .util.warm { background:#f9ab00; } .util.hot { background:#d93025; }
 </style>
 </head>
 <body>
@@ -81,476 +90,39 @@ HTML = r"""<!doctype html>
 </html>
 """
 
-# The UI behavior, served as its own asset at /webui.js (kept out of
-# the inline page so the server tests can assert on it directly).
-JS = r"""const KINDS = ["pods","nodes","persistentvolumes","persistentvolumeclaims","storageclasses","priorityclasses","namespaces","deployments","replicasets","scenarios"];
-const state = Object.fromEntries(KINDS.map(k=>[k,{}]));
-const dlg = document.getElementById("dlg");
-const key = o => (o.metadata.namespace? o.metadata.namespace+"/" : "") + o.metadata.name;
+# The UI behavior is componentized into real asset files (the role of the
+# reference's web/components/*), served individually at /webui/{name} and
+# as the single concatenated /webui.js the page loads (classic scripts
+# share one top-level lexical environment, so the concat is equivalent).
+import os as _os
 
-async function api(method, path, body, ctype) {
-  // JSON round-trip by default; string bodies pass through raw (the YAML
-  // create/edit paths set ctype="application/yaml"), and non-JSON
-  // responses (?format=yaml, templates) come back as text
-  const raw = typeof body === "string";
-  const r = await fetch(path, {method, headers:{"Content-Type": ctype || "application/json"},
-                               body: body===undefined? undefined : (raw? body : JSON.stringify(body))});
-  const text = await r.text();
-  if (!r.ok) throw new Error(text || r.status);
-  if (!text) return null;
-  return (r.headers.get("Content-Type")||"").includes("json") ? JSON.parse(text) : text;
-}
-
-async function refreshAll() {
-  for (const k of KINDS) {
-    const lst = await api("GET", `/api/v1/resources/${k}`);
-    state[k] = {};
-    for (const o of lst.items) state[k][key(o)] = o;
-  }
-  render();
-}
-
-let filterText = "";
-let searchTimer = null;
-function onSearch() {
-  // debounced: at benchmark scale a per-keystroke full re-render of
-  // thousands of DOM nodes would freeze the tab
-  clearTimeout(searchTimer);
-  searchTimer = setTimeout(() => {
-    filterText = document.getElementById("search").value.toLowerCase();
-    render();
-  }, 150);
-}
-function matchesFilter(o) {
-  if (!filterText) return true;
-  const hay = key(o).toLowerCase() + " " + JSON.stringify(o.metadata.labels || {}).toLowerCase();
-  return hay.includes(filterText);
-}
-
-function render() {
-  if (tablesMode) { renderTables(); return; }
-  const nodesDiv = document.getElementById("nodes");
-  nodesDiv.innerHTML = "";
-  const buckets = {"(unscheduled)": []};
-  for (const n of Object.values(state.nodes)) buckets[n.metadata.name] = [];
-  for (const p of Object.values(state.pods)) {
-    if (!matchesFilter(p)) continue;
-    const nn = (p.spec||{}).nodeName;
-    (buckets[nn] || buckets["(unscheduled)"]).push(p);
-  }
-  for (const [nodeName, pods] of Object.entries(buckets)) {
-    if (nodeName === "(unscheduled)" && !pods.length) continue;
-    const div = document.createElement("div");
-    div.className = "node";
-    const node = state.nodes[nodeName];
-    const h = document.createElement("h3");
-    h.textContent = nodeName + (node ? `  —  cpu ${((node.status||{}).allocatable||{}).cpu||"?"} / mem ${((node.status||{}).allocatable||{}).memory||"?"}` : "");
-    if (node) { h.style.cursor = "pointer"; h.onclick = () => showNode(node); }
-    div.appendChild(h);
-    for (const p of pods) {
-      const s = document.createElement("span");
-      s.className = "pod" + (nodeName === "(unscheduled)" ? " unsched" : "");
-      s.textContent = key(p);
-      s.onclick = () => showPod(p);
-      div.appendChild(s);
-    }
-    nodesDiv.appendChild(div);
-  }
-  const others = document.getElementById("others");
-  others.innerHTML = "";
-  for (const k of KINDS) {
-    if (k === "pods" || k === "nodes") continue;
-    const row = document.createElement("div");
-    row.className = "kindrow";
-    row.innerHTML = `<b>${k}</b>`;
-    for (const o of Object.values(state[k])) {
-      if (!matchesFilter(o)) continue;
-      const s = document.createElement("span");
-      s.className = "item";
-      s.textContent = key(o);
-      s.onclick = () => showObject(k, o);
-      row.appendChild(s);
-    }
-    others.appendChild(row);
-  }
-}
+_ASSET_DIR = _os.path.join(_os.path.dirname(__file__), "webui_assets")
+MODULE_ORDER = [
+    "state.js",      # shared store: kinds, objects-by-key, search filter
+    "api.js",        # fetch wrapper + HTML escaping + full refresh
+    "quantity.js",   # kube resource.Quantity parsing + usage bars
+    "editor.js",     # YAML editor pane: gutter, highlighting, error lines
+    "clusterview.js",# nodes-and-pods view with utilization badges
+    "tables.js",     # per-kind data tables (reference DataTables role)
+    "dialogs.js",    # pod results / node capacity / object dialogs
+    "forms.js",      # create/edit YAML, scheduler config, export/import
+    "metrics.js",    # Prometheus metrics panel
+    "watch.js",      # live list-watch stream + workload polling
+    "main.js",       # bootstrap
+]
 
 
-// ---- node detail: capacity vs requested, with usage bars ----------------
+def _load_modules() -> "dict[str, str]":
+    out = {}
+    for name in MODULE_ORDER:
+        with open(_os.path.join(_ASSET_DIR, name), encoding="utf-8") as f:
+            out[name] = f.read()
+    return out
 
-function parseCpu(v) {
-  if (v === undefined || v === null || v === "") return 0;
-  v = String(v);
-  return v.endsWith("m") ? parseFloat(v) / 1000 : parseFloat(v);
-}
-function parseMem(v) {
-  if (!v) return 0;
-  // kube resource.Quantity suffixes: binary Ki..Ei, decimal k/M/G/T/P/E,
-  // and milli (m)
-  const m = String(v).match(/^([0-9.]+)(Ki|Mi|Gi|Ti|Pi|Ei|k|M|G|T|P|E|m)?$/);
-  if (!m) return parseFloat(v) || 0;
-  const mult = {Ki: 2**10, Mi: 2**20, Gi: 2**30, Ti: 2**40, Pi: 2**50, Ei: 2**60,
-                k: 1e3, M: 1e6, G: 1e9, T: 1e12, P: 1e15, E: 1e18, m: 1e-3}[m[2]] || 1;
-  return parseFloat(m[1]) * mult;
-}
-function bar(frac, label) {
-  const pct = Math.min(100, Math.round(frac * 100));
-  const color = pct > 90 ? "#d93025" : pct > 70 ? "#f9ab00" : "#1e8e3e";
-  return `<div style="margin:4px 0"><span class="muted">${esc(label)} — ${pct}%</span>
-    <div style="background:#eee;border-radius:4px;height:10px"><div style="width:${pct}%;background:${color};height:10px;border-radius:4px"></div></div></div>`;
-}
 
-function showNode(node) {
-  const name = node.metadata.name;
-  const alloc = (node.status||{}).allocatable || {};
-  const pods = Object.values(state.pods).filter(p => (p.spec||{}).nodeName === name);
-  let cpuReq = 0, memReq = 0;
-  for (const p of pods) {
-    for (const c of (p.spec||{}).containers || []) {
-      const r = ((c.resources||{}).requests) || {};
-      cpuReq += parseCpu(r.cpu); memReq += parseMem(r.memory);
-    }
-  }
-  const cpuCap = parseCpu(alloc.cpu), memCap = parseMem(alloc.memory);
-  const body = document.getElementById("dlgbody");
-  body.innerHTML = `<h2>Node / ${esc(name)}</h2>` +
-    bar(cpuCap ? cpuReq / cpuCap : 0, `cpu ${cpuReq.toFixed(2)} / ${esc(alloc.cpu||"?")}`) +
-    bar(memCap ? memReq / memCap : 0, `memory ${(memReq/2**30).toFixed(2)}Gi / ${esc(alloc.memory||"?")}`) +
-    bar((parseFloat(alloc.pods)||0) ? pods.length / parseFloat(alloc.pods) : 0,
-        `pods ${pods.length} / ${esc(alloc.pods||"?")}`) +
-    `<p class="muted">taints: ${esc((((node.spec||{}).taints)||[]).map(t=>`${t.key}=${t.value}:${t.effect}`).join(", ") || "none")}</p>`;
-  const list = document.createElement("div");
-  for (const p of pods) {
-    const sp = document.createElement("span");
-    sp.className = "pod"; sp.textContent = key(p); sp.onclick = () => showPod(p);
-    list.appendChild(sp);
-  }
-  body.appendChild(list);
-  body.appendChild(editButton("nodes", node));
-  const raw = document.createElement("pre");
-  raw.textContent = JSON.stringify(node, null, 2);
-  body.appendChild(raw);
-  dlg.showModal();
-}
+MODULES = _load_modules()
+JS = "\n".join(f"// ==== {name} ====\n{src}" for name, src in MODULES.items())
 
-// ---- metrics panel -------------------------------------------------------
-
-async function openMetrics() {
-  let text = "";
-  try { text = await api("GET", "/api/v1/metrics"); }
-  catch (e) { alert(e.message); return; }
-  const rows = [];
-  for (const line of text.split("\n")) {
-    if (!line || line.startsWith("#")) continue;
-    const sp = line.lastIndexOf(" ");
-    rows.push([line.slice(0, sp), line.slice(sp + 1)]);
-  }
-  const body = document.getElementById("dlgbody");
-  body.innerHTML = `<h2>Metrics</h2>`;
-  const tbl = document.createElement("table");
-  tbl.className = "kv";
-  for (const [k, v] of rows) {
-    const tr = document.createElement("tr");
-    const td1 = document.createElement("td"); td1.textContent = k;
-    const td2 = document.createElement("td"); td2.textContent = v;
-    tr.appendChild(td1); tr.appendChild(td2); tbl.appendChild(tr);
-  }
-  body.appendChild(tbl);
-  dlg.showModal();
-}
-
-function esc(s){ return String(s).replace(/&/g,"&amp;").replace(/</g,"&lt;"); }
-
-let tablesMode = false;
-function toggleView() {
-  tablesMode = !tablesMode;
-  document.getElementById("clusterview").style.display = tablesMode ? "none" : "";
-  document.getElementById("tablesview").style.display = tablesMode ? "grid" : "";
-  document.getElementById("viewtoggle").textContent = tablesMode ? "Cluster" : "Tables";
-  render();
-}
-
-// column extractors per kind (the reference's DataTables headers)
-const TABLE_COLS = {
-  pods: [["namespace", o=>(o.metadata||{}).namespace||""], ["name", o=>o.metadata.name],
-         ["node", o=>(o.spec||{}).nodeName||""], ["phase", o=>(o.status||{}).phase||""],
-         ["cpu req", o=>{try{return o.spec.containers[0].resources.requests.cpu||""}catch(e){return ""}}],
-         ["selectedNode", o=>((o.metadata||{}).annotations||{})["scheduler-simulator/selected-node"]||""]],
-  nodes: [["name", o=>o.metadata.name], ["cpu", o=>{try{return o.status.allocatable.cpu}catch(e){return ""}}],
-          ["memory", o=>{try{return o.status.allocatable.memory}catch(e){return ""}}],
-          ["pods", o=>{try{return o.status.allocatable.pods}catch(e){return ""}}],
-          ["taints", o=>(((o.spec||{}).taints)||[]).map(t=>t.key).join(",")]],
-  persistentvolumes: [["name", o=>o.metadata.name], ["capacity", o=>{try{return o.spec.capacity.storage}catch(e){return ""}}],
-                      ["class", o=>(o.spec||{}).storageClassName||""], ["claim", o=>{try{return o.spec.claimRef.name}catch(e){return ""}}]],
-  persistentvolumeclaims: [["namespace", o=>(o.metadata||{}).namespace||""], ["name", o=>o.metadata.name],
-                           ["class", o=>(o.spec||{}).storageClassName||""], ["phase", o=>(o.status||{}).phase||""]],
-  storageclasses: [["name", o=>o.metadata.name], ["provisioner", o=>o.provisioner||""]],
-  priorityclasses: [["name", o=>o.metadata.name], ["value", o=>o.value]],
-  namespaces: [["name", o=>o.metadata.name], ["phase", o=>(o.status||{}).phase||""]],
-  deployments: [["namespace", o=>(o.metadata||{}).namespace||""], ["name", o=>o.metadata.name],
-                ["replicas", o=>(o.spec||{}).replicas]],
-  replicasets: [["namespace", o=>(o.metadata||{}).namespace||""], ["name", o=>o.metadata.name],
-                ["replicas", o=>(o.spec||{}).replicas]],
-  scenarios: [["namespace", o=>(o.metadata||{}).namespace||""], ["name", o=>o.metadata.name],
-              ["phase", o=>(o.status||{}).phase||"(queued)"],
-              ["operations", o=>(((o.spec||{}).operations)||[]).length]],
-};
-
-function renderTables() {
-  const root = document.getElementById("tables");
-  root.innerHTML = "";
-  for (const k of KINDS) {
-    const cols = TABLE_COLS[k] || [["name", o=>o.metadata.name]];
-    const objs = Object.values(state[k]).filter(matchesFilter);
-    const h = document.createElement("h2");
-    h.textContent = `${k} (${objs.length})`;
-    root.appendChild(h);
-    const tbl = document.createElement("table");
-    tbl.className = "kv";
-    tbl.dataset.kind = k;
-    const hr = document.createElement("tr");
-    for (const [label] of cols) {
-      const th = document.createElement("td");
-      th.innerHTML = `<b>${esc(label)}</b>`;
-      hr.appendChild(th);
-    }
-    tbl.appendChild(hr);
-    for (const o of objs) {
-      const tr = document.createElement("tr");
-      tr.style.cursor = "pointer";
-      tr.addEventListener("click", () => k === "pods" ? showPod(o) : showObject(k, o));
-      for (const [, fn] of cols) {
-        const td = document.createElement("td");
-        let v = ""; try { v = fn(o); } catch (e) {}
-        td.textContent = v === undefined ? "" : v;
-        tr.appendChild(td);
-      }
-      tbl.appendChild(tr);
-    }
-    root.appendChild(tbl);
-  }
-}
-
-function deleteButton(kind, k) {
-  // built via DOM (not inline onclick) so stored object names can't inject
-  // script through attribute strings
-  const b = document.createElement("button");
-  b.textContent = "Delete";
-  b.addEventListener("click", () => del(kind, k));
-  const p = document.createElement("p");
-  p.appendChild(b);
-  return p;
-}
-
-function historyViewer(annos) {
-  // result-history is a JSON array of per-attempt maps; render newest
-  // last, one expandable block per attempt (the reference appends every
-  // scheduling attempt's full result set, storereflector.go:148-167)
-  const raw = annos["scheduler-simulator/result-history"];
-  if (!raw) return "";
-  let hist;
-  try { hist = JSON.parse(raw); } catch (e) { return ""; }
-  if (!Array.isArray(hist)) return "";
-  let out = `<h3 style="margin:10px 0 4px">result history (${hist.length} attempt${hist.length===1?"":"s"})</h3>`;
-  hist.forEach((attempt, idx) => {
-    let rows = "";
-    for (const [k,v] of Object.entries(attempt)) {
-      let pretty = v;
-      try { pretty = JSON.stringify(JSON.parse(v), null, 1); } catch (e) {}
-      rows += `<tr><td>${esc(String(k).replace("scheduler-simulator/",""))}</td><td><pre style="margin:0;white-space:pre-wrap">${esc(pretty)}</pre></td></tr>`;
-    }
-    out += `<details ${idx===hist.length-1?"open":""}><summary>attempt ${idx+1}</summary><table class="kv">${rows}</table></details>`;
-  });
-  return out;
-}
-
-function showPod(p) {
-  const annos = (p.metadata||{}).annotations || {};
-  let rows = "";
-  for (const [k,v] of Object.entries(annos)) {
-    if (!k.startsWith("scheduler-simulator/") || k === "scheduler-simulator/result-history") continue;
-    let pretty = v;
-    try { pretty = JSON.stringify(JSON.parse(v), null, 1); } catch (e) {}
-    rows += `<tr><td>${esc(k.replace("scheduler-simulator/",""))}</td><td><pre style="margin:0;white-space:pre-wrap">${esc(pretty)}</pre></td></tr>`;
-  }
-  const body = document.getElementById("dlgbody");
-  body.innerHTML =
-    `<h2>Pod ${esc(key(p))} — scheduling results</h2>
-     <p class="muted">node: ${esc((p.spec||{}).nodeName||"(unscheduled)")}</p>
-     <table class="kv">${rows || "<tr><td>no scheduler-simulator/* annotations yet</td></tr>"}</table>
-     ${historyViewer(annos)}
-     <details><summary>manifest</summary><pre>${esc(JSON.stringify(p,null,2))}</pre></details>`;
-  body.appendChild(editButton("pods", p));
-  body.appendChild(deleteButton("pods", key(p)));
-  dlg.showModal();
-}
-
-function showObject(kind, o) {
-  const body = document.getElementById("dlgbody");
-  body.innerHTML =
-    `<h2>${esc(kind)} / ${esc(key(o))}</h2>
-     <pre>${esc(JSON.stringify(o,null,2))}</pre>`;
-  body.appendChild(editButton(kind, o));
-  body.appendChild(deleteButton(kind, key(o)));
-  dlg.showModal();
-}
-
-function editButton(kind, o) {
-  const b = document.createElement("button");
-  b.textContent = "Edit";
-  b.addEventListener("click", () => editObject(kind, o));
-  const p = document.createElement("p");
-  p.appendChild(b);
-  return p;
-}
-
-async function editObject(kind, o) {
-  // YAML round-trip through the backend (?format=yaml GET, YAML PUT) —
-  // the reference's monaco editor role, no client-side YAML lib needed
-  const ns = (o.metadata||{}).namespace;
-  const path = `/api/v1/resources/${kind}/${o.metadata.name}` + (ns?`?namespace=${ns}`:"");
-  let yamlText;
-  try {
-    yamlText = await api("GET", path + (ns?"&":"?") + "format=yaml");
-  } catch (e) { alert(e.message); return; }
-  const body = document.getElementById("dlgbody");
-  body.innerHTML = `<h2>Edit ${esc(kind)} / ${esc(key(o))} (YAML)</h2>`;
-  const ta = document.createElement("textarea");
-  ta.id = "editbody";
-  ta.value = yamlText;
-  ta.style.minHeight = "340px";
-  body.appendChild(ta);
-  const b = document.createElement("button");
-  b.textContent = "Apply";
-  b.addEventListener("click", async () => {
-    try {
-      await api("PUT", path, ta.value, "application/yaml");
-      dlg.close();
-    } catch (e) { alert(e.message); }
-  });
-  const p = document.createElement("p");
-  p.appendChild(b);
-  body.appendChild(p);
-  dlg.showModal();
-}
-
-async function del(kind, k) {
-  const [ns, name] = k.includes("/") ? k.split("/") : [null, k];
-  await api("DELETE", `/api/v1/resources/${kind}/${name}` + (ns?`?namespace=${ns}`:""));
-  dlg.close();
-}
-
-// Creation templates are YAML served by the backend (the reference ships
-// web/components/lib/templates/*.yaml); bodies POST as application/yaml.
-const TEMPLATE_KINDS = ["pods","nodes","deployments","persistentvolumes","persistentvolumeclaims","storageclasses","priorityclasses","namespaces","scenarios"];
-
-async function loadTemplate(kind) {
-  document.getElementById("newbody").value = await api("GET", `/api/v1/templates/${kind}`);
-}
-
-async function newResource() {
-  const opts = TEMPLATE_KINDS.map(k=>`<option>${k}</option>`).join("");
-  document.getElementById("dlgbody").innerHTML =
-    `<h2>Create resource (YAML)</h2>
-     <p><select id="newkind" onchange="loadTemplate(this.value)">${opts}</select></p>
-     <textarea id="newbody"></textarea>
-     <p><button onclick="createResource()">Create</button></p>`;
-  await loadTemplate("pods");
-  dlg.showModal();
-}
-
-async function createResource() {
-  const kind = document.getElementById("newkind").value;
-  try {
-    await api("POST", `/api/v1/resources/${kind}`,
-              document.getElementById("newbody").value, "application/yaml");
-    dlg.close();
-  } catch (e) { alert(e.message); }
-}
-
-async function openSchedConfig() {
-  const cfg = await api("GET", "/api/v1/schedulerconfiguration");
-  document.getElementById("dlgbody").innerHTML =
-    `<h2>KubeSchedulerConfiguration</h2>
-     <p class="muted">POST honors only .profiles (reference behavior)</p>
-     <textarea id="schedcfg">${esc(JSON.stringify(cfg,null,2))}</textarea>
-     <p><button onclick="applySchedConfig()">Apply</button></p>`;
-  dlg.showModal();
-}
-
-async function applySchedConfig() {
-  try {
-    await api("POST", "/api/v1/schedulerconfiguration", JSON.parse(document.getElementById("schedcfg").value));
-    dlg.close();
-  } catch (e) { alert(e.message); }
-}
-
-async function doExport() {
-  const snap = await api("GET", "/api/v1/export");
-  const blob = new Blob([JSON.stringify(snap, null, 2)], {type: "application/json"});
-  const a = Object.assign(document.createElement("a"), {href: URL.createObjectURL(blob), download: "snapshot.json"});
-  a.click();
-}
-
-function doImport() {
-  const inp = Object.assign(document.createElement("input"), {type: "file", accept: ".json"});
-  inp.onchange = async () => {
-    const text = await inp.files[0].text();
-    await api("POST", "/api/v1/import", JSON.parse(text));
-  };
-  inp.click();
-}
-
-async function doReset() { if (confirm("Reset the simulator?")) await api("PUT", "/api/v1/reset"); }
-
-async function watchLoop() {
-  while (true) {
-    try {
-      const resp = await fetch("/api/v1/listwatchresources");
-      const reader = resp.body.getReader();
-      const decoder = new TextDecoder();
-      let buf = "";
-      for (;;) {
-        const {done, value} = await reader.read();
-        if (done) break;
-        buf += decoder.decode(value, {stream: true});
-        const lines = buf.split("\n");
-        buf = lines.pop();
-        let dirty = false;
-        for (const line of lines) {
-          if (!line.trim()) continue;
-          const ev = JSON.parse(line);
-          const k = key(ev.Obj);
-          if (!(ev.Kind in state)) continue;
-          if (ev.EventType === "DELETED") delete state[ev.Kind][k];
-          else state[ev.Kind][k] = ev.Obj;
-          dirty = true;
-        }
-        if (dirty) render();
-      }
-    } catch (e) { /* server restart — retry */ }
-    await new Promise(r => setTimeout(r, 1000));
-  }
-}
-
-// deployments/replicasets/scenarios are kinds the watch stream doesn't
-// carry (it mirrors the reference's 7 kinds) — poll them instead.
-async function pollWorkloads() {
-  for (;;) {
-    try {
-      for (const k of ["deployments", "replicasets", "scenarios"]) {
-        const lst = await api("GET", `/api/v1/resources/${k}`);
-        state[k] = {};
-        for (const o of lst.items) state[k][key(o)] = o;
-      }
-      render();
-    } catch (e) {}
-    await new Promise(r => setTimeout(r, 3000));
-  }
-}
-
-refreshAll().then(() => { watchLoop(); pollWorkloads(); });
-"""
 
 
 # YAML creation templates per store kind, served at /api/v1/templates/{kind}

@@ -1,0 +1,1 @@
+refreshAll().then(() => { watchLoop(); pollWorkloads(); });

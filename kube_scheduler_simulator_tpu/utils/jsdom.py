@@ -190,6 +190,9 @@ class Harness:
             payload = payload(method, path, body)
         if payload is None:
             return self._response(404, _json.dumps({"message": f"no route {method} {path}"}), "application/json")
+        if isinstance(payload, tuple):  # (status, text) for error-path tests
+            status, text = payload
+            return self._response(status, text, "text/plain")
         if isinstance(payload, str):
             return self._response(200, payload, "text/plain")
         return self._response(200, _json.dumps(payload), "application/json")

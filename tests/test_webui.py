@@ -132,8 +132,16 @@ def test_webui_js_served_and_consistent(server):
     created |= set(re.findall(r'id=\\?"([\w-]+)', js))  # innerHTML templates
     for el in set(re.findall(r'getElementById\("([\w-]+)"\)', js)):
         assert f'id="{el}"' in html or el in created, f"element #{el} missing"
-    # structural balance (cheap syntax smoke without a JS engine)
-    stripped = re.sub(r"//[^\n]*", "", js)
-    stripped = re.sub(r'"(?:\\.|[^"\\])*"|\'(?:\\.|[^\'\\])*\'|`(?:\\.|[^`\\])*`', "", stripped, flags=re.S)
-    for o, c in (("{", "}"), ("(", ")"), ("[", "]")):
-        assert stripped.count(o) == stripped.count(c), f"unbalanced {o}{c}"
+    # full grammar + scope check of the SERVED bytes (the regex-based
+    # brace balance this replaced could not handle regex literals)
+    from kube_scheduler_simulator_tpu.utils import jscheck
+
+    jscheck.check(js)
+    # component assets serve individually and concatenate into /webui.js
+    from kube_scheduler_simulator_tpu.server.webui import MODULE_ORDER
+
+    for name in MODULE_ORDER:
+        mod = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/webui/{name}", timeout=10
+        ).read().decode()
+        assert mod.strip() and mod in js, f"module {name} not served/concatenated"

@@ -191,3 +191,116 @@ def test_runtime_defect_turns_suite_red(name, mutate):
     h = make_harness(pods=[pod_obj("p1", node="n1")], nodes=[node_obj("n1")])
     with pytest.raises(ThrowSig):
         h.boot(broken)
+
+
+# ---- editor pane (editor.js: the reference's monaco role) ---------------
+
+
+def test_yaml_highlight_classes():
+    h = make_harness()
+    interp = h.boot(JS)
+    out = interp.get_global("yamlHighlight")(
+        "# comment\nmetadata:\n  name: pod-1\n  weight: 10\n  note: \"quoted\""
+    )
+    assert '<span class="y-c"># comment</span>' in out
+    assert '<span class="y-k">metadata</span>:' in out
+    assert '<span class="y-k">name</span>:' in out
+    assert '<span class="y-n"> 10</span>' in out
+    assert '<span class="y-s"> "quoted"</span>' in out
+
+
+def test_edit_object_yaml_roundtrip_and_error_line_marking():
+    pod = pod_obj("edit-me", node="n1")
+    h = make_harness(pods=[pod], nodes=[node_obj("n1")])
+    path = "/api/v1/resources/pods/edit-me?namespace=default"
+    h.routes[("GET", path + "&format=yaml")] = "metadata:\n  name: edit-me\n"
+    interp = h.boot(JS)
+    state_pod = interp.get_global("state")["pods"]["default/edit-me"]
+    interp.get_global("editObject")("pods", state_pod)
+    ed = interp.get_global("activeEditor")
+    assert ed is not None and ed["ta"].value.startswith("metadata:")
+    # gutter numbered per line
+    assert ed["gutter"].innerHTML.splitlines()[0] == "1"
+    # edit + apply -> YAML PUT with the edited body
+    h.routes[("PUT", path)] = {}
+    ed["ta"].value = "metadata:\n  name: edit-me\n  labels: {a: b}\n"
+    ed["ta"].oninput()
+    assert ed["gutter"].dataset["count"] == 4  # 3 lines + trailing newline
+    apply_btn = _find_button(h.document._by_id["dlgbody"], "Apply")
+    apply_btn.click()
+    assert ("PUT", path, ed["ta"].value) in h.requests
+    assert not h.document._by_id["dlg"].open  # closed on success
+
+    # error path: server rejects with a line-numbered message; the
+    # gutter marks the line and the dialog stays open
+    interp.get_global("editObject")("pods", state_pod)
+    ed = interp.get_global("activeEditor")
+    h.routes[("PUT", path)] = (400, "yaml parse error at line 3: bad mapping")
+    _find_button(h.document._by_id["dlgbody"], "Apply").click()
+    assert '<span class="errline">3</span>' in ed["gutter"].innerHTML
+
+
+def test_new_resource_template_flows_into_editor():
+    h = make_harness()
+    h.routes[("GET", "/api/v1/templates/pods")] = "metadata:\n  generateName: pod-\n"
+    h.routes[("GET", "/api/v1/templates/nodes")] = "metadata:\n  generateName: node-\n"
+    interp = h.boot(JS)
+    interp.get_global("newResource")()
+    ed = interp.get_global("activeEditor")
+    assert "generateName: pod-" in ed["ta"].value
+    # switching kind re-loads the template into the live editor
+    interp.get_global("loadTemplate")("nodes")
+    assert "generateName: node-" in ed["ta"].value
+    # create posts the edited YAML
+    h.routes[("POST", "/api/v1/resources/pods")] = {}
+    ed["ta"].value = "metadata:\n  name: created-1\n"
+    _find_button(h.document._by_id["dlgbody"], "Apply").click()
+    assert ("POST", "/api/v1/resources/pods", ed["ta"].value) in h.requests
+
+
+def test_sched_config_editor_posts_parsed_json():
+    h = make_harness()
+    h.routes[("GET", "/api/v1/schedulerconfiguration")] = {"profiles": [{"schedulerName": "default-scheduler"}]}
+    interp = h.boot(JS)
+    interp.get_global("openSchedConfig")()
+    ed = interp.get_global("activeEditor")
+    assert "default-scheduler" in ed["ta"].value
+    h.routes[("POST", "/api/v1/schedulerconfiguration")] = {}
+    _find_button(h.document._by_id["dlgbody"], "Apply").click()
+    posted = next(b for m, p, b in h.requests if (m, p) == ("POST", "/api/v1/schedulerconfiguration"))
+    assert json.loads(posted)["profiles"][0]["schedulerName"] == "default-scheduler"
+
+
+def test_cluster_view_utilization_badges():
+    # 1000m requested on a 2000m node -> 50% "cool" badge
+    h = make_harness(
+        pods=[
+            {
+                "metadata": {"name": "hot-pod", "namespace": "default"},
+                "spec": {"nodeName": "n1", "containers": [{"name": "c", "resources": {"requests": {"cpu": "1000m"}}}]},
+            }
+        ],
+        nodes=[node_obj("n1", cpu="2000m")],
+    )
+    h.boot(JS)
+    badges = _collect_by_class(h.document._by_id["nodes"], "util")
+    assert badges and badges[0].textContent == "50%"
+    assert "cool" in badges[0].className
+
+
+def _find_button(root, label):
+    for el in _walk(root):
+        if getattr(el, "tagName", "") == "BUTTON" and el.textContent == label:
+            return el
+    raise AssertionError(f"no {label!r} button in dialog")
+
+
+def _collect_by_class(root, cls):
+    return [el for el in _walk(root) if cls in getattr(el, "className", "").split()]
+
+
+def _walk(el):
+    yield el
+    for c in getattr(el, "children", []):
+        if hasattr(c, "children"):
+            yield from _walk(c)
