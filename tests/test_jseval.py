@@ -1,0 +1,218 @@
+"""Unit tests for the JS interpreter's semantics corners (utils/jseval).
+
+The execution suite (test_webui_exec.py) proves the real UI runs; these
+pin the language semantics the UI depends on, so an interpreter
+regression fails with a precise arrow instead of a broken render."""
+
+from __future__ import annotations
+
+import pytest
+
+from kube_scheduler_simulator_tpu.utils.jscheck import JSError
+from kube_scheduler_simulator_tpu.utils.jseval import (
+    UNDEF,
+    Interp,
+    JSArray,
+    JSObject,
+    JSPromise,
+    PendingAwait,
+    ThrowSig,
+    to_str,
+)
+
+
+def run(src: str, host=None):
+    return Interp(host or {}).run(src)
+
+
+def test_coercions_and_truthiness():
+    g = run("""
+        const plus = "n=" + 5;          // string concat coercion
+        const num = "3" * 2;            // numeric coercion
+        const falsy = [!!"", !!0, !!null, !!undefined].join(",");
+        const truthy = [!!"x", !!1, !![], !!{}].join(",");
+        const tmpl = `${null}/${undefined}/${[1,2]}`;
+        const nan = isNaN("abc" * 1);
+    """)
+    assert g.get("plus") == "n=5"
+    assert g.get("num") == 6
+    assert g.get("falsy") == "false,false,false,false"
+    assert g.get("truthy") == "true,true,true,true"
+    assert g.get("tmpl") == "null/undefined/1,2"
+    assert g.get("nan") is True
+
+
+def test_strict_vs_loose_equality():
+    g = run("""
+        const a = 1 === 1.0;
+        const b = "1" === 1;
+        const c = "1" == 1;
+        const d = null == undefined;
+        const e = null === undefined;
+        const o1 = {}, o2 = {};
+        const f = o1 === o2;
+        const g2 = o1 === o1;
+    """)
+    assert g.get("a") is True and g.get("b") is False
+    assert g.get("c") is True and g.get("d") is True and g.get("e") is False
+    assert g.get("f") is False and g.get("g2") is True
+
+
+def test_closures_and_hoisting():
+    g = run("""
+        const got = before();           // function declarations hoist
+        function before() { return make(3)(4); }
+        function make(x) { return y => x + y; }
+    """)
+    assert g.get("got") == 7
+
+
+def test_update_pre_vs_post():
+    g = run("let i = 5; const post = i++; const now1 = i; const pre = ++i; const now2 = i;")
+    assert g.get("post") == 5 and g.get("now1") == 6
+    assert g.get("pre") == 7 and g.get("now2") == 7
+
+
+def test_try_catch_finally_and_throw_values():
+    g = run("""
+        let order = [];
+        function f() {
+          try { throw new Error("boom"); }
+          catch (e) { order.push("caught:" + e.message); return "from-catch"; }
+          finally { order.push("finally"); }
+        }
+        const r = f();
+    """)
+    assert list(g.get("order")) == ["caught:boom", "finally"]
+    assert g.get("r") == "from-catch"
+
+
+def test_uncaught_throw_surfaces_as_throwsig():
+    with pytest.raises(ThrowSig) as exc:
+        run("null.x;")
+    assert "cannot read properties" in to_str(exc.value.value)
+
+
+def test_regex_replace_global_and_match():
+    g = run("""
+        const esc = "a&b&c".replace(/&/g, "+");
+        const one = "a&b&c".replace("&", "+");
+        const m = "node-42".match(/^node-(\\d+)$/);
+        const grp = m ? m[1] : "none";
+    """)
+    assert g.get("esc") == "a+b+c"
+    assert g.get("one") == "a+b&c"
+    assert g.get("grp") == "42"
+
+
+def test_destructuring_holes_and_defaults():
+    g = run("""
+        const [, second] = ["a", "b"];
+        const {x = 9, y} = {y: 2};
+        function f([a, [b]], {k} = {k: "dk"}) { return `${a}${b}${k}`; }
+        const r = f([1, [2]]);
+    """)
+    assert g.get("second") == "b"
+    assert g.get("x") == 9 and g.get("y") == 2
+    assert g.get("r") == "12dk"
+
+
+def test_async_returns_resolved_promise_and_pending_halts():
+    g = run("""
+        async function f() { return 41 + 1; }
+        const p = f();
+        let got = 0;
+        p.then(v => { got = v; });
+    """)
+    assert isinstance(g.get("p"), JSPromise)
+    assert g.get("got") == 42
+    # awaiting a promise that only a (never-run) timer would resolve
+    # halts the script — the harness's clean shutdown path
+    with pytest.raises(PendingAwait):
+        run(
+            "async function idle() { await new Promise(r => setTimeout(r, 50)); } idle();",
+            host={"setTimeout": lambda fn, ms=0, *a: 1},
+        )
+
+
+def test_rest_and_spread_are_refused_not_miscompiled():
+    for src in (
+        "function f(...xs) { return xs; }",
+        "const a = [1, 2]; f(...a); function f(x) { return x; }",
+        "const b = [...[1], 2];",
+    ):
+        with pytest.raises(JSError):
+            run(src)
+
+
+def test_switch_fallthrough_and_break():
+    g = run("""
+        function f(x) {
+          let out = [];
+          switch (x) {
+            case 1: out.push("one");
+            case 2: out.push("two"); break;
+            default: out.push("other");
+          }
+          return out.join(",");
+        }
+        const a = f(1), b = f(2), c = f(3);
+    """)
+    assert g.get("a") == "one,two"
+    assert g.get("b") == "two"
+    assert g.get("c") == "other"
+
+
+def test_json_bridge_roundtrip():
+    g = run("""
+        const obj = JSON.parse('{"a": [1, "x", null, true]}');
+        const back = JSON.stringify(obj);
+        const pretty = JSON.stringify({k: 1}, null, 1);
+    """)
+    assert isinstance(g.get("obj"), JSObject)
+    assert isinstance(g.get("obj")["a"], JSArray)
+    assert g.get("back") == '{"a":[1,"x",null,true]}'
+    assert g.get("pretty") == '{\n "k": 1\n}'
+
+
+def test_for_in_vs_for_of():
+    g = run("""
+        let keys = [], vals = [];
+        const o = {a: 1, b: 2};
+        for (const k in o) keys.push(k);
+        for (const v of [10, 20]) vals.push(v);
+        let idx = [];
+        for (const i in ["x", "y"]) idx.push(i);
+    """)
+    assert list(g.get("keys")) == ["a", "b"]
+    assert list(g.get("vals")) == [10, 20]
+    assert list(g.get("idx")) == ["0", "1"]  # for-in yields string indices
+
+
+def test_string_and_array_library_surface():
+    g = run("""
+        const s = "  Node-1  ".trim().toLowerCase();
+        const parts = "a,b,,c".split(",");
+        const found = [3, 1, 2].sort().join("");
+        const numsort = [30, 4, 21].sort((a, b) => a - b).join(",");
+        const sliced = "abcdef".slice(1, -1);
+        const padded = "7".padStart(3, "0");
+        const entries = Object.entries({z: 1}).flat().join(":");
+    """)
+    assert g.get("s") == "node-1"
+    assert list(g.get("parts")) == ["a", "b", "", "c"]
+    assert g.get("found") == "123"
+    assert g.get("numsort") == "4,21,30"
+    assert g.get("sliced") == "bcde"
+    assert g.get("padded") == "007"
+    assert g.get("entries") == "z:1"
+
+
+def test_undefined_member_chain_guards():
+    g = run("""
+        const o = {};
+        const safe = (o.metadata || {}).name || "(none)";
+        const t = typeof missingGlobalThing;
+    """)
+    assert g.get("safe") == "(none)"
+    assert g.get("t") == "undefined"
