@@ -526,19 +526,26 @@ def main() -> None:
 
     # --- preflight: find the device without letting a wedged tunnel eat
     # the whole budget.  One retry after a backoff, then CPU fallback.
-    platforms = _probe_devices(60.0)
-    if platforms is None:
-        _note_progress("device probe hung/failed; retrying in 20s")
-        time.sleep(20.0)
-        platforms = _probe_devices(60.0)
+    # KSS_BENCH_FORCE_CPU=1 skips the probes outright (dev shells, the
+    # harness's own tests).
     child_env = dict(os.environ)
     platform_note = None
-    if platforms is None:
-        platform_note = "tpu tunnel unresponsive after 2 probes; sweep ran CPU-pinned"
+    if os.environ.get("KSS_BENCH_FORCE_CPU") == "1":
+        platform_note = "KSS_BENCH_FORCE_CPU=1; sweep ran CPU-pinned"
         _note_progress(platform_note)
         child_env = _cpu_pinned_env()
     else:
-        _note_progress(f"devices: {platforms}")
+        platforms = _probe_devices(60.0)
+        if platforms is None:
+            _note_progress("device probe hung/failed; retrying in 20s")
+            time.sleep(20.0)
+            platforms = _probe_devices(60.0)
+        if platforms is None:
+            platform_note = "tpu tunnel unresponsive after 2 probes; sweep ran CPU-pinned"
+            _note_progress(platform_note)
+            child_env = _cpu_pinned_env()
+        else:
+            _note_progress(f"devices: {platforms}")
 
     def remaining() -> float:
         return deadline - time.monotonic()

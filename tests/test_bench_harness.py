@@ -1,0 +1,44 @@
+"""The bench orchestration itself (bench.py): one JSON line, per-config
+subprocess rows, CPU fallback labeling — the round-3 lesson is that a
+bench that can silently lose a round is a product defect, so the
+harness has tests like everything else."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
+
+
+def test_quick_sweep_emits_one_json_line_with_rows():
+    env = dict(os.environ)
+    env["KSS_BENCH_FORCE_CPU"] = "1"  # no tunnel probes in unit tests
+    env["KSS_BENCH_BUDGET_S"] = "240"
+    out = subprocess.run(
+        [sys.executable, BENCH, "--quick"],
+        capture_output=True,
+        text=True,
+        timeout=220,
+        env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    # the driver contract: stdout is exactly one JSON line
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, out.stdout
+    doc = json.loads(lines[0])
+    assert doc["unit"] == "pod-node pairs/s"
+    assert isinstance(doc["value"], (int, float))
+    rows = {r["config"]: r for r in doc["configs"]}
+    cfg1 = rows["cfg1-fit"]
+    assert cfg1["scheduled"] == 100 and cfg1["wall_s"] > 0
+    assert cfg1["parity_selected_identical_pct"] == 100.0
+    assert cfg1["parity_max_abs_dfinalscore"] == 0
+    # the fallback is labeled — a CPU sweep can never masquerade as TPU
+    assert any(r.get("note", "").startswith("KSS_BENCH_FORCE_CPU") for r in doc["configs"])
+    # quick/CPU runs must not claim the TPU north star
+    assert doc["north_star"]["met"] is False
+    # incremental partial file was written alongside
+    assert os.path.exists(os.path.join(os.path.dirname(BENCH), "BENCH_partial.json"))
