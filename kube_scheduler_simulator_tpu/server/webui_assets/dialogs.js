@@ -55,6 +55,20 @@ function showObject(kind, o) {
   body.innerHTML =
     `<h2>${esc(kind)} / ${esc(key(o))}</h2>
      <pre>${esc(JSON.stringify(o,null,2))}</pre>`;
+  if (kind === "scenarios") {
+    // run the KEP-140 scenario synchronously and re-open on the finished
+    // object (status.phase, per-step results)
+    const rb = document.createElement("button");
+    rb.textContent = "Run";
+    rb.addEventListener("click", async () => {
+      try {
+        showObject("scenarios", await api("POST", "/api/v1/scenarios", o));
+      } catch (e) { alert(e.message); }
+    });
+    const rp = document.createElement("p");
+    rp.appendChild(rb);
+    body.appendChild(rp);
+  }
   body.appendChild(editButton(kind, o));
   body.appendChild(deleteButton(kind, key(o)));
   dlg.showModal();

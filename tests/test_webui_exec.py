@@ -304,3 +304,22 @@ def _walk(el):
     for c in getattr(el, "children", []):
         if hasattr(c, "children"):
             yield from _walk(c)
+
+
+def test_scenario_run_button_posts_and_reopens_with_result():
+    scenario = {
+        "metadata": {"name": "sc-1", "namespace": "default"},
+        "spec": {"operations": [{"id": "op1", "createOperation": {}}]},
+    }
+    h = make_harness()
+    h.routes[("GET", "/api/v1/resources/scenarios")] = {"items": [scenario]}
+    finished = dict(scenario, status={"phase": "Succeeded"})
+    h.routes[("POST", "/api/v1/scenarios")] = finished
+    interp = h.boot(JS)
+    obj = interp.get_global("state")["scenarios"]["default/sc-1"]
+    interp.get_global("showObject")("scenarios", obj)
+    _find_button(h.document._by_id["dlgbody"], "Run").click()
+    sent = next(b for m, p, b in h.requests if (m, p) == ("POST", "/api/v1/scenarios"))
+    assert json.loads(sent)["metadata"]["name"] == "sc-1"
+    # the dialog re-rendered on the finished object
+    assert "Succeeded" in collect_text(h.document._by_id["dlgbody"])
