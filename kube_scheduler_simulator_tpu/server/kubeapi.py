@@ -55,6 +55,8 @@ CORE_RESOURCES = (
     ("", "v1", "namespaces", "Namespace", "namespaces"),
     ("", "v1", "persistentvolumes", "PersistentVolume", "persistentvolumes"),
     ("", "v1", "persistentvolumeclaims", "PersistentVolumeClaim", "persistentvolumeclaims"),
+    # client-go event recorders post here (older clients) …
+    ("", "v1", "events", "Event", "events"),
 )
 GROUP_RESOURCES = (
     ("storage.k8s.io", "v1", "storageclasses", "StorageClass", "storageclasses"),
@@ -66,9 +68,15 @@ GROUP_RESOURCES = (
     # KEP-140 Scenario CRD surface (reference scenario/api/v1alpha1);
     # reconciled by scenario/operator.py
     ("simulation.kube-scheduler-simulator.sigs.k8s.io", "v1alpha1", "scenarios", "Scenario", "scenarios"),
+    # … and newer clients use the events.k8s.io group; both serve the
+    # same store bucket
+    ("events.k8s.io", "v1", "events", "Event", "events"),
 )
 ALL_RESOURCES = CORE_RESOURCES + GROUP_RESOURCES
-_BY_RESOURCE = {r[2]: r for r in ALL_RESOURCES}
+# a resource name can be served under several groupVersions (events)
+_BY_RESOURCE: dict = {}
+for _r in ALL_RESOURCES:
+    _BY_RESOURCE.setdefault(_r[2], []).append(_r)
 
 
 def _api_version(group: str, version: str) -> str:
@@ -110,8 +118,11 @@ def resolve(path: str) -> "_Route | None":
         # through as an object route of the namespaces resource
         namespace, rest = rest[1], rest[2:]
     resource = rest[0]
-    entry = _BY_RESOURCE.get(resource)
-    if entry is None or entry[0] != group or entry[1] != version:
+    entry = next(
+        (e for e in _BY_RESOURCE.get(resource, ()) if e[0] == group and e[1] == version),
+        None,
+    )
+    if entry is None:
         return None
     name = rest[1] if len(rest) > 1 else None
     subresource = rest[2] if len(rest) > 2 else None

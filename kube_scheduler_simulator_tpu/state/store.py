@@ -60,9 +60,13 @@ KINDS: tuple[str, ...] = (
     # scenario/api/v1alpha1/scenario_types.go); the ScenarioOperator
     # reconciles them
     "scenarios",
+    # client-go schedulers/controllers record Events best-effort; the
+    # reference's real apiserver accepts them, so the kube port must too
+    # (a 404 per event pollutes external schedulers' logs)
+    "events",
 )
 NAMESPACED_KINDS: frozenset[str] = frozenset(
-    {"pods", "persistentvolumeclaims", "deployments", "replicasets", "poddisruptionbudgets", "scenarios"}
+    {"pods", "persistentvolumeclaims", "deployments", "replicasets", "poddisruptionbudgets", "scenarios", "events"}
 )
 
 KIND_NAMES: dict[str, str] = {
@@ -78,6 +82,7 @@ KIND_NAMES: dict[str, str] = {
     "poddisruptionbudgets": "PodDisruptionBudget",
     "csinodes": "CSINode",
     "scenarios": "Scenario",
+    "events": "Event",
 }
 
 EVENT_ADDED = "ADDED"

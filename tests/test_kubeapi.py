@@ -55,6 +55,7 @@ def test_discovery_documents(server):
         "scheduling.k8s.io",
         "storage.k8s.io",
         "simulation.kube-scheduler-simulator.sigs.k8s.io",
+        "events.k8s.io",
     }
     code, storage = _req(p, "GET", "/apis/storage.k8s.io/v1")
     assert {r["name"] for r in storage["resources"]} == {"storageclasses", "csinodes"}
@@ -150,3 +151,34 @@ def test_watch_resume_replays_backlog(server):
     ev = json.loads(resp.readline())
     assert ev["type"] == "ADDED" and ev["object"]["metadata"]["name"] == "late-node"
     conn.close()
+
+
+def test_events_resource_served_under_both_groups(server):
+    """client-go event recorders post to core v1 events (legacy) or
+    events.k8s.io/v1 (current); the reference's real apiserver accepts
+    both, and a 404 per event pollutes external schedulers' logs.  Both
+    groupVersions serve the same store bucket here."""
+    srv, _di = server
+    p = srv.kube_api_port
+    ev = {
+        "metadata": {"name": "pod-1.17af1", "namespace": "default"},
+        "reason": "Scheduled",
+        "message": "Successfully assigned default/pod-1 to node-a",
+        "type": "Normal",
+        "involvedObject": {"kind": "Pod", "name": "pod-1", "namespace": "default"},
+    }
+    code, created = _req(p, "POST", "/api/v1/namespaces/default/events", ev)
+    assert code == 201 and created["kind"] == "Event"
+    # the same object is visible through the events.k8s.io group
+    code, lst = _req(p, "GET", "/apis/events.k8s.io/v1/namespaces/default/events")
+    assert code == 200 and lst["kind"] == "EventList"
+    assert [e["metadata"]["name"] for e in lst["items"]] == ["pod-1.17af1"]
+    # recorder series updates PATCH the same name
+    code, patched = _req(p, "PATCH", "/apis/events.k8s.io/v1/namespaces/default/events/pod-1.17af1",
+                         {"count": 2})
+    assert code == 200 and patched["count"] == 2
+    # discovery advertises both
+    _code, core = _req(p, "GET", "/api/v1")
+    assert any(r["name"] == "events" for r in core["resources"])
+    _code, grp = _req(p, "GET", "/apis/events.k8s.io/v1")
+    assert any(r["name"] == "events" for r in grp["resources"])
