@@ -774,6 +774,7 @@ class SchedulerService:
             # whole history splice (the sequential path flushes per
             # attempt already)
             self.reflector.flush_pod(self.cluster_store, pod)
+            self._record_event(pod, "Normal", "Scheduled", f"Successfully assigned {ns}/{name} to {node_name}")
             return ScheduleResult(selected_node=node_name)
         diagnosis = result.diagnosis(i)
         from kube_scheduler_simulator_tpu.models.framework import Status
@@ -801,11 +802,48 @@ class SchedulerService:
             self._wait_move_seq[_pod_key(pod)] = attempt_move_seq
         elif not result.success:
             self._record_failure(pod, result, attempt_move_seq)
+        else:
+            ns = pod["metadata"].get("namespace", "default")
+            self._record_event(
+                pod, "Normal", "Scheduled",
+                f"Successfully assigned {ns}/{pod['metadata']['name']} to {result.selected_node}",
+            )
         # The reference's informer flushes results asynchronously after the
         # cycle; flush the queued pods now that all results are recorded.
         # Waiting pods keep their results queued until permit resolves.
         self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
         return result
+
+    def _record_event(self, pod: Obj, type_: str, reason: str, message: str) -> None:
+        """Record a scheduling Event like upstream's recorder (Scheduled /
+        FailedScheduling); best-effort — event failures never fail the
+        cycle, matching client-go's fire-and-forget recorder."""
+        meta = pod["metadata"]
+        ns = meta.get("namespace", "default")
+        self._event_seq = getattr(self, "_event_seq", 0) + 1
+        fw = self.framework_for(pod)
+        component = fw.profile_name if fw is not None else "default-scheduler"
+        try:
+            self.cluster_store.create(
+                "events",
+                {
+                    "metadata": {"name": f"{meta['name']}.{self._event_seq:x}", "namespace": ns},
+                    "involvedObject": {
+                        "kind": "Pod",
+                        "namespace": ns,
+                        "name": meta["name"],
+                        "uid": meta.get("uid", ""),
+                    },
+                    "reason": reason,
+                    "message": message,
+                    "type": type_,
+                    "count": 1,
+                    "source": {"component": component},
+                    "reportingComponent": component,
+                },
+            )
+        except Exception:  # noqa: BLE001 - recorder is fire-and-forget
+            pass
 
     def _record_failure(self, pod: Obj, result: ScheduleResult, attempt_move_seq: "int | None" = None) -> None:
         """Update pod status like upstream's failure handler: PodScheduled
@@ -852,6 +890,9 @@ class SchedulerService:
             ):
                 return
             self.cluster_store.patch("pods", name, patch, ns)
+            # the same no-op dedup guards the event: upstream's recorder
+            # aggregates repeats, this build skips them outright
+            self._record_event(pod, "Warning", "FailedScheduling", message)
         except KeyError:
             pass
 

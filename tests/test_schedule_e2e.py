@@ -864,3 +864,37 @@ def test_result_history_splice_and_foreign_values():
     for bad in ('[{not json}]', "[ ]", '{"a":1}', "garbage"):
         out = _updated_history(bad, attempt2, trusted=False)
         assert json.loads(out) == [attempt2]
+
+
+def test_scheduler_records_events():
+    """Upstream's scheduler records Scheduled / FailedScheduling Events
+    through the apiserver; this build's service records the same through
+    the store, visible at the kube port's events resource."""
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+    store = ClusterStore()
+    store.create("nodes", {"metadata": {"name": "ev-node"},
+                           "status": {"allocatable": {"cpu": "1000m", "memory": "2Gi", "pods": "10"}}})
+    svc = SchedulerService(store, use_batch="off")
+    svc.start_scheduler(None)
+    store.create("pods", {"metadata": {"name": "ev-ok", "namespace": "default"},
+                          "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "100m"}}}]}})
+    store.create("pods", {"metadata": {"name": "ev-fail", "namespace": "default"},
+                          "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "64000m"}}}]}})
+    svc.schedule_pending(max_rounds=1)
+    events = store.list("events", "default")
+    by_reason = {}
+    for e in events:
+        by_reason.setdefault(e["reason"], []).append(e)
+    ok = next(e for e in by_reason["Scheduled"] if e["involvedObject"]["name"] == "ev-ok")
+    assert ok["type"] == "Normal"
+    assert ok["message"] == "Successfully assigned default/ev-ok to ev-node"
+    assert ok["source"]["component"] == "default-scheduler"
+    fail = next(e for e in by_reason["FailedScheduling"] if e["involvedObject"]["name"] == "ev-fail")
+    assert fail["type"] == "Warning" and "Insufficient" in fail["message"]
+    # the no-op failure dedup also dedups the event: a second identical
+    # round must not append another FailedScheduling
+    n_before = len(store.list("events", "default"))
+    svc.schedule_pending(max_rounds=1)
+    assert len(store.list("events", "default")) == n_before
