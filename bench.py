@@ -398,10 +398,15 @@ def _parse_row(out: str | None, err: str | None, name: str) -> dict:
 
 
 def _probe_devices(timeout_s: float = 60.0) -> list | None:
-    """Enumerate jax devices in a killable subprocess.  Returns the platform
-    list, or None when the probe hung/failed (wedged tunnel)."""
+    """Enumerate jax devices AND run one tiny computation in a killable
+    subprocess.  Returns the platform list, or None when the probe
+    hung/failed.  The compute step matters: a flapping tunnel can answer
+    bare device enumeration yet hang on any sustained traffic (observed
+    live) — gating on real work keeps such a tunnel from luring the
+    sweep into burning every config's full cap."""
     code = (
-        "import jax, json; "
+        "import jax, json; import jax.numpy as jnp; "
+        "jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8))); "
         "print('PROBE:' + json.dumps([d.platform for d in jax.devices()]))"
     )
     out, err = _spawn_raw([sys.executable, "-c", code], timeout_s)
