@@ -14,12 +14,20 @@ extrapolated linearly.  Run with --quick for a smaller sweep.
 
 Wedge-proofing (the TPU here lives behind a tunnel that can hang even
 ``jax.devices()``): the parent process NEVER imports jax.  It first probes
-the device in a killable subprocess (60 s timeout, one retry after a
-backoff), then runs every config in its own subprocess with its own
-timeout, accumulating rows incrementally (stderr progress +
-``BENCH_partial.json``) so one hang costs one config, not the round.  If
-the probe finds no accelerator the sweep still runs, CPU-pinned with the
-tunnel-dialing plugin deregistered, and the rows say so.
+the device in a killable subprocess, then runs every config in its own
+subprocess with its own timeout, accumulating rows incrementally (stderr
+progress + ``BENCH_partial.json``) so one hang costs one config, not the
+round.  If the probe finds no accelerator the sweep still runs, CPU-pinned
+with the tunnel-dialing plugin deregistered — and a background prober
+keeps re-dialing the tunnel in killable subprocesses for the WHOLE budget
+(CPU-pinned children never touch the tunnel, so concurrent probing costs
+no sweep time).  The moment a probe answers, the remaining configs are
+promoted to TPU and, after the sweep, the configs that had run CPU-pinned
+are re-run on TPU in priority order (cfg4 + its warm row first).  Every
+row carries ``kernel_platform`` (the jax backend that executed it) and —
+where parity columns exist — ``oracle_platform: "host-python"`` (the
+sequential oracle is pure-Python arithmetic), so a CPU-pinned run's 100%
+parity can never be misread as float32-on-TPU exactness evidence.
 """
 
 from __future__ import annotations
@@ -253,6 +261,17 @@ def run_config(name, P, N, plugins, spread=False, interpod=False, oracle_sample=
                     max_delta = max(max_delta, delta)
         out["parity_selected_identical_pct"] = round(100.0 * identical / sample, 2)
         out["parity_max_abs_dfinalscore"] = max_delta
+        # honesty columns (VERDICT r4 weak #6): the oracle is pure-Python
+        # host arithmetic; only when the kernel ran on an accelerator do
+        # these parity numbers attest the float32-on-device exactness
+        # story (GCD scaling / ratio forms, ops/batch.py:24-26).
+        out["oracle_platform"] = "host-python"
+        import jax
+
+        if jax.default_backend() == "cpu":
+            out["parity_note"] = (
+                "cpu kernel vs host oracle; float32-on-TPU exactness not exercised by this row"
+            )
     return out
 
 
@@ -371,6 +390,18 @@ def _child_main(name: str, warm: bool, quick: bool) -> None:
         row = {"config": name, "error": f"{type(e).__name__}: {e}"}
         if warm:
             row["warm"] = True
+    if "error" not in row:
+        # attest which backend actually executed this row.  Error rows are
+        # NOT attested: default_backend() initializes jax, and in a
+        # tunnel-env child that failed before any dispatch that init would
+        # dial a possibly-wedged tunnel and turn a fast error into a
+        # full-cap hang that masks the real failure.
+        try:
+            import jax
+
+            row.setdefault("kernel_platform", jax.default_backend())
+        except Exception:
+            pass
     print("ROW:" + json.dumps(row), flush=True)
 
 
@@ -397,7 +428,7 @@ def _parse_row(out: str | None, err: str | None, name: str) -> dict:
     return {"config": name, "error": err or "child produced no ROW line"}
 
 
-def _probe_devices(timeout_s: float = 60.0) -> list | None:
+def _probe_devices(timeout_s: float = 60.0, on_spawn=None) -> list | None:
     """Enumerate jax devices AND run one tiny computation in a killable
     subprocess.  Returns the platform list, or None when the probe
     hung/failed.  The compute step matters: a flapping tunnel can answer
@@ -409,7 +440,7 @@ def _probe_devices(timeout_s: float = 60.0) -> list | None:
         "jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8))); "
         "print('PROBE:' + json.dumps([d.platform for d in jax.devices()]))"
     )
-    out, err = _spawn_raw([sys.executable, "-c", code], timeout_s)
+    out, err = _spawn_raw([sys.executable, "-c", code], timeout_s, on_spawn=on_spawn)
     if out:
         for line in out.splitlines():
             if line.startswith("PROBE:"):
@@ -420,7 +451,7 @@ def _probe_devices(timeout_s: float = 60.0) -> list | None:
     return None
 
 
-def _spawn_raw(cmd: list[str], timeout_s: float, env: dict | None = None, stderr=subprocess.DEVNULL):
+def _spawn_raw(cmd: list[str], timeout_s: float, env: dict | None = None, stderr=subprocess.DEVNULL, on_spawn=None):
     import signal
 
     proc = subprocess.Popen(
@@ -431,6 +462,8 @@ def _spawn_raw(cmd: list[str], timeout_s: float, env: dict | None = None, stderr
         start_new_session=True,
         text=True,
     )
+    if on_spawn is not None:
+        on_spawn(proc)
     try:
         out, _ = proc.communicate(timeout=timeout_s)
         return out, None
@@ -459,6 +492,72 @@ def _cpu_pinned_env() -> dict:
     return env
 
 
+class _TunnelProber:
+    """Background tunnel re-prober (VERDICT r4 weak #1: the old policy
+    probed twice at sweep start and never again, so a tunnel that
+    recovered 2 minutes into a ~900 s budget was never asked).  Runs
+    killable probe subprocesses back-to-back with a short gap for the
+    whole budget, CONCURRENTLY with the CPU-pinned sweep — CPU-pinned
+    children have the axon plugin stripped and cannot dial the tunnel,
+    so this costs zero sweep time.  Sets ``platforms`` on the first
+    probe that reports a non-cpu backend."""
+
+    def __init__(self, probe_cap_s: float = 45.0, gap_s: float = 15.0):
+        import threading
+
+        self.probe_cap_s = probe_cap_s
+        self.gap_s = gap_s
+        self.platforms: list | None = None
+        self.attempts = 0
+        self.started_at = time.monotonic()
+        self.recovered_after_s: float | None = None
+        self._stop = threading.Event()
+        self._proc = None  # in-flight probe child (killed by stop())
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> "_TunnelProber":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        def hold(proc) -> None:
+            self._proc = proc
+
+        while not self._stop.is_set():
+            self.attempts += 1
+            platforms = _probe_devices(self.probe_cap_s, on_spawn=hold)
+            self._proc = None
+            if platforms and any(p != "cpu" for p in platforms):
+                # recovered_after_s first: readers poll `platforms`, and
+                # summary() formats recovered_after_s once it's set
+                self.recovered_after_s = time.monotonic() - self.started_at
+                self.platforms = platforms
+                return
+            self._stop.wait(self.gap_s)
+
+    def stop(self) -> None:
+        """Stop the loop AND kill any in-flight probe child: the prober is
+        a daemon thread, so at interpreter exit its blocking communicate()
+        dies without firing the timeout killpg — without this, a probe
+        hung on a wedged tunnel (started in its own session) would outlive
+        the bench, leaking one wedged process per round."""
+        self._stop.set()
+        proc = self._proc
+        if proc is not None and proc.poll() is None:
+            import signal
+
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+
+    def summary(self) -> str:
+        dt = time.monotonic() - self.started_at
+        if self.platforms:
+            return f"tunnel answered probe #{self.attempts} at T+{self.recovered_after_s:.0f}s: {self.platforms}"
+        return f"{self.attempts} spaced probes over {dt:.0f}s, tunnel never answered"
+
+
 RESULTS: list = []  # accumulated config rows (watchdog reads them)
 
 
@@ -473,8 +572,13 @@ def _note_progress(msg: str) -> None:
 
 def _emit_line(results: list) -> None:
     # the north-star claim is ONLY about the 10k×5k config; a smaller
-    # config standing in for the headline row must not inherit it
-    star = next((r for r in results if r.get("config") == "cfg4-interpod" and "wall_s" in r), None)
+    # config standing in for the headline row must not inherit it.  When a
+    # config ran both CPU-pinned and TPU-promoted, the accelerator row is
+    # the headline (the north star is a TPU claim).
+    cfg4_rows = [r for r in results if r.get("config") == "cfg4-interpod" and "wall_s" in r]
+    star = next((r for r in cfg4_rows if r.get("kernel_platform") not in (None, "cpu")), None) or (
+        cfg4_rows[0] if cfg4_rows else None
+    )
     headline = star or next((r for r in reversed(results) if "pods_nodes_per_s" in r), {})
     # name the config the value actually came from — a smaller fallback row
     # must not report under the 10k×5k label
@@ -490,7 +594,15 @@ def _emit_line(results: list) -> None:
         "north_star": {
             "target": "10k pods x 5k nodes scored in <1 s on one TPU chip",
             "wall_s": star.get("wall_s") if star else None,
-            "met": bool(star and star.get("wall_s") and star["wall_s"] < 1.0),
+            "platform": star.get("kernel_platform") if star else None,
+            # a sub-1s CPU row would still not be the claim — "met" is
+            # strictly wall<1s on an accelerator backend
+            "met": bool(
+                star
+                and star.get("wall_s")
+                and star["wall_s"] < 1.0
+                and star.get("kernel_platform") not in (None, "cpu")
+            ),
         },
         "configs": results,
     }
@@ -530,34 +642,42 @@ def main() -> None:
     _start_watchdog(budget_s + 10)
 
     # --- preflight: find the device without letting a wedged tunnel eat
-    # the whole budget.  One retry after a backoff, then CPU fallback.
+    # the whole budget.  One inline probe; on failure the sweep starts
+    # CPU-pinned IMMEDIATELY and a background prober keeps re-dialing the
+    # tunnel for the rest of the budget (see _TunnelProber).
     # KSS_BENCH_FORCE_CPU=1 skips the probes outright (dev shells, the
     # harness's own tests).
     child_env = dict(os.environ)
     platform_note = None
+    prober: _TunnelProber | None = None
+    on_tpu = False
     if os.environ.get("KSS_BENCH_FORCE_CPU") == "1":
         platform_note = "KSS_BENCH_FORCE_CPU=1; sweep ran CPU-pinned"
         _note_progress(platform_note)
         child_env = _cpu_pinned_env()
     else:
         platforms = _probe_devices(60.0)
-        if platforms is None:
-            _note_progress("device probe hung/failed; retrying in 20s")
-            time.sleep(20.0)
-            platforms = _probe_devices(60.0)
-        if platforms is None:
-            platform_note = "tpu tunnel unresponsive after 2 probes; sweep ran CPU-pinned"
+        if platforms and any(p != "cpu" for p in platforms):
+            on_tpu = True
+            _note_progress(f"devices: {platforms}")
+        else:
+            platform_note = (
+                "jax reports cpu only at T+0; sweep started CPU-pinned"
+                if platforms
+                else "tpu tunnel unresponsive at T+0; sweep started CPU-pinned"
+            ) + " (background prober continues)"
             _note_progress(platform_note)
             child_env = _cpu_pinned_env()
-        else:
-            _note_progress(f"devices: {platforms}")
+            prober = _TunnelProber().start()
 
     def remaining() -> float:
         return deadline - time.monotonic()
 
     consec_timeouts = 0
+    wedged_midsweep = False
+    prober_history: list[str] = []
 
-    def run_one(name: str, cap: float, warm: bool = False) -> bool:
+    def run_one(name: str, cap: float, warm: bool = False, env_override: dict | None = None) -> bool:
         """Run one config child; returns True when it TIMED OUT."""
         nonlocal consec_timeouts
         cap = min(cap, remaining() - 15.0)
@@ -567,16 +687,22 @@ def main() -> None:
             _note_progress(f"{label} skipped (budget exhausted)")
             return False
         argv = ["--one", name] + (["--warm"] if warm else []) + (["--quick"] if args.quick else [])
-        env = dict(child_env)
+        env = dict(env_override if env_override is not None else child_env)
         if name == "cfg5-churn-default-profile":
             env["KSS_CFG5_BUDGET_S"] = str(max(60.0, cap - 60.0))
         t0 = time.monotonic()
         out, err = _spawn(argv, cap, env)
         row = _parse_row(out, err, name)
         if warm and "error" not in row:
-            # merge warm_compile_s into the existing config row
+            # merge warm_compile_s into the existing config row — the one
+            # measured on the SAME backend (a TPU warm number must not
+            # land on a CPU-pinned row)
             for r in RESULTS:
-                if r.get("config") == name and "wall_s" in r:
+                if (
+                    r.get("config") == name
+                    and "wall_s" in r
+                    and r.get("kernel_platform") == row.get("kernel_platform")
+                ):
                     r["warm_compile_s"] = row.get("warm_compile_s")
                     break
             else:
@@ -591,52 +717,155 @@ def main() -> None:
                           else f"warm_compile={row.get('warm_compile_s')}s" if "warm_compile_s" in row
                           else row.get("error", "?")))
         timed_out = bool(err)
+        if timed_out:
+            # a timeout while dialing the tunnel is worth a CPU-pinned
+            # retry; a timeout that happened ALREADY CPU-pinned is not —
+            # the retry would just time out again (same env, same cap)
+            row["timed_out_env"] = (
+                "cpu-pinned" if env.get("JAX_PLATFORMS") == "cpu" else "tunnel"
+            )
         consec_timeouts = consec_timeouts + 1 if timed_out else 0
         return timed_out
 
     def maybe_midsweep_fallback() -> None:
         """A tunnel that wedges AFTER a good probe makes every later child
         redial it and burn its full cap — after 2 consecutive timeouts,
-        pin the remaining children to CPU like the probe-failure path."""
-        nonlocal child_env, platform_note
-        if platform_note is None and consec_timeouts >= 2:
-            platform_note = "tpu tunnel wedged mid-sweep (2 consecutive timeouts); remaining configs ran CPU-pinned"
-            _note_progress(platform_note)
+        pin the remaining children to CPU like the probe-failure path
+        (and start the background prober: the tunnel may come back)."""
+        nonlocal child_env, platform_note, on_tpu, prober, wedged_midsweep
+        if on_tpu and consec_timeouts >= 2:
+            wedged_midsweep = True
+            note = "tpu tunnel wedged mid-sweep (2 consecutive timeouts); remaining configs ran CPU-pinned"
+            # append — the T+0 outage / earlier-recovery history must
+            # survive into the emitted platform-note row
+            platform_note = ((platform_note + "; ") if platform_note else "") + note
+            _note_progress(note)
             child_env = _cpu_pinned_env()
+            on_tpu = False
+            if prober is None or prober.platforms:
+                if prober is not None and prober.platforms:
+                    prober_history.append(prober.summary())
+                prober = _TunnelProber().start()
+
+    def maybe_promote() -> None:
+        """The background prober got an answer: un-pin the remaining
+        children so they run on the recovered TPU."""
+        nonlocal child_env, platform_note, on_tpu, consec_timeouts
+        if not on_tpu and prober and prober.platforms:
+            on_tpu = True
+            consec_timeouts = 0
+            child_env = dict(os.environ)
+            platform_note = (platform_note or "") + f"; recovered: {prober.summary()}"
+            _note_progress(f"tunnel recovered ({prober.summary()}); promoting remaining configs to TPU")
+
+    def has_tpu_row(name: str, warm: bool) -> bool:
+        for r in RESULTS:
+            if r.get("config") != name or r.get("kernel_platform") in (None, "cpu"):
+                continue
+            if ("warm_compile_s" in r) if warm else ("wall_s" in r):
+                return True
+        return False
+
+    def tpu_promotion_pass() -> None:
+        """Post-sweep: re-run the configs that executed CPU-pinned on the
+        recovered TPU, highest-value first (the north star is cfg4; one
+        warm row proves the persistent-cache path).  CPU rows are kept —
+        the TPU reruns land as additional rows tagged tpu-promoted."""
+        priority: list[tuple[str, bool]] = [
+            ("cfg4-interpod", False),
+            ("cfg4-interpod", True),
+            ("cfg2-fit-taint-aff", False),
+            ("cfg3-spread", False),
+            ("cfg2-fit-taint-aff", True),
+            ("cfg3-spread", True),
+            ("cfg5-churn-default-profile", False),
+        ]
+        for name, warm in priority:
+            if remaining() < 60.0:
+                break
+            if has_tpu_row(name, warm):
+                continue
+            if warm and not has_tpu_row(name, False):
+                continue  # warm proof needs the cache its cold sibling populates
+            before = len(RESULTS)
+            run_one(name, WARM_CAP_S if warm else CHILD_CAP_S.get(name, 180.0), warm=warm)
+            for r in RESULTS[before:]:
+                if "error" not in r:
+                    r["note"] = (r.get("note", "") + "; " if r.get("note") else "") + "tpu-promoted rerun"
+            if consec_timeouts >= 2:
+                break  # it wedged again; don't burn the rest of the budget
 
     if args.quick:
         run_one("cfg1-fit", CHILD_CAP_S["cfg1-fit"])
     else:
         for name in CONFIGS:
+            maybe_promote()
             run_one(name, CHILD_CAP_S[name])
             maybe_midsweep_fallback()
+        maybe_promote()
         run_one("cfg5-churn-default-profile", CHILD_CAP_S["cfg5-churn-default-profile"])
+        maybe_midsweep_fallback()
         # warm-start compile proof (VERDICT r3 #6): a SECOND process per
         # config hits the persistent XLA cache populated by the run above.
         # Meaningless on the CPU-fallback path, where CPU AOT persistence
         # is deliberately disabled — a "warm" child there would measure a
         # cold recompile and misreport it as cache-read proof.
-        if platform_note is None:
+        if on_tpu:
             for name in ("cfg2-fit-taint-aff", "cfg3-spread", "cfg4-interpod"):
-                run_one(name, WARM_CAP_S, warm=True)
-        else:
-            # configs that burned their cap dialing the dead tunnel BEFORE
-            # the fallback engaged get a CPU-pinned retry with what's left
-            timed_out = [
+                # only where the cold sibling ran on TPU and populated the
+                # persistent cache — after a mid-sweep promotion the
+                # earlier configs ran CPU-pinned (with CPU AOT persistence
+                # off), so a "warm" child there would measure a cold TPU
+                # compile and misreport it; those go through
+                # tpu_promotion_pass in cold-then-warm order instead
+                if has_tpu_row(name, warm=False):
+                    run_one(name, WARM_CAP_S, warm=True)
+        # configs that burned their cap dialing a wedged tunnel never
+        # produced a row — CPU-pinned retry with what's left.  Gated on
+        # the mid-sweep fallback having actually engaged: a lone timeout
+        # on a healthy TPU is genuine slowness, and a CPU rerun would
+        # burn the promotion window's budget and erase the evidence.
+        # Timeouts that were ALREADY CPU-pinned are excluded for the same
+        # reason (same env + same cap would just time out again).  And if
+        # the prober has ALREADY recovered the tunnel, skip CPU retries
+        # entirely — the TPU promotion pass below re-runs those configs
+        # on the recovered device, which is strictly better evidence.
+        maybe_promote()
+        timed_out = (
+            [
                 r["config"]
                 for r in list(RESULTS)
-                if "timeout" in str(r.get("error", "")) and not r.get("warm")
+                if "timeout" in str(r.get("error", ""))
+                and not r.get("warm")
+                and r.get("timed_out_env") == "tunnel"
             ]
-            for name in timed_out:
-                if remaining() < 60.0:
-                    break
-                prev = next(r for r in RESULTS if r.get("config") == name and "error" in r)
-                run_one(name, CHILD_CAP_S.get(name, 180.0))
-                if "error" not in RESULTS[-1]:
-                    RESULTS.remove(prev)
-                    RESULTS[-1]["note"] = "cpu-pinned retry after tpu timeout"
-                else:
-                    RESULTS.pop()  # keep the original timeout row only
+            if wedged_midsweep and not (prober and prober.platforms)
+            else []
+        )
+        for name in timed_out:
+            if remaining() < 60.0:
+                break
+            prev = next(r for r in RESULTS if r.get("config") == name and "error" in r)
+            run_one(name, CHILD_CAP_S.get(name, 180.0), env_override=_cpu_pinned_env())
+            if "error" not in RESULTS[-1]:
+                RESULTS.remove(prev)
+                RESULTS[-1]["note"] = "cpu-pinned retry after tpu timeout"
+            else:
+                RESULTS.pop()  # keep the original timeout row only
+        # spaced re-probing across the WHOLE budget (VERDICT r4 next #1):
+        # if the sweep finished CPU-pinned with budget to spare, sit on
+        # the prober and promote the moment the tunnel answers.
+        if not on_tpu and prober is not None:
+            while not prober.platforms and remaining() > 120.0:
+                time.sleep(5.0)
+            maybe_promote()
+        if on_tpu and prober is not None and prober.platforms:
+            tpu_promotion_pass()
+    if prober is not None:
+        prober.stop()
+        RESULTS.append(
+            {"config": "prober-note", "note": "; then ".join(prober_history + [prober.summary()])}
+        )
     if platform_note:
         RESULTS.append({"config": "platform-note", "note": platform_note})
     _emit_line(RESULTS)

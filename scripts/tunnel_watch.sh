@@ -1,0 +1,17 @@
+#!/bin/bash
+# Probe the TPU tunnel every ~10 minutes; log liveness to /tmp/tunnel_watch.log.
+# Each probe is a fresh subprocess so a wedged client can't poison the loop.
+LOG=/tmp/tunnel_watch.log
+PY=${PYTHON:-python3}
+while true; do
+  ts=$(date -u +%H:%M:%S)
+  out=$(timeout -k 10 120 "$PY" -c "
+import os
+os.environ['JAX_PLATFORM_NAME']='tpu'
+import jax, jax.numpy as jnp
+print('OK', jax.devices(), float(jnp.ones((128,128)).sum()), flush=True)
+" 2>&1 | tail -1)
+  echo "$ts $out" >> "$LOG"
+  tail -n 200 "$LOG" > "$LOG.tmp" && mv "$LOG.tmp" "$LOG"
+  sleep 600
+done

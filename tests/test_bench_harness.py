@@ -40,5 +40,51 @@ def test_quick_sweep_emits_one_json_line_with_rows():
     assert any(r.get("note", "").startswith("KSS_BENCH_FORCE_CPU") for r in doc["configs"])
     # quick/CPU runs must not claim the TPU north star
     assert doc["north_star"]["met"] is False
+    # platform honesty columns (VERDICT r4 weak #6): every executed row
+    # says which backend ran the kernel, parity rows say the oracle is
+    # host arithmetic, and a cpu-kernel parity row carries the caveat
+    assert cfg1["kernel_platform"] == "cpu"
+    assert cfg1["oracle_platform"] == "host-python"
+    assert "float32-on-TPU exactness" in cfg1["parity_note"]
     # incremental partial file was written alongside
     assert os.path.exists(os.path.join(os.path.dirname(BENCH), "BENCH_partial.json"))
+
+
+def _load_bench_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_under_test", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_tunnel_prober_recovers_and_reports(monkeypatch):
+    """The background prober (VERDICT r4 weak #1) keeps re-dialing for the
+    whole budget and flips to recovered the first time a non-cpu backend
+    answers — cpu-only answers must NOT count as recovery."""
+    import time as _time
+
+    bench = _load_bench_module()
+    answers = iter([None, ["cpu"], ["cpu", "tpu"]])
+    monkeypatch.setattr(bench, "_probe_devices", lambda cap, **kw: next(answers))
+    prober = bench._TunnelProber(probe_cap_s=0.01, gap_s=0.01).start()
+    deadline = _time.monotonic() + 5.0
+    while prober.platforms is None and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    assert prober.platforms == ["cpu", "tpu"]
+    assert prober.attempts == 3
+    assert "tunnel answered probe #3" in prober.summary()
+
+
+def test_tunnel_prober_never_answers(monkeypatch):
+    bench = _load_bench_module()
+    monkeypatch.setattr(bench, "_probe_devices", lambda cap, **kw: None)
+    prober = bench._TunnelProber(probe_cap_s=0.01, gap_s=0.01).start()
+    import time as _time
+
+    _time.sleep(0.2)
+    prober.stop()
+    assert prober.platforms is None
+    assert prober.attempts >= 2
+    assert "never answered" in prober.summary()
