@@ -26,81 +26,45 @@
 /* ------------------------------------------------------------------ buf */
 
 typedef struct {
+    PyObject *obj; /* the ascii PyUnicode the bytes are built INTO */
     char *p;
     Py_ssize_t len;
     Py_ssize_t cap;
     int nonascii; /* any byte >= 0x80 written (tracked per source str) */
-    int slot;     /* scratch-pool slot, or -1 for a plain malloc */
 } Buf;
 
-/* Grow-only scratch arenas reused across calls (GIL-serialized; at most
- * two Bufs are live at once — the *_pair functions).  At churn-bench
- * scale every call otherwise mallocs and frees a megabyte-class temp
- * buffer interleaved with the long-lived result strings, and glibc's
- * large-bin management of that mix turns each allocation into a
- * list-walk with multi-ms tails (measured 30-100 ms worst-case per
- * history_append at 2000-pod waves).  Reusing hot buffers removes the
- * churn entirely; only the final PyUnicode results touch malloc. */
-#define POOL_N 4
-static char *pool_p[POOL_N];
-static Py_ssize_t pool_cap[POOL_N];
-static unsigned char pool_used[POOL_N];
-
+/* The result PyUnicode is allocated up front and assembled IN PLACE — a
+ * megabyte-class result never pays a scratch->result memcpy, and because
+ * the only large allocation per call is the long-lived result itself
+ * (no temp buffer freed right after), glibc's large-bin churn from
+ * interleaved MB malloc/free (measured 30-100 ms tails per call in the
+ * scratch-buffer design this replaces) cannot occur.  The object is a
+ * compact ASCII str used as a byte arena; buf_take resizes it down to
+ * the written length (refcount 1, so PyUnicode_Resize reallocs — a
+ * shrink is in-place for glibc's large chunks) or, when non-ASCII bytes
+ * were written, decodes the arena as UTF-8 into the real result (rare:
+ * non-ASCII node names/messages). */
 static int buf_init(Buf *b, Py_ssize_t cap) {
-    int i;
-    if (cap < 256) cap = 256;
+    if (cap < 64) cap = 64;
+    b->obj = PyUnicode_New(cap, 127);
+    if (!b->obj) return -1;
+    b->p = (char *)PyUnicode_DATA(b->obj);
     b->len = 0;
-    b->nonascii = 0;
-    for (i = 0; i < POOL_N; i++) {
-        if (!pool_used[i] && pool_p[i] && pool_cap[i] >= cap) {
-            pool_used[i] = 1;
-            b->p = pool_p[i];
-            b->cap = pool_cap[i];
-            b->slot = i;
-            return 0;
-        }
-    }
-    for (i = 0; i < POOL_N; i++) {
-        if (!pool_used[i]) {
-            char *np = pool_p[i] ? (char *)PyMem_Realloc(pool_p[i], cap)
-                                 : (char *)PyMem_Malloc(cap);
-            if (!np) { PyErr_NoMemory(); return -1; }
-            pool_p[i] = np;
-            pool_cap[i] = cap;
-            pool_used[i] = 1;
-            b->p = np;
-            b->cap = cap;
-            b->slot = i;
-            return 0;
-        }
-    }
-    b->p = (char *)PyMem_Malloc(cap);
-    if (!b->p) { PyErr_NoMemory(); return -1; }
     b->cap = cap;
-    b->slot = -1;
+    b->nonascii = 0;
     return 0;
 }
 
 static void buf_release(Buf *b) {
-    if (!b->p) return;
-    if (b->slot >= 0) {
-        /* hand the (possibly grown) buffer back to its slot */
-        pool_p[b->slot] = b->p;
-        pool_cap[b->slot] = b->cap;
-        pool_used[b->slot] = 0;
-    } else {
-        PyMem_Free(b->p);
-    }
+    Py_CLEAR(b->obj);
     b->p = NULL;
-    b->slot = -1;
 }
 
 static int buf_grow(Buf *b, Py_ssize_t need) {
     Py_ssize_t cap = b->cap;
     while (cap - b->len < need) cap += cap >> 1;
-    char *np = (char *)PyMem_Realloc(b->p, cap);
-    if (!np) { PyErr_NoMemory(); return -1; }
-    b->p = np;
+    if (PyUnicode_Resize(&b->obj, cap) < 0) return -1;
+    b->p = (char *)PyUnicode_DATA(b->obj);
     b->cap = cap;
     return 0;
 }
@@ -121,13 +85,20 @@ static inline int buf_putc(Buf *b, char c) {
 static PyObject *buf_take(Buf *b) {
     PyObject *r;
     if (!b->nonascii) {
-        /* pure-ASCII output (the overwhelming case): build the str by
-         * memcpy instead of a validating UTF-8 decode pass */
-        r = PyUnicode_New(b->len, 127);
-        if (r) memcpy(PyUnicode_DATA(r), b->p, (size_t)b->len);
-    } else {
-        r = PyUnicode_DecodeUTF8(b->p, b->len, "strict");
+        /* pure-ASCII output (the overwhelming case): the result IS the
+         * arena, trimmed to length — no copy */
+        if (b->len != PyUnicode_GET_LENGTH(b->obj) &&
+            PyUnicode_Resize(&b->obj, b->len) < 0) {
+            Py_CLEAR(b->obj);
+            return NULL;
+        }
+        ((char *)PyUnicode_DATA(b->obj))[b->len] = 0;
+        r = b->obj;
+        b->obj = NULL;
+        b->p = NULL;
+        return r;
     }
+    r = PyUnicode_DecodeUTF8(b->p, b->len, "strict");
     buf_release(b);
     return r;
 }
@@ -435,12 +406,22 @@ static PyObject *py_filter_json(PyObject *self, PyObject *args) {
             over_idx[id] = (int)u;
         }
     }
-    if (buf_init(&b, 256 + T * 32) < 0) goto done;
-    be.p = NULL;
-    be.slot = -1;
-    if (pair && buf_init(&be, 256 + T * 32) < 0) {
-        buf_release(&b);
-        goto done;
+    {
+        /* size hint from the actual emit count x a real pass entry —
+         * an undersized hint costs megabyte-class realloc copies here */
+        Py_ssize_t per = 64;
+        Py_ssize_t emit = proc < n_true ? proc : n_true;
+        if (n_true > 0 && PyList_GET_SIZE(pass_arr) > 0) {
+            PyObject *p0 = PyList_GET_ITEM(pass_arr, 0);
+            if (PyUnicode_Check(p0)) per = PyUnicode_GET_LENGTH(p0) + 16;
+        }
+        if (buf_init(&b, 256 + emit * per) < 0) goto done;
+        be.obj = NULL;
+        be.p = NULL;
+        if (pair && buf_init(&be, 256 + emit * (per + (per >> 2))) < 0) {
+            buf_release(&b);
+            goto done;
+        }
     }
     if (buf_putc(&b, '{') < 0 || (pair && buf_putc(&be, '{') < 0)) goto fail;
     for (t = 0; t < T; t++) {
@@ -702,6 +683,231 @@ fail:
     return NULL;
 }
 
+/* ------------------------------------------------- lazy history assembly */
+
+/* Emit the history-escaped body of a filter annotation STRAIGHT into the
+ * trail buffer from the per-round escaped fragments — byte-identical to
+ * escape_body(filter_json(...plain...)) and to filter_json's pair-mode
+ * twin, but the twin never exists as its own string.  args (after the
+ * "filter" tag): (key_escs, pass_esc, order_i64, start, proc, n_true,
+ * fail_ids|None, fail_uidx|None, etable). */
+static int emit_filter_esc(Buf *b, PyObject *args) {
+    PyObject *key_escs, *pass_esc, *order_o, *fail_ids_o, *fail_uidx_o, *etable;
+    long long start, proc, n_true;
+    Py_buffer order_v = {0}, ids_v = {0}, uidx_v = {0};
+    const long long *order = NULL, *fail_ids = NULL, *fail_uidx = NULL;
+    Py_ssize_t T = 0, NF = 0, NF2 = 0, TBL = 0, t;
+    int *over_idx = NULL;
+    int first = 1, rc = -1;
+    if (!PyArg_ParseTuple(args, "OOOLLLOOO", &key_escs, &pass_esc, &order_o,
+                          &start, &proc, &n_true, &fail_ids_o, &fail_uidx_o, &etable))
+        return -1;
+    if (!PyList_Check(key_escs) || !PyList_Check(pass_esc) || !PyList_Check(etable) ||
+        n_true < 0 || PyList_GET_SIZE(key_escs) < n_true || PyList_GET_SIZE(pass_esc) < n_true) {
+        PyErr_SetString(PyExc_TypeError, "filter esc spec: bad arguments");
+        return -1;
+    }
+    if (get_i64(order_o, &order_v, &order, &T) < 0) return -1;
+    if (get_i64(fail_ids_o, &ids_v, &fail_ids, &NF) < 0) goto done;
+    if (get_i64(fail_uidx_o, &uidx_v, &fail_uidx, &NF2) < 0) goto done;
+    TBL = PyList_GET_SIZE(etable);
+    if (NF != NF2) {
+        PyErr_SetString(PyExc_ValueError, "filter esc spec: fail length mismatch");
+        goto done;
+    }
+    if (NF > 0) {
+        over_idx = (int *)PyMem_Malloc(sizeof(int) * (size_t)(n_true > 0 ? n_true : 1));
+        if (!over_idx) { PyErr_NoMemory(); goto done; }
+        memset(over_idx, 0xFF, sizeof(int) * (size_t)(n_true > 0 ? n_true : 1));
+        for (t = 0; t < NF; t++) {
+            long long id = fail_ids[t], u = fail_uidx[t];
+            if (id < 0 || id >= n_true || u < 0 || u >= TBL) {
+                PyErr_SetString(PyExc_IndexError, "filter esc spec: fail id out of range");
+                goto done;
+            }
+            over_idx[id] = (int)u;
+        }
+    }
+    if (buf_putc(b, '{') < 0) goto done;
+    for (t = 0; t < T; t++) {
+        long long id = order[t], rank;
+        if (id < 0 || id >= n_true) continue;
+        rank = id - start;
+        if (rank < 0) rank += n_true;
+        if (rank >= proc) continue;
+        if (!first && buf_putc(b, ',') < 0) goto done;
+        first = 0;
+        if (over_idx && over_idx[id] >= 0) {
+            /* failing node: escaped key fragment + distinct-failure entry */
+            if (put_str(b, PyList_GET_ITEM(key_escs, (Py_ssize_t)id)) < 0 ||
+                put_str(b, PyList_GET_ITEM(etable, over_idx[id])) < 0)
+                goto done;
+        } else {
+            /* pass entries already carry their key fragment */
+            if (put_str(b, PyList_GET_ITEM(pass_esc, (Py_ssize_t)id)) < 0) goto done;
+        }
+    }
+    if (buf_putc(b, '}') < 0) goto done;
+    rc = 0;
+done:
+    PyMem_Free(over_idx);
+    if (order_v.obj) PyBuffer_Release(&order_v);
+    if (ids_v.obj) PyBuffer_Release(&ids_v);
+    if (uidx_v.obj) PyBuffer_Release(&uidx_v);
+    return rc;
+}
+
+/* Escaped body of a score/finalScore annotation straight into the trail —
+ * byte-identical to score_json_pair's twin.  args (after the "score"
+ * tag): (keys_esc, frags_esc, rows, perm). */
+static int emit_score_esc(Buf *b, PyObject *args) {
+    PyObject *keys_esc, *frags_esc, *rows, *perm;
+    Py_ssize_t t, k, T, K;
+    if (!PyArg_ParseTuple(args, "OOOO", &keys_esc, &frags_esc, &rows, &perm)) return -1;
+    if (!PyList_Check(keys_esc) || !PyList_Check(frags_esc) || !PyList_Check(rows) ||
+        !PyList_Check(perm)) {
+        PyErr_SetString(PyExc_TypeError, "score esc spec: expected lists");
+        return -1;
+    }
+    T = PyList_GET_SIZE(keys_esc);
+    K = PyList_GET_SIZE(frags_esc);
+    if (PyList_GET_SIZE(perm) != T || PyList_GET_SIZE(rows) != K) {
+        PyErr_SetString(PyExc_ValueError, "score esc spec: length mismatch");
+        return -1;
+    }
+    for (k = 0; k < K; k++) {
+        if (!PyList_Check(PyList_GET_ITEM(rows, k))) {
+            PyErr_SetString(PyExc_TypeError, "score esc spec: rows must be lists");
+            return -1;
+        }
+    }
+    if (buf_putc(b, '{') < 0) return -1;
+    for (t = 0; t < T; t++) {
+        Py_ssize_t j = PyLong_AsSsize_t(PyList_GET_ITEM(perm, t));
+        if (j < 0) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_IndexError, "score esc spec: perm out of range");
+            return -1;
+        }
+        if (t && buf_putc(b, ',') < 0) return -1;
+        if (put_str(b, PyList_GET_ITEM(keys_esc, t)) < 0) return -1;
+        if (buf_putc(b, '{') < 0) return -1;
+        for (k = 0; k < K; k++) {
+            PyObject *row = PyList_GET_ITEM(rows, k);
+            if (j >= PyList_GET_SIZE(row)) {
+                PyErr_SetString(PyExc_IndexError, "score esc spec: perm out of range");
+                return -1;
+            }
+            if (k && buf_putc(b, ',') < 0) return -1;
+            if (put_str(b, PyList_GET_ITEM(frags_esc, k)) < 0) return -1;
+            if (put_str(b, PyList_GET_ITEM(row, j)) < 0) return -1;
+            if (buf_put(b, "\\\"", 2) < 0) return -1;
+        }
+        if (buf_putc(b, '}') < 0) return -1;
+    }
+    return buf_putc(b, '}');
+}
+
+/* history_append2(existing, keys, values, parts) -> str
+ *
+ * Like history_append, but parts[i] may be a DEFERRED escape spec:
+ *   None               -> escape values[i] here (small values)
+ *   str                -> pre-escaped body, copied verbatim
+ *   ("filter", ...)    -> emit the filter twin from per-round fragments
+ *   ("score", ...)     -> emit the score twin from per-round fragments
+ * The megabyte escaped twins are never materialized as their own
+ * strings: their bytes are written exactly once, into the trail. */
+static PyObject *py_history_append2(PyObject *self, PyObject *args) {
+    PyObject *existing, *keys, *values, *parts;
+    Buf b;
+    Py_ssize_t i, n;
+    const char *ex = NULL;
+    Py_ssize_t exn = 0;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OOOO", &existing, &keys, &values, &parts)) return NULL;
+    if (!PyList_Check(keys) || !PyList_Check(values) || !PyList_Check(parts) ||
+        PyList_GET_SIZE(keys) != PyList_GET_SIZE(values) ||
+        PyList_GET_SIZE(parts) != PyList_GET_SIZE(keys)) {
+        PyErr_SetString(PyExc_TypeError, "history_append2(existing, keys, values, parts)");
+        return NULL;
+    }
+    if (existing != Py_None) {
+        if (!PyUnicode_Check(existing)) {
+            PyErr_SetString(PyExc_TypeError, "existing must be str or None");
+            return NULL;
+        }
+        ex = PyUnicode_AsUTF8AndSize(existing, &exn);
+        if (!ex) return NULL;
+        if (exn < 2 || ex[0] != '[' || ex[exn - 1] != ']') {
+            PyErr_SetString(PyExc_ValueError, "existing history is not an array");
+            return NULL;
+        }
+    }
+    n = PyList_GET_SIZE(keys);
+    {
+        /* deferred parts emit ~the plain value's length plus escape
+         * growth — the plain value is in `values` either way */
+        Py_ssize_t hint = exn + 4 + n * 8;
+        for (i = 0; i < n; i++) {
+            PyObject *v = PyList_GET_ITEM(values, i);
+            PyObject *p = PyList_GET_ITEM(parts, i);
+            if (PyUnicode_Check(p)) {
+                hint += PyUnicode_GET_LENGTH(p) + 32;
+            } else if (PyUnicode_Check(v)) {
+                Py_ssize_t L = PyUnicode_GET_LENGTH(v);
+                hint += L + (L >> 2) + 32;
+            }
+        }
+        if (buf_init(&b, hint) < 0) return NULL;
+    }
+    if (existing != Py_None && !PyUnicode_IS_ASCII(existing)) b.nonascii = 1;
+    if (ex && exn > 2) {
+        if (buf_put(&b, ex, exn - 1) < 0) goto fail;
+        if (buf_putc(&b, ',') < 0) goto fail;
+    } else {
+        if (buf_putc(&b, '[') < 0) goto fail;
+    }
+    if (buf_putc(&b, '{') < 0) goto fail;
+    for (i = 0; i < n; i++) {
+        PyObject *p = PyList_GET_ITEM(parts, i);
+        if (i && buf_putc(&b, ',') < 0) goto fail;
+        if (put_str(&b, PyList_GET_ITEM(keys, i)) < 0) goto fail;
+        if (p == Py_None) {
+            if (escape_value(&b, PyList_GET_ITEM(values, i)) < 0) goto fail;
+        } else if (PyUnicode_Check(p)) {
+            if (buf_putc(&b, '"') < 0) goto fail;
+            if (put_str(&b, p) < 0) goto fail;
+            if (buf_putc(&b, '"') < 0) goto fail;
+        } else if (PyTuple_Check(p) && PyTuple_GET_SIZE(p) >= 1 &&
+                   PyUnicode_Check(PyTuple_GET_ITEM(p, 0))) {
+            PyObject *tag = PyTuple_GET_ITEM(p, 0);
+            PyObject *rest = PyTuple_GetSlice(p, 1, PyTuple_GET_SIZE(p));
+            int rc;
+            if (!rest) goto fail;
+            if (buf_putc(&b, '"') < 0) { Py_DECREF(rest); goto fail; }
+            if (PyUnicode_CompareWithASCIIString(tag, "filter") == 0) {
+                rc = emit_filter_esc(&b, rest);
+            } else if (PyUnicode_CompareWithASCIIString(tag, "score") == 0) {
+                rc = emit_score_esc(&b, rest);
+            } else {
+                PyErr_SetString(PyExc_TypeError, "history_append2: unknown deferred tag");
+                rc = -1;
+            }
+            Py_DECREF(rest);
+            if (rc < 0) goto fail;
+            if (buf_putc(&b, '"') < 0) goto fail;
+        } else {
+            PyErr_SetString(PyExc_TypeError, "history_append2: bad part");
+            goto fail;
+        }
+    }
+    if (buf_put(&b, "}]", 2) < 0) goto fail;
+    return buf_take(&b);
+fail:
+    buf_release(&b);
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
     {"escape_string", py_escape_string, METH_O,
      "Go-json string literal for s (gojson.go_string fast path)"},
@@ -711,6 +917,8 @@ static PyMethodDef methods[] = {
      "history entry JSON from ('\"k\":' fragment, value[, escaped]) lists"},
     {"history_append", py_history_append, METH_VARARGS,
      "full new result-history value: trusted splice + new entry, one buffer"},
+    {"history_append2", py_history_append2, METH_VARARGS,
+     "history splice with deferred filter/score twin emission (lazy-esc)"},
     {"score_json", py_score_json, METH_VARARGS,
      "score/finalScore annotation JSON from fragments"},
     {"score_json_pair", py_score_json_pair, METH_VARARGS,

@@ -538,6 +538,7 @@ def build_compact_fn(
     WS: int,
     raw_dtypes: "tuple[str, ...] | None" = None,
     code_max: int = 1 << 30,
+    in_step_ws0: "int | None" = None,
 ):
     """Build the trace-compaction function: reduce the [P,N] trace arrays
     to exactly what the annotation writer reads, and nothing more —
@@ -612,11 +613,27 @@ def build_compact_fn(
         # padded node columns can alias into the rank window when the
         # rotation start is nonzero — they were never really visited
         visited = (rank < out["sample_processed"][:, None]) & (idx < n_true)
+        rows = jnp.arange(P, dtype=jnp.int32)[:, None]
+
+        def partition_ids(mask, Wd):
+            """ids of True entries per row, ascending, padded to width Wd
+            — exactly argsort(where(mask, idx, N+idx))[:, :Wd], but as a
+            cumsum + scatter stable partition: the ids are already
+            sorted, so a comparison sort per row is pure overhead (the
+            two argsorts here were the dominant trace cost on CPU)."""
+            pos = jnp.cumsum(mask.astype(jnp.int32), axis=1) - 1
+            dest = jnp.where(mask & (pos < Wd), pos, Wd)
+            ids = jnp.zeros((P, Wd), dtype=jnp.int32).at[
+                rows, dest
+            ].set(jnp.broadcast_to(idx, mask.shape), mode="drop")
+            cnt = jnp.minimum(pos[:, -1] + 1, Wd)
+            valid = jnp.arange(Wd, dtype=jnp.int32)[None, :] < cnt[:, None]
+            return ids, valid
+
         res = {}
         if cfg.filters:
-            order = jnp.argsort(jnp.where(visited, idx, N + idx), axis=1)[:, :W]
+            order, valid = partition_ids(visited, W)
             take = lambda a: jnp.take_along_axis(a, order, axis=1)
-            valid = take(visited)
             # the step already tracked (first failing filter, code) planes
             plug = jnp.where(valid, take(out["fail_plug"]), -1)
             code = jnp.where(valid, take(out["fail_code"]), 0)
@@ -632,12 +649,21 @@ def build_compact_fn(
             else:
                 res["fail_plug"] = plug.astype(jnp.int8)
                 res["fail_code"] = code.astype(code_dtype)
-        feas = out["feasible"]
-        sorder = jnp.argsort(jnp.where(feas, idx, N + idx), axis=1)[:, :WS]
-        stake = lambda a: jnp.take_along_axis(a, sorder, axis=1)
-        svalid = stake(feas)
-        if not cfg.filters:
-            res["sids"] = jnp.where(svalid, sorder, -1).astype(jnp.int32)
+        if in_step_ws0 is not None:
+            # the scan already compacted score planes to [P, in_step_ws0]
+            # in ascending-id feasible order — just slice to the fetch
+            # width and mask positionally
+            svalid = (
+                jnp.arange(WS, dtype=jnp.int32)[None, :]
+                < out["feasible_count"].astype(jnp.int32)[:, None]
+            )
+            stake = lambda a: a[:, :WS]
+        else:
+            feas = out["feasible"]
+            sorder, svalid = partition_ids(feas, WS)
+            stake = lambda a: jnp.take_along_axis(a, sorder, axis=1)
+            if not cfg.filters:
+                res["sids"] = jnp.where(svalid, sorder, -1).astype(jnp.int32)
         stakem = lambda a: jnp.where(svalid, stake(a), 0)
         for k, (s, _w) in enumerate(cfg.scores):
             fetch_raw, fetch_norm, _host = plan[k]
@@ -769,14 +795,25 @@ def reconstruct_trace(
     return out
 
 
-def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
+def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False, ws0: "int | None" = None):
     """Build the jitted batch scheduling function for a static config/dims.
 
     Returns fn(dp: DeviceProblem) → dict of result arrays.  With
     ``donate``, the DeviceProblem's buffers are donated — the initial
     carry aliases into the scan carry instead of being copied; callers
     must not reuse ``dp`` after the call (BatchEngine builds a fresh one
-    per round)."""
+    per round).
+
+    ``ws0`` (trace mode, sampling on): a STATIC upper bound on per-pod
+    feasible nodes — bucket(sample_k).  When set, the per-step score
+    planes are compacted in the step itself (cumsum + scatter over the
+    feasible mask, ascending node id — the same order the post-pass
+    compaction would produce) so the scan emits [P, ws0] score planes
+    instead of [P, N]: at 10k x 5k with the default profile that is ~10x
+    less trace memory materialized per round, which is the dominant
+    in-context kernel cost on a host where those planes fault fresh
+    pages every round.  Callers must key their fn cache on ws0 (it
+    depends on sample_k, which is otherwise a traced scalar)."""
     P, N, D = dims["P"], dims["N"], dims["D"]
     KC, KS = dims["KC"], dims["KS"]
     KA, KB, KP, KO = dims["KA"], dims["KB"], dims["KP"], dims["KO"]
@@ -1208,12 +1245,27 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
             "sample_processed": processed,
         }
         if cfg.trace:
-            out["feasible"] = sampled
             out["fail_plug"] = fail_plug
             out["fail_code"] = fail_code
-            for n_ in raws:
-                out[f"raw:{n_}"] = raws[n_]
-                out[f"norm:{n_}"] = norms[n_]
+            if ws0 is not None and ws0 < N and cfg.filters:
+                # in-step score compaction: scatter the ≤ sample_k ≤ ws0
+                # feasible nodes' values to [ws0], ascending node id —
+                # byte-identical to the post-pass take_along_axis(sorder)
+                # (same order, same values), emitted at a tenth the size
+                pos_id = jnp.cumsum(sampled.astype(jnp.int32)) - 1
+                dest = jnp.where(sampled & (pos_id < ws0), pos_id, ws0)
+
+                def compact1(v):
+                    return jnp.zeros(ws0, v.dtype).at[dest].set(v, mode="drop")
+
+                for n_ in raws:
+                    out[f"raw:{n_}"] = compact1(raws[n_])
+                    out[f"norm:{n_}"] = compact1(norms[n_])
+            else:
+                out["feasible"] = sampled
+                for n_ in raws:
+                    out[f"raw:{n_}"] = raws[n_]
+                    out[f"norm:{n_}"] = norms[n_]
         return carry, out
 
     def _expand_features(dp: DeviceProblem, dt) -> DeviceProblem:
@@ -1261,6 +1313,15 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False):
             # feasible-window raw extrema (drives raw_dtype_for) plus the
             # global max filter-failure code (drives fail-plane packing)
             feas = ys.get("feasible")
+            if feas is None:
+                # in-step-compacted planes: validity is positional
+                # (column < that pod's feasible count); masked-out
+                # positions contribute 0 to the extrema exactly as the
+                # non-feasible nodes did in the full-width planes
+                feas = (
+                    jnp.arange(ws0, dtype=jnp.int32)[None, :]
+                    < ys["feasible_count"].astype(jnp.int32)[:, None]
+                )
             rows = [
                 jnp.stack(
                     [

@@ -193,12 +193,15 @@ def _entry_json(new_results: dict[str, str], escs: "dict[str, str] | None" = Non
     entry = None
     if _fastjson is not None:
         try:
-            entry = _fastjson.history_entry(frags, vals, esc_list)
+            entry = _fastjson.history_entry(
+                frags, vals, [e if isinstance(e, str) else None for e in esc_list]
+            )
         except UnicodeEncodeError:  # lone surrogates: take the Python path
             entry = None
     if entry is None:
+        # deferred (tuple) twins can't embed here — escape the plain value
         entry = "{" + ",".join(
-            frag + ('"' + e + '"' if e is not None else go_string(v))
+            frag + ('"' + e + '"' if isinstance(e, str) else go_string(v))
             for frag, v, e in zip(frags, vals, esc_list)
         ) + "}"
     return entry
@@ -228,11 +231,14 @@ def _updated_history(
         or (trusted and (existing == "[]" or (existing.startswith("[{") and existing.endswith("}]"))))
     ):
         # one C buffer builds splice + entry together (no intermediate
-        # entry string, no Python concat of the megabyte history); the
-        # megabyte values embed from their pre-escaped twins by memcpy
+        # entry string, no Python concat of the megabyte history).  The
+        # megabyte filter/score values embed from DEFERRED twin specs
+        # (batch engine) — their escaped bytes are emitted here, exactly
+        # once, straight into the trail — or from pre-escaped str twins
+        # where a caller still passes them.
         frags, vals, esc_list = _entry_parts(new_results, escs)
         try:
-            out = _fastjson.history_append(existing or None, frags, vals, esc_list)
+            out = _fastjson.history_append2(existing or None, frags, vals, esc_list)
         except UnicodeEncodeError:
             out = None
         if out is not None:

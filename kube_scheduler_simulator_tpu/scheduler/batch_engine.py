@@ -529,29 +529,29 @@ class BatchResult:
                 etable.append(pair[1])
             fail_ids = idsc
             fail_uidx = inv.astype(np.int64)
+        # plain-only C mode: the twin bytes are never materialized here —
+        # the history writer emits them straight into the trail from the
+        # DEFERRED spec below (native.fastjson.history_append2), so every
+        # escaped byte is written exactly once, into its final string
+        s = fj.filter_json(
+            fr["pass_list"], None, fr["key"], None, fr["order_i64"],
+            start, proc, n_true, fail_ids, fail_uidx, ftable, None,
+        )
         if not want_esc:
-            # plain-only C mode: no twin bytes materialized at all
-            s = fj.filter_json(
-                fr["pass_list"], None, fr["key"], None, fr["order_i64"],
-                start, proc, n_true, fail_ids, fail_uidx, ftable, None,
-            )
             return s, None
-        # pair mode: (plain, escaped) as two true str objects from one C
-        # pass — no wrapper copy on either
-        return fj.filter_json(
-            fr["pass_list"],
-            fr["pass_esc"],
-            fr["key"],
+        deferred = (
+            "filter",
             fr["key_esc"],
+            fr["pass_esc"],
             fr["order_i64"],
             start,
             proc,
             n_true,
             fail_ids,
             fail_uidx,
-            ftable,
             etable,
         )
+        return s, deferred
 
     def _filter_annotation_json_py(self, i: int, tr: dict, fr: dict) -> "str":
         from kube_scheduler_simulator_tpu.utils.gojson import go_marshal
@@ -640,9 +640,19 @@ class BatchResult:
             keys_esc = fr["key_esc_arr"][ns].tolist()
             frags_esc = fr["splug_esc"]
             try:
+                # plain strings here; the escaped twins are DEFERRED — the
+                # history writer emits their bytes straight into the trail
+                # from these specs (history_append2), never as their own
+                # megabyte str objects
                 return (
-                    native.fastjson.score_json_pair(keys, keys_esc, frags, frags_esc, raw_rows, perm),
-                    native.fastjson.score_json_pair(keys, keys_esc, frags, frags_esc, fin_rows, perm),
+                    (
+                        native.fastjson.score_json(keys, frags, raw_rows, perm),
+                        ("score", keys_esc, frags_esc, raw_rows, perm),
+                    ),
+                    (
+                        native.fastjson.score_json(keys, frags, fin_rows, perm),
+                        ("score", keys_esc, frags_esc, fin_rows, perm),
+                    ),
                 )
             except UnicodeEncodeError:
                 pass  # lone surrogates: Python loop below
@@ -1025,6 +1035,16 @@ class BatchEngine:
         # Compile out the sampling machinery when it cannot engage this
         # round (full coverage, no rotation): visit order == index order.
         cfg = self.cfg._replace(sampling=sample_k < len(nodes) or start0 != 0)
+        # In-step score-plane compaction width (see build_batch_fn): static
+        # bucket over sample_k.  Only pays when sampling truly narrows the
+        # feasible set; the fn cache must key on it (sample_k is traced).
+        ws0 = None
+        if self.trace and cfg.sampling and cfg.filters and sample_k < len(nodes):
+            from kube_scheduler_simulator_tpu.ops import encode as E_
+
+            w = min(dims["N"], E_._bucket(max(int(sample_k), 1)))
+            if w < dims["N"]:
+                ws0 = w
         if self.mesh is not None:
             # multi-chip: shard the node axis over the mesh; the jitted
             # computation picks the shardings up from the placed arrays
@@ -1035,7 +1055,12 @@ class BatchEngine:
             # ONE pytree-level H2D transfer — per-field dispatches each
             # pay the full tunnel latency (lower() returns host arrays)
             dp = jax.device_put(dp)
-        key = (tuple(sorted(dims.items())), cfg, id(self.mesh) if self.mesh is not None else None)
+        key = (
+            tuple(sorted(dims.items())),
+            cfg,
+            ws0,
+            id(self.mesh) if self.mesh is not None else None,
+        )
         fn = self._fn_cache.get(key)
         t2 = time.perf_counter()
         if fn is None:
@@ -1043,7 +1068,7 @@ class BatchEngine:
             # buffers can alias into the scan carry instead of being
             # copied; mesh: no donation (sharded carries would need
             # matching output shardings to alias)
-            fn = B.build_batch_fn(cfg, dims, donate=self.mesh is None)
+            fn = B.build_batch_fn(cfg, dims, donate=self.mesh is None, ws0=ws0)
             self._fn_cache[key] = fn
             self.compiles += 1
         out_dev = fn(dp)
@@ -1067,6 +1092,8 @@ class BatchEngine:
             W = min(dims["N"], E._bucket(max(max_processed, 1)))
             max_feasible = int(packed[1].max()) if packed.shape[1] else 1
             WS = min(dims["N"], E._bucket(max(max_feasible, 1)))
+            if ws0 is not None:
+                WS = min(WS, ws0)  # the in-step planes are [P, ws0]
             mm = np.asarray(out_dev["trace_meta"])
             widths = {"int8": 0, "int16": 1, "int32": 2}
             raw_dtypes = []
@@ -1083,11 +1110,16 @@ class BatchEngine:
             ckey = (key, W, WS, raw_dtypes, pack_mode)
             entry = self._compact_cache.get(ckey)
             if entry is None:
-                entry = B.build_compact_fn(cfg, dims, W, WS, raw_dtypes, code_max)
+                entry = B.build_compact_fn(
+                    cfg, dims, W, WS, raw_dtypes, code_max, in_step_ws0=ws0
+                )
                 self._compact_cache[ckey] = entry
                 self.compiles += 1
             cfn, manifest = entry
-            tr_keys = ("sample_start", "sample_processed", "feasible", "fail_plug", "fail_code")
+            tr_keys = (
+                "sample_start", "sample_processed", "feasible",
+                "feasible_count", "fail_plug", "fail_code",
+            )
             blob = cfn(
                 {
                     k: v
