@@ -38,6 +38,7 @@ from urllib.parse import parse_qs, urlparse
 
 from kube_scheduler_simulator_tpu.state.store import (
     AlreadyExistsError,
+    ConflictError,
     NAMESPACED_KINDS,
     NotFoundError,
 )
@@ -469,8 +470,23 @@ def _make_handler(server: KubeAPIServer):
                 body.setdefault("metadata", {}).setdefault("name", rt.name)
                 if rt.namespace:
                     body["metadata"].setdefault("namespace", rt.namespace)
-                updated = store.apply(rt.store_kind, body)
+                if body["metadata"].get("resourceVersion"):
+                    # PUT with a resourceVersion is an optimistic-
+                    # concurrency replace: stale RV must 409 (client-go
+                    # retry.RetryOnConflict depends on it); apply() would
+                    # strip the RV and last-write-win instead
+                    updated = store.update(rt.store_kind, body, owned=True)
+                else:
+                    updated = store.apply(rt.store_kind, body)
                 self._send_json(200, envelope(updated, rt.api_version, rt.kind))
+            except ConflictError as e:
+                # client-go's retry.RetryOnConflict keys on 409 + reason
+                # Conflict (a real apiserver never 400s a stale update)
+                self._status_err(409, "Conflict", str(e))
+            except NotFoundError as e:
+                # replace of a concurrently-deleted object: 404, so
+                # errors.IsNotFound() holds for delete-tolerant updaters
+                self._status_err(404, "NotFound", str(e))
             except Exception as e:
                 self._status_err(400, "BadRequest", f"{type(e).__name__}: {e}")
 
@@ -485,6 +501,8 @@ def _make_handler(server: KubeAPIServer):
                 self._send_json(200, envelope(patched, rt.api_version, rt.kind))
             except NotFoundError as e:
                 self._status_err(404, "NotFound", str(e))
+            except ConflictError as e:
+                self._status_err(409, "Conflict", str(e))
             except Exception as e:
                 self._status_err(400, "BadRequest", f"{type(e).__name__}: {e}")
 
