@@ -44,38 +44,27 @@ import time
 # dev shells).  Do NOT force JAX_PLATFORMS here.
 
 
-def _reexec_with_thp_malloc() -> None:
+def _reexec_with_tuned_malloc() -> None:
     """Re-exec once with tuned malloc (GLIBC_TUNABLES must be set before
-    process start).  Two tunables matter at churn-bench scale:
-    hugetlb=1 (THP-backed heap — the bench holds gigabytes of annotation
-    strings and 2 MB pages cut the TLB pressure that otherwise halves
-    string throughput past ~2 GB of heap, measured ~20% end-to-end on
-    cfg5) and a raised mmap/trim threshold (megabyte-class annotation
-    strings otherwise each take the mmap path: every allocation faults
-    its pages in from zero and every free munmaps them — keeping them on
-    the heap free lists reuses warm pages; measured +33% on the C
-    assembly microbench).  The parent re-execs once and config children
-    inherit the tunables.  THP part skipped when disabled system-wide."""
+    process start).  One tunable pair matters at churn-bench scale: a
+    raised mmap/trim threshold, so megabyte-class annotation strings are
+    served from the heap free lists (warm, already-faulted pages) instead
+    of each taking the mmap path — allocate-fault-zero-munmap per string.
+    Measured on the full 5-wave churn harness: 88s default -> 64s.
+
+    glibc.malloc.hugetlb=1 (used in earlier rounds) is deliberately NOT
+    set: this kernel runs THP defrag=madvise, so MADV_HUGEPAGE faults do
+    DIRECT compaction — at wave 2+ heap sizes (5-10 GB, churned) that
+    compaction dominated system time (measured 13-15s/wave of stime vs
+    1.4s in wave 0; 83s total vs 64s without it)."""
     if os.environ.get("KSS_MALLOC_TUNED") or os.environ.get("KSS_NO_MALLOPT"):
         return
-    thp_ok = True
-    try:
-        with open("/sys/kernel/mm/transparent_hugepage/enabled") as f:
-            if "[never]" in f.read():
-                thp_ok = False
-    except OSError:
-        thp_ok = False
     env = dict(os.environ)
     env["KSS_MALLOC_TUNED"] = "1"
     tun = env.get("GLIBC_TUNABLES", "")
-    add = []
-    if thp_ok and "glibc.malloc.hugetlb" not in tun:
-        add.append("glibc.malloc.hugetlb=1")
     if "glibc.malloc.mmap_threshold" not in tun:
-        add.append("glibc.malloc.mmap_threshold=134217728")
-        add.append("glibc.malloc.trim_threshold=134217728")
-    if add:
-        env["GLIBC_TUNABLES"] = (tun + ":" if tun else "") + ":".join(add)
+        add = "glibc.malloc.mmap_threshold=134217728:glibc.malloc.trim_threshold=134217728"
+        env["GLIBC_TUNABLES"] = (tun + ":" if tun else "") + add
         try:
             os.execve(sys.executable, [sys.executable] + sys.argv, env)
         except OSError:
@@ -309,6 +298,8 @@ def run_churn(P_total=10000, N=5000, waves=5, delete_frac=0.1, budget_s=480.0):
     scheduled = 0
     waves_done = 0
     wave_walls = []
+    wave_device = []
+    wave_commit = []
     device_s = 0.0
     t0 = time.perf_counter()
     for w in range(waves):
@@ -317,13 +308,19 @@ def run_churn(P_total=10000, N=5000, waves=5, delete_frac=0.1, budget_s=480.0):
             created += 1
         tw = time.perf_counter()
         dev_before = svc._batch_engine.cum_timings.get("device_s", 0.0) if svc._batch_engine else 0.0
+        commit_before = svc.stats.get("commit_s", 0.0)
         results = svc.schedule_pending(max_rounds=1)
         wave_walls.append(round(time.perf_counter() - tw, 2))
+        wave_commit.append(round(svc.stats.get("commit_s", 0.0) - commit_before, 2))
         eng = svc._batch_engine
         if eng:
             # cum delta: correct across mid-wave kernel restarts and
             # fallback waves (last_timings would double-count those)
-            device_s += eng.cum_timings.get("device_s", 0.0) - dev_before
+            dev_delta = eng.cum_timings.get("device_s", 0.0) - dev_before
+            device_s += dev_delta
+            wave_device.append(round(dev_delta, 2))
+        else:
+            wave_device.append(0.0)
         scheduled += sum(1 for r in results.values() if r.success)
         waves_done += 1
         if time.perf_counter() - t0 > budget_s and w + 1 < waves:
@@ -340,6 +337,11 @@ def run_churn(P_total=10000, N=5000, waves=5, delete_frac=0.1, budget_s=480.0):
         "waves": waves_done,
         "wall_s": round(wall, 4),
         "wave_walls_s": wave_walls,
+        # per-wave split: device (kernel+fetch) vs host commit (annotation
+        # assembly + result-store writes + history flush); the remainder
+        # of a wave wall is store churn + queue + encode
+        "wave_device_s": wave_device,
+        "wave_commit_s": wave_commit,
         "device_s": round(device_s, 2),
         "scheduled": scheduled,
         "pods_per_s": round(scheduled / wall),
@@ -896,5 +898,5 @@ if __name__ == "__main__":
     # only the bench PROCESS re-execs (importers like the profiling
     # scripts must not be replaced out from under themselves); children
     # inherit the tunable through the parent's env.
-    _reexec_with_thp_malloc()
+    _reexec_with_tuned_malloc()
     sys.exit(main())

@@ -143,9 +143,19 @@ static inline uint64_t swar_special(uint64_t w) {
     return special;
 }
 
-/* append the escaped body (no quotes) of s[0..n) */
-static int escape_into(Buf *b, const char *s, Py_ssize_t n) {
-    Py_ssize_t i = 0;
+/* The escape scan-and-classify pass.  With a buffer, appends the escaped
+ * body (no quotes) of s[0..n); with b==NULL, counts the bytes it WOULD
+ * emit (the exact-size pre-passes).  One function for both so the sizing
+ * can never diverge from the emission.  Returns emitted/counted length,
+ * -1 on error. */
+#define EMIT(lit, len)                                             \
+    do {                                                           \
+        if (b && buf_put(b, (lit), (len)) < 0) return -1;          \
+        out += (len);                                              \
+    } while (0)
+
+static Py_ssize_t escape_core(Buf *b, const char *s, Py_ssize_t n) {
+    Py_ssize_t i = 0, out = 0;
     while (i < n) {
         Py_ssize_t j = i;
         /* wide scan: almost all annotation bytes are plain, and the
@@ -159,40 +169,75 @@ static int escape_into(Buf *b, const char *s, Py_ssize_t n) {
             j += 8;
         }
         while (j < n && plain[(unsigned char)s[j]]) j++;
-        if (j > i && buf_put(b, s + i, j - i) < 0) return -1;
+        if (j > i) {
+            if (b && buf_put(b, s + i, j - i) < 0) return -1;
+            out += j - i;
+        }
         if (j >= n) break;
         unsigned char c = (unsigned char)s[j];
         switch (c) {
-        case '"':  if (buf_put(b, "\\\"", 2) < 0) return -1; break;
-        case '\\': if (buf_put(b, "\\\\", 2) < 0) return -1; break;
-        case '&':  if (buf_put(b, "\\u0026", 6) < 0) return -1; break;
-        case '<':  if (buf_put(b, "\\u003c", 6) < 0) return -1; break;
-        case '>':  if (buf_put(b, "\\u003e", 6) < 0) return -1; break;
+        case '"':  EMIT("\\\"", 2); break;
+        case '\\': EMIT("\\\\", 2); break;
+        case '&':  EMIT("\\u0026", 6); break;
+        case '<':  EMIT("\\u003c", 6); break;
+        case '>':  EMIT("\\u003e", 6); break;
         case 0xE2:
             if (j + 2 < n && (unsigned char)s[j + 1] == 0x80 &&
                 ((unsigned char)s[j + 2] == 0xA8 || (unsigned char)s[j + 2] == 0xA9)) {
-                if (buf_put(b, (unsigned char)s[j + 2] == 0xA8 ? "\\u2028" : "\\u2029", 6) < 0)
-                    return -1;
+                EMIT((unsigned char)s[j + 2] == 0xA8 ? "\\u2028" : "\\u2029", 6);
                 j += 2;
-            } else if (buf_putc(b, (char)c) < 0) return -1;
+            } else {
+                if (b && buf_putc(b, (char)c) < 0) return -1;
+                out += 1;
+            }
             break;
         default: { /* control chars < 0x20: json.dumps emits \b \t \n \f \r
                       for the named ones, \u00XX otherwise */
             char e[6] = {'\\', 'u', '0', '0', HEX[c >> 4], HEX[c & 15]};
             switch (c) {
-            case '\b': if (buf_put(b, "\\b", 2) < 0) return -1; break;
-            case '\t': if (buf_put(b, "\\t", 2) < 0) return -1; break;
-            case '\n': if (buf_put(b, "\\n", 2) < 0) return -1; break;
-            case '\f': if (buf_put(b, "\\f", 2) < 0) return -1; break;
-            case '\r': if (buf_put(b, "\\r", 2) < 0) return -1; break;
-            default:   if (buf_put(b, e, 6) < 0) return -1; break;
+            case '\b': EMIT("\\b", 2); break;
+            case '\t': EMIT("\\t", 2); break;
+            case '\n': EMIT("\\n", 2); break;
+            case '\f': EMIT("\\f", 2); break;
+            case '\r': EMIT("\\r", 2); break;
+            default:   EMIT(e, 6); break;
             }
             break;
         }
         }
         i = j + 1;
     }
-    return 0;
+    return out;
+}
+
+#undef EMIT
+
+static int escape_into(Buf *b, const char *s, Py_ssize_t n) {
+    return escape_core(b, s, n) < 0 ? -1 : 0;
+}
+
+static Py_ssize_t escape_len(const char *s, Py_ssize_t n) {
+    return escape_core(NULL, s, n);
+}
+
+/* exact output length of escape_into(s, n): the ONE scan-and-classify
+ * pass in count mode (escape_core with b==NULL) — the sizing and the
+ * emission can never diverge because they are the same code */
+static Py_ssize_t escape_len(const char *s, Py_ssize_t n);
+
+/* UTF-8 byte length of a str (== char length for the ASCII fast path);
+ * sets TypeError and returns -1 for non-str (every exact-size pre-pass
+ * funnels list elements through here, so a bad element raises instead
+ * of tripping PyUnicode_* assertions) */
+static Py_ssize_t frag_len(PyObject *v) {
+    Py_ssize_t n;
+    if (!PyUnicode_Check(v)) {
+        PyErr_SetString(PyExc_TypeError, "expected str");
+        return -1;
+    }
+    if (PyUnicode_IS_ASCII(v)) return PyUnicode_GET_LENGTH(v);
+    if (!PyUnicode_AsUTF8AndSize(v, &n)) return -1;
+    return n;
 }
 
 static int escape_value(Buf *b, PyObject *v) {
@@ -283,16 +328,32 @@ static PyObject *py_history_entry(PyObject *self, PyObject *args) {
         return NULL;
     }
     n = PyList_GET_SIZE(keys);
-    /* size hint: sum of value lengths + overhead */
+    /* exact size (see filter_json: exact allocations keep glibc's large
+     * bins clean at churn scale) */
     {
-        Py_ssize_t hint = 2 + n * 8;
+        Py_ssize_t sz = 2, l;
         for (i = 0; i < n; i++) {
-            PyObject *v = PyList_GET_ITEM(values, i);
-            if (escs != Py_None && PyList_GET_ITEM(escs, i) != Py_None)
-                v = PyList_GET_ITEM(escs, i);
-            if (PyUnicode_Check(v)) hint += PyUnicode_GET_LENGTH(v) + 32;
+            PyObject *e = escs == Py_None ? Py_None : PyList_GET_ITEM(escs, i);
+            if (i) sz += 1;
+            if ((l = frag_len(PyList_GET_ITEM(keys, i))) < 0) return NULL;
+            sz += l + 2;
+            if (e != Py_None) {
+                if ((l = frag_len(e)) < 0) return NULL;
+                sz += l;
+            } else {
+                PyObject *v = PyList_GET_ITEM(values, i);
+                Py_ssize_t vn;
+                const char *vs;
+                if (!PyUnicode_Check(v)) {
+                    PyErr_SetString(PyExc_TypeError, "expected str");
+                    return NULL;
+                }
+                vs = PyUnicode_AsUTF8AndSize(v, &vn);
+                if (!vs) return NULL;
+                sz += escape_len(vs, vn);
+            }
         }
-        if (buf_init(&b, hint) < 0) return NULL;
+        if (buf_init(&b, sz) < 0) return NULL;
     }
     if (buf_putc(&b, '{') < 0) goto fail;
     for (i = 0; i < n; i++) {
@@ -407,18 +468,48 @@ static PyObject *py_filter_json(PyObject *self, PyObject *args) {
         }
     }
     {
-        /* size hint from the actual emit count x a real pass entry —
-         * an undersized hint costs megabyte-class realloc copies here */
-        Py_ssize_t per = 64;
-        Py_ssize_t emit = proc < n_true ? proc : n_true;
-        if (n_true > 0 && PyList_GET_SIZE(pass_arr) > 0) {
-            PyObject *p0 = PyList_GET_ITEM(pass_arr, 0);
-            if (PyUnicode_Check(p0)) per = PyUnicode_GET_LENGTH(p0) + 16;
+        /* EXACT output size via a metadata-only pre-pass over the same
+         * emit loop.  Exactness matters beyond avoiding realloc copies:
+         * a generous-alloc-then-shrink design frees odd-size tail chunks
+         * into glibc's large bins, and once the churn bench's heap holds
+         * thousands of them every megabyte-class malloc walks the bins
+         * (measured 4-7x slowdown on these functions from wave 1 on);
+         * exact-size allocations recycle cleanly instead. */
+        Py_ssize_t sz = 2, sze = 2, t2, first2 = 1;
+        for (t2 = 0; t2 < T; t2++) {
+            long long id = order[t2], rank;
+            Py_ssize_t l;
+            if (id < 0 || id >= n_true) continue;
+            rank = id - start;
+            if (rank < 0) rank += n_true;
+            if (rank >= proc) continue;
+            if (!first2) { sz += 1; sze += 1; }
+            first2 = 0;
+            if (over_idx && over_idx[id] >= 0) {
+                int u = over_idx[id];
+                if ((l = frag_len(PyList_GET_ITEM(key_frags, (Py_ssize_t)id))) < 0) goto done;
+                sz += l;
+                if ((l = frag_len(PyList_GET_ITEM(ftable, u))) < 0) goto done;
+                sz += l;
+                if (pair) {
+                    if ((l = frag_len(PyList_GET_ITEM(key_escs, (Py_ssize_t)id))) < 0) goto done;
+                    sze += l;
+                    if ((l = frag_len(PyList_GET_ITEM(etable, u))) < 0) goto done;
+                    sze += l;
+                }
+            } else {
+                if ((l = frag_len(PyList_GET_ITEM(pass_arr, (Py_ssize_t)id))) < 0) goto done;
+                sz += l;
+                if (pair) {
+                    if ((l = frag_len(PyList_GET_ITEM(pass_esc, (Py_ssize_t)id))) < 0) goto done;
+                    sze += l;
+                }
+            }
         }
-        if (buf_init(&b, 256 + emit * per) < 0) goto done;
+        if (buf_init(&b, sz) < 0) goto done;
         be.obj = NULL;
         be.p = NULL;
-        if (pair && buf_init(&be, 256 + emit * (per + (per >> 2))) < 0) {
+        if (pair && buf_init(&be, sze) < 0) {
             buf_release(&b);
             goto done;
         }
@@ -501,7 +592,35 @@ static PyObject *py_score_json(PyObject *self, PyObject *args) {
             return NULL;
         }
     }
-    if (buf_init(&b, 2 + T * (24 + K * 24)) < 0) return NULL;
+    {
+        /* exact size (see filter_json: exactness keeps glibc's large
+         * bins clean at churn scale) */
+        Py_ssize_t sz = 2, fixed = 2 + (K > 0 ? K - 1 : 0), l;
+        for (k = 0; k < K; k++) {
+            if ((l = frag_len(PyList_GET_ITEM(frags, k))) < 0) return NULL;
+            fixed += l + 1;
+        }
+        for (t = 0; t < T; t++) {
+            Py_ssize_t j = PyLong_AsSsize_t(PyList_GET_ITEM(perm, t));
+            if (j < 0) {
+                if (!PyErr_Occurred())
+                    PyErr_SetString(PyExc_IndexError, "score_json: perm out of range");
+                return NULL;
+            }
+            if ((l = frag_len(PyList_GET_ITEM(keys, t))) < 0) return NULL;
+            sz += (t ? 1 : 0) + l + fixed;
+            for (k = 0; k < K; k++) {
+                PyObject *row = PyList_GET_ITEM(rows, k);
+                if (j >= PyList_GET_SIZE(row)) {
+                    PyErr_SetString(PyExc_IndexError, "score_json: perm out of range");
+                    return NULL;
+                }
+                if ((l = frag_len(PyList_GET_ITEM(row, j))) < 0) return NULL;
+                sz += l;
+            }
+        }
+        if (buf_init(&b, sz) < 0) return NULL;
+    }
     if (buf_putc(&b, '{') < 0) goto fail;
     for (t = 0; t < T; t++) {
         Py_ssize_t j = PyLong_AsSsize_t(PyList_GET_ITEM(perm, t));
@@ -567,14 +686,34 @@ static PyObject *py_history_append(PyObject *self, PyObject *args) {
     }
     n = PyList_GET_SIZE(keys);
     {
-        Py_ssize_t hint = exn + 4 + n * 8;
+        /* exact size: splice + '{' + entries + "}]" (see filter_json) */
+        Py_ssize_t sz = (ex && exn > 2 ? exn : 1) + 1 + 2, l;
         for (i = 0; i < n; i++) {
-            PyObject *v = PyList_GET_ITEM(values, i);
-            if (escs != Py_None && PyList_GET_ITEM(escs, i) != Py_None)
-                v = PyList_GET_ITEM(escs, i);
-            if (PyUnicode_Check(v)) hint += PyUnicode_GET_LENGTH(v) + 32;
+            PyObject *e = escs == Py_None ? Py_None : PyList_GET_ITEM(escs, i);
+            if (i) sz += 1;
+            if ((l = frag_len(PyList_GET_ITEM(keys, i))) < 0) return NULL;
+            sz += l + 2;
+            if (e != Py_None) {
+                if (!PyUnicode_Check(e)) {
+                    PyErr_SetString(PyExc_TypeError, "escs must be str or None");
+                    return NULL;
+                }
+                if ((l = frag_len(e)) < 0) return NULL;
+                sz += l;
+            } else {
+                PyObject *v = PyList_GET_ITEM(values, i);
+                Py_ssize_t vn;
+                const char *vs;
+                if (!PyUnicode_Check(v)) {
+                    PyErr_SetString(PyExc_TypeError, "expected str");
+                    return NULL;
+                }
+                vs = PyUnicode_AsUTF8AndSize(v, &vn);
+                if (!vs) return NULL;
+                sz += escape_len(vs, vn);
+            }
         }
-        if (buf_init(&b, hint) < 0) return NULL;
+        if (buf_init(&b, sz) < 0) return NULL;
     }
     if (existing != Py_None && !PyUnicode_IS_ASCII(existing)) b.nonascii = 1;
     if (ex && exn > 2) {
@@ -690,8 +829,10 @@ fail:
  * escape_body(filter_json(...plain...)) and to filter_json's pair-mode
  * twin, but the twin never exists as its own string.  args (after the
  * "filter" tag): (key_escs, pass_esc, order_i64, start, proc, n_true,
- * fail_ids|None, fail_uidx|None, etable). */
-static int emit_filter_esc(Buf *b, PyObject *args) {
+ * fail_ids|None, fail_uidx|None, etable).  With b==NULL, computes the
+ * exact emitted size into *size_out instead (used by the caller's
+ * exact-allocation pre-pass). */
+static int emit_filter_esc(Buf *b, PyObject *args, Py_ssize_t *size_out) {
     PyObject *key_escs, *pass_esc, *order_o, *fail_ids_o, *fail_uidx_o, *etable;
     long long start, proc, n_true;
     Py_buffer order_v = {0}, ids_v = {0}, uidx_v = {0};
@@ -728,27 +869,47 @@ static int emit_filter_esc(Buf *b, PyObject *args) {
             over_idx[id] = (int)u;
         }
     }
-    if (buf_putc(b, '{') < 0) goto done;
-    for (t = 0; t < T; t++) {
-        long long id = order[t], rank;
-        if (id < 0 || id >= n_true) continue;
-        rank = id - start;
-        if (rank < 0) rank += n_true;
-        if (rank >= proc) continue;
-        if (!first && buf_putc(b, ',') < 0) goto done;
-        first = 0;
-        if (over_idx && over_idx[id] >= 0) {
-            /* failing node: escaped key fragment + distinct-failure entry */
-            if (put_str(b, PyList_GET_ITEM(key_escs, (Py_ssize_t)id)) < 0 ||
-                put_str(b, PyList_GET_ITEM(etable, over_idx[id])) < 0)
-                goto done;
-        } else {
-            /* pass entries already carry their key fragment */
-            if (put_str(b, PyList_GET_ITEM(pass_esc, (Py_ssize_t)id)) < 0) goto done;
+    {
+        Py_ssize_t sz = 2;
+        if (b && buf_putc(b, '{') < 0) goto done;
+        for (t = 0; t < T; t++) {
+            long long id = order[t], rank;
+            Py_ssize_t l;
+            if (id < 0 || id >= n_true) continue;
+            rank = id - start;
+            if (rank < 0) rank += n_true;
+            if (rank >= proc) continue;
+            if (!first) {
+                if (b && buf_putc(b, ',') < 0) goto done;
+                sz += 1;
+            }
+            first = 0;
+            if (over_idx && over_idx[id] >= 0) {
+                /* failing node: escaped key fragment + distinct entry */
+                if (b) {
+                    if (put_str(b, PyList_GET_ITEM(key_escs, (Py_ssize_t)id)) < 0 ||
+                        put_str(b, PyList_GET_ITEM(etable, over_idx[id])) < 0)
+                        goto done;
+                } else {
+                    if ((l = frag_len(PyList_GET_ITEM(key_escs, (Py_ssize_t)id))) < 0) goto done;
+                    sz += l;
+                    if ((l = frag_len(PyList_GET_ITEM(etable, over_idx[id]))) < 0) goto done;
+                    sz += l;
+                }
+            } else {
+                /* pass entries already carry their key fragment */
+                if (b) {
+                    if (put_str(b, PyList_GET_ITEM(pass_esc, (Py_ssize_t)id)) < 0) goto done;
+                } else {
+                    if ((l = frag_len(PyList_GET_ITEM(pass_esc, (Py_ssize_t)id))) < 0) goto done;
+                    sz += l;
+                }
+            }
         }
+        if (b && buf_putc(b, '}') < 0) goto done;
+        if (size_out) *size_out = sz;
+        rc = 0;
     }
-    if (buf_putc(b, '}') < 0) goto done;
-    rc = 0;
 done:
     PyMem_Free(over_idx);
     if (order_v.obj) PyBuffer_Release(&order_v);
@@ -759,10 +920,11 @@ done:
 
 /* Escaped body of a score/finalScore annotation straight into the trail —
  * byte-identical to score_json_pair's twin.  args (after the "score"
- * tag): (keys_esc, frags_esc, rows, perm). */
-static int emit_score_esc(Buf *b, PyObject *args) {
+ * tag): (keys_esc, frags_esc, rows, perm).  With b==NULL, computes the
+ * exact emitted size into *size_out. */
+static int emit_score_esc(Buf *b, PyObject *args, Py_ssize_t *size_out) {
     PyObject *keys_esc, *frags_esc, *rows, *perm;
-    Py_ssize_t t, k, T, K;
+    Py_ssize_t t, k, T, K, sz = 2, l;
     if (!PyArg_ParseTuple(args, "OOOO", &keys_esc, &frags_esc, &rows, &perm)) return -1;
     if (!PyList_Check(keys_esc) || !PyList_Check(frags_esc) || !PyList_Check(rows) ||
         !PyList_Check(perm)) {
@@ -781,7 +943,7 @@ static int emit_score_esc(Buf *b, PyObject *args) {
             return -1;
         }
     }
-    if (buf_putc(b, '{') < 0) return -1;
+    if (b && buf_putc(b, '{') < 0) return -1;
     for (t = 0; t < T; t++) {
         Py_ssize_t j = PyLong_AsSsize_t(PyList_GET_ITEM(perm, t));
         if (j < 0) {
@@ -789,23 +951,43 @@ static int emit_score_esc(Buf *b, PyObject *args) {
                 PyErr_SetString(PyExc_IndexError, "score esc spec: perm out of range");
             return -1;
         }
-        if (t && buf_putc(b, ',') < 0) return -1;
-        if (put_str(b, PyList_GET_ITEM(keys_esc, t)) < 0) return -1;
-        if (buf_putc(b, '{') < 0) return -1;
+        if (t) {
+            if (b && buf_putc(b, ',') < 0) return -1;
+            sz += 1;
+        }
+        if (b) {
+            if (put_str(b, PyList_GET_ITEM(keys_esc, t)) < 0) return -1;
+            if (buf_putc(b, '{') < 0) return -1;
+        } else {
+            if ((l = frag_len(PyList_GET_ITEM(keys_esc, t))) < 0) return -1;
+            sz += l + 2;
+        }
         for (k = 0; k < K; k++) {
             PyObject *row = PyList_GET_ITEM(rows, k);
             if (j >= PyList_GET_SIZE(row)) {
                 PyErr_SetString(PyExc_IndexError, "score esc spec: perm out of range");
                 return -1;
             }
-            if (k && buf_putc(b, ',') < 0) return -1;
-            if (put_str(b, PyList_GET_ITEM(frags_esc, k)) < 0) return -1;
-            if (put_str(b, PyList_GET_ITEM(row, j)) < 0) return -1;
-            if (buf_put(b, "\\\"", 2) < 0) return -1;
+            if (k) {
+                if (b && buf_putc(b, ',') < 0) return -1;
+                sz += 1;
+            }
+            if (b) {
+                if (put_str(b, PyList_GET_ITEM(frags_esc, k)) < 0) return -1;
+                if (put_str(b, PyList_GET_ITEM(row, j)) < 0) return -1;
+                if (buf_put(b, "\\\"", 2) < 0) return -1;
+            } else {
+                if ((l = frag_len(PyList_GET_ITEM(frags_esc, k))) < 0) return -1;
+                sz += l;
+                if ((l = frag_len(PyList_GET_ITEM(row, j))) < 0) return -1;
+                sz += l + 2;
+            }
         }
-        if (buf_putc(b, '}') < 0) return -1;
+        if (b && buf_putc(b, '}') < 0) return -1;
     }
-    return buf_putc(b, '}');
+    if (b && buf_putc(b, '}') < 0) return -1;
+    if (size_out) *size_out = sz;
+    return 0;
 }
 
 /* history_append2(existing, keys, values, parts) -> str
@@ -845,20 +1027,56 @@ static PyObject *py_history_append2(PyObject *self, PyObject *args) {
     }
     n = PyList_GET_SIZE(keys);
     {
-        /* deferred parts emit ~the plain value's length plus escape
-         * growth — the plain value is in `values` either way */
-        Py_ssize_t hint = exn + 4 + n * 8;
+        /* EXACT size pre-pass (see filter_json: exact-size allocations
+         * keep glibc's large bins clean at churn-bench heap sizes).
+         * splice body: (exn-1 existing bytes incl '[', or 1 for '[') +
+         * optional ',' + '{' + per-entry frag + '"' body '"' [+ ','] +
+         * "}]" */
+        Py_ssize_t sz = (ex && exn > 2 ? exn - 1 + 1 : 1) + 1 + 2;
         for (i = 0; i < n; i++) {
             PyObject *v = PyList_GET_ITEM(values, i);
             PyObject *p = PyList_GET_ITEM(parts, i);
-            if (PyUnicode_Check(p)) {
-                hint += PyUnicode_GET_LENGTH(p) + 32;
-            } else if (PyUnicode_Check(v)) {
-                Py_ssize_t L = PyUnicode_GET_LENGTH(v);
-                hint += L + (L >> 2) + 32;
+            Py_ssize_t l;
+            if (i) sz += 1;
+            if ((l = frag_len(PyList_GET_ITEM(keys, i))) < 0) return NULL;
+            sz += l + 2;
+            if (p == Py_None) {
+                Py_ssize_t vn;
+                const char *vs;
+                if (!PyUnicode_Check(v)) {
+                    PyErr_SetString(PyExc_TypeError, "expected str value");
+                    return NULL;
+                }
+                vs = PyUnicode_AsUTF8AndSize(v, &vn);
+                if (!vs) return NULL;
+                sz += escape_len(vs, vn);
+            } else if (PyUnicode_Check(p)) {
+                if ((l = frag_len(p)) < 0) return NULL;
+                sz += l;
+            } else if (PyTuple_Check(p) && PyTuple_GET_SIZE(p) >= 1 &&
+                       PyUnicode_Check(PyTuple_GET_ITEM(p, 0))) {
+                PyObject *tag = PyTuple_GET_ITEM(p, 0);
+                PyObject *rest = PyTuple_GetSlice(p, 1, PyTuple_GET_SIZE(p));
+                Py_ssize_t part_sz = 0;
+                int rc;
+                if (!rest) return NULL;
+                if (PyUnicode_CompareWithASCIIString(tag, "filter") == 0) {
+                    rc = emit_filter_esc(NULL, rest, &part_sz);
+                } else if (PyUnicode_CompareWithASCIIString(tag, "score") == 0) {
+                    rc = emit_score_esc(NULL, rest, &part_sz);
+                } else {
+                    PyErr_SetString(PyExc_TypeError, "history_append2: unknown deferred tag");
+                    rc = -1;
+                }
+                Py_DECREF(rest);
+                if (rc < 0) return NULL;
+                sz += part_sz;
+            } else {
+                PyErr_SetString(PyExc_TypeError, "history_append2: bad part");
+                return NULL;
             }
         }
-        if (buf_init(&b, hint) < 0) return NULL;
+        if (buf_init(&b, sz) < 0) return NULL;
     }
     if (existing != Py_None && !PyUnicode_IS_ASCII(existing)) b.nonascii = 1;
     if (ex && exn > 2) {
@@ -886,9 +1104,9 @@ static PyObject *py_history_append2(PyObject *self, PyObject *args) {
             if (!rest) goto fail;
             if (buf_putc(&b, '"') < 0) { Py_DECREF(rest); goto fail; }
             if (PyUnicode_CompareWithASCIIString(tag, "filter") == 0) {
-                rc = emit_filter_esc(&b, rest);
+                rc = emit_filter_esc(&b, rest, NULL);
             } else if (PyUnicode_CompareWithASCIIString(tag, "score") == 0) {
-                rc = emit_score_esc(&b, rest);
+                rc = emit_score_esc(&b, rest, NULL);
             } else {
                 PyErr_SetString(PyExc_TypeError, "history_append2: unknown deferred tag");
                 rc = -1;

@@ -18,6 +18,7 @@ from __future__ import annotations
 import copy
 import gc
 import threading
+import time
 from typing import Any, Callable
 
 from kube_scheduler_simulator_tpu.config import scheduler_config as sc
@@ -117,6 +118,13 @@ class SchedulerService:
             "batch_fallbacks": {},
             "batch_restarts": 0,
             "sequential_pods": 0,
+            # cumulative host-side scheduling/commit wall within batch
+            # rounds: batch commits (annotation assembly + result-store
+            # writes + history flush) AND any pods the round routed
+            # through the sequential cycle (post-filter failures,
+            # fallback waves) — the bench reports per-wave deltas
+            # alongside device_s
+            "commit_s": 0.0,
         }
         # guards batch_fallbacks against the metrics scrape thread
         self._stats_lock = threading.Lock()
@@ -597,8 +605,10 @@ class SchedulerService:
                 # (schedule_one syncs rotation per pod)
                 self._count_fallback(f"{why} [profile {fw.profile_name}]")
                 snapshot = self.build_snapshot()
+                tc = time.perf_counter()
                 for pod in pending:
                     results[_pod_key(pod)] = self.schedule_one(pod, snapshot)
+                self.stats["commit_s"] += time.perf_counter() - tc
             else:
                 self._run_segment_batch(fw, eng, pending, nodes, volumes, results)
                 any_batched = True
@@ -641,7 +651,9 @@ class SchedulerService:
             for j, pod in enumerate(tail):
                 key = _pod_key(pod)
                 if int(result.selected[j]) >= 0 or not seq_failures:
+                    tc = time.perf_counter()
                     results[key] = self._commit_batch_pod(result, j, pod, snapshot, point_names, fw)
+                    self.stats["commit_s"] += time.perf_counter() - tc
                     fw.sched_counter += 1
                     self.stats["batch_pods"] += 1
                 else:
@@ -649,7 +661,9 @@ class SchedulerService:
                     # state (earlier commits assumed), same attempt counter
                     # and rotation start as the all-sequential round.
                     fw.next_start_node_index = int(sample_start[j])
+                    tc = time.perf_counter()
                     res = self.schedule_one(pod, snapshot)
+                    self.stats["commit_s"] += time.perf_counter() - tc
                     results[key] = res
                     if res.nominated_node:
                         restart_at = i + j + 1
