@@ -1,0 +1,121 @@
+"""KEP-184 SchedulerSimulation example: same Scenario, two schedulers.
+
+Runs one KEP-140 Scenario in two ISOLATED in-process simulator instances
+(the in-process analog of the KEP's Simulator Pods) — the full default
+profile vs a NodeResourcesFit-only profile — and prints the comparative
+report (allocation rate, divergent placements).
+
+    PYTHONPATH=. JAX_PLATFORMS=cpu python examples/scheduler_simulation.py
+
+Reference design: keps/184-scheduler-simulation/README.md (design-only
+there; implemented by scenario/simulation.py here).
+"""
+
+from __future__ import annotations
+
+import json
+
+from kube_scheduler_simulator_tpu.scenario.simulation import run_scheduler_simulation
+
+
+def node(name: str, zone: str) -> dict:
+    return {
+        "metadata": {
+            "name": name,
+            "labels": {"topology.kubernetes.io/zone": zone, "kubernetes.io/hostname": name},
+        },
+        "status": {"allocatable": {"cpu": "4000m", "memory": "8Gi", "pods": "110"}},
+    }
+
+
+def pod(name: str) -> dict:
+    return {
+        "metadata": {"name": name, "namespace": "default", "labels": {"app": "web"}},
+        "spec": {
+            "containers": [{"name": "c", "resources": {"requests": {"cpu": "500m"}}}],
+            # prefer zone z1: visible to the default profile's NodeAffinity
+            # scoring, invisible to the fit-only profile
+            "affinity": {
+                "nodeAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "weight": 100,
+                            "preference": {
+                                "matchExpressions": [
+                                    {
+                                        "key": "topology.kubernetes.io/zone",
+                                        "operator": "In",
+                                        "values": ["z1"],
+                                    }
+                                ]
+                            },
+                        }
+                    ]
+                }
+            },
+        },
+    }
+
+
+FIT_ONLY = {
+    "profiles": [
+        {
+            "schedulerName": "default-scheduler",
+            "plugins": {
+                "multiPoint": {
+                    "enabled": [
+                        {"name": "PrioritySort"},
+                        {"name": "NodeResourcesFit"},
+                        {"name": "DefaultBinder"},
+                    ],
+                    "disabled": [{"name": "*"}],
+                }
+            },
+        }
+    ]
+}
+
+
+def main() -> None:
+    ops = [
+        {
+            "id": f"node-{i}",
+            "step": {"major": 1, "minor": i + 1},
+            "createOperation": {"typeMeta": {"kind": "Node"}, "object": node(f"n{i}", f"z{i % 2}")},
+        }
+        for i in range(2)
+    ] + [
+        {
+            "id": f"pod-{i}",
+            "step": {"major": 2, "minor": i + 1},
+            "createOperation": {"typeMeta": {"kind": "Pod"}, "object": pod(f"p{i}")},
+        }
+        for i in range(4)
+    ] + [{"id": "done", "step": {"major": 3}, "doneOperation": {}}]
+
+    simulation = {
+        "apiVersion": "simulation.kube-scheduler-simulator.sigs.k8s.io/v1alpha1",
+        "kind": "SchedulerSimulation",
+        "metadata": {"name": "compare", "namespace": "default"},
+        "spec": {
+            "scenario": {"operations": ops},
+            "simulators": [
+                {"name": "default-profile"},
+                {"name": "fit-only", "schedulerConfig": FIT_ONLY},
+            ],
+        },
+    }
+    done = run_scheduler_simulation(simulation)
+    status = done["status"]
+    print(f"phase: {status['phase']}")
+    for r in status.get("results", []):
+        rep = r["report"]
+        print(
+            f"  {r['simulator']}: scheduled {rep['scheduledPods']}/{rep['pods']} "
+            f"(allocation rate {rep['allocationRate']})"
+        )
+    print("comparison:", json.dumps(status.get("comparison", {}), indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -216,14 +216,12 @@ static int escape_into(Buf *b, const char *s, Py_ssize_t n) {
     return escape_core(b, s, n) < 0 ? -1 : 0;
 }
 
+/* exact output length of escape_into(s, n): the ONE scan-and-classify
+ * pass in count mode — the exact-size pre-passes and the emission can
+ * never diverge because they are the same code */
 static Py_ssize_t escape_len(const char *s, Py_ssize_t n) {
     return escape_core(NULL, s, n);
 }
-
-/* exact output length of escape_into(s, n): the ONE scan-and-classify
- * pass in count mode (escape_core with b==NULL) — the sizing and the
- * emission can never diverge because they are the same code */
-static Py_ssize_t escape_len(const char *s, Py_ssize_t n);
 
 /* UTF-8 byte length of a str (== char length for the ASCII fast path);
  * sets TypeError and returns -1 for non-str (every exact-size pre-pass

@@ -9,5 +9,14 @@ is implemented here as a first-class engine over the in-memory store.
 from kube_scheduler_simulator_tpu.scenario.engine import ScenarioEngine
 from kube_scheduler_simulator_tpu.scenario.operator import ScenarioOperator
 from kube_scheduler_simulator_tpu.scenario.result import allocation_rate, node_utilization
+from kube_scheduler_simulator_tpu.scenario.simulation import run_scheduler_simulation
+from kube_scheduler_simulator_tpu.scenario.simulator_operator import SimulatorOperator
 
-__all__ = ["ScenarioEngine", "ScenarioOperator", "allocation_rate", "node_utilization"]
+__all__ = [
+    "ScenarioEngine",
+    "ScenarioOperator",
+    "SimulatorOperator",
+    "allocation_rate",
+    "node_utilization",
+    "run_scheduler_simulation",
+]

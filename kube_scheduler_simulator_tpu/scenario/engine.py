@@ -63,16 +63,35 @@ def _store_kind(type_meta: "Obj | str") -> str:
 
 
 class ScenarioEngine:
-    # Process-wide: a scenario run owns the cluster (KEP determinism —
+    # Per-STORE: a scenario run owns its cluster (KEP determinism —
     # concurrent operations are forbidden, README.md:600-610); the
-    # operator's worker and the synchronous REST route both run under
-    # this lock so two runs can never interleave wipes/replays.
-    RUN_LOCK = threading.RLock()
+    # operator's worker and the synchronous REST route of the same
+    # simulator instance run under one lock so two runs can never
+    # interleave wipes/replays.  Distinct simulator instances (KEP-159
+    # Simulator objects, KEP-184 runs) have distinct stores and distinct
+    # locks — their scenarios run CONCURRENTLY, like the reference's
+    # one-Pod-per-Simulator design.  The lock LIVES ON the store object
+    # (not in a registry keyed by id(store)): it dies with its store, so
+    # ephemeral KEP-184 instances leak nothing and a recycled id can
+    # never alias a dead store's lock.
+    _RUN_LOCKS_MU = threading.Lock()
+
+    @classmethod
+    def run_lock_for(cls, store: Any) -> threading.RLock:
+        lock = getattr(store, "_scenario_run_lock", None)
+        if lock is None:
+            with cls._RUN_LOCKS_MU:
+                lock = getattr(store, "_scenario_run_lock", None)
+                if lock is None:
+                    lock = threading.RLock()
+                    store._scenario_run_lock = lock
+        return lock
 
     def __init__(self, cluster_store: Any, scheduler_service: Any, controller_manager: Any = None):
         self.store = cluster_store
         self.scheduler = scheduler_service
         self.controllers = controller_manager
+        self.RUN_LOCK = self.run_lock_for(cluster_store)
 
     # ------------------------------------------------------------------ run
 
@@ -107,7 +126,10 @@ class ScenarioEngine:
         # would silently delete scenarios queued behind this run.  The
         # preserve happens atomically inside restore (a list-then-restore
         # snapshot would race scenarios created in the gap).
-        self.store.restore({}, preserve=("scenarios",))
+        # simulators / schedulersimulations are operator bookkeeping too
+        # (KEP-159/184): wiping them would tear down live simulator
+        # instances and abort queued comparative runs mid-scenario
+        self.store.restore({}, preserve=("scenarios", "simulators", "schedulersimulations"))
 
         ops = list(spec.get("operations") or [])
         for op in ops:

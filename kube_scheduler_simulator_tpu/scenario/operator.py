@@ -23,9 +23,12 @@ Lifecycle notes:
   scenarios survive an in-flight run and get their turn.  Results write
   back as ``.status``; terminal phases (Succeeded / Failed / Paused) are
   never auto-re-run, so the status write does not loop.
-- Scenario runs serialize on ``ScenarioEngine.RUN_LOCK`` — the
-  synchronous ``POST /api/v1/scenarios`` route shares it, so an operator
-  reconcile and a REST run can never interleave their wipes/replays.
+- Scenario runs serialize on the per-store run lock
+  (``ScenarioEngine.run_lock_for(store)``) — the synchronous
+  ``POST /api/v1/scenarios`` route of the same instance shares it, so an
+  operator reconcile and a REST run can never interleave their
+  wipes/replays; DISTINCT simulator instances (KEP-159) run
+  concurrently.
 """
 
 from __future__ import annotations
@@ -135,10 +138,10 @@ class ScenarioOperator:
                     continue  # deleted (or wiped by an earlier run) meanwhile
                 if not self._should_run(obj):
                     continue
-                # run AND status write-back under the run lock: a
+                # run AND status write-back under THIS STORE's run lock: a
                 # concurrent run starting between them could observe the
                 # scenario without its terminal status
-                with ScenarioEngine.RUN_LOCK:
+                with self.engine.RUN_LOCK:
                     try:
                         finished = self.engine.run(obj)
                     except Exception as e:  # scenario bug: record the failure

@@ -45,6 +45,14 @@ class DIContainer:
             self.cluster_store, self._scheduler_service, self._controller_manager
         )
         self._scenario_operator.start()
+        # KEP-159/184 operator: reconciles Simulator objects into live
+        # isolated in-process simulator instances (own store + scheduler
+        # + HTTP servers) and SchedulerSimulation objects into one-shot
+        # comparative runs.
+        from kube_scheduler_simulator_tpu.scenario import SimulatorOperator
+
+        self._simulator_operator = SimulatorOperator(self.cluster_store)
+        self._simulator_operator.start()
         self._snapshot_service = SnapshotService(self.cluster_store, self._scheduler_service)
         # Reset captures the post-boot state (reference NewDIContainer order:
         # reset service is built at boot, capturing the initial keyspace).
@@ -59,9 +67,14 @@ class DIContainer:
     def scenario_operator(self):
         return self._scenario_operator
 
+    def simulator_operator(self):
+        return self._simulator_operator
+
     def close(self) -> None:
         """Tear down the container's background machinery (operator worker
-        thread + store subscriptions, controllers, scheduler loop)."""
+        threads + store subscriptions, spawned simulator instances,
+        controllers, scheduler loop)."""
+        self._simulator_operator.stop()
         self._scenario_operator.stop()
         self._controller_manager.stop()
         self._scheduler_service.stop_background()

@@ -69,6 +69,14 @@ GROUP_RESOURCES = (
     # KEP-140 Scenario CRD surface (reference scenario/api/v1alpha1);
     # reconciled by scenario/operator.py
     ("simulation.kube-scheduler-simulator.sigs.k8s.io", "v1alpha1", "scenarios", "Scenario", "scenarios"),
+    # KEP-159 Simulator CRD surface (reference keps/159: design-only) —
+    # reconciled by scenario/simulator_operator.py into isolated
+    # in-process simulator instances
+    ("simulation.kube-scheduler-simulator.sigs.k8s.io", "v1alpha1", "simulators", "Simulator", "simulators"),
+    # KEP-184 SchedulerSimulation CRD surface (reference keps/184:
+    # design-only) — one-shot Scenario × N-scheduler comparative runs,
+    # reconciled by the same operator loop
+    ("simulation.kube-scheduler-simulator.sigs.k8s.io", "v1alpha1", "schedulersimulations", "SchedulerSimulation", "schedulersimulations"),
     # … and newer clients use the events.k8s.io group; both serve the
     # same store bucket
     ("events.k8s.io", "v1", "events", "Event", "events"),

@@ -282,6 +282,14 @@ def _make_handler(server: SimulatorServer):
                         di.cluster_store, di.scheduler_service(), di.controller_manager()
                     )
                     self._send_json(200, engine.run(self._body() or {}))
+                elif url.path == "/api/v1/schedulersimulations":
+                    # KEP-184 one-shot runner: one Scenario × N isolated
+                    # simulator instances, comparative report in status
+                    from kube_scheduler_simulator_tpu.scenario.simulation import (
+                        run_scheduler_simulation,
+                    )
+
+                    self._send_json(200, run_scheduler_simulation(self._body() or {}))
                 elif m := _EXTENDER_RE.match(url.path):
                     verb, id_ = m.group(1), int(m.group(2))
                     ext = di.extender_service()

@@ -60,13 +60,21 @@ KINDS: tuple[str, ...] = (
     # scenario/api/v1alpha1/scenario_types.go); the ScenarioOperator
     # reconciles them
     "scenarios",
+    # KEP-159 Simulator objects (reconciled into isolated in-process
+    # simulator instances) and KEP-184 SchedulerSimulation one-shot runs
+    "simulators",
+    "schedulersimulations",
     # client-go schedulers/controllers record Events best-effort; the
     # reference's real apiserver accepts them, so the kube port must too
     # (a 404 per event pollutes external schedulers' logs)
     "events",
 )
 NAMESPACED_KINDS: frozenset[str] = frozenset(
-    {"pods", "persistentvolumeclaims", "deployments", "replicasets", "poddisruptionbudgets", "scenarios", "events"}
+    {
+        "pods", "persistentvolumeclaims", "deployments", "replicasets",
+        "poddisruptionbudgets", "scenarios", "simulators",
+        "schedulersimulations", "events",
+    }
 )
 
 KIND_NAMES: dict[str, str] = {
@@ -82,6 +90,8 @@ KIND_NAMES: dict[str, str] = {
     "poddisruptionbudgets": "PodDisruptionBudget",
     "csinodes": "CSINode",
     "scenarios": "Scenario",
+    "simulators": "Simulator",
+    "schedulersimulations": "SchedulerSimulation",
     "events": "Event",
 }
 

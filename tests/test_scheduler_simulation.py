@@ -1,0 +1,327 @@
+"""KEP-184 SchedulerSimulation (one-shot Scenario × N-scheduler compare)
+and KEP-159 Simulator objects (isolated in-process simulator instances).
+
+Both are design-only in the reference (keps/184-scheduler-simulation,
+keps/159-scheduler-simulator-operator) — these tests pin this build's
+implementation of those designs: comparative runs produce differing
+timelines for differing profiles, Simulator objects come up as isolated
+live instances, and two of them run two scenarios CONCURRENTLY.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+from typing import Any
+
+import pytest
+
+from kube_scheduler_simulator_tpu.scenario.simulation import run_scheduler_simulation
+
+Obj = dict[str, Any]
+
+
+def _node(name: str, zone: str) -> Obj:
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {
+            "name": name,
+            "labels": {"topology.kubernetes.io/zone": zone, "kubernetes.io/hostname": name},
+        },
+        "status": {"allocatable": {"cpu": "4000m", "memory": "8Gi", "pods": "110"}},
+    }
+
+
+def _pod(name: str) -> Obj:
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default", "labels": {"app": "web"}},
+        "spec": {
+            "containers": [{"name": "c", "resources": {"requests": {"cpu": "1500m"}}}],
+            "topologySpreadConstraints": [
+                {
+                    "maxSkew": 1,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": "web"}},
+                }
+            ],
+        },
+    }
+
+
+# a scenario whose outcome DEPENDS on the profile: with PodTopologySpread
+# filtering enabled, the 4 pods must spread across the 2 zones (max 1 skew);
+# with it disabled, NodeResourcesFit alone lets them pile up 2-per-node on
+# whatever wins scoring — different timelines, different placements.
+def _scenario_spec() -> Obj:
+    ops = [
+        {
+            "id": f"node-{i}",
+            "step": {"major": 1, "minor": i + 1},
+            "createOperation": {
+                "typeMeta": {"kind": "Node", "apiVersion": "v1"},
+                "object": _node(f"sim-node-{i}", f"z{i % 2}"),
+            },
+        }
+        for i in range(2)
+    ] + [
+        {
+            "id": f"pod-{i}",
+            "step": {"major": 2, "minor": i + 1},
+            "createOperation": {
+                "typeMeta": {"kind": "Pod", "apiVersion": "v1"},
+                "object": _pod(f"sim-pod-{i}"),
+            },
+        }
+        for i in range(4)
+    ] + [{"id": "done", "step": {"major": 3}, "doneOperation": {}}]
+    return {"operations": ops}
+
+
+_SPREAD_PROFILE = None  # full default profile (PodTopologySpread active)
+_FIT_ONLY_PROFILE = {
+    "profiles": [
+        {
+            "schedulerName": "default-scheduler",
+            "plugins": {
+                "multiPoint": {
+                    "enabled": [
+                        {"name": "PrioritySort"},
+                        {"name": "NodeResourcesFit"},
+                        {"name": "DefaultBinder"},
+                    ],
+                    "disabled": [{"name": "*"}],
+                }
+            },
+        }
+    ]
+}
+
+
+def _simulation_obj() -> Obj:
+    return {
+        "apiVersion": "simulation.kube-scheduler-simulator.sigs.k8s.io/v1alpha1",
+        "kind": "SchedulerSimulation",
+        "metadata": {"name": "compare-profiles", "namespace": "default"},
+        "spec": {
+            "scenario": _scenario_spec(),
+            "simulators": [
+                {"name": "default-profile", "schedulerConfig": _SPREAD_PROFILE},
+                {"name": "fit-only", "schedulerConfig": _FIT_ONLY_PROFILE},
+            ],
+        },
+    }
+
+
+def test_one_shot_comparative_run_differing_timelines():
+    done = run_scheduler_simulation(_simulation_obj())
+    status = done["status"]
+    assert status["phase"] == "Completed", status
+    assert status["startTime"] <= status["completionTime"]
+    results = {r["simulator"]: r for r in status["results"]}
+    assert set(results) == {"default-profile", "fit-only"}
+    for r in results.values():
+        assert r["scenarioPhase"] == "Succeeded"
+        rep = r["report"]
+        assert rep["pods"] == 4 and rep["steps"] >= 2
+        assert 0.0 <= rep["allocationRate"] <= 1.0
+        assert set(rep["nodeUtilization"]) == {"sim-node-0", "sim-node-1"}
+    # the spread profile must reject the 3rd pod per zone-node (maxSkew 1
+    # over 2 zones with cpu for only 2 pods per node); fit-only packs all 4
+    spread = results["default-profile"]["report"]
+    fit = results["fit-only"]["report"]
+    assert fit["scheduledPods"] == 4
+    assert spread["scheduledPods"] == 4  # 2 zones × 2 pods fits the skew
+    # differing profiles => differing finalscore timelines; comparison
+    # reports where placements/metrics diverge
+    cmp_ = status["comparison"]
+    assert set(cmp_["metrics"]) == {"default-profile", "fit-only"}
+    assert cmp_["bestAllocationRate"] in ("default-profile", "fit-only")
+
+
+def test_timelines_actually_diverge_between_profiles():
+    """Placements must differ between the two profiles for at least one
+    pod (the KEP's whole point: same scenario, different scheduler,
+    visible difference).  Pods PREFER zone z1 via node affinity — the
+    default profile's NodeAffinity scoring honors it, the fit-only
+    profile cannot see it and spreads by LeastAllocated instead."""
+    obj = _simulation_obj()
+    for op in obj["spec"]["scenario"]["operations"]:
+        pod = (op.get("createOperation") or {}).get("object") or {}
+        if "containers" in (pod.get("spec") or {}):
+            pod["spec"].pop("topologySpreadConstraints", None)
+            pod["spec"]["containers"][0]["resources"]["requests"]["cpu"] = "500m"
+            pod["spec"]["affinity"] = {
+                "nodeAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "weight": 100,
+                            "preference": {
+                                "matchExpressions": [
+                                    {
+                                        "key": "topology.kubernetes.io/zone",
+                                        "operator": "In",
+                                        "values": ["z1"],
+                                    }
+                                ]
+                            },
+                        }
+                    ]
+                }
+            }
+    done = run_scheduler_simulation(obj)
+    assert done["status"]["phase"] == "Completed", done["status"]
+    cmp_ = done["status"]["comparison"]
+    assert cmp_["divergentCount"] >= 1, cmp_
+
+
+def test_failed_scenario_fails_the_simulation():
+    obj = _simulation_obj()
+    obj["spec"]["scenario"] = {"operations": [{"id": "bogus", "step": {"major": 1}}]}
+    done = run_scheduler_simulation(obj)
+    assert done["status"]["phase"] == "Failed"
+    assert "message" in done["status"]
+
+
+def test_spec_validation():
+    done = run_scheduler_simulation({"spec": {}})
+    assert done["status"]["phase"] == "Failed"
+    dup = _simulation_obj()
+    dup["spec"]["simulators"] = [{"name": "x"}, {"name": "x"}]
+    done = run_scheduler_simulation(dup)
+    assert done["status"]["phase"] == "Failed"
+    assert "duplicate" in done["status"]["message"]
+
+
+# --------------------------------------------------------------------------
+# serving paths: sync REST route + CRD reconcile
+
+
+@pytest.fixture()
+def host():
+    from kube_scheduler_simulator_tpu.server import DIContainer, SimulatorServer
+
+    di = DIContainer(use_batch="off")
+    srv = SimulatorServer(di, port=0, kube_api_port=0)
+    srv.start(background=True)
+    yield srv, di
+    srv.shutdown()
+    di.close()
+
+
+def _req(port: int, method: str, path: str, body: "Obj | None" = None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request(method, path, json.dumps(body) if body is not None else None,
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    raw = resp.read()
+    conn.close()
+    return resp.status, (json.loads(raw) if raw else None)
+
+
+def test_sync_rest_route(host):
+    srv, _di = host
+    status, doc = _req(srv.port, "POST", "/api/v1/schedulersimulations", _simulation_obj())
+    assert status == 200
+    assert doc["status"]["phase"] == "Completed", doc["status"]
+    assert len(doc["status"]["results"]) == 2
+
+
+def test_schedulersimulation_object_reconciled(host):
+    """KEP-184 controller flow: create the CR on the kube port, the
+    operator runs it, .status lands on the object."""
+    srv, di = host
+    path = (
+        "/apis/simulation.kube-scheduler-simulator.sigs.k8s.io/v1alpha1"
+        "/namespaces/default/schedulersimulations"
+    )
+    status, _ = _req(srv.kube_api_port, "POST", path, _simulation_obj())
+    assert status == 201
+    di.simulator_operator().wait_idle(timeout=120)
+    _, obj = _req(srv.kube_api_port, "GET", path + "/compare-profiles")
+    assert obj["status"]["phase"] == "Completed", obj.get("status")
+    assert {r["simulator"] for r in obj["status"]["results"]} == {"default-profile", "fit-only"}
+
+
+def test_two_simulator_objects_run_isolated_scenarios_concurrently(host):
+    """KEP-159 done-criterion: two Simulator objects come up as two live,
+    fully isolated instances; each runs its own scenario and neither
+    sees the other's cluster."""
+    srv, di = host
+    sim_path = (
+        "/apis/simulation.kube-scheduler-simulator.sigs.k8s.io/v1alpha1"
+        "/namespaces/default/simulators"
+    )
+    for name in ("sim-a", "sim-b"):
+        status, _ = _req(
+            srv.kube_api_port, "POST", sim_path,
+            {
+                "apiVersion": "simulation.kube-scheduler-simulator.sigs.k8s.io/v1alpha1",
+                "kind": "Simulator",
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {},
+            },
+        )
+        assert status == 201
+    di.simulator_operator().wait_idle(timeout=60)
+    ports = {}
+    for name in ("sim-a", "sim-b"):
+        _, obj = _req(srv.kube_api_port, "GET", sim_path + f"/{name}")
+        st = obj.get("status") or {}
+        assert st.get("phase") == "Available", st
+        ports[name] = st
+    assert ports["sim-a"]["kubeAPIServerPort"] != ports["sim-b"]["kubeAPIServerPort"]
+
+    # drive a DIFFERENT scenario into each instance's own simulator API,
+    # concurrently (per-store run locks — KEP-159's one-Pod-per-Simulator
+    # isolation), then check isolation of the resulting clusters
+    import threading
+
+    outs = {}
+
+    def run_in(name: str, n_nodes: int) -> None:
+        scenario = {
+            "spec": {
+                "operations": [
+                    {
+                        "id": f"{name}-{i}",
+                        "step": {"major": 1, "minor": i + 1},
+                        "createOperation": {
+                            "typeMeta": {"kind": "Node", "apiVersion": "v1"},
+                            "object": _node(f"{name}-node-{i}", "z0"),
+                        },
+                    }
+                    for i in range(n_nodes)
+                ]
+                + [{"id": "done", "step": {"major": 2}, "doneOperation": {}}]
+            }
+        }
+        outs[name] = _req(ports[name]["simulatorServerPort"], "POST", "/api/v1/scenarios", scenario)
+
+    threads = [
+        threading.Thread(target=run_in, args=("sim-a", 2)),
+        threading.Thread(target=run_in, args=("sim-b", 3)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    for name in ("sim-a", "sim-b"):
+        status, doc = outs[name]
+        assert status == 200 and doc["status"]["phase"] == "Succeeded", (name, doc.get("status"))
+    _, la = _req(ports["sim-a"]["kubeAPIServerPort"], "GET", "/api/v1/nodes")
+    _, lb = _req(ports["sim-b"]["kubeAPIServerPort"], "GET", "/api/v1/nodes")
+    assert len(la["items"]) == 2 and len(lb["items"]) == 3
+    assert {n["metadata"]["name"] for n in la["items"]}.isdisjoint(
+        {n["metadata"]["name"] for n in lb["items"]}
+    )
+    # the HOST cluster saw none of it
+    assert di.cluster_store.list("nodes") == []
+
+    # deleting a Simulator tears its instance down (KEP controller step)
+    _req(srv.kube_api_port, "DELETE", sim_path + "/sim-a")
+    di.simulator_operator().wait_idle(timeout=30)
+    assert ("default", "sim-a") not in di.simulator_operator().instances
