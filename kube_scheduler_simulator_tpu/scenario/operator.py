@@ -47,6 +47,19 @@ Obj = dict[str, Any]
 _TERMINAL_PHASES = {"Succeeded", "Failed", "Paused"}
 
 
+def wait_queue_idle(q: "queue.Queue", timeout: float, what: str) -> None:
+    """Poll a reconcile queue until drained (shared by the queue-driven
+    operators' ``wait_idle``)."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if q.unfinished_tasks == 0:
+            return
+        time.sleep(0.01)
+    raise TimeoutError(f"{what} still busy")
+
+
 class ScenarioOperator:
     def __init__(self, cluster_store: Any, scheduler_service: Any, controller_manager: Any = None):
         self.store = cluster_store
@@ -99,14 +112,7 @@ class ScenarioOperator:
 
     def wait_idle(self, timeout: float = 30.0) -> None:
         """Block until every queued reconcile finished (tests)."""
-        import time
-
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if self._queue.unfinished_tasks == 0:
-                return
-            time.sleep(0.01)
-        raise TimeoutError("scenario operator still busy")
+        wait_queue_idle(self._queue, timeout, "scenario operator")
 
     # -------------------------------------------------------------- reconcile
 

@@ -100,6 +100,9 @@ def _run_in_isolated_simulator(scenario_spec: Obj, sim: Obj) -> "tuple[Obj, Obj]
         initial_scheduler_cfg=sim.get("schedulerConfig"),
         use_batch=sim.get("useBatch", "auto"),
         seed=int(sim.get("seed") or 0),
+        # the ephemeral store never holds Simulator/SchedulerSimulation
+        # CRs — don't boot an operator that reconciles nothing
+        enable_simulator_operator=False,
     )
     try:
         engine = ScenarioEngine(

@@ -60,13 +60,23 @@ class _Instance:
             initial_scheduler_cfg=spec.get("schedulerConfig"),
             use_batch=spec.get("useBatch", "auto"),
             seed=int(spec.get("seed") or 0),
+            # the instance's own store holds no Simulator/
+            # SchedulerSimulation CRs; a nested operator would be pure
+            # thread overhead (and unbounded recursion bait)
+            enable_simulator_operator=False,
         )
-        self.server = SimulatorServer(
-            self.di,
-            port=int(spec.get("simulatorServerPort") or 0),
-            kube_api_port=int(spec.get("kubeAPIServerPort") or 0),
-        )
-        self.server.start(background=True)
+        try:
+            self.server = SimulatorServer(
+                self.di,
+                port=int(spec.get("simulatorServerPort") or 0),
+                kube_api_port=int(spec.get("kubeAPIServerPort") or 0),
+            )
+            self.server.start(background=True)
+        except BaseException:
+            # a bad port spec/bind failure must not leak the fully
+            # booted container's threads and subscriptions
+            self.di.close()
+            raise
 
     def ports(self) -> Obj:
         return {
@@ -136,20 +146,15 @@ class SimulatorOperator:
             # a still-draining worker (long comparative run in flight)
             # sees _stopping and closes anything it creates itself
         with self._mu:
-            insts = list(self.instances.values())
+            insts = [i for i in self.instances.values() if i is not None]
             self.instances.clear()
         for inst in insts:
             inst.close()
 
     def wait_idle(self, timeout: float = 30.0) -> None:
-        import time
+        from kube_scheduler_simulator_tpu.scenario.operator import wait_queue_idle
 
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if self._queue.unfinished_tasks == 0:
-                return
-            time.sleep(0.01)
-        raise TimeoutError("simulator operator still busy")
+        wait_queue_idle(self._queue, timeout, "simulator operator")
 
     # -------------------------------------------------------------- reconcile
 
@@ -203,27 +208,36 @@ class SimulatorOperator:
             return
         with self._mu:
             if self._stopping or key in self.instances:
-                return  # shutting down / Available already (spec immutable, KEP)
+                # shutting down / Available already (spec immutable, KEP) /
+                # reserved by a concurrently-draining older worker
+                return
+            # reserve BEFORE building: after a timed-out stop() + restart
+            # two workers can drain the same queue, and a check-then-
+            # create outside the lock would build two instances for one
+            # key, the dict overwrite leaking the first one's servers
+            self.instances[key] = None
         if (obj.get("status") or {}).get("phase") in _SIM_TERMINAL:
+            self._pop_instance(key)
             return
         self._patch_status("simulators", ns, name, {"phase": "Creating"})
         try:
             inst = _Instance(obj.get("spec") or {})
         except Exception as e:
+            self._pop_instance(key)
             self._patch_status(
                 "simulators", ns, name,
                 {"phase": "Failed", "message": f"{type(e).__name__}: {e}"},
             )
             return
         with self._mu:
-            if self._stopping:
-                keep = False
-            else:
+            # keep only if the reservation survived (no stop(), no DELETE
+            # raced the build) — else close what we just booted
+            keep = not self._stopping and key in self.instances
+            if keep:
                 self.instances[key] = inst
-                keep = True
+            else:
+                self.instances.pop(key, None)
         if not keep:
-            # stop() ran while we were booting this instance — it cannot
-            # see it in the dict, so close it ourselves
             inst.close()
             return
         self._patch_status("simulators", ns, name, {"phase": "Available", **inst.ports()})
@@ -237,6 +251,11 @@ class SimulatorOperator:
             return
         if (obj.get("status") or {}).get("phase") in _RUN_TERMINAL:
             return
+        with self._mu:
+            if self._stopping:
+                # runs queued behind a timed-out stop() must not keep
+                # spawning nested containers into a torn-down host
+                return
         from kube_scheduler_simulator_tpu.scenario.simulation import now_rfc3339, run_scheduler_simulation
 
         # observable lifecycle (KEP-184 status): Running + startTime land

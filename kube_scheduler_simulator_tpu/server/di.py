@@ -26,6 +26,7 @@ class DIContainer:
         use_batch: str = "auto",
         external_snap_source: Any = None,
         seed: int = 0,
+        enable_simulator_operator: bool = True,
     ):
         self.cluster_store = cluster_store or ClusterStore()
         # Controllers start before the scheduler (reference boot order,
@@ -48,11 +49,15 @@ class DIContainer:
         # KEP-159/184 operator: reconciles Simulator objects into live
         # isolated in-process simulator instances (own store + scheduler
         # + HTTP servers) and SchedulerSimulation objects into one-shot
-        # comparative runs.
-        from kube_scheduler_simulator_tpu.scenario import SimulatorOperator
+        # comparative runs.  Disabled for the ephemeral containers those
+        # very features spawn (their stores never hold the CRs; a nested
+        # operator would be thread overhead and recursion bait).
+        self._simulator_operator = None
+        if enable_simulator_operator:
+            from kube_scheduler_simulator_tpu.scenario import SimulatorOperator
 
-        self._simulator_operator = SimulatorOperator(self.cluster_store)
-        self._simulator_operator.start()
+            self._simulator_operator = SimulatorOperator(self.cluster_store)
+            self._simulator_operator.start()
         self._snapshot_service = SnapshotService(self.cluster_store, self._scheduler_service)
         # Reset captures the post-boot state (reference NewDIContainer order:
         # reset service is built at boot, capturing the initial keyspace).
@@ -74,7 +79,8 @@ class DIContainer:
         """Tear down the container's background machinery (operator worker
         threads + store subscriptions, spawned simulator instances,
         controllers, scheduler loop)."""
-        self._simulator_operator.stop()
+        if self._simulator_operator is not None:
+            self._simulator_operator.stop()
         self._scenario_operator.stop()
         self._controller_manager.stop()
         self._scheduler_service.stop_background()

@@ -1,0 +1,179 @@
+// Engine-side browser-host harness for the web UI differential suite —
+// the REAL-JS mirror of utils/jsdom.py (Element/Document/fetch router/
+// timers), byte-matched semantics: innerHTML is an opaque string whose
+// setter clears children; collect_text joins textContent + innerHTML +
+// value + children text with single spaces, dropping empties.
+//
+// The test injects, BEFORE this file: __HTML__, __ROUTES__ (array of
+// [method, path, payloadJSONString]), __WATCH__ (array of chunk strings).
+// After it: the UI source, then the scenario driver, which reports via
+// __emit(name, value) and finishes with __done() (printed lines are
+// JSON, parsed and compared against the interpreter run).
+
+(function () {
+  'use strict';
+
+  function Element(tag, id) {
+    this.tagName = String(tag).toUpperCase();
+    this.id = id || '';
+    this.className = '';
+    this.textContent = '';
+    this.value = '';
+    this.style = {};
+    this.dataset = {};
+    this.children = [];
+    this.open = false;
+    this.__innerHTML = '';
+    this.__listeners = {};
+    this.onclick = null;
+    this.oninput = null;
+    this.onchange = null;
+    this.href = '';
+    this.download = '';
+  }
+  Object.defineProperty(Element.prototype, 'innerHTML', {
+    get: function () { return this.__innerHTML; },
+    set: function (v) { this.__innerHTML = String(v); this.children = []; },
+  });
+  Element.prototype.appendChild = function (child) {
+    this.children.push(child);
+    return child;
+  };
+  Element.prototype.addEventListener = function (type, fn) {
+    (this.__listeners[String(type)] = this.__listeners[String(type)] || []).push(fn);
+  };
+  Element.prototype.click = function () {
+    if (this.onclick) this.onclick();
+    var fns = this.__listeners['click'] || [];
+    for (var i = 0; i < fns.length; i++) fns[i]();
+  };
+  Element.prototype.showModal = function () { this.open = true; };
+  Element.prototype.close = function () { this.open = false; };
+
+  function collectText(el) {
+    var parts = [String(el.textContent || ''), String(el.__innerHTML || ''), String(el.value || '')];
+    for (var i = 0; i < el.children.length; i++) {
+      if (el.children[i] instanceof Element) parts.push(collectText(el.children[i]));
+    }
+    var out = [];
+    for (var j = 0; j < parts.length; j++) if (parts[j]) out.push(parts[j]);
+    return out.join(' ');
+  }
+
+  var byId = {};
+  var re = /<(\w+)[^>]*\bid="([\w$-]+)"/g;
+  var m;
+  while ((m = re.exec(__HTML__)) !== null) {
+    byId[m[2]] = new Element(m[1], m[2]);
+  }
+
+  var routes = {};
+  for (var i = 0; i < __ROUTES__.length; i++) {
+    routes[__ROUTES__[i][0] + ' ' + __ROUTES__[i][1]] = __ROUTES__[i][2];
+  }
+  var watchChunks = __WATCH__.slice();
+  var requests = [];
+
+  function response(status, text, ctype) {
+    return {
+      ok: status >= 200 && status < 300,
+      status: status,
+      headers: { get: function (k) { return String(k).toLowerCase() === 'content-type' ? ctype : null; } },
+      text: function () { return text; },
+      body: null,
+    };
+  }
+
+  var timers = [];
+  var timerSeq = 0;
+
+  globalThis.document = {
+    getElementById: function (id) { return byId[String(id)]; },
+    createElement: function (tag) { return new Element(String(tag), ''); },
+  };
+  globalThis.fetch = function (path, opts) {
+    var method = (opts && opts.method) ? String(opts.method) : 'GET';
+    var body = (opts && opts.body != null) ? String(opts.body) : null;
+    path = String(path);
+    requests.push([method, path, body]);
+    if (path.indexOf('/api/v1/listwatchresources') === 0) {
+      var reader = {
+        read: function () {
+          if (watchChunks.length) return { done: false, value: watchChunks.shift() };
+          return { done: true, value: undefined };
+        },
+      };
+      return {
+        ok: true, status: 200,
+        headers: { get: function () { return 'application/json'; } },
+        text: function () { return ''; },
+        body: { getReader: function () { return reader; } },
+      };
+    }
+    var payload = routes[method + ' ' + path];
+    if (payload === undefined) {
+      return response(404, JSON.stringify({ message: 'no route ' + method + ' ' + path }), 'application/json');
+    }
+    return response(200, payload, 'application/json');
+  };
+  globalThis.setTimeout = function (fn) { timers.push([++timerSeq, fn]); return timerSeq; };
+  globalThis.clearTimeout = function (tid) {
+    var keep = [];
+    for (var i = 0; i < timers.length; i++) if (timers[i][0] !== tid) keep.push(timers[i]);
+    timers = keep;
+  };
+  globalThis.confirm = function () { return true; };
+  globalThis.alert = function () {};
+  globalThis.prompt = function () { return null; };
+  globalThis.TextDecoder = function () { return { decode: function (v) { return v === undefined ? '' : String(v); } }; };
+  globalThis.URL = { createObjectURL: function () { return 'blob:stub'; } };
+  globalThis.Blob = function () { return {}; };
+  globalThis.location = { href: 'http://localhost:1212/', reload: function () {} };
+  globalThis.window = {};
+  globalThis.EventSource = function () { return { close: function () {} }; };
+
+  // ---- driver helpers (same names the interpreter harness exposes)
+  var emitted = [];
+  globalThis.__emit = function (name, value) {
+    emitted.push([String(name), value]);
+  };
+  globalThis.__collectText = function (id) {
+    var el = byId[String(id)];
+    return el ? collectText(el) : '';
+  };
+  globalThis.__elementOpen = function (id) {
+    var el = byId[String(id)];
+    return el ? !!el.open : false;
+  };
+  globalThis.__click = function (id) {
+    var el = byId[String(id)];
+    if (el) el.click();
+  };
+  globalThis.__setValue = function (id, v) {
+    var el = byId[String(id)];
+    if (el) {
+      el.value = String(v);
+      if (el.oninput) el.oninput();
+    }
+  };
+  globalThis.__flushTimers = function () {
+    var pending = timers;
+    timers = [];
+    for (var i = 0; i < pending.length; i++) {
+      try { pending[i][1](); } catch (e) { /* PendingAwait analog: ignore */ }
+    }
+    return pending.length;
+  };
+  globalThis.__requestCount = function () { return requests.length; };
+  globalThis.__done = function () {
+    print_impl('__RESULT__' + JSON.stringify(emitted));
+  };
+  // a real engine resolves awaits in microtasks; the driver calls this
+  // to let every pending chain quiesce before reading the DOM (the
+  // interpreter's synchronous await makes it a no-op there)
+  globalThis.__drain = function () {
+    var p = Promise.resolve();
+    for (var i = 0; i < 400; i++) p = p.then(function () {});
+    return p;
+  };
+})();
