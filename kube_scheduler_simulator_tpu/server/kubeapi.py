@@ -138,7 +138,7 @@ def resolve(path: str) -> "_Route | None":
     return _Route(entry[3], entry[4], _api_version(group, version), namespace, name, subresource)
 
 
-def discovery_document(path: str) -> "Obj | None":
+def discovery_document(path: str, disabled_kinds: "frozenset[str]" = frozenset()) -> "Obj | None":
     parts = [p for p in path.split("/") if p]
     group_versions: dict[str, str] = {g: v for g, v, *_ in GROUP_RESOURCES}
     if parts == ["api"]:
@@ -160,10 +160,10 @@ def discovery_document(path: str) -> "Obj | None":
         len(parts) == 3 and parts[0] == "apis" and group_versions.get(parts[1]) == parts[2]
     ):
         if parts[0] == "api":
-            rows = [r for r in CORE_RESOURCES]
+            rows = [r for r in CORE_RESOURCES if r[4] not in disabled_kinds]
             gv = "v1"
         else:
-            rows = [r for r in GROUP_RESOURCES if r[0] == parts[1]]
+            rows = [r for r in GROUP_RESOURCES if r[0] == parts[1] and r[4] not in disabled_kinds]
             gv = f"{parts[1]}/{parts[2]}"
         return {
             "kind": "APIResourceList",
@@ -191,9 +191,15 @@ class KubeAPIServer:
     """The simulator's kube-API port (reference layout: kube API on its
     own port next to the simulator API)."""
 
-    def __init__(self, cluster_store: Any, port: int = 3131):
+    def __init__(self, cluster_store: Any, port: int = 3131, disabled_kinds: "frozenset[str]" = frozenset()):
+        # disabled_kinds: store kinds this apiserver does NOT serve —
+        # e.g. a spawned KEP-159 simulator instance has no simulator
+        # operator, so its apiserver must 404 the operator CRDs exactly
+        # as a real apiserver without those CRDs installed would, rather
+        # than accept objects nothing will ever reconcile
         self.store = cluster_store
         self.port = port
+        self.disabled_kinds = frozenset(disabled_kinds)
         self._httpd: "ThreadingHTTPServer | None" = None
         self._thread: "threading.Thread | None" = None
         self._stop = threading.Event()
@@ -220,6 +226,14 @@ class KubeAPIServer:
 
 def _make_handler(server: KubeAPIServer):
     store = server.store
+
+    def resolve_active(path: str) -> "_Route | None":
+        """resolve(), minus this apiserver's disabled kinds — a route to
+        an uninstalled CRD must 404 like a real apiserver's would."""
+        rt = resolve(path)
+        if rt is not None and rt.store_kind in server.disabled_kinds:
+            return None
+        return rt
 
     def envelope(obj: Obj, api_version: str, kind: str) -> Obj:
         out = dict(obj)
@@ -284,11 +298,11 @@ def _make_handler(server: KubeAPIServer):
                 self.end_headers()
                 self.wfile.write(data)
                 return
-            doc = discovery_document(url.path)
+            doc = discovery_document(url.path, server.disabled_kinds)
             if doc is not None:
                 self._send_json(200, doc)
                 return
-            rt = resolve(url.path)
+            rt = resolve_active(url.path)
             if rt is None:
                 self._status_err(404, "NotFound", f"no handler for {url.path}")
                 return
@@ -436,7 +450,7 @@ def _make_handler(server: KubeAPIServer):
 
         def do_POST(self) -> None:
             url = urlparse(self.path)
-            rt = resolve(url.path)
+            rt = resolve_active(url.path)
             if rt is None:
                 self._status_err(404, "NotFound", f"no handler for {url.path}")
                 return
@@ -469,7 +483,7 @@ def _make_handler(server: KubeAPIServer):
 
         def do_PUT(self) -> None:
             url = urlparse(self.path)
-            rt = resolve(url.path)
+            rt = resolve_active(url.path)
             if rt is None or rt.name is None:
                 self._status_err(404, "NotFound", f"no handler for {url.path}")
                 return
@@ -500,7 +514,7 @@ def _make_handler(server: KubeAPIServer):
 
         def do_PATCH(self) -> None:
             url = urlparse(self.path)
-            rt = resolve(url.path)
+            rt = resolve_active(url.path)
             if rt is None or rt.name is None:
                 self._status_err(404, "NotFound", f"no handler for {url.path}")
                 return
@@ -518,7 +532,7 @@ def _make_handler(server: KubeAPIServer):
 
         def do_DELETE(self) -> None:
             url = urlparse(self.path)
-            rt = resolve(url.path)
+            rt = resolve_active(url.path)
             if rt is None or rt.name is None:
                 self._status_err(404, "NotFound", f"no handler for {url.path}")
                 return

@@ -76,6 +76,16 @@ class SimulatorServer:
         self.cors = cors_allowed_origins or []
         self.kube_api_port = kube_api_port
         self.kube_api_server: Any = None
+        # a container without the simulator operator (the isolated
+        # instances KEP-159/184 spawn) must NOT serve the operator CRDs:
+        # objects nothing reconciles would sit status-less forever —
+        # a real apiserver without those CRDs installed 404s them, and
+        # the KEP applies them to the USER cluster, not the simulator's
+        self.disabled_kinds: "frozenset[str]" = (
+            frozenset()
+            if di.simulator_operator() is not None
+            else frozenset({"simulators", "schedulersimulations"})
+        )
         self._httpd: "ThreadingHTTPServer | None" = None
         self._thread: "threading.Thread | None" = None
         self._stop = threading.Event()  # ends open watch streams on shutdown
@@ -92,7 +102,11 @@ class SimulatorServer:
         if self.kube_api_port is not None:
             from kube_scheduler_simulator_tpu.server.kubeapi import KubeAPIServer
 
-            self.kube_api_server = KubeAPIServer(self.di.cluster_store, port=self.kube_api_port)
+            self.kube_api_server = KubeAPIServer(
+                self.di.cluster_store,
+                port=self.kube_api_port,
+                disabled_kinds=self.disabled_kinds,
+            )
             self.kube_api_port = self.kube_api_server.start(background=True)
         # The scheduler runs continuously like the reference's
         # `go sched.Run(ctx)` (scheduler.go:183).
@@ -245,7 +259,7 @@ def _make_handler(server: SimulatorServer):
                     kind, name = m.group(1), m.group(2)
                     ns = (q.get("namespace") or [None])[0]
                     as_yaml = (q.get("format") or [""])[0] == "yaml"
-                    if kind not in KINDS:
+                    if kind not in KINDS or kind in server.disabled_kinds:
                         self._send_json(404, {"message": f"unknown resource kind {kind}"})
                     elif name is None:
                         obj = {"items": di.cluster_store.list(kind, ns)}
@@ -303,7 +317,7 @@ def _make_handler(server: SimulatorServer):
                     self._send_json(200, getattr(bridge, verb)(self._body() or {}))
                 elif m := _RESOURCE_RE.match(url.path):
                     kind = m.group(1)
-                    if kind not in KINDS:
+                    if kind not in KINDS or kind in server.disabled_kinds:
                         self._send_json(404, {"message": f"unknown resource kind {kind}"})
                     else:
                         self._send_json(201, di.cluster_store.create(kind, self._body() or {}))
@@ -326,7 +340,7 @@ def _make_handler(server: SimulatorServer):
                     self._send_empty(202)
                 elif m := _RESOURCE_RE.match(url.path):
                     kind, name = m.group(1), m.group(2)
-                    if kind not in KINDS or name is None:
+                    if kind not in KINDS or kind in server.disabled_kinds or name is None:
                         self._send_json(404, {"message": "not found"})
                     else:
                         body = self._body() or {}
@@ -344,7 +358,7 @@ def _make_handler(server: SimulatorServer):
                 if m := _RESOURCE_RE.match(url.path):
                     kind, name = m.group(1), m.group(2)
                     ns = (q.get("namespace") or [None])[0]
-                    if kind not in KINDS or name is None:
+                    if kind not in KINDS or kind in server.disabled_kinds or name is None:
                         self._send_json(404, {"message": "not found"})
                     else:
                         di.cluster_store.delete(kind, name, ns)

@@ -321,6 +321,22 @@ def test_two_simulator_objects_run_isolated_scenarios_concurrently(host):
     # the HOST cluster saw none of it
     assert di.cluster_store.list("nodes") == []
 
+    # a spawned instance hosts no simulator operator, so its apiserver
+    # must NOT serve the operator CRDs (a real apiserver 404s an
+    # uninstalled CRD; the KEP applies these to the USER cluster only) —
+    # otherwise objects nothing reconciles would sit status-less forever
+    st, body = _req(
+        ports["sim-b"]["kubeAPIServerPort"], "POST", sim_path,
+        {"metadata": {"name": "nested"}, "spec": {}},
+    )
+    assert st == 404, (st, body)
+    _, rl = _req(
+        ports["sim-b"]["kubeAPIServerPort"], "GET",
+        "/apis/simulation.kube-scheduler-simulator.sigs.k8s.io/v1alpha1",
+    )
+    names = {r["name"] for r in rl["resources"]}
+    assert "scenarios" in names and "simulators" not in names
+
     # deleting a Simulator tears its instance down (KEP controller step)
     _req(srv.kube_api_port, "DELETE", sim_path + "/sim-a")
     di.simulator_operator().wait_idle(timeout=30)

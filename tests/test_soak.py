@@ -107,8 +107,41 @@ def test_concurrent_api_traffic_soak():
             _req(port, "GET", "/api/v1/schedulerconfiguration")
             op_counts["read"] += 1
 
+        @guard
+        def simulator_churner(rng):
+            """KEP-159 lifecycle under storm: create a Simulator object,
+            wait for Available, drive ONE scenario into the isolated
+            instance, delete the object — all while the host store is
+            being hammered by the other workers."""
+            import time as _t
+
+            name = f"soak-sim-{next_id()}"
+            _req(port, "POST", "/api/v1/resources/simulators",
+                 {"metadata": {"name": name, "namespace": "default"}, "spec": {}})
+            inst_port = None
+            deadline = _t.monotonic() + 20
+            while _t.monotonic() < deadline and not stop.is_set():
+                obj = _req(port, f"GET", f"/api/v1/resources/simulators/{name}?namespace=default")
+                st = obj.get("status") or {}
+                if st.get("phase") == "Available":
+                    inst_port = st["simulatorServerPort"]
+                    break
+                _t.sleep(0.05)
+            if inst_port:
+                doc = _req(inst_port, "POST", "/api/v1/scenarios", {"spec": {"operations": [
+                    {"id": "n", "step": {"major": 1},
+                     "createOperation": {"typeMeta": {"kind": "Node"},
+                                         "object": {"metadata": {"name": f"{name}-node"}}}},
+                    {"id": "d", "step": {"major": 2}, "doneOperation": {}},
+                ]}})
+                assert doc["status"]["phase"] == "Succeeded", doc["status"]
+            _req(port, "DELETE", f"/api/v1/resources/simulators/{name}?namespace=default")
+            op_counts["simulator"] += 1
+            _t.sleep(0.2)
+
+        op_counts["simulator"] = 0
         threads = [threading.Thread(target=t, daemon=True)
-                   for t in (pod_creator, pod_creator, pod_deleter, deployer, reader)]
+                   for t in (pod_creator, pod_creator, pod_deleter, deployer, reader, simulator_churner)]
         import time
 
         try:
@@ -144,6 +177,14 @@ def test_concurrent_api_traffic_soak():
         for p in _req(port, "GET", "/api/v1/resources/pods")["items"]:
             nn = (p.get("spec") or {}).get("nodeName")
             assert nn is None or nn in nodes, f"{p['metadata']['name']} bound to missing node {nn}"
+        # simulator instances match surviving Simulator objects — every
+        # deleted object's instance was torn down despite the storm
+        di.simulator_operator().wait_idle(timeout=30)
+        live_objs = {
+            ("default", s["metadata"]["name"])
+            for s in _req(port, "GET", "/api/v1/resources/simulators")["items"]
+        }
+        assert set(di.simulator_operator().instances) <= live_objs
 
     finally:
         # always tear down the background machinery — leaked daemon

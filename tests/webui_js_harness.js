@@ -50,8 +50,14 @@
   Element.prototype.showModal = function () { this.open = true; };
   Element.prototype.close = function () { this.open = false; };
 
+  function asText(v) {
+    // mirror jsdom.py's to_str-then-filter: null/undefined become '',
+    // but falsy NON-nullish values (0, false) keep their string form
+    return v === null || v === undefined ? '' : String(v);
+  }
+
   function collectText(el) {
-    var parts = [String(el.textContent || ''), String(el.__innerHTML || ''), String(el.value || '')];
+    var parts = [asText(el.textContent), asText(el.__innerHTML), asText(el.value)];
     for (var i = 0; i < el.children.length; i++) {
       if (el.children[i] instanceof Element) parts.push(collectText(el.children[i]));
     }
@@ -157,11 +163,13 @@
     }
   };
   globalThis.__flushTimers = function () {
+    // real errors PROPAGATE, mirroring the interpreter harness (which
+    // swallows only its PendingAwait control signal — a concept with no
+    // real-engine analog); eating them here would mask exactly the
+    // defects the differential exists to catch
     var pending = timers;
     timers = [];
-    for (var i = 0; i < pending.length; i++) {
-      try { pending[i][1](); } catch (e) { /* PendingAwait analog: ignore */ }
-    }
+    for (var i = 0; i < pending.length; i++) pending[i][1]();
     return pending.length;
   };
   globalThis.__requestCount = function () { return requests.length; };
