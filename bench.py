@@ -802,6 +802,12 @@ def main() -> None:
             ("cfg2-fit-taint-aff", True),
             ("cfg3-spread", True),
             ("cfg5-churn-default-profile", False),
+            # last: every BASELINE config must end the round with SOME
+            # result row — a cfg1 that burned its cap dialing the wedged
+            # tunnel gets no CPU retry when the prober recovered (the
+            # promotion pass supersedes the retry loop), so it re-runs
+            # here or not at all
+            ("cfg1-fit", False),
         ]
         for name, warm in priority:
             if remaining() < 60.0:

@@ -650,96 +650,6 @@ fail:
     return NULL;
 }
 
-/* history_append(existing: str | None, keys, values, escs) -> str
- * The FULL new result-history value in one buffer: existing array body
- * (trusted splice) or a fresh array, plus the new entry assembled as in
- * history_entry — skips materializing the entry and the splice copy. */
-static PyObject *py_history_append(PyObject *self, PyObject *args) {
-    PyObject *existing, *keys, *values, *escs;
-    Buf b;
-    Py_ssize_t i, n;
-    const char *ex = NULL;
-    Py_ssize_t exn = 0;
-    (void)self;
-    if (!PyArg_ParseTuple(args, "OOOO", &existing, &keys, &values, &escs)) return NULL;
-    if (!PyList_Check(keys) || !PyList_Check(values) ||
-        PyList_GET_SIZE(keys) != PyList_GET_SIZE(values) ||
-        (escs != Py_None &&
-         (!PyList_Check(escs) || PyList_GET_SIZE(escs) != PyList_GET_SIZE(keys)))) {
-        PyErr_SetString(PyExc_TypeError, "history_append(existing, keys, values[, escs])");
-        return NULL;
-    }
-    if (existing != Py_None) {
-        if (!PyUnicode_Check(existing)) {
-            PyErr_SetString(PyExc_TypeError, "existing must be str or None");
-            return NULL;
-        }
-        ex = PyUnicode_AsUTF8AndSize(existing, &exn);
-        if (!ex) return NULL;
-        /* caller guarantees the trusted '[{...}]' shape (or "[]") */
-        if (exn < 2 || ex[0] != '[' || ex[exn - 1] != ']') {
-            PyErr_SetString(PyExc_ValueError, "existing history is not an array");
-            return NULL;
-        }
-    }
-    n = PyList_GET_SIZE(keys);
-    {
-        /* exact size: splice + '{' + entries + "}]" (see filter_json) */
-        Py_ssize_t sz = (ex && exn > 2 ? exn : 1) + 1 + 2, l;
-        for (i = 0; i < n; i++) {
-            PyObject *e = escs == Py_None ? Py_None : PyList_GET_ITEM(escs, i);
-            if (i) sz += 1;
-            if ((l = frag_len(PyList_GET_ITEM(keys, i))) < 0) return NULL;
-            sz += l + 2;
-            if (e != Py_None) {
-                if (!PyUnicode_Check(e)) {
-                    PyErr_SetString(PyExc_TypeError, "escs must be str or None");
-                    return NULL;
-                }
-                if ((l = frag_len(e)) < 0) return NULL;
-                sz += l;
-            } else {
-                PyObject *v = PyList_GET_ITEM(values, i);
-                Py_ssize_t vn;
-                const char *vs;
-                if (!PyUnicode_Check(v)) {
-                    PyErr_SetString(PyExc_TypeError, "expected str");
-                    return NULL;
-                }
-                vs = PyUnicode_AsUTF8AndSize(v, &vn);
-                if (!vs) return NULL;
-                sz += escape_len(vs, vn);
-            }
-        }
-        if (buf_init(&b, sz) < 0) return NULL;
-    }
-    if (existing != Py_None && !PyUnicode_IS_ASCII(existing)) b.nonascii = 1;
-    if (ex && exn > 2) {
-        /* existing non-empty array: copy "...}" minus "]", then "," */
-        if (buf_put(&b, ex, exn - 1) < 0) goto fail;
-        if (buf_putc(&b, ',') < 0) goto fail;
-    } else {
-        if (buf_putc(&b, '[') < 0) goto fail;
-    }
-    if (buf_putc(&b, '{') < 0) goto fail;
-    for (i = 0; i < n; i++) {
-        PyObject *e = escs == Py_None ? Py_None : PyList_GET_ITEM(escs, i);
-        if (i && buf_putc(&b, ',') < 0) goto fail;
-        if (put_str(&b, PyList_GET_ITEM(keys, i)) < 0) goto fail;
-        if (e != Py_None) {
-            if (buf_putc(&b, '"') < 0) goto fail;
-            if (put_str(&b, e) < 0) goto fail;
-            if (buf_putc(&b, '"') < 0) goto fail;
-        } else if (escape_value(&b, PyList_GET_ITEM(values, i)) < 0) {
-            goto fail;
-        }
-    }
-    if (buf_put(&b, "}]", 2) < 0) goto fail;
-    return buf_take(&b);
-fail:
-    buf_release(&b);
-    return NULL;
-}
 
 /* score_json_pair(keys, keys_esc, frags, frags_esc, rows, perm)
  * -> (str, str): like score_json, but also emits the escaped twin from
@@ -1131,8 +1041,6 @@ static PyMethodDef methods[] = {
      "escaped body of s, no surrounding quotes"},
     {"history_entry", py_history_entry, METH_VARARGS,
      "history entry JSON from ('\"k\":' fragment, value[, escaped]) lists"},
-    {"history_append", py_history_append, METH_VARARGS,
-     "full new result-history value: trusted splice + new entry, one buffer"},
     {"history_append2", py_history_append2, METH_VARARGS,
      "history splice with deferred filter/score twin emission (lazy-esc)"},
     {"score_json", py_score_json, METH_VARARGS,

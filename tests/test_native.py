@@ -154,6 +154,56 @@ def test_score_json_pair_twins():
     assert '"' + esc + '"' == py_go_string(s)
 
 
+def test_history_append2_deferred_matches_pair_twins():
+    """The lazy path's whole claim: history_append2's DEFERRED filter and
+    score emissions are byte-identical to the pair-mode twins (which are
+    themselves pinned against go_string above) — the pair functions stay
+    as the oracle for the deferred emitters."""
+    import numpy as np
+
+    keys = [f'"n{i}":' for i in range(6)]
+    keys_esc = [native.fastjson.escape_body(k) for k in keys]
+    pass_arr = [k + '{"P":"passed"}' for k in keys]
+    pass_esc = [native.fastjson.escape_body(x) for x in pass_arr]
+    order = np.arange(6, dtype=np.int64)
+    ftable = ['{"P":"nope & <bad>"}']
+    etable = [native.fastjson.escape_body(ftable[0])]
+    fail_ids = np.array([5], dtype=np.int64)
+    fail_uidx = np.array([0], dtype=np.int64)
+    plain_f, twin_f = native.fastjson.filter_json(
+        pass_arr, pass_esc, keys, keys_esc, order, 4, 3, 6, fail_ids, fail_uidx, ftable, etable
+    )
+    skeys = ['"n1":', '"n0":']
+    skeys_esc = [native.fastjson.escape_body(k) for k in skeys]
+    frags = ['"P1":"', '"P2":"']
+    frags_esc = [native.fastjson.escape_body(f) for f in frags]
+    rows = [["10", "20"], ["1", "2"]]
+    perm = [1, 0]
+    plain_s, twin_s = native.fastjson.score_json_pair(skeys, skeys_esc, frags, frags_esc, rows, perm)
+
+    frag_keys = ['"a-filter":', '"b-score":', '"c-small":']
+    got = native.fastjson.history_append2(
+        None,
+        frag_keys,
+        [plain_f, plain_s, 'v"x'],
+        [
+            ("filter", keys_esc, pass_esc, order, 4, 3, 6, fail_ids, fail_uidx, etable),
+            ("score", skeys_esc, frags_esc, rows, perm),
+            None,
+        ],
+    )
+    want = (
+        "[{" + frag_keys[0] + '"' + twin_f + '"'
+        + "," + frag_keys[1] + '"' + twin_s + '"'
+        + "," + frag_keys[2] + native.fastjson.escape_string('v"x')
+        + "}]"
+    )
+    assert got == want
+    # and splicing onto an existing trail keeps the bytes exact
+    got2 = native.fastjson.history_append2(got, frag_keys[2:], ["y"], [None])
+    assert got2 == got[:-1] + ',{"c-small":"y"}]'
+
+
 def test_error_paths():
     with pytest.raises(TypeError):
         native.fastjson.escape_string(b"bytes")
