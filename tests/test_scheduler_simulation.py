@@ -186,6 +186,30 @@ def test_failed_scenario_fails_the_simulation():
     assert "message" in done["status"]
 
 
+def test_scenario_template_file_path(tmp_path):
+    """The KEP's file indirection (etcd size limits motivate it there;
+    here it reads a local YAML/JSON Scenario file) — a full Scenario
+    object or a bare spec both work."""
+    import yaml
+
+    obj = _simulation_obj()
+    scenario_spec = obj["spec"].pop("scenario")
+    f = tmp_path / "scenario.yaml"
+    f.write_text(yaml.safe_dump({"kind": "Scenario", "spec": scenario_spec}))
+    obj["spec"]["scenarioTemplateFilePath"] = str(f)
+    obj["spec"]["simulators"] = [{"name": "only"}]
+    done = run_scheduler_simulation(obj)
+    assert done["status"]["phase"] == "Completed", done["status"]
+    assert done["status"]["results"][0]["report"]["scheduledPods"] == 4
+    # bare-spec file form (no top-level "spec" wrapper) works too
+    f2 = tmp_path / "bare.yaml"
+    f2.write_text(yaml.safe_dump(scenario_spec))
+    obj["spec"]["scenarioTemplateFilePath"] = str(f2)
+    done2 = run_scheduler_simulation(obj)
+    assert done2["status"]["phase"] == "Completed", done2["status"]
+    assert done2["status"]["results"][0]["report"]["scheduledPods"] == 4
+
+
 def test_spec_validation():
     done = run_scheduler_simulation({"spec": {}})
     assert done["status"]["phase"] == "Failed"
@@ -341,3 +365,24 @@ def test_two_simulator_objects_run_isolated_scenarios_concurrently(host):
     _req(srv.kube_api_port, "DELETE", sim_path + "/sim-a")
     di.simulator_operator().wait_idle(timeout=30)
     assert ("default", "sim-a") not in di.simulator_operator().instances
+
+
+def test_simulator_bad_spec_fails_without_leaking(host):
+    """A Simulator whose server cannot come up (unparseable port) lands
+    in phase Failed with a message, and no instance is retained."""
+    srv, di = host
+    sim_path = (
+        "/apis/simulation.kube-scheduler-simulator.sigs.k8s.io/v1alpha1"
+        "/namespaces/default/simulators"
+    )
+    status, _ = _req(
+        srv.kube_api_port, "POST", sim_path,
+        {"metadata": {"name": "sim-bad", "namespace": "default"},
+         "spec": {"simulatorServerPort": "not-a-port"}},
+    )
+    assert status == 201
+    di.simulator_operator().wait_idle(timeout=30)
+    _, obj = _req(srv.kube_api_port, "GET", sim_path + "/sim-bad")
+    st = obj.get("status") or {}
+    assert st.get("phase") == "Failed" and "message" in st, st
+    assert ("default", "sim-bad") not in di.simulator_operator().instances
