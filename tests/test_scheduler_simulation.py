@@ -367,6 +367,21 @@ def test_two_simulator_objects_run_isolated_scenarios_concurrently(host):
     assert ("default", "sim-a") not in di.simulator_operator().instances
 
 
+def test_reset_tears_down_simulator_instances(host):
+    """Reset deletes everything in the store (reference semantics: wipe
+    etcd back to boot state) — Simulator objects included — and the
+    DELETED events must tear the live instances down with them."""
+    srv, di = host
+    di.cluster_store.create(
+        "simulators", {"metadata": {"name": "reset-sim", "namespace": "default"}, "spec": {}}
+    )
+    di.simulator_operator().wait_idle(timeout=60)
+    assert ("default", "reset-sim") in di.simulator_operator().instances
+    di.reset_service().reset()
+    di.simulator_operator().wait_idle(timeout=30)
+    assert di.simulator_operator().instances == {}
+
+
 def test_simulator_bad_spec_fails_without_leaking(host):
     """A Simulator whose server cannot come up (unparseable port) lands
     in phase Failed with a message, and no instance is retained."""
