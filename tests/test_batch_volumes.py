@@ -293,7 +293,7 @@ def test_volume_workload_no_longer_forces_fallback():
     assert ok, why
 
 
-@pytest.mark.parametrize("seed", [4242, 7, 99])
+@pytest.mark.parametrize("seed", [4242, 7, 99, 1001, 31337])
 def test_mixed_everything_differential_full_default_profile(seed):
     """Cross-feature differential: one workload exercising EVERY kernel
     family at once — volumes (bound/WFC PVCs, gce conflicts, CSI limits),
