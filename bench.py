@@ -300,6 +300,8 @@ def run_churn(P_total=10000, N=5000, waves=5, delete_frac=0.1, budget_s=480.0):
     wave_walls = []
     wave_device = []
     wave_commit = []
+    wave_commit_rate = []
+    wave_overlap = []
     device_s = 0.0
     t0 = time.perf_counter()
     for w in range(waves):
@@ -308,10 +310,15 @@ def run_churn(P_total=10000, N=5000, waves=5, delete_frac=0.1, budget_s=480.0):
             created += 1
         tw = time.perf_counter()
         dev_before = svc._batch_engine.cum_timings.get("device_s", 0.0) if svc._batch_engine else 0.0
+        est_before = svc._batch_engine.cum_timings.get("device_est_s", 0.0) if svc._batch_engine else 0.0
         commit_before = svc.stats.get("commit_s", 0.0)
         results = svc.schedule_pending(max_rounds=1)
         wave_walls.append(round(time.perf_counter() - tw, 2))
-        wave_commit.append(round(svc.stats.get("commit_s", 0.0) - commit_before, 2))
+        commit_delta = svc.stats.get("commit_s", 0.0) - commit_before
+        wave_commit.append(round(commit_delta, 2))
+        wave_ok = sum(1 for r in results.values() if r.success)
+        # commit-path trajectory: pods committed per host-commit second
+        wave_commit_rate.append(round(wave_ok / commit_delta) if commit_delta > 0.005 else 0)
         eng = svc._batch_engine
         if eng:
             # cum delta: correct across mid-wave kernel restarts and
@@ -319,9 +326,20 @@ def run_churn(P_total=10000, N=5000, waves=5, delete_frac=0.1, budget_s=480.0):
             dev_delta = eng.cum_timings.get("device_s", 0.0) - dev_before
             device_s += dev_delta
             wave_device.append(round(dev_delta, 2))
+            # pipelined rounds: device_s is the BLOCKED wait, device_est_s
+            # estimates total device busy (first unoverlapped window × the
+            # window count) — the hidden fraction is the overlap win.
+            # Non-pipelined rounds report no estimate → 0.
+            est_delta = eng.cum_timings.get("device_est_s", 0.0) - est_before
+            wave_overlap.append(
+                round(max(0.0, min(1.0, 1.0 - dev_delta / est_delta)), 3)
+                if est_delta > 0.005
+                else 0.0
+            )
         else:
             wave_device.append(0.0)
-        scheduled += sum(1 for r in results.values() if r.success)
+            wave_overlap.append(0.0)
+        scheduled += wave_ok
         waves_done += 1
         if time.perf_counter() - t0 > budget_s and w + 1 < waves:
             break
@@ -342,6 +360,12 @@ def run_churn(P_total=10000, N=5000, waves=5, delete_frac=0.1, budget_s=480.0):
         # of a wave wall is store churn + queue + encode
         "wave_device_s": wave_device,
         "wave_commit_s": wave_commit,
+        # commit-path trajectory columns (tracked across BENCH rounds):
+        # pods committed per host-commit second, and the fraction of
+        # device time the pipeline hid under host commits (0 when the
+        # round ran un-pipelined — e.g. CPU-pinned on a tiny host)
+        "commit_pods_per_s": wave_commit_rate,
+        "overlap_efficiency": wave_overlap,
         "device_s": round(device_s, 2),
         "scheduled": scheduled,
         "pods_per_s": round(scheduled / wall),

@@ -730,6 +730,522 @@ fail:
     return NULL;
 }
 
+/* ----------------------------------------------------- wave commit tables */
+
+/* A "wave" capsule pre-resolves every per-round fragment table to raw
+ * (ptr, len) pairs ONCE per scheduling wave: the per-(plugin, node)
+ * skeleton of the annotation documents is identical across the
+ * thousands of pods in a wave, and re-walking the Python lists
+ * (PyList_GET_ITEM + PyUnicode_AsUTF8AndSize per fragment, per pod) was
+ * a third of the per-pod emission cost.  Per-pod emission then reduces
+ * to window tests over int buffers plus memcpys of resolved fragments,
+ * with per-pod numbers spliced in via small value LUTs (np.unique
+ * inverse indices).  The Python fallbacks and the per-pod entry points
+ * above remain byte-identical (the parity suites pin all three). */
+typedef struct {
+    const char *p;
+    Py_ssize_t n;
+} Frag;
+
+typedef struct {
+    PyObject *refs;       /* keeps every source str/buffer alive */
+    Py_ssize_t n_true;
+    Frag *pass_p, *pass_e; /* [n_true] whole '"node":{...passed}' entries */
+    Frag *key_p, *key_e;   /* [n_true] '"node":' fragments */
+    const long long *order; /* [n_true] node ids in go_marshal key order */
+    Py_buffer order_v;
+    Py_ssize_t K;          /* score plugins */
+    Frag *sfrag_p, *sfrag_e; /* [K] '"Plugin":"' fragments */
+    Frag **lut_raw;        /* [K][lut_raw_n[k]] rendered score strings */
+    Frag **lut_fin;
+    Py_ssize_t *lut_raw_n, *lut_fin_n;
+    int nonascii;          /* any fragment non-ASCII: outputs decode UTF-8 */
+} Wave;
+
+static void wave_free(PyObject *cap) {
+    Wave *w = (Wave *)PyCapsule_GetPointer(cap, "kss_wave");
+    Py_ssize_t k;
+    if (!w) return;
+    PyMem_Free(w->pass_p);
+    PyMem_Free(w->pass_e);
+    PyMem_Free(w->key_p);
+    PyMem_Free(w->key_e);
+    PyMem_Free(w->sfrag_p);
+    PyMem_Free(w->sfrag_e);
+    if (w->lut_raw)
+        for (k = 0; k < w->K; k++) PyMem_Free(w->lut_raw[k]);
+    if (w->lut_fin)
+        for (k = 0; k < w->K; k++) PyMem_Free(w->lut_fin[k]);
+    PyMem_Free(w->lut_raw);
+    PyMem_Free(w->lut_fin);
+    PyMem_Free(w->lut_raw_n);
+    PyMem_Free(w->lut_fin_n);
+    if (w->order_v.obj) PyBuffer_Release(&w->order_v);
+    Py_XDECREF(w->refs);
+    PyMem_Free(w);
+}
+
+/* resolve a list[str] into a malloc'd Frag array; returns NULL on error */
+static Frag *resolve_frags(PyObject *list, Py_ssize_t want, int *nonascii) {
+    Py_ssize_t n, i;
+    Frag *out;
+    if (!PyList_Check(list) || PyList_GET_SIZE(list) < want) {
+        PyErr_SetString(PyExc_TypeError, "wave_new: expected list[str] of table length");
+        return NULL;
+    }
+    n = want;
+    out = (Frag *)PyMem_Malloc(sizeof(Frag) * (size_t)(n > 0 ? n : 1));
+    if (!out) {
+        PyErr_NoMemory();
+        return NULL;
+    }
+    for (i = 0; i < n; i++) {
+        PyObject *v = PyList_GET_ITEM(list, i);
+        Py_ssize_t ln;
+        const char *s;
+        if (!PyUnicode_Check(v)) {
+            PyErr_SetString(PyExc_TypeError, "wave_new: expected str");
+            PyMem_Free(out);
+            return NULL;
+        }
+        s = PyUnicode_AsUTF8AndSize(v, &ln);
+        if (!s) {
+            PyMem_Free(out);
+            return NULL;
+        }
+        if (!PyUnicode_IS_ASCII(v)) *nonascii = 1;
+        out[i].p = s;
+        out[i].n = ln;
+    }
+    return out;
+}
+
+/* wave_new(pass_list, pass_esc, key_frags, key_escs, order_i64, n_true,
+ *          sfrags, sfrags_esc, luts_raw, luts_fin) -> capsule
+ * The caller must keep the fragment lists unmutated for the capsule's
+ * lifetime (they are per-wave internals of the batch result). */
+static PyObject *py_wave_new(PyObject *self, PyObject *args) {
+    PyObject *pass_list, *pass_esc, *key_frags, *key_escs, *order_o;
+    PyObject *sfrags, *sfrags_esc, *luts_raw, *luts_fin;
+    long n_true;
+    Wave *w;
+    PyObject *cap = NULL;
+    Py_ssize_t k;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OOOOOlOOOO", &pass_list, &pass_esc, &key_frags,
+                          &key_escs, &order_o, &n_true, &sfrags, &sfrags_esc,
+                          &luts_raw, &luts_fin))
+        return NULL;
+    if (n_true < 0 || !PyList_Check(sfrags) || !PyList_Check(sfrags_esc) ||
+        !PyList_Check(luts_raw) || !PyList_Check(luts_fin) ||
+        PyList_GET_SIZE(sfrags_esc) != PyList_GET_SIZE(sfrags) ||
+        PyList_GET_SIZE(luts_raw) != PyList_GET_SIZE(sfrags) ||
+        PyList_GET_SIZE(luts_fin) != PyList_GET_SIZE(sfrags)) {
+        PyErr_SetString(PyExc_TypeError, "wave_new: bad arguments");
+        return NULL;
+    }
+    w = (Wave *)PyMem_Calloc(1, sizeof(Wave));
+    if (!w) return PyErr_NoMemory();
+    w->n_true = n_true;
+    w->K = PyList_GET_SIZE(sfrags);
+    w->refs = PyTuple_Pack(9, pass_list, pass_esc, key_frags, key_escs, order_o,
+                           sfrags, sfrags_esc, luts_raw, luts_fin);
+    if (!w->refs) goto fail;
+    {
+        Py_ssize_t on;
+        if (get_i64(order_o, &w->order_v, &w->order, &on) < 0) goto fail;
+        if (on < n_true) {
+            PyErr_SetString(PyExc_ValueError, "wave_new: order shorter than n_true");
+            goto fail;
+        }
+    }
+    if (!(w->pass_p = resolve_frags(pass_list, n_true, &w->nonascii))) goto fail;
+    if (!(w->pass_e = resolve_frags(pass_esc, n_true, &w->nonascii))) goto fail;
+    if (!(w->key_p = resolve_frags(key_frags, n_true, &w->nonascii))) goto fail;
+    if (!(w->key_e = resolve_frags(key_escs, n_true, &w->nonascii))) goto fail;
+    if (!(w->sfrag_p = resolve_frags(sfrags, w->K, &w->nonascii))) goto fail;
+    if (!(w->sfrag_e = resolve_frags(sfrags_esc, w->K, &w->nonascii))) goto fail;
+    w->lut_raw = (Frag **)PyMem_Calloc((size_t)(w->K > 0 ? w->K : 1), sizeof(Frag *));
+    w->lut_fin = (Frag **)PyMem_Calloc((size_t)(w->K > 0 ? w->K : 1), sizeof(Frag *));
+    w->lut_raw_n = (Py_ssize_t *)PyMem_Calloc((size_t)(w->K > 0 ? w->K : 1), sizeof(Py_ssize_t));
+    w->lut_fin_n = (Py_ssize_t *)PyMem_Calloc((size_t)(w->K > 0 ? w->K : 1), sizeof(Py_ssize_t));
+    if (!w->lut_raw || !w->lut_fin || !w->lut_raw_n || !w->lut_fin_n) {
+        PyErr_NoMemory();
+        goto fail;
+    }
+    for (k = 0; k < w->K; k++) {
+        PyObject *lr = PyList_GET_ITEM(luts_raw, k);
+        PyObject *lf = PyList_GET_ITEM(luts_fin, k);
+        if (!PyList_Check(lr) || !PyList_Check(lf)) {
+            PyErr_SetString(PyExc_TypeError, "wave_new: luts must be lists of lists");
+            goto fail;
+        }
+        w->lut_raw_n[k] = PyList_GET_SIZE(lr);
+        w->lut_fin_n[k] = PyList_GET_SIZE(lf);
+        if (!(w->lut_raw[k] = resolve_frags(lr, w->lut_raw_n[k], &w->nonascii))) goto fail;
+        if (!(w->lut_fin[k] = resolve_frags(lf, w->lut_fin_n[k], &w->nonascii))) goto fail;
+    }
+    cap = PyCapsule_New(w, "kss_wave", wave_free);
+    if (cap) return cap;
+fail:
+    /* manual teardown: the capsule (and its destructor) never existed */
+    {
+        Py_ssize_t kk;
+        PyMem_Free(w->pass_p);
+        PyMem_Free(w->pass_e);
+        PyMem_Free(w->key_p);
+        PyMem_Free(w->key_e);
+        PyMem_Free(w->sfrag_p);
+        PyMem_Free(w->sfrag_e);
+        if (w->lut_raw)
+            for (kk = 0; kk < w->K; kk++) PyMem_Free(w->lut_raw[kk]);
+        if (w->lut_fin)
+            for (kk = 0; kk < w->K; kk++) PyMem_Free(w->lut_fin[kk]);
+        PyMem_Free(w->lut_raw);
+        PyMem_Free(w->lut_fin);
+        PyMem_Free(w->lut_raw_n);
+        PyMem_Free(w->lut_fin_n);
+        if (w->order_v.obj) PyBuffer_Release(&w->order_v);
+        Py_XDECREF(w->refs);
+        PyMem_Free(w);
+    }
+    return NULL;
+}
+
+static Wave *wave_arg(PyObject *cap) {
+    Wave *w = (Wave *)PyCapsule_GetPointer(cap, "kss_wave");
+    if (!w) PyErr_SetString(PyExc_TypeError, "expected a wave capsule");
+    return w;
+}
+
+/* shared emit/size core for the wave filter document.  mode: 0 = plain
+ * (pass_p/key_p + ftable), 1 = escaped twin (pass_e/key_e + ftable).
+ * With b==NULL computes the exact size into *size_out. */
+static int wave_filter_core(Buf *b, Wave *w, int esc, long long start, long long proc,
+                            const long long *fail_ids, const long long *fail_uidx,
+                            Py_ssize_t NF, Frag *ftab, Py_ssize_t TBL,
+                            Py_ssize_t *size_out) {
+    Frag *pass = esc ? w->pass_e : w->pass_p;
+    Frag *key = esc ? w->key_e : w->key_p;
+    int *over_idx = NULL;
+    Py_ssize_t sz = 2, t;
+    int first = 1, rc = -1;
+    if (NF > 0) {
+        over_idx = (int *)PyMem_Malloc(sizeof(int) * (size_t)(w->n_true > 0 ? w->n_true : 1));
+        if (!over_idx) {
+            PyErr_NoMemory();
+            return -1;
+        }
+        memset(over_idx, 0xFF, sizeof(int) * (size_t)(w->n_true > 0 ? w->n_true : 1));
+        for (t = 0; t < NF; t++) {
+            long long id = fail_ids[t], u = fail_uidx[t];
+            if (id < 0 || id >= w->n_true || u < 0 || u >= TBL) {
+                PyErr_SetString(PyExc_IndexError, "wave filter: fail id out of range");
+                goto done;
+            }
+            over_idx[id] = (int)u;
+        }
+    }
+    if (b && buf_putc(b, '{') < 0) goto done;
+    for (t = 0; t < w->n_true; t++) {
+        long long id = w->order[t], rank;
+        if (id < 0 || id >= w->n_true) continue;
+        rank = id - start;
+        if (rank < 0) rank += w->n_true;
+        if (rank >= proc) continue;
+        if (!first) {
+            if (b && buf_putc(b, ',') < 0) goto done;
+            sz += 1;
+        }
+        first = 0;
+        if (over_idx && over_idx[id] >= 0) {
+            int u = over_idx[id];
+            if (b) {
+                if (buf_put(b, key[id].p, key[id].n) < 0 ||
+                    buf_put(b, ftab[u].p, ftab[u].n) < 0)
+                    goto done;
+            } else {
+                sz += key[id].n + ftab[u].n;
+            }
+        } else {
+            if (b) {
+                if (buf_put(b, pass[id].p, pass[id].n) < 0) goto done;
+            } else {
+                sz += pass[id].n;
+            }
+        }
+    }
+    if (b && buf_putc(b, '}') < 0) goto done;
+    if (size_out) *size_out = sz;
+    rc = 0;
+done:
+    PyMem_Free(over_idx);
+    return rc;
+}
+
+/* wave_filter_json(cap, start, proc, fail_ids|None, fail_uidx|None,
+ *                  ftable|None) -> plain str */
+static PyObject *py_wave_filter_json(PyObject *self, PyObject *args) {
+    PyObject *cap, *fail_ids_o, *fail_uidx_o, *ftable;
+    long long start, proc;
+    Wave *w;
+    Py_buffer ids_v = {0}, uidx_v = {0};
+    const long long *fail_ids = NULL, *fail_uidx = NULL;
+    Py_ssize_t NF = 0, NF2 = 0, TBL = 0, sz = 0;
+    Frag *ftab = NULL;
+    Buf b;
+    PyObject *out = NULL;
+    int nonascii_tab = 0;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OLLOOO", &cap, &start, &proc, &fail_ids_o,
+                          &fail_uidx_o, &ftable))
+        return NULL;
+    if (!(w = wave_arg(cap))) return NULL;
+    if (get_i64(fail_ids_o, &ids_v, &fail_ids, &NF) < 0) return NULL;
+    if (get_i64(fail_uidx_o, &uidx_v, &fail_uidx, &NF2) < 0) goto done;
+    if (NF != NF2) {
+        PyErr_SetString(PyExc_ValueError, "wave_filter_json: fail length mismatch");
+        goto done;
+    }
+    if (ftable != Py_None) {
+        TBL = PyList_Check(ftable) ? PyList_GET_SIZE(ftable) : -1;
+        if (TBL < 0) {
+            PyErr_SetString(PyExc_TypeError, "wave_filter_json: ftable must be a list");
+            goto done;
+        }
+        if (TBL && !(ftab = resolve_frags(ftable, TBL, &nonascii_tab))) goto done;
+    }
+    if (wave_filter_core(NULL, w, 0, start, proc, fail_ids, fail_uidx, NF, ftab, TBL, &sz) < 0)
+        goto done;
+    if (buf_init(&b, sz) < 0) goto done;
+    if (w->nonascii || nonascii_tab) b.nonascii = 1;
+    if (wave_filter_core(&b, w, 0, start, proc, fail_ids, fail_uidx, NF, ftab, TBL, NULL) < 0) {
+        buf_release(&b);
+        goto done;
+    }
+    out = buf_take(&b);
+done:
+    PyMem_Free(ftab);
+    if (ids_v.obj) PyBuffer_Release(&ids_v);
+    if (uidx_v.obj) PyBuffer_Release(&uidx_v);
+    return out;
+}
+
+/* deferred twin: rest = (cap, start, proc, fail_ids|None, fail_uidx|None,
+ * etable) — emits the history-escaped filter body from the wave tables */
+static int emit_wave_filter_esc(Buf *b, PyObject *rest, Py_ssize_t *size_out) {
+    PyObject *cap, *fail_ids_o, *fail_uidx_o, *etable;
+    long long start, proc;
+    Wave *w;
+    Py_buffer ids_v = {0}, uidx_v = {0};
+    const long long *fail_ids = NULL, *fail_uidx = NULL;
+    Py_ssize_t NF = 0, NF2 = 0, TBL = 0;
+    Frag *etab = NULL;
+    int nonascii_tab = 0, rc = -1;
+    if (!PyArg_ParseTuple(rest, "OLLOOO", &cap, &start, &proc, &fail_ids_o,
+                          &fail_uidx_o, &etable))
+        return -1;
+    if (!(w = wave_arg(cap))) return -1;
+    if (get_i64(fail_ids_o, &ids_v, &fail_ids, &NF) < 0) return -1;
+    if (get_i64(fail_uidx_o, &uidx_v, &fail_uidx, &NF2) < 0) goto done;
+    if (NF != NF2) {
+        PyErr_SetString(PyExc_ValueError, "wave filter esc: fail length mismatch");
+        goto done;
+    }
+    if (etable != Py_None) {
+        TBL = PyList_Check(etable) ? PyList_GET_SIZE(etable) : -1;
+        if (TBL < 0) {
+            PyErr_SetString(PyExc_TypeError, "wave filter esc: etable must be a list");
+            goto done;
+        }
+        if (TBL && !(etab = resolve_frags(etable, TBL, &nonascii_tab))) goto done;
+    }
+    if (b && (w->nonascii || nonascii_tab)) b->nonascii = 1;
+    rc = wave_filter_core(b, w, 1, start, proc, fail_ids, fail_uidx, NF, etab, TBL, size_out);
+done:
+    PyMem_Free(etab);
+    if (ids_v.obj) PyBuffer_Release(&ids_v);
+    if (uidx_v.obj) PyBuffer_Release(&uidx_v);
+    return rc;
+}
+
+/* shared emit/size core for the wave score document.  esc selects the
+ * escaped key/plugin fragments and the \" closer; which selects the
+ * raw (0) or final (1) value LUT. */
+static int wave_score_core(Buf *b, Wave *w, int esc, int which, const long long *ns,
+                           const long long *perm, Py_ssize_t T,
+                           const long long **inv, Py_ssize_t *inv_n,
+                           Py_ssize_t *size_out) {
+    Frag *key = esc ? w->key_e : w->key_p;
+    Frag *sfrag = esc ? w->sfrag_e : w->sfrag_p;
+    Frag **lut = which ? w->lut_fin : w->lut_raw;
+    Py_ssize_t *lut_n = which ? w->lut_fin_n : w->lut_raw_n;
+    Py_ssize_t sz = 2, t, k;
+    for (t = 0; t < T; t++) {
+        long long id = ns[t], j = perm[t];
+        if (id < 0 || id >= w->n_true) {
+            PyErr_SetString(PyExc_IndexError, "wave score: node id out of range");
+            return -1;
+        }
+        if (t) {
+            if (b && buf_putc(b, ',') < 0) return -1;
+            sz += 1;
+        }
+        if (b) {
+            if (buf_put(b, key[id].p, key[id].n) < 0 || buf_putc(b, '{') < 0) return -1;
+        } else {
+            sz += key[id].n + 2;
+        }
+        for (k = 0; k < w->K; k++) {
+            long long u;
+            if (j < 0 || j >= inv_n[k]) {
+                PyErr_SetString(PyExc_IndexError, "wave score: perm out of range");
+                return -1;
+            }
+            u = inv[k][j];
+            if (u < 0 || u >= lut_n[k]) {
+                PyErr_SetString(PyExc_IndexError, "wave score: lut index out of range");
+                return -1;
+            }
+            if (k) {
+                if (b && buf_putc(b, ',') < 0) return -1;
+                sz += 1;
+            }
+            if (b) {
+                if (buf_put(b, sfrag[k].p, sfrag[k].n) < 0) return -1;
+                if (buf_put(b, lut[k][u].p, lut[k][u].n) < 0) return -1;
+                if (esc ? buf_put(b, "\\\"", 2) < 0 : buf_putc(b, '"') < 0) return -1;
+            } else {
+                sz += sfrag[k].n + lut[k][u].n + (esc ? 2 : 1);
+            }
+        }
+        if (b && buf_putc(b, '}') < 0) return -1;
+    }
+    /* the enclosing '{' '}' are the caller's (counted in sz) */
+    if (size_out) *size_out = sz;
+    return 0;
+}
+
+/* wave_score_json(cap, which, ns_i64, perm_i64, inv_bufs) -> plain str.
+ * inv_bufs: sequence of K int64 buffers (np.unique inverse rows). */
+static int wave_score_invs(PyObject *inv_o, Py_ssize_t K, Py_buffer *views,
+                           const long long **inv, Py_ssize_t *inv_n) {
+    Py_ssize_t k;
+    PyObject *seq = PySequence_Fast(inv_o, "wave score: inv_bufs must be a sequence");
+    if (!seq) return -1;
+    if (PySequence_Fast_GET_SIZE(seq) != K) {
+        Py_DECREF(seq);
+        PyErr_SetString(PyExc_ValueError, "wave score: need one inv row per plugin");
+        return -1;
+    }
+    for (k = 0; k < K; k++) {
+        if (get_i64(PySequence_Fast_GET_ITEM(seq, k), &views[k], &inv[k], &inv_n[k]) < 0) {
+            while (--k >= 0)
+                if (views[k].obj) PyBuffer_Release(&views[k]);
+            Py_DECREF(seq);
+            return -1;
+        }
+    }
+    Py_DECREF(seq);
+    return 0;
+}
+
+static PyObject *py_wave_score_json(PyObject *self, PyObject *args) {
+    PyObject *cap, *ns_o, *perm_o, *inv_o;
+    int which;
+    Wave *w;
+    Py_buffer ns_v = {0}, perm_v = {0};
+    Py_buffer *views = NULL;
+    const long long *ns = NULL, *perm = NULL;
+    const long long **inv = NULL;
+    Py_ssize_t *inv_n = NULL;
+    Py_ssize_t T = 0, T2 = 0, sz = 0, k;
+    Buf b;
+    PyObject *out = NULL;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OiOOO", &cap, &which, &ns_o, &perm_o, &inv_o)) return NULL;
+    if (!(w = wave_arg(cap))) return NULL;
+    views = (Py_buffer *)PyMem_Calloc((size_t)(w->K > 0 ? w->K : 1), sizeof(Py_buffer));
+    inv = (const long long **)PyMem_Calloc((size_t)(w->K > 0 ? w->K : 1), sizeof(long long *));
+    inv_n = (Py_ssize_t *)PyMem_Calloc((size_t)(w->K > 0 ? w->K : 1), sizeof(Py_ssize_t));
+    if (!views || !inv || !inv_n) {
+        PyErr_NoMemory();
+        goto done;
+    }
+    if (get_i64(ns_o, &ns_v, &ns, &T) < 0) goto done;
+    if (get_i64(perm_o, &perm_v, &perm, &T2) < 0) goto done;
+    if (T != T2) {
+        PyErr_SetString(PyExc_ValueError, "wave_score_json: ns/perm length mismatch");
+        goto done;
+    }
+    if (wave_score_invs(inv_o, w->K, views, inv, inv_n) < 0) goto done;
+    if (wave_score_core(NULL, w, 0, which, ns, perm, T, inv, inv_n, &sz) < 0) goto done;
+    if (buf_init(&b, sz) < 0) goto done;
+    if (w->nonascii) b.nonascii = 1;
+    if (buf_putc(&b, '{') < 0 ||
+        wave_score_core(&b, w, 0, which, ns, perm, T, inv, inv_n, NULL) < 0 ||
+        buf_putc(&b, '}') < 0) {
+        buf_release(&b);
+        goto done;
+    }
+    out = buf_take(&b);
+done:
+    if (ns_v.obj) PyBuffer_Release(&ns_v);
+    if (perm_v.obj) PyBuffer_Release(&perm_v);
+    if (views)
+        for (k = 0; k < w->K; k++)
+            if (views[k].obj) PyBuffer_Release(&views[k]);
+    PyMem_Free(views);
+    PyMem_Free(inv);
+    PyMem_Free(inv_n);
+    return out;
+}
+
+/* deferred twin: rest = (cap, which, ns_i64, perm_i64, inv_bufs) */
+static int emit_wave_score_esc(Buf *b, PyObject *rest, Py_ssize_t *size_out) {
+    PyObject *cap, *ns_o, *perm_o, *inv_o;
+    int which;
+    Wave *w;
+    Py_buffer ns_v = {0}, perm_v = {0};
+    Py_buffer *views = NULL;
+    const long long *ns = NULL, *perm = NULL;
+    const long long **inv = NULL;
+    Py_ssize_t *inv_n = NULL;
+    Py_ssize_t T = 0, T2 = 0, k;
+    int rc = -1;
+    if (!PyArg_ParseTuple(rest, "OiOOO", &cap, &which, &ns_o, &perm_o, &inv_o)) return -1;
+    if (!(w = wave_arg(cap))) return -1;
+    views = (Py_buffer *)PyMem_Calloc((size_t)(w->K > 0 ? w->K : 1), sizeof(Py_buffer));
+    inv = (const long long **)PyMem_Calloc((size_t)(w->K > 0 ? w->K : 1), sizeof(long long *));
+    inv_n = (Py_ssize_t *)PyMem_Calloc((size_t)(w->K > 0 ? w->K : 1), sizeof(Py_ssize_t));
+    if (!views || !inv || !inv_n) {
+        PyErr_NoMemory();
+        goto done;
+    }
+    if (get_i64(ns_o, &ns_v, &ns, &T) < 0) goto done;
+    if (get_i64(perm_o, &perm_v, &perm, &T2) < 0) goto done;
+    if (T != T2) {
+        PyErr_SetString(PyExc_ValueError, "wave score esc: ns/perm length mismatch");
+        goto done;
+    }
+    if (wave_score_invs(inv_o, w->K, views, inv, inv_n) < 0) goto done;
+    if (b && w->nonascii) b->nonascii = 1;
+    if (b && buf_putc(b, '{') < 0) goto done;
+    if (wave_score_core(b, w, 1, which, ns, perm, T, inv, inv_n, size_out) < 0) goto done;
+    if (b && buf_putc(b, '}') < 0) goto done;
+    rc = 0;
+done:
+    if (ns_v.obj) PyBuffer_Release(&ns_v);
+    if (perm_v.obj) PyBuffer_Release(&perm_v);
+    if (views)
+        for (k = 0; k < w->K; k++)
+            if (views[k].obj) PyBuffer_Release(&views[k]);
+    PyMem_Free(views);
+    PyMem_Free(inv);
+    PyMem_Free(inv_n);
+    return rc;
+}
+
 /* ------------------------------------------------- lazy history assembly */
 
 /* Emit the history-escaped body of a filter annotation STRAIGHT into the
@@ -972,6 +1488,10 @@ static PyObject *py_history_append2(PyObject *self, PyObject *args) {
                     rc = emit_filter_esc(NULL, rest, &part_sz);
                 } else if (PyUnicode_CompareWithASCIIString(tag, "score") == 0) {
                     rc = emit_score_esc(NULL, rest, &part_sz);
+                } else if (PyUnicode_CompareWithASCIIString(tag, "wfilter") == 0) {
+                    rc = emit_wave_filter_esc(NULL, rest, &part_sz);
+                } else if (PyUnicode_CompareWithASCIIString(tag, "wscore") == 0) {
+                    rc = emit_wave_score_esc(NULL, rest, &part_sz);
                 } else {
                     PyErr_SetString(PyExc_TypeError, "history_append2: unknown deferred tag");
                     rc = -1;
@@ -1015,6 +1535,10 @@ static PyObject *py_history_append2(PyObject *self, PyObject *args) {
                 rc = emit_filter_esc(&b, rest, NULL);
             } else if (PyUnicode_CompareWithASCIIString(tag, "score") == 0) {
                 rc = emit_score_esc(&b, rest, NULL);
+            } else if (PyUnicode_CompareWithASCIIString(tag, "wfilter") == 0) {
+                rc = emit_wave_filter_esc(&b, rest, NULL);
+            } else if (PyUnicode_CompareWithASCIIString(tag, "wscore") == 0) {
+                rc = emit_wave_score_esc(&b, rest, NULL);
             } else {
                 PyErr_SetString(PyExc_TypeError, "history_append2: unknown deferred tag");
                 rc = -1;
@@ -1049,6 +1573,12 @@ static PyMethodDef methods[] = {
      "score annotation JSON plus its escaped twin"},
     {"filter_json", py_filter_json, METH_VARARGS,
      "filter annotation JSON plus its escaped twin, from per-node entries"},
+    {"wave_new", py_wave_new, METH_VARARGS,
+     "pre-resolve a commit wave's fragment tables into a capsule"},
+    {"wave_filter_json", py_wave_filter_json, METH_VARARGS,
+     "plain filter annotation JSON from a wave capsule's tables"},
+    {"wave_score_json", py_wave_score_json, METH_VARARGS,
+     "plain score/finalScore annotation JSON from a wave capsule's LUTs"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -768,6 +768,10 @@ def reconstruct_trace(
                 "derived feasible ids disagree with the kernel's feasible counts"
             )
         out["sids"] = sids
+        # keep the sorted visit-id matrix: per-pod annotation builders
+        # read their visited windows from it (first `processed` columns
+        # of a row) instead of re-deriving and re-sorting per pod
+        out["visit_ids"] = ids.astype(np.int64, copy=False)
     else:
         out["sids"] = fetched["sids"]
     if cfg.scores:
@@ -795,7 +799,54 @@ def reconstruct_trace(
     return out
 
 
-def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False, ws0: "int | None" = None):
+CARRY0_FIELDS = (
+    "requested0", "nonzero0", "pod_count0", "ports_used0", "restr_used0",
+    "cloud_used0", "csi_attached0", "spread_counts0",
+    "ip_sel0", "ip_own0", "ip_anti0", "start0",
+)
+
+# DeviceProblem fields carrying the pod axis (axis 0 / axis 1): the
+# windowed scan slices exactly these to its [offset, offset+Wp) view.
+POD_WINDOW_AXIS0 = (
+    "pod_req", "pod_nonzero", "fit_checked", "pod_tol_idx", "pod_aff_idx",
+    "pod_pref_idx", "pod_img_idx", "name_target", "pod_ports", "pod_vol_idx",
+    "pod_restr", "cloud_cnt", "pod_csi", "ip_aff_g", "ip_anti_g", "ip_pref_g",
+    "ip_pref_w", "ip_own_g", "ip_own_w", "ip_self_match", "pod_active",
+    "spf_ku", "sps_ku",
+)
+POD_WINDOW_AXIS1 = ("spread_match", "term_match")
+
+
+def slice_pod_window(dp: DeviceProblem, offset, Wp: int) -> DeviceProblem:
+    """The [offset, offset+Wp) pod-window view of a DeviceProblem (traced
+    offset, static width) — everything the scan step reads per pod is
+    sliced; node-axis state and class matrices pass through.  tb_base
+    shifts by the offset so the counter-keyed tie-break draws stay those
+    of the pod's GLOBAL queue position."""
+    offset = jnp.asarray(offset, jnp.int32)
+    repl: dict = {
+        f: lax.dynamic_slice_in_dim(getattr(dp, f), offset, Wp, axis=0)
+        for f in POD_WINDOW_AXIS0
+    }
+    repl.update(
+        {
+            f: lax.dynamic_slice_in_dim(getattr(dp, f), offset, Wp, axis=1)
+            for f in POD_WINDOW_AXIS1
+        }
+    )
+    repl["spf"] = tuple(lax.dynamic_slice_in_dim(a, offset, Wp, axis=0) for a in dp.spf)
+    repl["sps"] = tuple(lax.dynamic_slice_in_dim(a, offset, Wp, axis=0) for a in dp.sps)
+    repl["tb_base"] = dp.tb_base + offset.astype(jnp.uint32)
+    return dp._replace(**repl)
+
+
+def build_batch_fn(
+    cfg: BatchConfig,
+    dims: dict,
+    donate: bool = False,
+    ws0: "int | None" = None,
+    window: "int | None" = None,
+):
     """Build the jitted batch scheduling function for a static config/dims.
 
     Returns fn(dp: DeviceProblem) → dict of result arrays.  With
@@ -803,6 +854,18 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False, ws0: "int
     carry aliases into the scan carry instead of being copied; callers
     must not reuse ``dp`` after the call (BatchEngine builds a fresh one
     per round).
+
+    ``window`` (static pod-window width Wp): returns
+    fn(carry0, dp, offset) → ys instead, scanning ONLY pods
+    [offset, offset+Wp) and returning the final carry under
+    ``ys["_final_carry"]`` — the commit pipeline chains windows through
+    it, keeping the carry on device, and dispatches window k+1 before
+    window k's trace is fetched so device execution overlaps the host
+    commit.  carry0 is donated (each window's carry aliases forward);
+    ``dp`` must arrive with the CARRY0_FIELDS slimmed to scalars (the
+    real initial carry travels as the first window's carry0).  Windowed
+    scans are byte-equivalent to one full scan: the scan body is
+    identical and the carry chains exactly.
 
     ``ws0`` (trace mode, sampling on): a STATIC upper bound on per-pod
     feasible nodes — bucket(sample_k).  When set, the per-step score
@@ -815,6 +878,7 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False, ws0: "int
     pages every round.  Callers must key their fn cache on ws0 (it
     depends on sample_k, which is otherwise a traced scalar)."""
     P, N, D = dims["P"], dims["N"], dims["D"]
+    Pw = window or P  # pods per scan (the full pod axis, or one window)
     KC, KS = dims["KC"], dims["KS"]
     KA, KB, KP, KO = dims["KA"], dims["KB"], dims["KP"], dims["KO"]
     G, SG = dims["G"], dims["SG"]
@@ -1289,9 +1353,11 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False, ws0: "int
             vz_code=pair(dp.vz_cls, dp.pod_vol_idx, dp.node_label_idx),
         )
 
-    def _scan(carry0, dp: DeviceProblem):
+    def _scan(carry0, dp: DeviceProblem, offset=None):
+        if window is not None:
+            dp = slice_pod_window(dp, offset, window)
         dp = _expand_features(dp, carry0[0].dtype)
-        carry, ys = lax.scan(functools.partial(step, dp), carry0, jnp.arange(P))
+        carry, ys = lax.scan(functools.partial(step, dp), carry0, jnp.arange(Pw))
         ys["final_requested"] = carry[0]
         ys["final_pod_count"] = carry[2]
         ys["final_start"] = carry[-1]
@@ -1305,7 +1371,7 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False, ws0: "int
                 ys["feasible_count"].astype(jnp.int32),
                 ys["sample_start"].astype(jnp.int32),
                 ys["sample_processed"].astype(jnp.int32),
-                jnp.broadcast_to(ys["final_start"], (P,)).astype(jnp.int32),
+                jnp.broadcast_to(ys["final_start"], (Pw,)).astype(jnp.int32),
             ]
         )
         if cfg.trace:
@@ -1322,6 +1388,12 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False, ws0: "int
                     jnp.arange(ws0, dtype=jnp.int32)[None, :]
                     < ys["feasible_count"].astype(jnp.int32)[:, None]
                 )
+            else:
+                # padding pod rows (pod_active=False) still carry sampled
+                # nodes in the full-width planes; the in-step path zeroes
+                # them via feasible_count — mask here too so both paths
+                # select identical fetch dtypes for identical rounds
+                feas = feas & dp.pod_active[:, None]
             rows = [
                 jnp.stack(
                     [
@@ -1340,11 +1412,14 @@ def build_batch_fn(cfg: BatchConfig, dims: dict, donate: bool = False, ws0: "int
             ys["trace_meta"] = jnp.stack(rows)
         return carry, ys
 
-    CARRY0_FIELDS = (
-        "requested0", "nonzero0", "pod_count0", "ports_used0", "restr_used0",
-        "cloud_used0", "csi_attached0", "spread_counts0",
-        "ip_sel0", "ip_own0", "ip_anti0", "start0",
-    )
+    if window is not None:
+
+        def run_windowed(carry0, dp: DeviceProblem, offset):
+            carry, ys = _scan(carry0, dp, offset)
+            ys["_final_carry"] = carry
+            return ys
+
+        return jax.jit(run_windowed, donate_argnums=(0,))
 
     def run(dp: DeviceProblem):
         carry0 = tuple(getattr(dp, f) for f in CARRY0_FIELDS)

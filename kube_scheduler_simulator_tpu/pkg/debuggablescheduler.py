@@ -42,6 +42,8 @@ def new_scheduler(
     plugin_extenders: "dict[str, PluginExtenderInitializer] | None" = None,
     config: "Obj | None" = None,
     use_batch: str = "off",
+    commit_wave: int = 256,
+    pipeline: "bool | str" = "auto",
 ) -> "tuple[SchedulerService, Any]":
     """NewSchedulerCommand analog: returns (scheduler service, result store).
 
@@ -50,8 +52,13 @@ def new_scheduler(
     ``plugin_extenders``: plugin name → initializer(result_store) returning
     an object with before_/after_ hook methods — the WithPluginExtenders
     option (command.go:41-46).
+    ``commit_wave`` / ``pipeline``: the batch path's bulk-commit wave size
+    and double-buffered round setting (SchedulerService docstring) — embed
+    hosts running big batch rounds tune these alongside ``use_batch``.
     """
-    svc = SchedulerService(cluster_store, use_batch=use_batch)
+    svc = SchedulerService(
+        cluster_store, use_batch=use_batch, commit_wave=commit_wave, pipeline=pipeline
+    )
     if plugins:
         svc.set_out_of_tree_registries(dict(plugins))
         # out-of-tree plugins default to enabled at every point they

@@ -62,6 +62,21 @@ WAIT_MESSAGE = "wait"
 POST_FILTER_NOMINATED_MESSAGE = "preemption victim"
 
 
+def _merge_categories(e: dict, categories: dict) -> None:
+    """The ONE category-merge rule both batch recorders share (per-pod
+    ``add_batch_results`` and wave ``add_wave_results``): dict categories
+    merge into the pod's own maps, pre-marshaled strings / pairs /
+    scalars replace wholesale.  Callers hold the store mutex."""
+    for cat, data in categories.items():
+        if cat not in e:
+            raise KeyError(f"unknown result category {cat!r}")
+        if isinstance(e[cat], dict) and isinstance(data, dict):
+            e[cat].update(data)
+        else:
+            # RawJSON (pre-marshaled), pair, or scalar: replace wholesale
+            e[cat] = data
+
+
 def _new_result() -> dict[str, Any]:
     return {
         "selectedNode": "",
@@ -188,15 +203,18 @@ class ResultStore:
         it by memcpy instead of re-escaping megabytes of quote-dense
         JSON (see ``get_stored_escs``)."""
         with self._mu:
-            e = self._entry(namespace, pod_name)
-            for cat, data in categories.items():
-                if cat not in e:
-                    raise KeyError(f"unknown result category {cat!r}")
-                if isinstance(e[cat], dict) and isinstance(data, dict):
-                    e[cat].update(data)
-                else:
-                    # RawJSON (pre-marshaled) or scalar: replace wholesale
-                    e[cat] = data
+            _merge_categories(self._entry(namespace, pod_name), categories)
+
+    def add_wave_results(self, entries: "list[tuple[str, str, dict]]") -> None:
+        """``add_batch_results`` for a whole commit wave under ONE lock
+        acquisition: ``entries`` is [(namespace, pod_name, categories)].
+        Category dicts may be SHARED across entries (the per-wave
+        prefilter/reserve/bind status maps are identical for every pod)
+        — dict categories are merged by ``update`` into each pod's own
+        maps, so sharing never aliases mutable state between pods."""
+        with self._mu:
+            for ns, pod_name, categories in entries:
+                _merge_categories(self._entry(ns, pod_name), categories)
 
     # ------------------------------------------------------------------ read
 

@@ -159,6 +159,74 @@ class StoreReflector:
         finally:
             self._in_flush.discard(key)
 
+    def flush_wave(self, cluster_store: Any, pods: "list[Obj]") -> None:
+        """``flush_pod`` for a whole commit wave in ONE store transaction.
+
+        Byte-identical to flushing each pod individually — same store
+        merge, same history splice, same trust bookkeeping — but the
+        wave's annotation patches commit through the store's bulk-apply
+        entry point: one lock acquisition and one batched watch-event
+        dispatch instead of N get/update round-trips.  Each pod's
+        read-modify-write runs atomically under the store lock, so a
+        mid-wave conflict (the per-pod path's retry_on_conflict case)
+        cannot occur; pods deleted since the kernel decided are skipped,
+        exactly as flush_pod's vanished-pod path does."""
+        muts: list[tuple[str, str, Any]] = []
+        keys: list[str] = []
+        for pod in pods:
+            ns = pod["metadata"].get("namespace", "default")
+            name = pod["metadata"]["name"]
+            key = f"{ns}/{name}"
+            if key in self._in_flush:
+                continue
+            merged: dict[str, str] = {}
+            escs: dict[str, str] = {}
+            had_any = False
+            for store in self._stores.values():
+                if not store.has_result(pod):
+                    continue
+                result = store.get_stored_result(pod)
+                if result:
+                    had_any = True
+                    merged.update(result)
+                    getter = getattr(store, "get_stored_escs", None)
+                    if getter is not None:
+                        escs.update(getter(pod))
+            if not had_any:
+                continue
+            for store in self._stores.values():
+                store.delete_data(pod)
+
+            def mutate(cur: Obj, key=key, merged=merged, escs=escs) -> Obj:
+                # copy-on-write along the changed path only (bulk_update's
+                # read-only contract): everything but metadata/annotations
+                # is shared with the replaced object
+                meta = cur["metadata"]
+                annotations = dict(meta.get("annotations") or {})
+                annotations.update(merged)
+                existing = (meta.get("annotations") or {}).get(anno.RESULT_HISTORY)
+                rec = self._history_written.get(key)
+                trusted = (
+                    rec is not None
+                    and existing is not None
+                    and rec[0] == len(existing)
+                    and existing[-64:] == rec[1]
+                )
+                new_history = _updated_history(existing, merged, trusted=trusted, escs=escs)
+                annotations[anno.RESULT_HISTORY] = new_history
+                self._history_written[key] = (len(new_history), new_history[-64:])
+                return {**cur, "metadata": {**meta, "annotations": annotations}}
+
+            muts.append((name, ns, mutate))
+            keys.append(key)
+        if not muts:
+            return
+        self._in_flush.update(keys)
+        try:
+            cluster_store.bulk_update("pods", muts)
+        finally:
+            self._in_flush.difference_update(keys)
+
 
 # annotation keys repeat per pod — marshal each key fragment once
 _KEY_FRAGS: dict[str, str] = {}

@@ -22,6 +22,13 @@ The "SimulationController" of the KEP maps to the scheduler service's
 synchronous ``schedule_pending`` (TPU batch path included) plus the
 controller manager's ``reconcile_all``; ControllerWaiter convergence is
 detected when a full pass makes no progress (README.md:371-381).
+
+Large replay steps ride the service's pipelined bulk-commit path: the
+batch kernel runs in pod windows whose device execution overlaps the
+previous window's host-side annotation commit, and each commit wave
+lands through one store transaction (docs/batch-engine.md, "The commit
+pipeline") — determinism is unaffected because windows chain the scan
+carry exactly and commits stay in queue order.
 """
 
 from __future__ import annotations

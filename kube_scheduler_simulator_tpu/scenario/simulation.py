@@ -60,6 +60,29 @@ class SchedulerSimulationError(Exception):
     pass
 
 
+def _resolve_template_path(path: str) -> str:
+    """Resolve ``spec.scenarioTemplateFilePath`` INSIDE the configured
+    template directory ($KSS_SCENARIO_TEMPLATE_DIR).  The field arrives
+    from API clients (POST /api/v1/schedulersimulations, the CRD), so an
+    unrestricted open() is a file-disclosure primitive; with no directory
+    configured the indirection is disabled outright."""
+    import os
+
+    base = os.environ.get("KSS_SCENARIO_TEMPLATE_DIR")
+    if not base:
+        raise SchedulerSimulationError(
+            "spec.scenarioTemplateFilePath is disabled: set "
+            "KSS_SCENARIO_TEMPLATE_DIR to the scenario-template directory"
+        )
+    root = os.path.realpath(base)
+    full = os.path.realpath(os.path.join(root, path))
+    if full != root and not full.startswith(root + os.sep):
+        raise SchedulerSimulationError(
+            "spec.scenarioTemplateFilePath escapes the scenario-template directory"
+        )
+    return full
+
+
 def _load_scenario_spec(spec: Obj) -> Obj:
     scenario = spec.get("scenario")
     if scenario is None:
@@ -70,8 +93,14 @@ def _load_scenario_spec(spec: Obj) -> Obj:
             )
         import json
 
-        with open(path) as f:
-            text = f.read()
+        full = _resolve_template_path(path)
+        try:
+            with open(full) as f:
+                text = f.read()
+        except OSError:
+            raise SchedulerSimulationError(f"cannot read scenario template {path!r}")
+        # Parser exceptions embed file-content snippets (YAML error
+        # context) — never reflect their text into status.message.
         try:
             doc = json.loads(text)
         except ValueError:
@@ -79,8 +108,14 @@ def _load_scenario_spec(spec: Obj) -> Obj:
                 import yaml
 
                 doc = yaml.safe_load(text)
-            except ImportError as e:  # pragma: no cover - yaml is bundled
-                raise SchedulerSimulationError(f"cannot parse {path}: {e}")
+            except ImportError:  # pragma: no cover - yaml is bundled
+                raise SchedulerSimulationError(
+                    f"cannot parse scenario template {path!r} (yaml unavailable)"
+                )
+            except Exception:
+                raise SchedulerSimulationError(
+                    f"cannot parse scenario template {path!r} as JSON or YAML"
+                )
         # accept either a full Scenario object or a bare spec
         scenario = doc.get("spec", doc) if isinstance(doc, dict) else None
     if not isinstance(scenario, dict):

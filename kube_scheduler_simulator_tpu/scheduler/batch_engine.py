@@ -199,7 +199,13 @@ class BatchResult:
     building."""
 
     def __init__(
-        self, engine: "BatchEngine", pending: list[Obj], out: dict, pr: E.BatchProblem, nodes: list[Obj]
+        self,
+        engine: "BatchEngine",
+        pending: list[Obj],
+        out: dict,
+        pr: "E.BatchProblem | _WindowProblem",
+        nodes: list[Obj],
+        fr_shared: "dict | None" = None,
     ):
         self._engine = engine
         self.pending = pending
@@ -211,6 +217,10 @@ class BatchResult:
         self.node_names = pr.node_names
         self.pod_keys = pr.pod_keys
         self._lists: "dict | None" = None  # lazy tolist() caches
+        # round-level fragment-table cache: a pipelined round's windows
+        # share one node axis, so the O(N) fragment build (_fr) runs once
+        # per ROUND, not once per window (schedule_waves passes the dict)
+        self._fr_shared = fr_shared
 
     @property
     def selected_nodes(self) -> "list[str | None]":
@@ -237,13 +247,26 @@ class BatchResult:
             tr = self.out["trace"]
             cfg = self._engine.cfg
 
-            def strs(arr: "np.ndarray") -> list:
-                """[P,WS] ints → [P][WS] of INTERNED str objects: np.unique
-                + object-LUT indexing formats each distinct value once
-                (unicode astype would re-format all P×WS elements)."""
+            def lut_inv(arr: "np.ndarray") -> tuple:
+                """[P,WS] ints → (LUT of rendered str per DISTINCT value,
+                [P,WS] int64 inverse indices): each distinct value is
+                formatted ONCE, and the wave C path splices values from
+                the LUT by index — the materialized [P][WS] object lists
+                (``_strs_of``) are only built for the fallback paths.
+                Score planes are narrow-range ints, so the common case is
+                a direct offset LUT (min/max + one subtract) instead of
+                np.unique's full sort of P×WS elements."""
+                mn = int(arr.min()) if arr.size else 0
+                mx = int(arr.max()) if arr.size else 0
+                if mx - mn <= 4096:
+                    lut = [str(v) for v in range(mn, mx + 1)]
+                    inv = arr.astype(np.int64) - mn
+                    return lut, np.ascontiguousarray(inv)
                 uniq, inv = np.unique(arr, return_inverse=True)
-                lut = np.array([str(int(v)) for v in uniq], dtype=object)
-                return lut[inv].reshape(arr.shape).tolist()
+                lut = [str(int(v)) for v in uniq]
+                return lut, np.ascontiguousarray(
+                    inv.reshape(arr.shape).astype(np.int64)
+                )
 
             fp = tr.get("fail_plug")
             self._lists = {
@@ -264,11 +287,14 @@ class BatchResult:
                     else -1
                 ),
                 "norm_int": {s: tr["norm"][k] for k, (s, _w) in enumerate(cfg.scores)},
-                "raw_s": {s: strs(tr["raw"][k]) for k, (s, _w) in enumerate(cfg.scores)},
-                "final_s": {
-                    s: strs(tr["norm"][k].astype(np.int32) * int(w))
+                "raw_li": {s: lut_inv(tr["raw"][k]) for k, (s, _w) in enumerate(cfg.scores)},
+                "fin_li": {
+                    s: lut_inv(tr["norm"][k].astype(np.int32) * int(w))
                     for k, (s, w) in enumerate(cfg.scores)
                 },
+                # lazily materialized [P][WS] interned-str lists (fallbacks)
+                "raw_s": {},
+                "final_s": {},
                 # failure messages repeat across pods — memo by site
                 "msg_memo": {},
             }
@@ -280,15 +306,87 @@ class BatchResult:
             }
         return self._lists
 
+    def _strs_of(self, plugin: str, final: bool = False) -> list:
+        """[P][WS] interned score strings for one plugin, materialized
+        lazily from its LUT (the wave C path never needs these)."""
+        tr = self._tr()
+        cache = tr["final_s" if final else "raw_s"]
+        v = cache.get(plugin)
+        if v is None:
+            lut, inv = tr["fin_li" if final else "raw_li"][plugin]
+            v = cache[plugin] = np.array(lut, dtype=object)[inv].tolist()
+        return v
+
+    def _wave(self) -> "dict | None":
+        """The per-wave C commit tables (None when the native wave path
+        can't engage): a capsule pre-resolving every fragment table once
+        per wave, plus ONE batched name-order argsort of the feasible ids
+        — per-pod annotation assembly then runs entirely from resolved
+        (ptr, len) tables and int buffers (native.fastjson wave_*)."""
+        tr = self._tr()
+        if "wave" in tr:
+            return tr["wave"]
+        wave = None
+        from kube_scheduler_simulator_tpu import native
+
+        fj = native.fastjson
+        fr = self._fr()
+        cfg = self._engine.cfg
+        if fj is not None and hasattr(fj, "wave_new") and "pass_esc" in fr:
+            try:
+                splug = fr["splug"]
+                cap = fj.wave_new(
+                    fr["pass_list"],
+                    fr["pass_esc"],
+                    fr["key"],
+                    fr["key_esc"],
+                    fr["order_i64"],
+                    self.problem.N_true,
+                    [f for f, _s in splug],
+                    fr["splug_esc"],
+                    [tr["raw_li"][s][0] for _f, s in splug],
+                    [tr["fin_li"][s][0] for _f, s in splug],
+                )
+                sids = tr["sids"]
+                valid = sids >= 0
+                rank = fr["rank_by_name"]
+                keys = np.where(valid, rank[np.clip(sids, 0, None)], len(rank) + 1)
+                sperm = np.ascontiguousarray(
+                    np.argsort(keys, axis=1, kind="stable").astype(np.int64)
+                )
+                ns_sorted = np.ascontiguousarray(
+                    np.take_along_axis(sids.astype(np.int64), sperm, axis=1)
+                )
+                wave = {
+                    "cap": cap,
+                    "ns": ns_sorted,
+                    "perm": sperm,
+                    "counts": valid.sum(axis=1),
+                    "raw_inv": [tr["raw_li"][s][1] for _f, s in splug],
+                    "fin_inv": [tr["fin_li"][s][1] for _f, s in splug],
+                }
+            except UnicodeEncodeError:
+                wave = None
+        tr["wave"] = wave
+        return wave
+
     def _visited_ids(self, i: int) -> "np.ndarray":
         """The nodes pod i's cycle visited, ascending node index — the
         column order of the compact fail planes.  Derived (not fetched):
-        the visit window is (start + r) % n_true for r < processed."""
-        start = int(self.out["sample_start"][i])
+        the visit window is (start + r) % n_true for r < processed.
+        ``reconstruct_trace`` already sorted the whole [P,W] id matrix
+        when fail planes exist — reuse it instead of re-sorting per pod."""
         proc = int(self.out["sample_processed"][i])
         n_true = self.problem.N_true
         if proc >= n_true:
             return np.arange(n_true, dtype=np.int64)
+        trace = self.out.get("trace")
+        ids = trace.get("visit_ids") if trace else None
+        if ids is not None:
+            # sorted row with invalid columns pushed past n_true: the
+            # first `proc` entries are exactly the visited ids
+            return ids[i, :proc]
+        start = int(self.out["sample_start"][i])
         r = np.arange(proc, dtype=np.int64)
         return np.sort((start + r) % n_true)
 
@@ -349,7 +447,7 @@ class BatchResult:
         tr = self._tr()
         sids = tr["sids"][i]
         rows = [
-            (plugin, tr["raw_s"][plugin][i], tr["final_s"][plugin][i])
+            (plugin, self._strs_of(plugin)[i], self._strs_of(plugin, final=True)[i])
             for plugin, _weight in self._engine.cfg.scores
         ]
         node_names = self.problem.node_names
@@ -400,6 +498,10 @@ class BatchResult:
         cost at bench scale — the parity suites pin the bytes."""
         tr = self._tr()
         if "frags" not in tr:
+            shared = self._fr_shared
+            if shared is not None and "frags" in shared:
+                tr["frags"] = shared["frags"]
+                return tr["frags"]
             from kube_scheduler_simulator_tpu.utils.gojson import go_marshal, go_string_key
 
             names = self.problem.node_names
@@ -449,6 +551,8 @@ class BatchResult:
                     )
                 except UnicodeEncodeError:
                     pass
+            if shared is not None:
+                shared["frags"] = tr["frags"]
         return tr["frags"]
 
     def filter_annotation_json(self, i: int) -> "str":
@@ -474,61 +578,82 @@ class BatchResult:
         fr = self._fr()
         fj = native.fastjson
         if fj is not None and "pass_esc" in fr and self._prefilter_node_set(i) is None:
+            wave = self._wave() if hasattr(fj, "wave_new") else None
             try:
+                if wave is not None:
+                    return self._filter_annotation_wave(i, tr, fj, wave, want_esc)
                 return self._filter_annotation_native(i, tr, fr, fj, want_esc)
             except UnicodeEncodeError:
                 pass  # lone surrogates in a message: Python path below
         return self._filter_annotation_json_py(i, tr, fr), None
 
+    def _fail_tables(self, i: int, tr: dict, fj) -> tuple:
+        """(fail_ids, fail_uidx, ftable, etable) for pod i's failing
+        visited nodes — (None, None, [], []) when every visited node
+        passed.  Distinct-failure dedup: entries depend on (plugin, code)
+        only — except TaintToleration, whose message names the node's
+        taint, so its key also carries the node id."""
+        fp_all = tr["fail_plug"]
+        if fp_all is None or not tr["fail_any_row"][i]:
+            return None, None, [], []
+        from kube_scheduler_simulator_tpu.utils.gojson import go_marshal
+
+        ids = self._visited_ids(i)
+        fp = fp_all[i][: len(ids)]
+        cols = np.nonzero(fp >= 0)[0]
+        fpc = fp[cols].astype(np.int64)
+        fcc = tr["fail_code"][i][cols].astype(np.int64)
+        idsc = ids[cols].astype(np.int64)
+        taint_k = tr["taint_k"]
+        if taint_k >= 0:
+            extra = np.where(fpc == taint_k, idsc + 1, 0)
+        else:
+            extra = 0
+        ucode = (fpc << 40) | (extra << 16) | fcc
+        uniq, first, inv = np.unique(ucode, return_index=True, return_inverse=True)
+        entry_memo = tr.setdefault("entry_memo_esc", {})
+        cfg_filters = self._engine.cfg.filters
+        filters = self._engine.filters
+        fail_pos = tr["fail_pos"]
+        ftable: list = []
+        etable: list = []
+        for t0, u in zip(first, uniq):
+            k = int(u >> 40)
+            plugin = cfg_filters[k]
+            msg = self._msg(i, int(idsc[t0]), plugin, int(fcc[t0]))
+            ek = (k, msg)
+            pair = entry_memo.get(ek)
+            if pair is None:
+                entry = {p: PASSED_FILTER_MESSAGE for p in filters[: fail_pos[k]]}
+                entry[plugin] = msg
+                frag = go_marshal(entry)
+                pair = entry_memo[ek] = (frag, fj.escape_body(frag))
+            ftable.append(pair[0])
+            etable.append(pair[1])
+        return idsc, inv.astype(np.int64), ftable, etable
+
+    def _filter_annotation_wave(
+        self, i: int, tr: dict, fj, wave: dict, want_esc: bool
+    ) -> "tuple[str, Any]":
+        """Filter pair from the wave capsule: one C call over resolved
+        tables; the escaped twin is a DEFERRED wave spec the history
+        writer emits straight into the trail."""
+        start = int(self.out["sample_start"][i])
+        proc = int(self.out["sample_processed"][i])
+        fail_ids, fail_uidx, ftable, etable = self._fail_tables(i, tr, fj)
+        cap = wave["cap"]
+        s = fj.wave_filter_json(cap, start, proc, fail_ids, fail_uidx, ftable)
+        if not want_esc:
+            return s, None
+        return s, ("wfilter", cap, start, proc, fail_ids, fail_uidx, etable)
+
     def _filter_annotation_native(
         self, i: int, tr: dict, fr: dict, fj, want_esc: bool
     ) -> "tuple[str, str | None]":
-        from kube_scheduler_simulator_tpu.utils.gojson import go_marshal
-
         start = int(self.out["sample_start"][i])
         proc = int(self.out["sample_processed"][i])
         n_true = self.problem.N_true
-        fail_ids = None
-        fail_uidx = None
-        ftable: list = []
-        etable: list = []
-        fp_all = tr["fail_plug"]
-        if fp_all is not None and tr["fail_any_row"][i]:
-            ids = self._visited_ids(i)
-            fp = fp_all[i][: len(ids)]
-            cols = np.nonzero(fp >= 0)[0]
-            fpc = fp[cols].astype(np.int64)
-            fcc = tr["fail_code"][i][cols].astype(np.int64)
-            idsc = ids[cols]
-            # distinct-failure dedup: entries depend on (plugin, code)
-            # only — except TaintToleration, whose message names the
-            # node's taint, so its key also carries the node id
-            taint_k = tr["taint_k"]
-            if taint_k >= 0:
-                extra = np.where(fpc == taint_k, idsc + 1, 0)
-            else:
-                extra = 0
-            ucode = (fpc << 40) | (extra << 16) | fcc
-            uniq, first, inv = np.unique(ucode, return_index=True, return_inverse=True)
-            entry_memo = tr.setdefault("entry_memo_esc", {})
-            cfg_filters = self._engine.cfg.filters
-            filters = self._engine.filters
-            fail_pos = tr["fail_pos"]
-            for t0, u in zip(first, uniq):
-                k = int(u >> 40)
-                plugin = cfg_filters[k]
-                msg = self._msg(i, int(idsc[t0]), plugin, int(fcc[t0]))
-                ek = (k, msg)
-                pair = entry_memo.get(ek)
-                if pair is None:
-                    entry = {p: PASSED_FILTER_MESSAGE for p in filters[: fail_pos[k]]}
-                    entry[plugin] = msg
-                    frag = go_marshal(entry)
-                    pair = entry_memo[ek] = (frag, fj.escape_body(frag))
-                ftable.append(pair[0])
-                etable.append(pair[1])
-            fail_ids = idsc
-            fail_uidx = inv.astype(np.int64)
+        fail_ids, fail_uidx, ftable, etable = self._fail_tables(i, tr, fj)
         # plain-only C mode: the twin bytes are never materialized here —
         # the history writer emits them straight into the trail from the
         # DEFERRED spec below (native.fastjson.history_append2), so every
@@ -622,6 +747,33 @@ class BatchResult:
 
         tr = self._tr()
         fr = self._fr()
+        wave = self._wave()
+        if wave is not None:
+            # one C call per document from the wave capsule's resolved
+            # tables; the escaped twins are DEFERRED wave specs — the
+            # history writer emits their bytes straight into the trail
+            T = int(wave["counts"][i])
+            if T == 0:
+                return ("{}", "{}"), ("{}", "{}")
+            fj = native.fastjson
+            cap = wave["cap"]
+            ns_row = wave["ns"][i, :T]
+            perm_row = wave["perm"][i, :T]
+            raw_inv = [inv[i] for inv in wave["raw_inv"]]
+            fin_inv = [inv[i] for inv in wave["fin_inv"]]
+            try:
+                return (
+                    (
+                        fj.wave_score_json(cap, 0, ns_row, perm_row, raw_inv),
+                        ("wscore", cap, 0, ns_row, perm_row, raw_inv),
+                    ),
+                    (
+                        fj.wave_score_json(cap, 1, ns_row, perm_row, fin_inv),
+                        ("wscore", cap, 1, ns_row, perm_row, fin_inv),
+                    ),
+                )
+            except UnicodeEncodeError:
+                pass  # lone surrogates: non-wave paths below
         sids_row = tr["sids"][i]
         js = np.nonzero(sids_row >= 0)[0]
         if js.size == 0:
@@ -634,8 +786,8 @@ class BatchResult:
         perm = js.tolist()
         splug = fr["splug"]
         frags = [frag for frag, _s in splug]
-        raw_rows = [tr["raw_s"][s][i] for _f, s in splug]
-        fin_rows = [tr["final_s"][s][i] for _f, s in splug]
+        raw_rows = [self._strs_of(s)[i] for _f, s in splug]
+        fin_rows = [self._strs_of(s, final=True)[i] for _f, s in splug]
         if native.fastjson is not None and "key_esc_arr" in fr:
             keys_esc = fr["key_esc_arr"][ns].tolist()
             frags_esc = fr["splug_esc"]
@@ -700,6 +852,22 @@ class BatchResult:
             return None
         idx = {nm: j for j, nm in enumerate(self.problem.node_names)}
         return {idx[nm] for nm in narrowed if nm in idx}
+
+
+class _WindowProblem:
+    """Pod-window view of an encoded BatchProblem: exactly what
+    BatchResult and the annotation formatters read, with the pod-axis
+    host metadata sliced to the window.  Node-axis metadata is shared
+    (the per-wave fragment tables key off node_names identity)."""
+
+    __slots__ = ("node_names", "pod_keys", "fit_order", "resource_names", "N_true")
+
+    def __init__(self, pr: "E.BatchProblem", lo: int, hi: int):
+        self.node_names = pr.node_names
+        self.pod_keys = pr.pod_keys[lo:hi]
+        self.fit_order = pr.fit_order[lo:hi]
+        self.resource_names = pr.resource_names
+        self.N_true = pr.N_true
 
 
 class BatchEngine:
@@ -992,16 +1160,19 @@ class BatchEngine:
                 return self._schedule(nodes, all_pods, pending, namespaces, base_counter, start_index, volumes)
         return self._schedule(nodes, all_pods, pending, namespaces, base_counter, start_index, volumes)
 
-    def _schedule(
+    def _prep(
         self,
         nodes: list[Obj],
         all_pods: list[Obj],
         pending: list[Obj],
-        namespaces: "list[Obj] | None" = None,
-        base_counter: int = 0,
-        start_index: int = 0,
-        volumes: "dict[str, list[Obj]] | None" = None,
-    ) -> BatchResult:
+        namespaces: "list[Obj] | None",
+        base_counter: int,
+        start_index: int,
+        volumes: "dict[str, list[Obj]] | None",
+    ) -> dict:
+        """Encode + pad + lower + place a round's problem; shared by the
+        one-dispatch path (``_schedule``) and the pipelined windowed path
+        (``schedule_waves``)."""
         from kube_scheduler_simulator_tpu.scheduler.framework_runner import (
             num_feasible_nodes_to_find,
         )
@@ -1061,100 +1232,243 @@ class BatchEngine:
             ws0,
             id(self.mesh) if self.mesh is not None else None,
         )
-        fn = self._fn_cache.get(key)
-        t2 = time.perf_counter()
-        if fn is None:
-            # single-device: donate — dp is rebuilt per round, so its
-            # buffers can alias into the scan carry instead of being
-            # copied; mesh: no donation (sharded carries would need
-            # matching output shardings to alias)
-            fn = B.build_batch_fn(cfg, dims, donate=self.mesh is None, ws0=ws0)
-            self._fn_cache[key] = fn
-            self.compiles += 1
-        out_dev = fn(dp)
-        # one roundtrip: the packed [5,P] per-pod view (see ops/batch)
-        packed = np.asarray(out_dev["packed_pod"])
-        out = {
+        return dict(
+            pr=pr, dp=dp, dims=dims, cfg=cfg, ws0=ws0, key=key,
+            nodes=nodes, pending=pending, t0=t0, t1=t1,
+        )
+
+    @staticmethod
+    def _packed_out(packed: "np.ndarray") -> dict:
+        return {
             "selected": packed[0],
             "feasible_count": packed[1],
             "sample_start": packed[2],
             "sample_processed": packed[3],
             "final_start": packed[4, 0] if packed.shape[1] else np.int32(0),
         }
-        if self.trace:
-            # Compact the [P,N] trace on device to the annotation writer's
-            # minimal reads — one (first-fail plugin, code) plane over the
-            # visited width, scores over the (much narrower) feasible
-            # width at per-plugin minimal dtypes — then fetch and expand
-            # host-side (reconstruct_trace); the tunnel D2H path is
-            # ~10 MB/s, so fetch volume is the trace cost.
-            max_processed = int(packed[3].max()) if packed.shape[1] else 1
-            W = min(dims["N"], E._bucket(max(max_processed, 1)))
-            max_feasible = int(packed[1].max()) if packed.shape[1] else 1
-            WS = min(dims["N"], E._bucket(max(max_feasible, 1)))
-            if ws0 is not None:
-                WS = min(WS, ws0)  # the in-step planes are [P, ws0]
-            mm = np.asarray(out_dev["trace_meta"])
-            widths = {"int8": 0, "int16": 1, "int32": 2}
-            raw_dtypes = []
-            for k in range(len(cfg.scores)):
-                dt = B.raw_dtype_for(int(mm[k, 0]), int(mm[k, 1]))
-                prev = self._raw_dtypes.get(k)
-                if prev is not None and widths[prev] > widths[dt]:
-                    dt = prev
-                self._raw_dtypes[k] = dt
-                raw_dtypes.append(dt)
-            raw_dtypes = tuple(raw_dtypes)
-            code_max = int(mm[-1, 1])
-            pack_mode = B.fail_pack_mode(code_max, len(cfg.filters))
-            ckey = (key, W, WS, raw_dtypes, pack_mode)
-            entry = self._compact_cache.get(ckey)
-            if entry is None:
-                entry = B.build_compact_fn(
-                    cfg, dims, W, WS, raw_dtypes, code_max, in_step_ws0=ws0
-                )
-                self._compact_cache[ckey] = entry
-                self.compiles += 1
-            cfn, manifest = entry
-            tr_keys = (
-                "sample_start", "sample_processed", "feasible",
-                "feasible_count", "fail_plug", "fail_code",
+
+    def _compact_dispatch(
+        self, cfg, dims: dict, key, ws0, out_dev: dict, packed: "np.ndarray", n_true: int
+    ):
+        """Build/reuse the trace-compaction executable for this round's
+        observed widths and DISPATCH it (async) — returns
+        (blob device array, manifest, raw_dtypes, WS); the caller fetches
+        the blob when it needs the bytes, letting later device work queue
+        behind the compaction in the meantime."""
+        max_processed = int(packed[3].max()) if packed.shape[1] else 1
+        W = min(dims["N"], E._bucket(max(max_processed, 1)))
+        max_feasible = int(packed[1].max()) if packed.shape[1] else 1
+        WS = min(dims["N"], E._bucket(max(max_feasible, 1)))
+        if ws0 is not None:
+            WS = min(WS, ws0)  # the in-step planes are [P, ws0]
+        mm = np.asarray(out_dev["trace_meta"])
+        widths = {"int8": 0, "int16": 1, "int32": 2}
+        raw_dtypes = []
+        for k in range(len(cfg.scores)):
+            dt = B.raw_dtype_for(int(mm[k, 0]), int(mm[k, 1]))
+            prev = self._raw_dtypes.get(k)
+            if prev is not None and widths[prev] > widths[dt]:
+                dt = prev
+            self._raw_dtypes[k] = dt
+            raw_dtypes.append(dt)
+        raw_dtypes = tuple(raw_dtypes)
+        code_max = int(mm[-1, 1])
+        pack_mode = B.fail_pack_mode(code_max, len(cfg.filters))
+        ckey = (key, W, WS, raw_dtypes, pack_mode)
+        entry = self._compact_cache.get(ckey)
+        if entry is None:
+            entry = B.build_compact_fn(
+                cfg, dims, W, WS, raw_dtypes, code_max, in_step_ws0=ws0
             )
-            blob = cfn(
-                {
-                    k: v
-                    for k, v in out_dev.items()
-                    if k in tr_keys or k.startswith(("raw:", "norm:"))
-                },
-                dp.n_true,
-            )
-            # ONE D2H transfer for the whole compacted trace
-            fetched = B.unpack_compact_blob(np.asarray(blob), manifest)
-            out["trace"] = B.reconstruct_trace(
-                cfg,
-                fetched,
-                out["sample_start"],
-                out["sample_processed"],
-                pr.N_true,
-                out["feasible_count"],
-                raw_dtypes,
-                len(pending),
-                WS,
-            )
-        t3 = time.perf_counter()
-        self.last_timings = {
-            "encode_s": t1 - t0,
-            "lower_s": t2 - t1,
-            "device_s": t3 - t2,
-            "total_s": t3 - t0,
-        }
+            self._compact_cache[ckey] = entry
+            self.compiles += 1
+        cfn, manifest = entry
+        tr_keys = (
+            "sample_start", "sample_processed", "feasible",
+            "feasible_count", "fail_plug", "fail_code",
+        )
+        blob = cfn(
+            {
+                k: v
+                for k, v in out_dev.items()
+                if k in tr_keys or k.startswith(("raw:", "norm:"))
+            },
+            np.int32(n_true),
+        )
+        return blob, manifest, raw_dtypes, WS
+
+    def _note_round(self, timings: dict) -> None:
+        self.last_timings = timings
         self.rounds += 1
         # rebind (not mutate) so the metrics scrape thread can copy the
         # captured dict without holding a lock
         self.cum_timings = {
-            k: self.cum_timings.get(k, 0.0) + v for k, v in self.last_timings.items()
+            k: self.cum_timings.get(k, 0.0) + v
+            for k, v in {**{j: 0.0 for j in self.cum_timings}, **timings}.items()
         }
-        return BatchResult(self, pending, out, pr, nodes)
+
+    def _schedule(
+        self,
+        nodes: list[Obj],
+        all_pods: list[Obj],
+        pending: list[Obj],
+        namespaces: "list[Obj] | None" = None,
+        base_counter: int = 0,
+        start_index: int = 0,
+        volumes: "dict[str, list[Obj]] | None" = None,
+    ) -> BatchResult:
+        return self._finish_prepped(
+            self._prep(nodes, all_pods, pending, namespaces, base_counter, start_index, volumes)
+        )
+
+    def schedule_waves(
+        self,
+        nodes: list[Obj],
+        all_pods: list[Obj],
+        pending: list[Obj],
+        namespaces: "list[Obj] | None" = None,
+        base_counter: int = 0,
+        start_index: int = 0,
+        volumes: "dict[str, list[Obj]] | None" = None,
+        wave_pods: int = 512,
+    ):
+        """Pipelined round: yields (BatchResult, offset, count) per pod
+        WINDOW, double-buffering the kernel against the caller's commit.
+
+        The round encodes ONCE; the scan then runs in windows of ~
+        ``wave_pods`` pods whose carry chains on device (byte-equivalent
+        to one full scan — same step, same carry).  Window k+1's scan is
+        dispatched BEFORE window k's trace blob is fetched, so while the
+        caller formats and commits window k's annotations on the host,
+        window k+1 executes on the device.  Single-device trace mode
+        only; callers must consume the generator in order and stop
+        consuming on a mid-round restart (abandoned windows' device work
+        is simply discarded, as a full-scan restart would discard it)."""
+        assert self.trace and self.mesh is None, "pipelined rounds are single-device trace rounds"
+        ctx = self._prep(nodes, all_pods, pending, namespaces, base_counter, start_index, volumes)
+        pr, dims, cfg, ws0 = ctx["pr"], ctx["dims"], ctx["cfg"], ctx["ws0"]
+        P = dims["P"]
+        pend_n = len(pending)
+        # window width: the largest power-of-two split of the (bucketed)
+        # pod axis that keeps windows at or above ~wave_pods
+        S = 1
+        while P % (S * 2) == 0 and P // (S * 2) >= max(int(wave_pods), 1):
+            S *= 2
+        Wp = P // S
+        if S == 1 or pend_n <= Wp // 2:
+            # degenerate split: the one-dispatch path (shares its
+            # executable cache with plain schedule() rounds)
+            yield self._finish_prepped(ctx), 0, pend_n
+            return
+        wdims = dict(dims, P=Wp)
+        wkey = (tuple(sorted(wdims.items())), cfg, ws0, "window")
+        t2 = time.perf_counter()
+        fnw = self._fn_cache.get(wkey)
+        if fnw is None:
+            fnw = B.build_batch_fn(cfg, dims, ws0=ws0, window=Wp)
+            self._fn_cache[wkey] = fnw
+            self.compiles += 1
+        dp = ctx.pop("dp")
+        # the initial carry travels separately (donated forward window to
+        # window); dp itself must not also carry those buffers
+        carry = tuple(getattr(dp, f) for f in B.CARRY0_FIELDS)
+        dp = dp._replace(**{f: np.int32(0) for f in B.CARRY0_FIELDS})
+        n_windows = (min(pend_n, P) + Wp - 1) // Wp
+        dev_wait = 0.0
+        est_scan = None
+        fr_shared: dict = {}  # one O(N) fragment build per ROUND
+        try:
+            ys = fnw(carry, dp, np.int32(0))
+            for c in range(n_windows):
+                offset = c * Wp
+                tw = time.perf_counter()
+                packed = np.asarray(ys["packed_pod"])  # blocks on window c's scan
+                wait = time.perf_counter() - tw
+                dev_wait += wait
+                if est_scan is None:
+                    est_scan = wait  # first window never overlaps anything
+                out = self._packed_out(packed)
+                blob, manifest, raw_dtypes, WS = self._compact_dispatch(
+                    cfg, wdims, wkey, ws0, ys, packed, pr.N_true
+                )
+                # double-buffer: next window's scan queues BEHIND this
+                # window's compaction and ahead of the host commit
+                if c + 1 < n_windows:
+                    ys = fnw(ys["_final_carry"], dp, np.int32(offset + Wp))
+                tw = time.perf_counter()
+                fetched = B.unpack_compact_blob(np.asarray(blob), manifest)
+                dev_wait += time.perf_counter() - tw
+                cnt = min(Wp, pend_n - offset)
+                out["trace"] = B.reconstruct_trace(
+                    cfg,
+                    fetched,
+                    out["sample_start"],
+                    out["sample_processed"],
+                    pr.N_true,
+                    out["feasible_count"],
+                    raw_dtypes,
+                    cnt,
+                    WS,
+                )
+                result = BatchResult(
+                    self,
+                    pending[offset : offset + cnt],
+                    out,
+                    _WindowProblem(pr, offset, offset + cnt),
+                    nodes,
+                    fr_shared=fr_shared,
+                )
+                yield result, offset, cnt
+        finally:
+            t3 = time.perf_counter()
+            self._note_round(
+                {
+                    "encode_s": ctx["t1"] - ctx["t0"],
+                    "lower_s": t2 - ctx["t1"],
+                    # blocked device wait — the device time the host PAID
+                    # (hidden windows don't show up here)
+                    "device_s": dev_wait,
+                    # estimated total device busy: the first window's
+                    # (unoverlapped) latency times the window count
+                    "device_est_s": (est_scan or 0.0) * n_windows,
+                    "total_s": t3 - ctx["t0"],
+                }
+            )
+
+    def _finish_prepped(self, ctx: dict) -> BatchResult:
+        """Run a prepped round through the one-dispatch path (used by
+        schedule_waves when the pod axis is too small to split)."""
+        pr, dp, dims = ctx["pr"], ctx["dp"], ctx["dims"]
+        cfg, ws0, key = ctx["cfg"], ctx["ws0"], ctx["key"]
+        fn = self._fn_cache.get(key)
+        t2 = time.perf_counter()
+        if fn is None:
+            fn = B.build_batch_fn(cfg, dims, donate=self.mesh is None, ws0=ws0)
+            self._fn_cache[key] = fn
+            self.compiles += 1
+        out_dev = fn(dp)
+        packed = np.asarray(out_dev["packed_pod"])
+        out = self._packed_out(packed)
+        if self.trace:
+            blob, manifest, raw_dtypes, WS = self._compact_dispatch(
+                cfg, dims, key, ws0, out_dev, packed, pr.N_true
+            )
+            fetched = B.unpack_compact_blob(np.asarray(blob), manifest)
+            out["trace"] = B.reconstruct_trace(
+                cfg, fetched, out["sample_start"], out["sample_processed"],
+                pr.N_true, out["feasible_count"], raw_dtypes,
+                len(ctx["pending"]), WS,
+            )
+        t3 = time.perf_counter()
+        self._note_round(
+            {
+                "encode_s": ctx["t1"] - ctx["t0"],
+                "lower_s": t2 - ctx["t1"],
+                "device_s": t3 - t2,
+                "total_s": t3 - ctx["t0"],
+            }
+        )
+        return BatchResult(self, ctx["pending"], out, pr, ctx["nodes"])
 
     # ----------------------------------------------------- trace helpers
 

@@ -50,6 +50,8 @@ class SchedulerService:
         batch_max_restarts: int = 8,
         clock: "Callable[[], float] | None" = None,
         mesh: Any = None,
+        commit_wave: int = 256,
+        pipeline: "bool | str" = "auto",
     ):
         """``use_batch``: "off" = sequential cycle only; "auto" = run whole
         pending rounds through the TPU batch engine when the profile ×
@@ -60,7 +62,17 @@ class SchedulerService:
 
         ``batch_min_work``: in auto mode, rounds with pods×nodes below this
         skip the batch path — XLA compile + dispatch overhead dwarfs tiny
-        interactive rounds; the sequential cycle answers instantly."""
+        interactive rounds; the sequential cycle answers instantly.
+
+        ``commit_wave``: pods per bulk-commit wave on the batch path —
+        each wave's annotation payloads land through ONE result-store /
+        reflector / cluster-store transaction.  ``pipeline``: double-
+        buffer the kernel over pod windows so wave k+1's device execution
+        overlaps wave k's host commit (single-device trace rounds only).
+        "auto" (default) enables it when the kernel runs on an
+        accelerator or the host has cores to spare — on a 1-2 core
+        CPU-pinned box the XLA scan and the host commit compete for the
+        same cores and the overlap is a wash."""
         self.cluster_store = cluster_store
         self.seed = seed
         self.tie_break = tie_break
@@ -69,6 +81,9 @@ class SchedulerService:
         # shards its node axis over it (SURVEY §2.5 scaling axis)
         self.mesh = mesh
         self.batch_min_work = batch_min_work
+        self.commit_wave = max(int(commit_wave), 1)
+        self.pipeline = pipeline
+        self._pipeline_resolved: "bool | None" = None if pipeline == "auto" else bool(pipeline)
         # Successful preemptions free resources mid-round, forcing a kernel
         # re-run on the remaining tail; past this many re-runs the round
         # finishes on the (equally exact) sequential cycle.
@@ -636,40 +651,39 @@ class SchedulerService:
         restarts = 0
         while i < len(pending):
             tail = pending[i:]
-            result = eng.schedule(
+            args = (
                 nodes,
                 self._pods_with_waiting_assumed(),
                 tail,
                 self.cluster_store.list("namespaces", copy_objects=False),
+            )
+            kw = dict(
                 base_counter=fw.sched_counter,
                 start_index=fw.next_start_node_index,
                 volumes=volumes,
             )
-            snapshot = self.build_snapshot()
-            sample_start = result.out["sample_start"]
+            if self._pipeline_on() and self.mesh is None and len(tail) > self.commit_wave:
+                # pipelined round: window k+1's device execution overlaps
+                # window k's host commit (engine double-buffers the scan)
+                windows = eng.schedule_waves(
+                    *args, **kw, wave_pods=max(self.commit_wave, 256)
+                )
+            else:
+                result = eng.schedule(*args, **kw)
+                windows = iter([(result, 0, len(tail))])
+            snapshot = None
             restart_at = None
-            for j, pod in enumerate(tail):
-                key = _pod_key(pod)
-                if int(result.selected[j]) >= 0 or not seq_failures:
-                    tc = time.perf_counter()
-                    results[key] = self._commit_batch_pod(result, j, pod, snapshot, point_names, fw)
-                    self.stats["commit_s"] += time.perf_counter() - tc
-                    fw.sched_counter += 1
-                    self.stats["batch_pods"] += 1
-                else:
-                    # Exact sequential cycle for this pod: same snapshot
-                    # state (earlier commits assumed), same attempt counter
-                    # and rotation start as the all-sequential round.
-                    fw.next_start_node_index = int(sample_start[j])
-                    tc = time.perf_counter()
-                    res = self.schedule_one(pod, snapshot)
-                    self.stats["commit_s"] += time.perf_counter() - tc
-                    results[key] = res
-                    if res.nominated_node:
-                        restart_at = i + j + 1
-                        break
-            if restart_at is None:
+            for result, off, cnt in windows:
+                if snapshot is None:
+                    # after the round's encode captured the cluster state
+                    snapshot = self.build_snapshot()
+                restart_at = self._replay_window(
+                    result, i, off, cnt, snapshot, point_names, fw, seq_failures, results
+                )
+                if restart_at is not None:
+                    break  # abandon the remaining windows (state changed)
                 fw.next_start_node_index = result.final_start
+            if restart_at is None:
                 break
             i = restart_at
             restarts += 1
@@ -682,6 +696,88 @@ class SchedulerService:
                 for pod in pending[i:]:
                     results[_pod_key(pod)] = self.schedule_one(pod, snapshot)
                 break
+
+    def _pipeline_on(self) -> bool:
+        """Resolve the ``pipeline`` setting once: "auto" turns the
+        double-buffered round on when the kernel executes somewhere the
+        host commit doesn't (an accelerator), or when the host has spare
+        cores for the XLA scan threads to overlap into."""
+        if self._pipeline_resolved is None:
+            on = False
+            try:
+                import jax
+
+                on = jax.default_backend() != "cpu"
+            except Exception:
+                on = False
+            if not on:
+                import os
+
+                on = (os.cpu_count() or 1) >= 4
+            self._pipeline_resolved = on
+        return self._pipeline_resolved
+
+    def _replay_window(
+        self,
+        result: Any,
+        base_i: int,
+        off: int,
+        cnt: int,
+        snapshot: "Snapshot",
+        point_names: dict[str, list[str]],
+        fw: Framework,
+        seq_failures: bool,
+        results: dict,
+    ) -> "int | None":
+        """Replay one kernel window's decisions in queue order.
+        Successful pods accumulate into bulk-commit waves
+        (``_commit_batch_wave``); kernel failures run per pod (the exact
+        sequential cycle when the profile owns preemption).  Returns the
+        absolute pending-index to restart the kernel from after a
+        successful preemption, else None."""
+        window = result.pending
+        sample_start = result.out["sample_start"]
+        wave_js: list[int] = []
+
+        def flush_wave() -> None:
+            if not wave_js:
+                return
+            tc = time.perf_counter()
+            self._commit_batch_wave(result, wave_js, window, snapshot, point_names, fw, results)
+            self.stats["commit_s"] += time.perf_counter() - tc
+            fw.sched_counter += len(wave_js)
+            self.stats["batch_pods"] += len(wave_js)
+            wave_js.clear()
+
+        for j in range(cnt):
+            pod = window[j]
+            key = _pod_key(pod)
+            if int(result.selected[j]) >= 0:
+                wave_js.append(j)
+                if len(wave_js) >= self.commit_wave:
+                    flush_wave()
+            elif not seq_failures:
+                # force mode: record the kernel's failure per pod
+                flush_wave()
+                tc = time.perf_counter()
+                results[key] = self._commit_batch_pod(result, j, pod, snapshot, point_names, fw)
+                self.stats["commit_s"] += time.perf_counter() - tc
+                fw.sched_counter += 1
+                self.stats["batch_pods"] += 1
+            else:
+                # Exact sequential cycle for this pod: same snapshot
+                # state (earlier commits assumed), same attempt counter
+                # and rotation start as the all-sequential round.
+                flush_wave()
+                fw.next_start_node_index = int(sample_start[j])
+                tc = time.perf_counter()
+                res = self.schedule_one(pod, snapshot)
+                self.stats["commit_s"] += time.perf_counter() - tc
+                results[key] = res
+                if res.nominated_node:
+                    return base_i + off + j + 1
+        flush_wave()
+        return None
 
     def _count_fallback(self, reason: str) -> None:
         with self._stats_lock:
@@ -711,6 +807,88 @@ class SchedulerService:
             "engine_last_timings": dict(eng.last_timings) if eng else {},
             "engine_cum_timings": dict(eng.cum_timings) if eng else {},
         }
+
+    def _commit_batch_wave(
+        self,
+        result: Any,
+        js: list[int],
+        tail: list[Obj],
+        snapshot: "Snapshot | None",
+        point_names: dict[str, list[str]],
+        fw: Framework,
+        results: dict,
+    ) -> None:
+        """Commit a wave of kernel-SCHEDULED pods in bulk: materialize
+        every pod's annotation payloads (the same categories the wrapped
+        plugins record), fill the result store under one lock, bind, and
+        flush the whole wave through the reflector's bulk-apply — one
+        cluster-store transaction with one batched watch-event dispatch.
+        Byte-identical to committing each pod via ``_commit_batch_pod``
+        (the commit-parity suite pins it): the shared per-wave status
+        maps marshal to the same bytes, and the filter/score documents
+        come from the same per-pod pair builders."""
+        from kube_scheduler_simulator_tpu.plugins.resultstore import SUCCESS_MESSAGE
+
+        rs = fw.result_store
+        pf_names = point_names["pre_filter"]
+        # per-wave shared category maps — identical content for every pod
+        # in the wave (add_wave_results merges them into per-pod state)
+        pf_status = {pn: SUCCESS_MESSAGE for pn in pf_names}
+        pre_score = {pn: SUCCESS_MESSAGE for pn in point_names["pre_score"]}
+        reserve = {pn: SUCCESS_MESSAGE for pn in point_names["reserve"]}
+        prebind = {pn: SUCCESS_MESSAGE for pn in point_names["pre_bind"]}
+        bind = {point_names["bind"][0]: SUCCESS_MESSAGE} if point_names["bind"] else None
+        entries: list[tuple[str, str, dict]] = []
+        bound: list[tuple[Obj, str, str, str]] = []
+        for j in js:
+            pod = tail[j]
+            ns = pod["metadata"].get("namespace", "default")
+            name = pod["metadata"]["name"]
+            node_name = result.node_names[int(result.selected[j])]
+            cats: dict = {}
+            if pf_names:
+                cats["preFilterStatus"] = pf_status
+                if "NodeAffinity" in pf_names:
+                    names = result._engine.prefilter_node_names(pod)
+                    if names is not None:
+                        cats["preFilterResult"] = {"NodeAffinity": sorted(names)}
+            cats["filter"] = result.filter_annotation_pair(j)
+            if int(result.feasible_count[j]) > 1:
+                if pre_score:
+                    cats["preScore"] = pre_score
+                score_pair, final_pair = result.score_annotations_pairs(j)
+                cats["score"] = score_pair
+                cats["finalScore"] = final_pair
+            if reserve:
+                # selected-node is recorded BY the wrapped Reserve hooks —
+                # a profile with no reserve plugins leaves it unset
+                cats["selectedNode"] = node_name
+                cats["reserve"] = reserve
+            if prebind:
+                cats["prebind"] = prebind
+            if bind:
+                cats["bind"] = bind
+            entries.append((ns, name, cats))
+            bound.append((pod, ns, name, node_name))
+        rs.add_wave_results(entries)
+        committed: list[tuple[Obj, str, str, str]] = []
+        for pod, ns, name, node_name in bound:
+            try:
+                self.cluster_store.bind_pod(ns, name, node_name)
+            except KeyError:
+                # deleted between the kernel's decision and this wave's
+                # commit: nothing to bind, nothing to flush — the
+                # reflector's store entry dies with the round
+                continue
+            if snapshot is not None:
+                snapshot.assume(pod, node_name)
+            results[_pod_key(pod)] = ScheduleResult(selected_node=node_name)
+            committed.append((pod, ns, name, node_name))
+        self.reflector.flush_wave(self.cluster_store, [p for p, *_ in committed])
+        for pod, ns, name, node_name in committed:
+            self._record_event(
+                pod, "Normal", "Scheduled", f"Successfully assigned {ns}/{name} to {node_name}"
+            )
 
     def _commit_batch_pod(
         self,

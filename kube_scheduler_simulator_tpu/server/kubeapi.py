@@ -499,7 +499,14 @@ def _make_handler(server: KubeAPIServer):
                     # strip the RV and last-write-win instead
                     updated = store.update(rt.store_kind, body, owned=True)
                 else:
-                    updated = store.apply(rt.store_kind, body)
+                    # RV-less PUT is still a REPLACE: the apiserver keeps
+                    # AllowCreateOnUpdate=false for these resources, so a
+                    # missing object must 404 (errors.IsNotFound for
+                    # delete-tolerant updaters) — never silently upsert.
+                    # update() IS that atomic replace-or-404 (no RV on the
+                    # body means no conflict check; stale uid overwritten)
+                    body["metadata"].pop("uid", None)
+                    updated = store.update(rt.store_kind, body, owned=True)
                 self._send_json(200, envelope(updated, rt.api_version, rt.kind))
             except ConflictError as e:
                 # client-go's retry.RetryOnConflict keys on 409 + reason

@@ -411,6 +411,56 @@ class ClusterStore:
                 return self.update(kind, o, owned=True)
             return self.create(kind, o)
 
+    def bulk_update(self, kind: str, mutations: "Iterable[tuple[str, str | None, Callable[[Obj], Obj | None]]]") -> int:
+        """Apply a wave of object mutations under ONE lock acquisition
+        with one batched watch-event dispatch — the bulk-apply entry point
+        the batch scheduler's commit pipeline uses instead of N
+        get/update round-trips (each of which would take and release the
+        lock and dispatch its event inline).
+
+        ``mutations``: (name, namespace, fn) triples.  ``fn`` receives the
+        LIVE current object — read under the lock, so the
+        read-modify-write is atomic and conflict-free by construction —
+        and must treat it as READ-ONLY, returning a full replacement
+        object (copy-on-write: rebuild the dicts along the changed path,
+        share everything else), or None to skip.  The read-only contract
+        is what makes the wave cheap: a defensive deep copy of a
+        megabyte-annotation pod per mutation would cost more than the
+        lock round-trips this entry point removes.  Objects deleted since
+        the caller planned the wave are skipped silently, exactly as a
+        per-object update loop would drop its NotFound.  Events are
+        appended to the log in mutation order (per-object
+        resourceVersions stay monotonic) and dispatched to
+        subscribers/hooks in one batch after all mutations land.
+        The replacement's ``metadata`` dict must itself be fresh — the
+        store stamps uid/creationTimestamp/resourceVersion into it.
+        Returns the number of objects updated."""
+        applied = 0
+        events: list[tuple[Obj, Obj]] = []
+        with self._lock:
+            bucket = self._bucket(kind)
+            for name, namespace, fn in mutations:
+                if kind in NAMESPACED_KINDS:
+                    k = f"{namespace or 'default'}/{name}"
+                else:
+                    k = name
+                cur = bucket.get(k)
+                if cur is None:
+                    continue
+                o = fn(cur)
+                if o is None or o is cur:
+                    continue
+                meta = o.setdefault("metadata", {})
+                meta["uid"] = cur["metadata"]["uid"]
+                meta["creationTimestamp"] = cur["metadata"]["creationTimestamp"]
+                meta["resourceVersion"] = str(self._next_rv())
+                bucket[k] = o
+                events.append((o, cur))
+                applied += 1
+            for o, old in events:
+                self._emit(kind, EVENT_MODIFIED, o, old=old)
+        return applied
+
     def patch(self, kind: str, name: str, patch: Mapping[str, Any], namespace: str | None = None) -> Obj:
         """Strategic-merge-lite patch: dicts merge recursively, None deletes."""
         with self._lock:

@@ -1,0 +1,327 @@
+"""The bulk-commit pipeline vs the per-pod commit path.
+
+The batch engine's commit side was rebuilt around waves (PR: pipelined
+bulk-commit): annotation payloads materialize wave-at-a-time through the
+native wave tables, land in the result store under one lock, and flush
+through the cluster store's bulk-apply with one batched event dispatch,
+while the kernel double-buffers pod windows under the host commit.  The
+contract is BYTE identity: every annotation (and the result-history
+trail) must equal what the sequential per-pod path writes.  The golden
+suite (tests/test_golden_reference.py) pins the underlying byte formats
+against the reference's Go tests; these suites pin the new path against
+the old ones on mixed-profile workloads, plus the ordering/atomicity
+properties of the pipeline itself.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any
+
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+from tests.test_batch_parity import mk_node, mk_pod, profile_with
+
+Obj = dict[str, Any]
+
+
+def _mixed_cluster(n_nodes: int = 48):
+    rng = random.Random(99)
+    nodes = []
+    for i in range(n_nodes):
+        labels = {
+            "kubernetes.io/hostname": f"node-{i}",
+            "topology.kubernetes.io/zone": f"z{i % 3}",
+            "disk": "ssd" if i % 2 else "hdd",
+        }
+        taints = (
+            [{"key": "spot", "value": "true", "effect": "NoSchedule"}]
+            if i % 11 == 0
+            else None
+        )
+        nodes.append(
+            mk_node(
+                f"node-{i}",
+                cpu_m=rng.choice([4000, 8000, 16000]),
+                mem_mi=16384,
+                labels=labels,
+                taints=taints,
+            )
+        )
+    return nodes
+
+
+def _mixed_pods(lo: int, hi: int):
+    """A mixed-profile workload: plain fits, selector-pinned pods, spread
+    constraints, and unschedulable giants (failure paths must stay
+    byte-identical too)."""
+    rng = random.Random(7)
+    pods = []
+    for i in range(lo, hi):
+        extra: dict = {}
+        if i % 5 == 0:
+            extra["nodeSelector"] = {"disk": "ssd"}
+        if i % 7 == 0:
+            extra["topologySpreadConstraints"] = [
+                {
+                    "maxSkew": 2,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": f"a{i % 2}"}},
+                }
+            ]
+        cpu = 900000 if i % 17 == 0 else rng.choice([100, 300, 700])
+        pods.append(
+            mk_pod(
+                f"pod-{i}",
+                cpu_m=cpu,
+                mem_mi=rng.choice([128, 512]),
+                labels={"app": f"a{i % 2}"},
+                **extra,
+            )
+        )
+    return pods
+
+
+def _run_rounds(svc: SchedulerService, store: ClusterStore, rounds: list[list[Obj]]):
+    for pods in rounds:
+        for p in pods:
+            store.create("pods", dict(p))
+        svc.schedule_pending(max_rounds=1)
+
+
+def _pod_states(store: ClusterStore) -> dict:
+    out = {}
+    for p in store.list("pods"):
+        name = p["metadata"]["name"]
+        out[name] = (
+            (p.get("spec") or {}).get("nodeName"),
+            p["metadata"].get("annotations") or {},
+        )
+    return out
+
+
+def test_bulk_commit_bytes_identical_to_per_pod_path():
+    """The acceptance oracle: the SAME workload committed through the
+    bulk wave path (pipeline forced on, small commit waves so several
+    waves + windows engage) and through the per-pod path (pipeline off,
+    wave size 1 → every pod takes `_commit_batch_pod`+`flush_pod`) must
+    leave byte-identical annotations, result-history included, across
+    TWO rounds (history splices on the second attempt's flush)."""
+    nodes = _mixed_cluster()
+    rounds = [_mixed_pods(0, 40), _mixed_pods(40, 64)]
+
+    def build(commit_wave: int, pipeline):
+        store = ClusterStore()
+        for n in nodes:
+            store.create("nodes", n)
+        svc = SchedulerService(
+            store,
+            seed=5,
+            use_batch="force",
+            batch_min_work=0,
+            commit_wave=commit_wave,
+            pipeline=pipeline,
+        )
+        svc.start_scheduler(
+            {"profiles": [profile_with(["NodeResourcesFit", "TaintToleration",
+                                        "NodeAffinity", "PodTopologySpread"])],
+             "percentageOfNodesToScore": 100}
+        )
+        return store, svc
+
+    store_bulk, svc_bulk = build(commit_wave=8, pipeline=True)
+    store_pp, svc_pp = build(commit_wave=1, pipeline=False)
+    _run_rounds(svc_bulk, store_bulk, rounds)
+    _run_rounds(svc_pp, store_pp, rounds)
+
+    bulk = _pod_states(store_bulk)
+    pp = _pod_states(store_pp)
+    assert bulk.keys() == pp.keys()
+    for name in bulk:
+        assert bulk[name][0] == pp[name][0], f"{name}: node divergence"
+        b_ann, p_ann = bulk[name][1], pp[name][1]
+        assert b_ann.keys() == p_ann.keys(), f"{name}: annotation keys differ"
+        for k in p_ann:
+            assert b_ann[k] == p_ann[k], (
+                f"{name} annotation {k} diverges:\n bulk={b_ann[k][:300]}\n"
+                f" perpod={p_ann[k][:300]}"
+            )
+
+
+def test_bulk_commit_matches_sequential_cycle_bytes():
+    """Bulk-committed annotations must also match the SEQUENTIAL cycle
+    (use_batch=off) — the reference semantics — not merely the old batch
+    commit path."""
+    nodes = _mixed_cluster(24)
+    rounds = [_mixed_pods(0, 24)]
+
+    def build(mode: str, **kw):
+        store = ClusterStore()
+        for n in nodes:
+            store.create("nodes", n)
+        svc = SchedulerService(store, seed=3, use_batch=mode, batch_min_work=0, **kw)
+        svc.start_scheduler(
+            {"profiles": [profile_with(["NodeResourcesFit", "TaintToleration"])],
+             "percentageOfNodesToScore": 100}
+        )
+        return store, svc
+
+    store_seq, svc_seq = build("off")
+    store_bulk, svc_bulk = build("auto", commit_wave=6, pipeline=True)
+    _run_rounds(svc_seq, store_seq, rounds)
+    _run_rounds(svc_bulk, store_bulk, rounds)
+    assert svc_bulk.stats["batch_pods"] > 0
+    seq = _pod_states(store_seq)
+    bulk = _pod_states(store_bulk)
+    assert seq.keys() == bulk.keys()
+    for name in seq:
+        assert seq[name] == bulk[name], f"{name}: bulk != sequential"
+
+
+def test_windowed_rounds_match_single_dispatch_rounds():
+    """schedule_waves' carry-chained pod windows must reproduce the one-
+    dispatch kernel exactly: same placements, same annotation bytes —
+    windows are forced small so several chain per round."""
+    from kube_scheduler_simulator_tpu.scheduler.batch_engine import BatchEngine
+
+    nodes = _mixed_cluster(16)
+    pods = _mixed_pods(0, 40)
+
+    def build():
+        store = ClusterStore()
+        for n in nodes:
+            store.create("nodes", n)
+        for p in pods:
+            store.create("pods", dict(p))
+        svc = SchedulerService(store, seed=1, use_batch="off")
+        svc.start_scheduler({"percentageOfNodesToScore": 100})
+        return store, svc
+
+    _store_a, svc_a = build()
+    fw = svc_a.framework
+    eng = BatchEngine.from_framework(fw, trace=True)
+    pending = fw.sort_pods(svc_a.pending_pods())
+    args = (
+        svc_a.cluster_store.list("nodes"),
+        svc_a.cluster_store.list("pods"),
+        pending,
+        svc_a.cluster_store.list("namespaces"),
+    )
+    full = eng.schedule(*args)
+    eng2 = BatchEngine.from_framework(fw, trace=True)
+    parts = list(eng2.schedule_waves(*args, wave_pods=8))
+    assert len(parts) > 1, "expected several windows"
+    got_sel: list = []
+    for result, off, cnt in parts:
+        assert len(result.pending) == cnt
+        got_sel.extend(result.selected_nodes[:cnt])
+        for j in range(cnt):
+            i = off + j
+            assert result.filter_annotation_json(j) == full.filter_annotation_json(i), (
+                f"pod {i}: windowed filter annotation diverges"
+            )
+            ws, wf = result.score_annotations_json(j)
+            fs, ff = full.score_annotations_json(i)
+            assert (ws, wf) == (fs, ff), f"pod {i}: windowed score annotations diverge"
+    assert got_sel == full.selected_nodes[: len(pending)]
+    assert parts[-1][0].final_start == full.final_start
+
+
+def test_mid_wave_store_conflict_preserves_order_and_skips_deleted():
+    """A pod deleted between the kernel's decision and the wave flush
+    must be skipped (no resurrection, no error), while every OTHER pod in
+    the wave still commits in queue order — the bulk apply reads each
+    object fresh under the store lock, so the per-pod path's conflict
+    retry has nothing left to race against."""
+    nodes = _mixed_cluster(12)
+    store = ClusterStore()
+    for n in nodes:
+        store.create("nodes", n)
+    svc = SchedulerService(
+        store, seed=2, use_batch="force", batch_min_work=0,
+        commit_wave=4, pipeline=True,
+    )
+    svc.start_scheduler(
+        {"profiles": [profile_with(["NodeResourcesFit"])],
+         "percentageOfNodesToScore": 100}
+    )
+    pods = [mk_pod(f"pod-{i}", cpu_m=100, mem_mi=128) for i in range(12)]
+    for p in pods:
+        store.create("pods", dict(p))
+
+    # delete one mid-wave: hook the FIRST bind event of the round and
+    # remove a LATER pod before its wave flushes
+    deleted = {"done": False}
+
+    def on_event(ev):
+        if (
+            not deleted["done"]
+            and ev.type == "MODIFIED"
+            and (ev.obj.get("spec") or {}).get("nodeName")
+        ):
+            deleted["done"] = True
+            store.delete("pods", "pod-9", "default")
+
+    store.subscribe(["pods"], on_event)
+    svc.schedule_pending(max_rounds=1)
+
+    remaining = {p["metadata"]["name"]: p for p in store.list("pods")}
+    assert "pod-9" not in remaining, "deleted pod must not be resurrected"
+    # every surviving pod committed: bound, annotated, history present
+    for name, pod in remaining.items():
+        assert (pod.get("spec") or {}).get("nodeName"), f"{name} not bound"
+        annos = pod["metadata"].get("annotations") or {}
+        assert "scheduler-simulator/result-history" in annos, f"{name} missing history"
+    # queue order preserved: attempt counters assigned in pod order means
+    # identical placements to a run without the mid-wave delete for the
+    # pods BEFORE the deletion point
+    store2 = ClusterStore()
+    for n in nodes:
+        store2.create("nodes", n)
+    svc2 = SchedulerService(
+        store2, seed=2, use_batch="force", batch_min_work=0,
+        commit_wave=4, pipeline=True,
+    )
+    svc2.start_scheduler(
+        {"profiles": [profile_with(["NodeResourcesFit"])],
+         "percentageOfNodesToScore": 100}
+    )
+    for p in pods:
+        store2.create("pods", dict(p))
+    svc2.schedule_pending(max_rounds=1)
+    for i in range(9):  # pods before the deleted one
+        a = store.get("pods", f"pod-{i}")["spec"].get("nodeName")
+        b = store2.get("pods", f"pod-{i}")["spec"].get("nodeName")
+        assert a == b, f"pod-{i}: order disturbed by mid-wave delete ({a} != {b})"
+
+
+def test_bulk_update_skips_missing_and_batches_events():
+    """ClusterStore.bulk_update: one lock, per-object RV bumps, missing
+    objects skipped, events delivered for exactly the applied set."""
+    store = ClusterStore()
+    for i in range(4):
+        store.create("pods", mk_pod(f"p-{i}"))
+    seen: list = []
+    store.subscribe(["pods"], lambda ev: seen.append((ev.type, ev.obj["metadata"]["name"])))
+
+    def mark(o):
+        # bulk_update contract: the live object is read-only — rebuild
+        # the changed path, share the rest
+        annotations = dict(o["metadata"].get("annotations") or {})
+        annotations["marked"] = "yes"
+        return {**o, "metadata": {**o["metadata"], "annotations": annotations}}
+
+    applied = store.bulk_update(
+        "pods",
+        [("p-0", "default", mark), ("missing", "default", mark),
+         ("p-2", "default", mark), ("p-3", "default", lambda o: None)],
+    )
+    assert applied == 2
+    assert [n for t, n in seen if t == "MODIFIED"] == ["p-0", "p-2"]
+    assert store.get("pods", "p-0")["metadata"]["annotations"]["marked"] == "yes"
+    assert "annotations" not in store.get("pods", "p-3")["metadata"]
+    rv0 = int(store.get("pods", "p-0")["metadata"]["resourceVersion"])
+    rv2 = int(store.get("pods", "p-2")["metadata"]["resourceVersion"])
+    assert rv2 == rv0 + 1, "per-object resourceVersions stay monotonic per mutation"

@@ -99,10 +99,30 @@ def test_crud_and_binding_subresource(server):
         "metadata": {"labels": {"patched": "yes"}},
     })
     assert code == 200 and patched["metadata"]["labels"]["patched"] == "yes"
+
+    # RV-less PUT replaces an EXISTING object...
+    cur = di.cluster_store.get("pods", "pod-1")
+    code, put = _req(p, "PUT", "/api/v1/namespaces/default/pods/pod-1", {
+        "metadata": {"name": "pod-1", "labels": {"put": "yes"}},
+        "spec": cur["spec"],
+    })
+    assert code == 200 and put["metadata"]["labels"] == {"put": "yes"}
+
     code, _ = _req(p, "DELETE", "/api/v1/namespaces/default/pods/pod-1")
     assert code == 200
     code, err = _req(p, "GET", "/api/v1/namespaces/default/pods/pod-1")
     assert code == 404 and err["kind"] == "Status" and err["reason"] == "NotFound"
+
+    # ...but a replace of a MISSING object is 404, never an upsert
+    # (apiserver AllowCreateOnUpdate=false: errors.IsNotFound must hold
+    # for delete-tolerant client-go updaters)
+    code, err = _req(p, "PUT", "/api/v1/namespaces/default/pods/pod-1", {
+        "metadata": {"name": "pod-1"},
+        "spec": {"containers": [{"name": "c"}]},
+    })
+    assert code == 404 and err["reason"] == "NotFound"
+    with pytest.raises(KeyError):
+        di.cluster_store.get("pods", "pod-1")
 
 
 def test_grouped_resources(server):

@@ -186,17 +186,18 @@ def test_failed_scenario_fails_the_simulation():
     assert "message" in done["status"]
 
 
-def test_scenario_template_file_path(tmp_path):
+def test_scenario_template_file_path(tmp_path, monkeypatch):
     """The KEP's file indirection (etcd size limits motivate it there;
-    here it reads a local YAML/JSON Scenario file) — a full Scenario
-    object or a bare spec both work."""
+    here it reads a YAML/JSON Scenario file from the CONFIGURED template
+    directory) — a full Scenario object or a bare spec both work."""
     import yaml
 
+    monkeypatch.setenv("KSS_SCENARIO_TEMPLATE_DIR", str(tmp_path))
     obj = _simulation_obj()
     scenario_spec = obj["spec"].pop("scenario")
     f = tmp_path / "scenario.yaml"
     f.write_text(yaml.safe_dump({"kind": "Scenario", "spec": scenario_spec}))
-    obj["spec"]["scenarioTemplateFilePath"] = str(f)
+    obj["spec"]["scenarioTemplateFilePath"] = "scenario.yaml"
     obj["spec"]["simulators"] = [{"name": "only"}]
     done = run_scheduler_simulation(obj)
     assert done["status"]["phase"] == "Completed", done["status"]
@@ -204,10 +205,42 @@ def test_scenario_template_file_path(tmp_path):
     # bare-spec file form (no top-level "spec" wrapper) works too
     f2 = tmp_path / "bare.yaml"
     f2.write_text(yaml.safe_dump(scenario_spec))
-    obj["spec"]["scenarioTemplateFilePath"] = str(f2)
+    obj["spec"]["scenarioTemplateFilePath"] = str(f2)  # absolute-inside ok
     done2 = run_scheduler_simulation(obj)
     assert done2["status"]["phase"] == "Completed", done2["status"]
     assert done2["status"]["results"][0]["report"]["scheduledPods"] == 4
+
+
+def test_scenario_template_file_path_is_confined(tmp_path, monkeypatch):
+    """The template indirection is an API-reachable open(): it must be
+    disabled without a configured directory, reject escapes, and never
+    reflect file content or parser context into status.message."""
+    obj = _simulation_obj()
+    obj["spec"].pop("scenario")
+    obj["spec"]["scenarioTemplateFilePath"] = "/etc/hostname"
+
+    # no configured directory: the field is disabled outright
+    monkeypatch.delenv("KSS_SCENARIO_TEMPLATE_DIR", raising=False)
+    done = run_scheduler_simulation(obj)
+    assert done["status"]["phase"] == "Failed"
+    assert "disabled" in done["status"]["message"]
+
+    # configured directory: traversal out of it is rejected
+    monkeypatch.setenv("KSS_SCENARIO_TEMPLATE_DIR", str(tmp_path))
+    for escape in ("../secrets.yaml", "/etc/hostname"):
+        obj["spec"]["scenarioTemplateFilePath"] = escape
+        done = run_scheduler_simulation(obj)
+        assert done["status"]["phase"] == "Failed", escape
+        assert "escapes" in done["status"]["message"], escape
+
+    # unparseable template: the message names the file, not its content
+    secret = "SECRET-CONTENT-@@: {unbalanced"
+    (tmp_path / "bad.yaml").write_text(secret)
+    obj["spec"]["scenarioTemplateFilePath"] = "bad.yaml"
+    done = run_scheduler_simulation(obj)
+    assert done["status"]["phase"] == "Failed"
+    assert "SECRET-CONTENT" not in done["status"]["message"]
+    assert "bad.yaml" in done["status"]["message"]
 
 
 def test_spec_validation():
