@@ -1,0 +1,31 @@
+"""Capacity engine: a simulated cluster-autoscaler over the batch kernel.
+
+See docs/autoscaler.md.  Public surface:
+
+- :class:`ClusterAutoscaler` — the scale-up / scale-down pass driver
+- :class:`ScaleUpEstimator` — P pods x G templates in one XLA dispatch
+- :data:`NODE_GROUP_LABEL` — the ownership label on autoscaled nodes
+- :func:`validate_node_group` — NodeGroup admission
+"""
+
+from kube_scheduler_simulator_tpu.autoscaler.engine import ClusterAutoscaler
+from kube_scheduler_simulator_tpu.autoscaler.estimator import GroupEstimate, ScaleUpEstimator
+from kube_scheduler_simulator_tpu.autoscaler.expander import EXPANDERS, pick
+from kube_scheduler_simulator_tpu.autoscaler.nodegroups import (
+    NODE_GROUP_LABEL,
+    group_nodes,
+    synthetic_node,
+    validate_node_group,
+)
+
+__all__ = [
+    "ClusterAutoscaler",
+    "ScaleUpEstimator",
+    "GroupEstimate",
+    "EXPANDERS",
+    "pick",
+    "NODE_GROUP_LABEL",
+    "group_nodes",
+    "synthetic_node",
+    "validate_node_group",
+]

@@ -35,6 +35,10 @@ class Config:
         external_import_enabled: bool = False,
         kubeconfig: str = "",
         external_scheduler_enabled: bool = False,
+        autoscale: str = "off",
+        autoscaler_expander: str = "least-waste",
+        autoscaler_scale_down_threshold: float = 0.5,
+        autoscaler_scale_down_rounds: int = 3,
     ):
         self.port = port
         self.etcd_url = etcd_url
@@ -45,6 +49,11 @@ class Config:
         self.external_import_enabled = external_import_enabled
         self.kubeconfig = kubeconfig
         self.external_scheduler_enabled = external_scheduler_enabled
+        # capacity engine (docs/autoscaler.md): off | on | scenario
+        self.autoscale = autoscale
+        self.autoscaler_expander = autoscaler_expander
+        self.autoscaler_scale_down_threshold = autoscaler_scale_down_threshold
+        self.autoscaler_scale_down_rounds = autoscaler_scale_down_rounds
 
 
 def load_yaml_config(path: "str | None" = None) -> Obj:
@@ -95,6 +104,27 @@ def new_config(config_path: "str | None" = None) -> Config:
         with open(sched_cfg_path) as f:
             initial_cfg = yaml.safe_load(f) or None
 
+    def env_float(name: str, yaml_key: str, default: float) -> float:
+        v = os.environ.get(name)
+        if v:
+            try:
+                return float(v)
+            except ValueError as e:
+                raise ValueError(f"env {name} must be a number: {v!r}") from e
+        yv = y.get(yaml_key)
+        return default if yv is None else float(yv)
+
+    autoscale = env_str("AUTOSCALE_MODE", "autoscale", "off")
+    if autoscale not in ("off", "on", "scenario"):
+        raise ValueError(f"AUTOSCALE_MODE must be off|on|scenario, got {autoscale!r}")
+    expander = env_str("AUTOSCALE_EXPANDER", "autoscalerExpander", "least-waste")
+    # mirror autoscaler/expander.EXPANDERS without importing the package
+    # (it pulls in the jax-backed estimator, which config loading must not)
+    if expander not in ("least-waste", "most-pods", "priority"):
+        raise ValueError(
+            f"AUTOSCALE_EXPANDER must be least-waste|most-pods|priority, got {expander!r}"
+        )
+
     return Config(
         port=env_int("PORT", "port", 1212),
         etcd_url=env_str("KUBE_SCHEDULER_SIMULATOR_ETCD_URL", "etcdURL", ""),
@@ -106,5 +136,13 @@ def new_config(config_path: "str | None" = None) -> Config:
         kubeconfig=env_str("KUBECONFIG", "kubeConfig", ""),
         external_scheduler_enabled=env_bool(
             "EXTERNAL_SCHEDULER_ENABLED", "externalSchedulerEnabled", False
+        ),
+        autoscale=autoscale,
+        autoscaler_expander=expander,
+        autoscaler_scale_down_threshold=env_float(
+            "AUTOSCALE_SCALE_DOWN_THRESHOLD", "autoscalerScaleDownUtilizationThreshold", 0.5
+        ),
+        autoscaler_scale_down_rounds=env_int(
+            "AUTOSCALE_SCALE_DOWN_ROUNDS", "autoscalerScaleDownUnneededRounds", 3
         ),
     )

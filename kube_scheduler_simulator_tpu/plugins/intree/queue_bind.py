@@ -128,25 +128,11 @@ class DefaultPreemption:
 
     def _violates_pdb(self, victim: Obj, pdbs: list[Obj], budget: dict[int, int]) -> bool:
         """Would evicting ``victim`` violate any matching PDB, given the
-        remaining per-PDB budget for this dry run?"""
-        from kube_scheduler_simulator_tpu.utils.labels import match_label_selector
+        remaining per-PDB budget for this dry run?  (Shared rule —
+        utils/pdb.py — so the autoscaler's drain math can't diverge.)"""
+        from kube_scheduler_simulator_tpu.utils.pdb import violates_pdb
 
-        vio = False
-        for idx, pdb in enumerate(pdbs):
-            if (pdb["metadata"].get("namespace") or "default") != (
-                victim["metadata"].get("namespace") or "default"
-            ):
-                continue
-            if not match_label_selector(
-                (pdb.get("spec") or {}).get("selector"), victim["metadata"].get("labels") or {}
-            ):
-                continue
-            if idx not in budget:
-                budget[idx] = int(((pdb.get("status") or {}).get("disruptionsAllowed")) or 0)
-            budget[idx] -= 1
-            if budget[idx] < 0:
-                vio = True
-        return vio
+        return violates_pdb(victim, pdbs, budget)
 
     @staticmethod
     def _start_time(p: Obj) -> str:

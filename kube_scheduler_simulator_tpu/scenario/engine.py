@@ -233,12 +233,26 @@ class ScenarioEngine:
 
     def _run_controllers_to_convergence(self, major: int, minor: int) -> list[Obj]:
         """Run controllers + scheduler until quiescent; emit generated
-        timeline events (PodScheduled, preemption-victim Delete)."""
+        timeline events (PodScheduled, preemption-victim Delete, and —
+        with the capacity engine enabled — Autoscale actions).
+
+        The autoscaler joins the convergence loop exactly like the KEP's
+        SimulationController members: when a scheduling pass makes no
+        progress, one autoscaler pass runs; if it acted (nodes added or
+        drained), the loop continues — the node events re-activated the
+        unschedulable pods — and only a pass where BOTH are quiescent
+        ends the step.  Actions are deterministic functions of cluster
+        state (docs/autoscaler.md), so replays stay byte-identical."""
         events: list[Obj] = []
         before = {
             f"{p['metadata'].get('namespace', 'default')}/{p['metadata']['name']}": (p.get("spec") or {}).get("nodeName")
             for p in self.store.list("pods")
         }
+        get_asc = getattr(self.scheduler, "scenario_autoscaler", None)
+        autoscaler = get_asc() if get_asc is not None else None
+        if autoscaler is not None:
+            # actions from outside this step must not leak into its timeline
+            autoscaler.drain_events()
         for _ in range(50):
             if self.controllers is not None:
                 self.controllers.reconcile_all()
@@ -247,12 +261,22 @@ class ScenarioEngine:
             if self.controllers is not None:
                 self.controllers.reconcile_all()
             if not progressed:
+                if autoscaler is not None and autoscaler.run_once()["actions"]:
+                    continue
                 break
         after_pods = self.store.list("pods")
         after = {
             f"{p['metadata'].get('namespace', 'default')}/{p['metadata']['name']}": p for p in after_pods
         }
         m = minor
+        # Autoscale actions first: the capacity they added/drained is what
+        # the PodScheduled events below landed on.
+        if autoscaler is not None:
+            for act in autoscaler.drain_events():
+                events.append(
+                    {"step": {"major": major, "minor": m}, "autoscale": act}
+                )
+                m += 1
         for key, pod in after.items():
             node = (pod.get("spec") or {}).get("nodeName")
             if node and before.get(key) != node:

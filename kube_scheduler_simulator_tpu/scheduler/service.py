@@ -52,6 +52,9 @@ class SchedulerService:
         mesh: Any = None,
         commit_wave: int = 256,
         pipeline: "bool | str" = "auto",
+        autoscale: str = "off",
+        autoscaler_opts: "dict | None" = None,
+        autoscale_interval_s: float = 10.0,
     ):
         """``use_batch``: "off" = sequential cycle only; "auto" = run whole
         pending rounds through the TPU batch engine when the profile ×
@@ -72,7 +75,22 @@ class SchedulerService:
         "auto" (default) enables it when the kernel runs on an
         accelerator or the host has cores to spare — on a 1-2 core
         CPU-pinned box the XLA scan and the host commit compete for the
-        same cores and the overlap is a wash."""
+        same cores and the overlap is a wash.
+
+        ``autoscale``: "off" = no capacity engine; "on" = the
+        synchronous autoscaled drain AND the background loop run
+        autoscaler passes between scheduling rounds; "scenario" = only
+        scenario replays engage the autoscaler (the REST/background
+        paths behave as "off").  ``autoscaler_opts`` forwards to
+        :class:`~kube_scheduler_simulator_tpu.autoscaler.ClusterAutoscaler`
+        (expander, scale-down threshold/rounds).
+        ``autoscale_interval_s`` throttles the BACKGROUND loop's
+        autoscaler passes: the poll tick is ~0.25 s, and an
+        unneeded-rounds timer advancing at 4 Hz would drain idle
+        capacity in under a second (upstream's equivalent is a
+        10-minute unneeded window) while full-store utilization scans
+        burn CPU — synchronous drains (scenario replay,
+        schedule_pending_autoscaled callers) are never throttled."""
         self.cluster_store = cluster_store
         self.seed = seed
         self.tie_break = tie_break
@@ -140,9 +158,76 @@ class SchedulerService:
             # fallback waves) — the bench reports per-wave deltas
             # alongside device_s
             "commit_s": 0.0,
+            # per-wave commit-path trajectory (the bench's cfg5 columns,
+            # surfaced through /metrics so scrapes see commit-path
+            # regressions between bench rounds): waves flushed, the last
+            # wave's wall and size
+            "commit_waves": 0,
+            "last_wave_commit_s": 0.0,
+            "last_wave_pods": 0,
         }
         # guards batch_fallbacks against the metrics scrape thread
         self._stats_lock = threading.Lock()
+        # Capacity engine (autoscaler/): built lazily on first use so
+        # autoscale="off" services never import the package.
+        if autoscale not in ("off", "on", "scenario"):
+            raise ValueError(f"autoscale must be off|on|scenario, got {autoscale!r}")
+        self.autoscale = autoscale
+        self._autoscaler_opts = dict(autoscaler_opts or {})
+        self._autoscaler: Any = None
+        # lazy construction races the background loop against HTTP
+        # threads (GET /api/v1/autoscaler) — losing an instance would
+        # silently drop its stats and unneeded-timers
+        self._autoscaler_build_lock = threading.Lock()
+        self.autoscale_interval_s = float(autoscale_interval_s)
+        self._last_autoscale_ts = float("-inf")
+
+    # ----------------------------------------------------------- autoscaler
+
+    @property
+    def autoscaler(self) -> Any:
+        """The capacity engine (None when ``autoscale="off"``)."""
+        if self._autoscaler is None and self.autoscale != "off":
+            from kube_scheduler_simulator_tpu.autoscaler import ClusterAutoscaler
+
+            with self._autoscaler_build_lock:
+                if self._autoscaler is None:
+                    self._autoscaler = ClusterAutoscaler(
+                        self.cluster_store, self, **self._autoscaler_opts
+                    )
+        return self._autoscaler
+
+    @autoscaler.setter
+    def autoscaler(self, value: Any) -> None:
+        self._autoscaler = value
+        if value is not None and self.autoscale == "off":
+            self.autoscale = "on"
+
+    def scenario_autoscaler(self) -> Any:
+        """The autoscaler a scenario replay should drive (None unless
+        the knob enables it for scenarios — "on" or "scenario")."""
+        return self.autoscaler if self.autoscale in ("on", "scenario") else None
+
+    def schedule_pending_autoscaled(
+        self,
+        max_rounds: int = 3,
+        respect_backoff: bool = False,
+        max_passes: int = 8,
+    ) -> dict[str, ScheduleResult]:
+        """The converged autoscale→schedule→autoscale loop: drain the
+        queue, run one autoscaler pass, and repeat while the autoscaler
+        keeps acting (its node adds/drains re-activate pods through the
+        queue's move machinery).  With ``autoscale="off"`` this IS
+        ``schedule_pending``."""
+        results: dict[str, ScheduleResult] = {}
+        for _ in range(max(max_passes, 1)):
+            results.update(
+                self.schedule_pending(max_rounds=max_rounds, respect_backoff=respect_backoff)
+            )
+            asc = self.autoscaler
+            if asc is None or not asc.run_once()["actions"]:
+                break
+        return results
 
     # ----------------------------------------------------------- extension
 
@@ -744,7 +829,11 @@ class SchedulerService:
                 return
             tc = time.perf_counter()
             self._commit_batch_wave(result, wave_js, window, snapshot, point_names, fw, results)
-            self.stats["commit_s"] += time.perf_counter() - tc
+            dt = time.perf_counter() - tc
+            self.stats["commit_s"] += dt
+            self.stats["commit_waves"] += 1
+            self.stats["last_wave_commit_s"] = dt
+            self.stats["last_wave_pods"] = len(wave_js)
             fw.sched_counter += len(wave_js)
             self.stats["batch_pods"] += len(wave_js)
             wave_js.clear()
@@ -792,20 +881,37 @@ class SchedulerService:
         eng = self._batch_engine
         with self._stats_lock:
             fallbacks = dict(self.stats["batch_fallbacks"])
+        last_t = dict(eng.last_timings) if eng else {}
+        # the fraction of the last pipelined round's device time hidden
+        # under host commits (0 for un-pipelined rounds) — the bench's
+        # overlap_efficiency column, live
+        est = last_t.get("device_est_s", 0.0)
+        overlap = max(0.0, min(1.0, 1.0 - last_t.get("device_s", 0.0) / est)) if est > 1e-9 else 0.0
+        last_wave_s = self.stats["last_wave_commit_s"]
         return {
             "batch_commits": self.stats["batch_commits"],
             "batch_pods": self.stats["batch_pods"],
             "batch_restarts": self.stats["batch_restarts"],
             "sequential_pods": self.stats["sequential_pods"],
             "batch_fallbacks": fallbacks,
+            # commit-pipeline trajectory (bench cfg5 columns, live)
+            "commit_s": self.stats["commit_s"],
+            "commit_waves": self.stats["commit_waves"],
+            "wave_commit_s": last_wave_s,
+            "commit_pods_per_s": (
+                self.stats["last_wave_pods"] / last_wave_s if last_wave_s > 1e-9 else 0.0
+            ),
+            "overlap_efficiency": overlap,
             **self.queue.stats(),
             "engine_rounds": eng.rounds if eng else 0,
             "engine_compiles": eng.compiles if eng else 0,
             "engine_cache_entries": len(eng._fn_cache) if eng else 0,
             # the engine rebinds these dicts wholesale per round, so
             # copying the captured object is race-free
-            "engine_last_timings": dict(eng.last_timings) if eng else {},
+            "engine_last_timings": last_t,
             "engine_cum_timings": dict(eng.cum_timings) if eng else {},
+            # capacity engine (None when off or never engaged)
+            "autoscaler": self._autoscaler.metrics() if self._autoscaler is not None else None,
         }
 
     def _commit_batch_wave(
@@ -1125,11 +1231,31 @@ class SchedulerService:
                     if self.framework is not None:
                         self.process_waiting_pods()
                         self.queue.flush_stuck()
+                        # background autoscaler passes are throttled to
+                        # autoscale_interval_s (see __init__): the tick
+                        # is ~0.25 s, and the unneeded-rounds hysteresis
+                        # is counted in PASSES
+                        now = time.monotonic()
+                        autoscale_due = (
+                            self.autoscale == "on"
+                            and now - self._last_autoscale_ts >= self.autoscale_interval_s
+                        )
                         if self.pending_pods():
                             # real backoff semantics: persistently
                             # unschedulable pods are NOT re-filtered on
                             # every event — they wait in unschedulableQ
-                            self.schedule_pending(max_rounds=1, respect_backoff=True)
+                            if autoscale_due:
+                                self._last_autoscale_ts = now
+                                self.schedule_pending_autoscaled(
+                                    max_rounds=1, respect_backoff=True
+                                )
+                            else:
+                                self.schedule_pending(max_rounds=1, respect_backoff=True)
+                        elif autoscale_due and self.autoscaler is not None:
+                            # idle ticks advance the scale-down timers so
+                            # unneeded capacity drains without pod churn
+                            self._last_autoscale_ts = now
+                            self.autoscaler.run_once()
                 except Exception:  # pragma: no cover - keep the loop alive
                     pass
 

@@ -27,6 +27,8 @@ class DIContainer:
         external_snap_source: Any = None,
         seed: int = 0,
         enable_simulator_operator: bool = True,
+        autoscale: str = "off",
+        autoscaler_opts: "dict | None" = None,
     ):
         self.cluster_store = cluster_store or ClusterStore()
         # Controllers start before the scheduler (reference boot order,
@@ -35,7 +37,13 @@ class DIContainer:
 
         self._controller_manager = ControllerManager(self.cluster_store)
         self._controller_manager.start()
-        self._scheduler_service = SchedulerService(self.cluster_store, seed=seed, use_batch=use_batch)
+        self._scheduler_service = SchedulerService(
+            self.cluster_store,
+            seed=seed,
+            use_batch=use_batch,
+            autoscale=autoscale,
+            autoscaler_opts=autoscaler_opts,
+        )
         self._scheduler_service.start_scheduler(initial_scheduler_cfg)
         # KEP-140 operator: reconciles Scenario OBJECTS (created via the
         # kube-API group or resource routes) into finished runs; the

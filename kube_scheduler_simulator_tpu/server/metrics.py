@@ -63,6 +63,13 @@ def render_metrics(di: Any) -> str:
     counter("queue_pods", "Pods tracked by the scheduling queue, by state.", m["queue_unschedulable"], {"state": "unschedulable"}, typ="gauge")
     counter("queue_moves_total", "Unschedulable-queue moves triggered by cluster events.", m["queue_moves"])
     counter("queue_flushes_total", "Stuck unschedulable pods flushed by timeout.", m["queue_flushes"])
+    # commit-pipeline trajectory (the bench's cfg5 columns, live — a
+    # scrape can catch commit-path regressions between bench rounds)
+    counter("commit_seconds_total", "Cumulative host-side commit wall within batch rounds.", round(m["commit_s"], 6))
+    counter("commit_waves_total", "Bulk-commit waves flushed on the batch path.", m["commit_waves"])
+    counter("wave_commit_seconds", "Host commit wall of the last bulk-commit wave.", round(m["wave_commit_s"], 6), typ="gauge")
+    counter("commit_pods_per_s", "Pods committed per host-commit second (last wave).", round(m["commit_pods_per_s"], 3), typ="gauge")
+    counter("overlap_efficiency", "Fraction of the last pipelined round's device time hidden under host commits (0 when un-pipelined).", round(m["overlap_efficiency"], 4), typ="gauge")
     counter("batch_compiles_total", "XLA compilations of the batch kernel (jit cache misses).", m["engine_compiles"])
     counter("batch_executable_cache_entries", "Compiled batch executables held in the jit cache.", m["engine_cache_entries"], typ="gauge")
     for phase, secs in sorted(m["engine_cum_timings"].items()):
@@ -80,6 +87,29 @@ def render_metrics(di: Any) -> str:
             {"phase": phase.removesuffix("_s")},
             typ="gauge",
         )
+
+    # capacity engine (autoscaler/) — only once it has been constructed
+    asc = m.get("autoscaler")
+    if asc is not None:
+        counter("autoscaler_passes_total", "Autoscaler passes run.", asc["passes"])
+        counter("autoscaler_scale_ups_total", "Scale-up actions taken.", asc["scale_ups"])
+        counter("autoscaler_scale_downs_total", "Scale-down (node drain) actions taken.", asc["scale_downs"])
+        counter("autoscaler_nodes_added_total", "Nodes materialized by scale-up.", asc["nodes_added"])
+        counter("autoscaler_nodes_removed_total", "Nodes drained by scale-down.", asc["nodes_removed"])
+        counter("autoscaler_estimation_dispatches_total", "Vmapped estimation-kernel dispatches (one per scale-up estimate, all groups).", asc["estimate_dispatches"])
+        counter("autoscaler_estimation_compiles_total", "XLA compilations of the estimation kernel.", asc["estimate_compiles"])
+        counter("autoscaler_estimation_kernel_errors_total", "Kernel-path crashes degraded to the resource-only fallback (nonzero = bug).", asc["estimate_kernel_errors"])
+        counter("autoscaler_estimation_seconds_total", "Cumulative scale-up estimation wall.", round(asc["estimate_cum_s"], 6))
+        counter("autoscaler_estimation_seconds_last", "Last scale-up estimation wall.", round(asc["estimate_last_s"], 6), typ="gauge")
+        for gname, gs in sorted(asc["groups"].items()):
+            for bound in ("current", "min", "max"):
+                counter(
+                    "autoscaler_node_group_size",
+                    "Node-group size, by bound (current/min/max).",
+                    gs[bound],
+                    {"group": gname, "bound": bound},
+                    typ="gauge",
+                )
 
     store = di.cluster_store
     from kube_scheduler_simulator_tpu.state.store import KINDS

@@ -55,6 +55,7 @@ Obj = dict[str, Any]
 
 _EXTENDER_RE = re.compile(r"^/api/v1/extender/(filter|prioritize|preempt|bind)/(\d+)$")
 _RESOURCE_RE = re.compile(r"^/api/v1/resources/([a-z]+)(?:/([^/]+))?$")
+_NODEGROUP_RE = re.compile(r"^/api/v1/nodegroups(?:/([^/]+))?$")
 
 
 class SimulatorServer:
@@ -203,6 +204,19 @@ def _make_handler(server: SimulatorServer):
 
         # --------------------------------------------------------- methods
 
+        def _group_with_status(self, group: Obj) -> Obj:
+            """NodeGroup + live status (current size from the ownership
+            label — the store is the source of truth, not a counter)."""
+            from kube_scheduler_simulator_tpu.autoscaler.nodegroups import group_nodes
+
+            nodes = sorted(
+                n["metadata"]["name"]
+                for n in group_nodes(di.cluster_store, group["metadata"]["name"])
+            )
+            out = dict(group)
+            out["status"] = {"currentSize": len(nodes), "nodes": nodes}
+            return out
+
         def do_OPTIONS(self) -> None:  # CORS preflight
             self._send_empty(204)
 
@@ -241,6 +255,24 @@ def _make_handler(server: SimulatorServer):
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)
+                elif url.path == "/api/v1/autoscaler":
+                    svc = di.scheduler_service()
+                    asc = svc.autoscaler
+                    if asc is None:
+                        self._send_json(200, {"mode": "off"})
+                    else:
+                        self._send_json(200, {"mode": svc.autoscale, **asc.status()})
+                elif m := _NODEGROUP_RE.match(url.path):
+                    name = m.group(1)
+                    if name is None:
+                        items = [
+                            self._group_with_status(g)
+                            for g in di.cluster_store.list("nodegroups")
+                        ]
+                        self._send_json(200, {"items": items})
+                    else:
+                        g = di.cluster_store.get("nodegroups", name)
+                        self._send_json(200, self._group_with_status(g))
                 elif url.path == "/api/v1/export":
                     self._send_json(200, di.snapshot_service().snap())
                 elif url.path == "/api/v1/listwatchresources":
@@ -315,6 +347,18 @@ def _make_handler(server: SimulatorServer):
                     bridge = di.tpu_scorer_bridge()
                     verb = url.path.rsplit("/", 1)[1]
                     self._send_json(200, getattr(bridge, verb)(self._body() or {}))
+                elif (m := _NODEGROUP_RE.match(url.path)) and not m.group(1):
+                    # collection URL only (POST to an item URL is 404, not
+                    # a silent create of a differently-named group); the
+                    # dedicated route ADMITS (validates) node groups — the
+                    # generic resources route stores them raw
+                    from kube_scheduler_simulator_tpu.autoscaler.nodegroups import (
+                        validate_node_group,
+                    )
+
+                    body = self._body() or {}
+                    validate_node_group(body)
+                    self._send_json(201, di.cluster_store.create("nodegroups", body))
                 elif m := _RESOURCE_RE.match(url.path):
                     kind = m.group(1)
                     if kind not in KINDS or kind in server.disabled_kinds:
@@ -327,6 +371,8 @@ def _make_handler(server: SimulatorServer):
                 self._send_json(409, {"message": str(e)})
             except NotFoundError as e:
                 self._send_json(404, {"message": str(e)})
+            except ValueError as e:
+                self._send_json(400, {"message": str(e)})
             except IndexError:
                 self._send_json(400, {"message": "unknown extender id"})
             except Exception as e:
@@ -355,7 +401,12 @@ def _make_handler(server: SimulatorServer):
             url = urlparse(self.path)
             q = parse_qs(url.query)
             try:
-                if m := _RESOURCE_RE.match(url.path):
+                if (m := _NODEGROUP_RE.match(url.path)) and m.group(1):
+                    # deleting a group stops future scaling; its nodes stay
+                    # (drain them first via scale-down, or delete directly)
+                    di.cluster_store.delete("nodegroups", m.group(1))
+                    self._send_empty(200)
+                elif m := _RESOURCE_RE.match(url.path):
                     kind, name = m.group(1), m.group(2)
                     ns = (q.get("namespace") or [None])[0]
                     if kind not in KINDS or kind in server.disabled_kinds or name is None:

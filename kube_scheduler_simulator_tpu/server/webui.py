@@ -78,6 +78,8 @@ HTML = r"""<!doctype html>
   <div class="panel">
     <h2>Other resources</h2>
     <div id="others"></div>
+    <h2 style="margin-top:14px">Autoscaler</h2>
+    <div id="autoscaler" class="muted">…</div>
   </div>
 </main>
 <main id="tablesview" style="display:none; grid-template-columns:1fr;">
@@ -107,6 +109,7 @@ MODULE_ORDER = [
     "dialogs.js",    # pod results / node capacity / object dialogs
     "forms.js",      # create/edit YAML, scheduler config, export/import
     "metrics.js",    # Prometheus metrics panel
+    "autoscaler.js", # node-group table + autoscaler action feed
     "watch.js",      # live list-watch stream + workload polling
     "main.js",       # bootstrap
 ]
@@ -212,6 +215,23 @@ globalDefault: false
 """,
     "namespaces": """metadata:
   generateName: namespace-
+""",
+    "nodegroups": """metadata:
+  generateName: nodegroup-
+spec:
+  minSize: 0
+  maxSize: 10
+  priority: 0
+  template:
+    metadata:
+      labels:
+        topology.kubernetes.io/zone: zone-a
+    spec: {}
+    status:
+      allocatable:
+        cpu: "8"
+        memory: 32Gi
+        pods: "110"
 """,
     "scenarios": """metadata:
   generateName: scenario-

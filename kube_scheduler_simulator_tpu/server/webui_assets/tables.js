@@ -22,6 +22,14 @@ const TABLE_COLS = {
   scenarios: [["namespace", o=>(o.metadata||{}).namespace||""], ["name", o=>o.metadata.name],
               ["phase", o=>(o.status||{}).phase||"(queued)"],
               ["operations", o=>(((o.spec||{}).operations)||[]).length]],
+  // "current" counts ownership labels on the LIVE watched node state —
+  // the generic resources route serves raw stored groups (no status)
+  nodegroups: [["name", o=>o.metadata.name], ["min", o=>(o.spec||{}).minSize||0],
+               ["max", o=>(o.spec||{}).maxSize||0],
+               ["current", o=>Object.values(state.nodes).filter(
+                  n=>((n.metadata||{}).labels||{})["scheduler-simulator/nodegroup"]===o.metadata.name).length],
+               ["priority", o=>(o.spec||{}).priority||0],
+               ["template cpu", o=>{try{return o.spec.template.status.allocatable.cpu}catch(e){return ""}}]],
 };
 function renderTables() {
   const root = document.getElementById("tables");

@@ -28,17 +28,19 @@ async function watchLoop() {
   }
 }
 
-// deployments/replicasets/scenarios are kinds the watch stream doesn't
-// carry (it mirrors the reference's 7 kinds) — poll them instead.
+// deployments/replicasets/scenarios/nodegroups are kinds the watch stream
+// doesn't carry (it mirrors the reference's 7 kinds) — poll them instead,
+// along with the autoscaler status panel.
 async function pollWorkloads() {
   for (;;) {
     try {
-      for (const k of ["deployments", "replicasets", "scenarios"]) {
+      for (const k of ["deployments", "replicasets", "scenarios", "nodegroups"]) {
         const lst = await api("GET", `/api/v1/resources/${k}`);
         state[k] = {};
         for (const o of lst.items) state[k][key(o)] = o;
       }
       render();
+      await refreshAutoscaler();
     } catch (e) {}
     await new Promise(r => setTimeout(r, 3000));
   }

@@ -37,6 +37,12 @@ def start_simulator(config_path: "str | None" = None, use_batch: str = "auto", b
         initial_scheduler_cfg=cfg.initial_scheduler_cfg,
         use_batch=use_batch,
         external_snap_source=external_source,
+        autoscale=cfg.autoscale,
+        autoscaler_opts={
+            "expander": cfg.autoscaler_expander,
+            "scale_down_utilization_threshold": cfg.autoscaler_scale_down_threshold,
+            "scale_down_unneeded_rounds": cfg.autoscaler_scale_down_rounds,
+        },
     )
     if di.import_cluster_resource_service() is not None:
         di.import_cluster_resource_service().import_cluster_resources()

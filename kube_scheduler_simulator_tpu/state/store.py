@@ -68,6 +68,10 @@ KINDS: tuple[str, ...] = (
     # reference's real apiserver accepts them, so the kube port must too
     # (a 404 per event pollutes external schedulers' logs)
     "events",
+    # capacity-engine NodeGroups (autoscaler/): declared node supply the
+    # simulated cluster-autoscaler can scale between minSize and maxSize;
+    # cluster-scoped, like the real CA's cloud-provider node groups
+    "nodegroups",
 )
 NAMESPACED_KINDS: frozenset[str] = frozenset(
     {
@@ -93,11 +97,16 @@ KIND_NAMES: dict[str, str] = {
     "simulators": "Simulator",
     "schedulersimulations": "SchedulerSimulation",
     "events": "Event",
+    "nodegroups": "NodeGroup",
 }
 
 EVENT_ADDED = "ADDED"
 EVENT_MODIFIED = "MODIFIED"
 EVENT_DELETED = "DELETED"
+
+# Sentinel a bulk_update mutation returns to delete its object
+# (bulk_update(allow_delete=True)) — the autoscaler's scale-down wave.
+BULK_DELETE: Any = object()
 
 
 class NotFoundError(KeyError):
@@ -411,7 +420,13 @@ class ClusterStore:
                 return self.update(kind, o, owned=True)
             return self.create(kind, o)
 
-    def bulk_update(self, kind: str, mutations: "Iterable[tuple[str, str | None, Callable[[Obj], Obj | None]]]") -> int:
+    def bulk_update(
+        self,
+        kind: str,
+        mutations: "Iterable[tuple[str, str | None, Callable[[Obj | None], Obj | None]]]",
+        allow_create: bool = False,
+        allow_delete: bool = False,
+    ) -> int:
         """Apply a wave of object mutations under ONE lock acquisition
         with one batched watch-event dispatch — the bulk-apply entry point
         the batch scheduler's commit pipeline uses instead of N
@@ -434,9 +449,19 @@ class ClusterStore:
         subscribers/hooks in one batch after all mutations land.
         The replacement's ``metadata`` dict must itself be fresh — the
         store stamps uid/creationTimestamp/resourceVersion into it.
-        Returns the number of objects updated."""
+
+        ``allow_create=True``: a mutation naming a MISSING object calls
+        ``fn(None)`` — a returned object is created in the wave (stamped
+        like ``create``, ADDED event).  ``allow_delete=True``: a mutation
+        whose ``fn`` returns the ``BULK_DELETE`` sentinel removes the
+        object (DELETED event).  The capacity engine materializes and
+        drains autoscaled nodes through these; events are dispatched
+        one-per-object after the wave commits — a subscriber (e.g. the
+        scheduling queue's moveRequestCycle) sees exactly the N events N
+        individual create/update/delete calls would have produced, in
+        mutation order.  Returns the number of objects changed."""
         applied = 0
-        events: list[tuple[Obj, Obj]] = []
+        events: list[tuple[str, Obj, Obj | None]] = []
         with self._lock:
             bucket = self._bucket(kind)
             for name, namespace, fn in mutations:
@@ -446,19 +471,46 @@ class ClusterStore:
                     k = name
                 cur = bucket.get(k)
                 if cur is None:
+                    if not allow_create:
+                        continue
+                    o = fn(None)
+                    if o is None or o is BULK_DELETE:
+                        continue
+                    meta = o.setdefault("metadata", {})
+                    meta.setdefault("name", name)
+                    if kind in NAMESPACED_KINDS:
+                        meta.setdefault("namespace", namespace or "default")
+                    meta["uid"] = self._next_uid()
+                    meta["resourceVersion"] = str(self._next_rv())
+                    meta.setdefault("creationTimestamp", _rfc3339(self._clock()))
+                    if kind == "pods":
+                        o.setdefault("status", {}).setdefault("phase", "Pending")
+                        self._admit_priority(o)
+                    bucket[k] = o
+                    events.append((EVENT_ADDED, o, None))
+                    applied += 1
                     continue
                 o = fn(cur)
                 if o is None or o is cur:
+                    continue
+                if o is BULK_DELETE:
+                    if not allow_delete:
+                        continue
+                    del bucket[k]
+                    dead = _clone(cur)
+                    dead["metadata"]["resourceVersion"] = str(self._next_rv())
+                    events.append((EVENT_DELETED, dead, None))
+                    applied += 1
                     continue
                 meta = o.setdefault("metadata", {})
                 meta["uid"] = cur["metadata"]["uid"]
                 meta["creationTimestamp"] = cur["metadata"]["creationTimestamp"]
                 meta["resourceVersion"] = str(self._next_rv())
                 bucket[k] = o
-                events.append((o, cur))
+                events.append((EVENT_MODIFIED, o, cur))
                 applied += 1
-            for o, old in events:
-                self._emit(kind, EVENT_MODIFIED, o, old=old)
+            for type_, o, old in events:
+                self._emit(kind, type_, o, old=old)
         return applied
 
     def patch(self, kind: str, name: str, patch: Mapping[str, Any], namespace: str | None = None) -> Obj:

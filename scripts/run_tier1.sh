@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# The tier-1 verification gate, encoding the ROADMAP.md "Tier-1 verify"
+# command VERBATIM so builders and CI run the exact same thing: pipefail
+# so the pytest exit code survives the tee, a hard timeout, and the
+# DOTS_PASSED count extracted from the progress lines.
+#
+# Usage: scripts/run_tier1.sh   (from the repo root)
+cd "$(dirname "$0")/.." || exit 1
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,0 +1,475 @@
+"""The capacity engine: simulated cluster-autoscaler (autoscaler/).
+
+Pins the subsystem's contract (docs/autoscaler.md):
+
+- scale-up estimation runs through the XLA batch kernel — ONE vmapped
+  device dispatch evaluates all P pending pods against all G group
+  templates, and the estimates drive a deterministic expander;
+- materialized nodes land through the store's bulk wave and re-activate
+  the unschedulable pods via the queue's move machinery;
+- scale-down drains under-utilized group nodes after N consecutive
+  passes, respecting minSize and the preemption-style PDB rules;
+- a scenario replayed with autoscale enabled produces an identical
+  timeline (including the Autoscale events) across runs.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import pytest
+
+from kube_scheduler_simulator_tpu.autoscaler import (
+    NODE_GROUP_LABEL,
+    ClusterAutoscaler,
+    validate_node_group,
+)
+from kube_scheduler_simulator_tpu.autoscaler.estimator import GroupEstimate
+from kube_scheduler_simulator_tpu.autoscaler.expander import pick
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+Obj = dict[str, Any]
+
+
+def mk_group(name: str, mx: int, cpu: str = "4000m", mem: str = "8Gi", mn: int = 0,
+             priority: int = 0, labels: "dict | None" = None, taints=None) -> Obj:
+    template: Obj = {
+        "metadata": {"labels": labels or {}},
+        "spec": ({"taints": taints} if taints else {}),
+        "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": "20"}},
+    }
+    return {
+        "metadata": {"name": name},
+        "spec": {"minSize": mn, "maxSize": mx, "priority": priority, "template": template},
+    }
+
+
+def mk_pod(name: str, cpu: str = "1000m", mem: str = "1Gi", labels=None, **spec_extra) -> Obj:
+    spec: Obj = {
+        "containers": [{"name": "c", "resources": {"requests": {"cpu": cpu, "memory": mem}}}]
+    }
+    spec.update(spec_extra)
+    return {"metadata": {"name": name, "namespace": "default", "labels": labels or {}}, "spec": spec}
+
+
+def mk_service(store: ClusterStore, **kw) -> SchedulerService:
+    svc = SchedulerService(store, tie_break="first", use_batch="off", **kw)
+    svc.start_scheduler(None)
+    return svc
+
+
+# ------------------------------------------------------------- validation
+
+
+def test_nodegroup_validation():
+    validate_node_group(mk_group("ok", 3))
+    with pytest.raises(ValueError):
+        validate_node_group({"metadata": {"name": ""}, "spec": {"maxSize": 1}})
+    with pytest.raises(ValueError):
+        validate_node_group(mk_group("bad-bounds", 1, mn=5))
+    g = mk_group("no-alloc", 2)
+    g["spec"]["template"]["status"] = {}
+    with pytest.raises(ValueError):
+        validate_node_group(g)
+    g = mk_group("bad-prio", 2)
+    g["spec"]["priority"] = "high"
+    with pytest.raises(ValueError):
+        validate_node_group(g)
+    # quantities must parse at admission, not crash the estimator later
+    with pytest.raises(ValueError):
+        validate_node_group(mk_group("bad-qty", 2, cpu="lots"))
+
+
+def test_malformed_group_skipped_not_fatal():
+    """A group created WITHOUT admission (raw resources route, scenario
+    create) must cost itself, not crash every autoscaler pass."""
+    store = ClusterStore()
+    store.create("nodegroups", mk_group("broken", mx=4, cpu="not-a-quantity"))
+    store.create("nodegroups", mk_group("pool", mx=4))
+    svc = mk_service(store)
+    for i in range(2):
+        store.create("pods", mk_pod(f"p{i}"))
+    svc.schedule_pending(max_rounds=1)
+    asc = ClusterAutoscaler(store, svc)
+    action = asc.run_once()["scaled_up"]
+    assert action is not None and action["nodeGroup"] == "pool"
+    assert asc._estimator.kernel_errors == 0
+    # the pods land on the new capacity; the quiescent pass (scale-down
+    # path, which must also tolerate the broken group) takes no action
+    svc.schedule_pending(max_rounds=2)
+    assert asc.run_once()["actions"] == 0
+
+
+# --------------------------------------------------- estimation (tentpole)
+
+
+def test_estimation_is_one_vmapped_kernel_dispatch():
+    """Acceptance: P pending pods x G group templates in ONE device
+    dispatch, with correct per-group bin-packing estimates."""
+    store = ClusterStore()
+    store.create("nodegroups", mk_group("small", mx=8, cpu="2000m", mem="4Gi"))
+    store.create("nodegroups", mk_group("big", mx=8, cpu="8000m", mem="16Gi"))
+    svc = mk_service(store)
+    # 6 pods x 1500m: small fits ONE per 2-cpu node, big fits FIVE per 8-cpu
+    for i in range(6):
+        store.create("pods", mk_pod(f"p{i}", cpu="1500m"))
+    svc.schedule_pending(max_rounds=1)
+    asc = ClusterAutoscaler(store, svc)
+    action = asc.scale_up(svc.pending_pods())
+    est = asc._estimator
+    assert est is not None and est.dispatches == 1  # one dispatch, both groups
+    by_group = {e["group"]: e for e in action["estimates"]}
+    assert set(by_group) == {"big", "small"}
+    assert action["method"] == "xla-batch"
+    assert by_group["big"]["nodesNeeded"] == 2  # 5 + 1 pods, best-fit packed
+    assert by_group["big"]["podsFit"] == 6
+    assert by_group["small"]["nodesNeeded"] == 6
+    assert by_group["small"]["podsFit"] == 6
+
+
+def test_estimation_respects_profile_filters():
+    """Feasibility inside the estimate is the profile's own filter set: a
+    group whose template carries an untolerated taint helps no pod."""
+    store = ClusterStore()
+    store.create(
+        "nodegroups",
+        mk_group("tainted", mx=4, taints=[{"key": "gpu", "value": "true", "effect": "NoSchedule"}]),
+    )
+    store.create("nodegroups", mk_group("plain", mx=4))
+    svc = mk_service(store)
+    for i in range(3):
+        store.create("pods", mk_pod(f"p{i}"))
+    svc.schedule_pending(max_rounds=1)
+    asc = ClusterAutoscaler(store, svc)
+    action = asc.scale_up(svc.pending_pods())
+    assert action["nodeGroup"] == "plain"
+    by_group = {e["group"]: e for e in action["estimates"]}
+    assert by_group["tainted"]["podsFit"] == 0
+    assert by_group["plain"]["podsFit"] == 3
+
+
+# --------------------------------------------------------------- expanders
+
+
+def _estimates():
+    return [
+        GroupEstimate("a", 8, 4, 4, waste=0.50, priority=1, method="xla-batch"),
+        GroupEstimate("b", 8, 2, 6, waste=0.30, priority=5, method="xla-batch"),
+        GroupEstimate("c", 8, 3, 5, waste=0.10, priority=0, method="xla-batch"),
+        GroupEstimate("never", 8, 0, 0, waste=0.0, priority=99, method="xla-batch"),
+    ]
+
+
+def test_expander_strategies():
+    assert pick("least-waste", _estimates()).group == "c"
+    assert pick("most-pods", _estimates()).group == "b"
+    assert pick("priority", _estimates()).group == "b"
+    assert pick("least-waste", []) is None
+    # groups that help no pod never win, whatever their priority
+    assert pick("priority", _estimates()).group != "never"
+
+
+def test_unknown_expander_rejected():
+    store = ClusterStore()
+    svc = mk_service(store)
+    with pytest.raises(ValueError):
+        ClusterAutoscaler(store, svc, expander="random")
+
+
+# ------------------------------------------------------------ scale-up e2e
+
+
+def test_scale_up_end_to_end_reactivates_pods():
+    store = ClusterStore()
+    store.create("nodegroups", mk_group("pool", mx=4))
+    svc = mk_service(store, autoscale="on")
+    for i in range(4):
+        store.create("pods", mk_pod(f"p{i}", cpu="3000m"))
+    results = svc.schedule_pending_autoscaled(max_rounds=2)
+    assert sum(1 for r in results.values() if r.success) == 4
+    nodes = store.list("nodes")
+    assert nodes and all(
+        (n["metadata"]["labels"] or {}).get(NODE_GROUP_LABEL) == "pool" for n in nodes
+    )
+    # synthetic nodes self-label a hostname (spread semantics need it)
+    assert all("kubernetes.io/hostname" in n["metadata"]["labels"] for n in nodes)
+    assert all((p.get("spec") or {}).get("nodeName") for p in store.list("pods"))
+    asc = svc.autoscaler
+    assert asc.stats["scale_ups"] >= 1 and asc.stats["nodes_added"] == len(nodes)
+
+
+def test_scale_up_respects_max_size_and_allocates_lowest_free_names():
+    store = ClusterStore()
+    store.create("nodegroups", mk_group("pool", mx=2))
+    svc = mk_service(store, autoscale="on")
+    for i in range(5):
+        store.create("pods", mk_pod(f"p{i}", cpu="3000m"))  # 1 pod per node
+    svc.schedule_pending_autoscaled(max_rounds=2)
+    names = sorted(n["metadata"]["name"] for n in store.list("nodes"))
+    assert names == ["pool-0", "pool-1"]  # capped at maxSize
+    assert len([p for p in store.list("pods") if not p["spec"].get("nodeName")]) == 3
+    # a gap left by a manual delete is refilled FIRST (deterministic names)
+    store.delete("nodes", "pool-0")
+    svc.schedule_pending_autoscaled(max_rounds=2)
+    names = sorted(n["metadata"]["name"] for n in store.list("nodes"))
+    assert names == ["pool-0", "pool-1"]
+
+
+def test_no_group_helps_no_action():
+    store = ClusterStore()
+    store.create("nodegroups", mk_group("tiny", mx=3, cpu="500m", mem="1Gi"))
+    svc = mk_service(store, autoscale="on")
+    store.create("pods", mk_pod("huge", cpu="64000m"))
+    svc.schedule_pending_autoscaled(max_rounds=1)
+    assert store.list("nodes") == []
+    assert svc.autoscaler.stats["scale_ups"] == 0
+
+
+# ---------------------------------------------------------------- scale-down
+
+
+def test_scale_down_after_unneeded_rounds_respecting_min_size():
+    store = ClusterStore()
+    store.create("nodegroups", mk_group("pool", mx=4, mn=1))
+    svc = mk_service(store)
+    asc = ClusterAutoscaler(store, svc, scale_down_unneeded_rounds=2)
+    # 3 idle group nodes
+    from kube_scheduler_simulator_tpu.autoscaler.nodegroups import synthetic_node
+
+    g = store.get("nodegroups", "pool")
+    for i in range(3):
+        store.create("nodes", synthetic_node(g, i))
+    assert asc.run_once()["scaled_down"] == []  # pass 1: timers advance only
+    down = asc.run_once()["scaled_down"]  # pass 2: ripe — but minSize floors
+    assert len(down) == 2
+    assert sorted(n["metadata"]["name"] for n in store.list("nodes")) == ["pool-2"]
+    # the survivor stays forever at minSize
+    assert asc.run_once()["scaled_down"] == []
+
+
+def test_scale_down_drains_pods_and_they_reschedule():
+    store = ClusterStore()
+    store.create("nodegroups", mk_group("pool", mx=4))
+    svc = mk_service(store, autoscale="on")
+    from kube_scheduler_simulator_tpu.autoscaler.nodegroups import synthetic_node
+
+    g = store.get("nodegroups", "pool")
+    for i in range(2):
+        store.create("nodes", synthetic_node(g, i))
+    # one tiny pod per node: both nodes under the 0.5 threshold
+    for i in range(2):
+        p = mk_pod(f"p{i}", cpu="100m", mem="128Mi")
+        p["spec"]["nodeName"] = f"pool-{i}"
+        store.create("pods", p)
+    asc = ClusterAutoscaler(store, svc, scale_down_unneeded_rounds=1)
+    svc.autoscaler = asc
+    down = asc.run_once()["scaled_down"]
+    assert len(down) >= 1 and down[0]["drainedPods"]
+    # drained pods are Pending again and re-schedule onto what's left
+    svc.schedule_pending(max_rounds=2)
+    pods = store.list("pods")
+    assert all(p["spec"].get("nodeName") for p in pods)
+
+
+def test_scale_down_blocked_by_pdb():
+    store = ClusterStore()
+    store.create("nodegroups", mk_group("pool", mx=4))
+    svc = mk_service(store)
+    from kube_scheduler_simulator_tpu.autoscaler.nodegroups import synthetic_node
+
+    g = store.get("nodegroups", "pool")
+    store.create("nodes", synthetic_node(g, 0))
+    # an unmanaged node with room: relocation is possible, only the PDB vetoes
+    store.create(
+        "nodes",
+        {
+            "metadata": {"name": "static-0", "labels": {"kubernetes.io/hostname": "static-0"}},
+            "status": {"allocatable": {"cpu": "4000m", "memory": "8Gi", "pods": "20"}},
+        },
+    )
+    p = mk_pod("guarded", cpu="100m", labels={"app": "db"})
+    p["spec"]["nodeName"] = "pool-0"
+    store.create("pods", p)
+    store.create(
+        "poddisruptionbudgets",
+        {
+            "metadata": {"name": "pdb", "namespace": "default"},
+            "spec": {"selector": {"matchLabels": {"app": "db"}}},
+            "status": {"disruptionsAllowed": 0},
+        },
+    )
+    asc = ClusterAutoscaler(store, svc, scale_down_unneeded_rounds=1)
+    assert asc.run_once()["scaled_down"] == []  # PDB vetoes the drain
+    assert "pool-0" in [n["metadata"]["name"] for n in store.list("nodes")]
+    # budget relaxed: the drain proceeds (the unmanaged node absorbs the pod)
+    store.patch("poddisruptionbudgets", "pdb", {"status": {"disruptionsAllowed": 1}}, "default")
+    assert len(asc.run_once()["scaled_down"]) == 1
+    assert [n["metadata"]["name"] for n in store.list("nodes")] == ["static-0"]
+
+
+def test_scale_down_never_drains_a_node_promised_to_relocations():
+    """Two ripe nodes whose pods both 'fit elsewhere' must not cash the
+    same slack twice: once node B absorbs node A's victims (virtually),
+    draining B later in the pass would delete capacity A's victims were
+    promised — B must survive the pass."""
+    store = ClusterStore()
+    store.create("nodegroups", mk_group("pool", mx=4, cpu="8000m", mem="16Gi"))
+    svc = mk_service(store)
+    from kube_scheduler_simulator_tpu.autoscaler.nodegroups import synthetic_node
+
+    g = store.get("nodegroups", "pool")
+    for i in range(2):
+        store.create("nodes", synthetic_node(g, i))
+        p = mk_pod(f"p{i}", cpu="3000m", mem="1Gi")  # util 3/8 < 0.5: ripe
+        p["spec"]["nodeName"] = f"pool-{i}"
+        store.create("pods", p)
+    # an unmanaged node that can hold ONE victim, not both
+    store.create(
+        "nodes",
+        {
+            "metadata": {"name": "static-0", "labels": {"kubernetes.io/hostname": "static-0"}},
+            "status": {"allocatable": {"cpu": "4000m", "memory": "8Gi", "pods": "20"}},
+        },
+    )
+    asc = ClusterAutoscaler(store, svc, scale_down_unneeded_rounds=1)
+    down = asc.run_once()["scaled_down"]
+    # pool-0 drains (victim promised pool-1's slack); pool-1 now holds
+    # that promise and must NOT drain, even though its own pod would fit
+    # on static-0
+    assert [a["nodes"] for a in down] == [["pool-0"]]
+    assert "pool-1" in [n["metadata"]["name"] for n in store.list("nodes")]
+    # total unbound demand fits the remaining capacity
+    svc.schedule_pending(max_rounds=2)
+    assert all(p["spec"].get("nodeName") for p in store.list("pods"))
+
+
+def test_pass_that_scales_up_does_not_scale_down():
+    store = ClusterStore()
+    store.create("nodegroups", mk_group("pool", mx=4))
+    svc = mk_service(store)
+    asc = ClusterAutoscaler(store, svc, scale_down_unneeded_rounds=1)
+    from kube_scheduler_simulator_tpu.autoscaler.nodegroups import synthetic_node
+
+    g = store.get("nodegroups", "pool")
+    store.create("nodes", synthetic_node(g, 3))  # idle, instantly "unneeded"
+    asc.run_once()  # advances its timer
+    store.create("pods", mk_pod("p0", cpu="3000m"))
+    svc.schedule_pending(max_rounds=1)
+    # pending pod -> the pass scales UP; the idle node survives the pass
+    s = asc.run_once()
+    assert s["scaled_up"] is not None and s["scaled_down"] == []
+
+
+# ----------------------------------------------- scenario replay (acceptance)
+
+
+def _autoscale_scenario() -> Obj:
+    ops = [
+        {
+            "id": "1",
+            "step": {"major": 1},
+            "createOperation": {
+                "typeMeta": {"kind": "NodeGroup"},
+                "object": mk_group("pool", mx=4, cpu="4000m", mem="8Gi"),
+            },
+        }
+    ]
+    for i in range(4):
+        ops.append(
+            {
+                "id": str(2 + i),
+                "step": {"major": 2},
+                "createOperation": {
+                    "typeMeta": {"kind": "Pod"},
+                    "object": mk_pod(f"p{i}", cpu="3000m", mem="1Gi"),
+                },
+            }
+        )
+    ops.append({"id": "done", "step": {"major": 3}, "doneOperation": {}})
+    return {"metadata": {"name": "autoscale-scn", "namespace": "default"}, "spec": {"operations": ops}}
+
+
+def _run_scenario_once() -> Obj:
+    from kube_scheduler_simulator_tpu.scenario import ScenarioEngine
+
+    store = ClusterStore(clock=lambda: 0.0)  # frozen timestamps: byte replay
+    svc = SchedulerService(
+        store, tie_break="first", use_batch="off", autoscale="scenario",
+        autoscaler_opts={"expander": "least-waste"},
+    )
+    svc.start_scheduler(None)
+    engine = ScenarioEngine(store, svc, None)
+    return engine.run(_autoscale_scenario())
+
+
+def test_scenario_replay_with_autoscaler_is_byte_deterministic():
+    """Acceptance: autoscale=scenario replays produce an identical
+    timeline — autoscaler events included — across two runs."""
+    a = _run_scenario_once()
+    b = _run_scenario_once()
+    assert a["status"]["phase"] == "Succeeded"
+    tl = a["status"]["scenarioResult"]["timeline"]
+    autoscale_events = [ev for evs in tl.values() for ev in evs if "autoscale" in ev]
+    assert autoscale_events, "timeline must carry the autoscaler's actions"
+    up = autoscale_events[0]["autoscale"]
+    assert up["action"] == "ScaleUp" and up["nodeGroup"] == "pool"
+    assert up["method"] == "xla-batch"  # estimation ran through the kernel
+    # Autoscale events carry major/minor steps like every timeline event
+    assert {"major", "minor"} <= set(autoscale_events[0]["step"])
+    # every pod scheduled (onto autoscaled capacity only)
+    assert a["status"]["scenarioResult"]["summary"]["allocationRate"] == 1.0
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def test_scenario_mode_off_keeps_autoscaler_out():
+    from kube_scheduler_simulator_tpu.scenario import ScenarioEngine
+
+    store = ClusterStore(clock=lambda: 0.0)
+    svc = SchedulerService(store, tie_break="first", use_batch="off")  # autoscale off
+    svc.start_scheduler(None)
+    out = ScenarioEngine(store, svc, None).run(_autoscale_scenario())
+    tl = out["status"]["scenarioResult"]["timeline"]
+    assert not [ev for evs in tl.values() for ev in evs if "autoscale" in ev]
+    assert out["status"]["scenarioResult"]["summary"]["allocationRate"] == 0.0
+
+
+# ------------------------------------------------------------------- server
+
+
+def test_nodegroups_api_and_autoscaler_status():
+    from kube_scheduler_simulator_tpu.server import DIContainer, SimulatorServer
+    from tests.test_server import _req
+
+    di = DIContainer(use_batch="off", autoscale="on")
+    srv = SimulatorServer(di, port=0)
+    srv.start(background=True)
+    try:
+        code, out = _req(srv, "POST", "/api/v1/nodegroups", mk_group("pool", mx=3))
+        assert code == 201
+        # admission: invalid bounds rejected with 400
+        code, out = _req(srv, "POST", "/api/v1/nodegroups", mk_group("bad", mx=1, mn=5))
+        assert code == 400
+        code, out = _req(srv, "GET", "/api/v1/nodegroups")
+        assert code == 200 and [g["metadata"]["name"] for g in out["items"]] == ["pool"]
+        assert out["items"][0]["status"] == {"currentSize": 0, "nodes": []}
+        code, out = _req(srv, "GET", "/api/v1/nodegroups/pool")
+        assert code == 200 and out["spec"]["maxSize"] == 3
+        code, out = _req(srv, "GET", "/api/v1/autoscaler")
+        assert code == 200 and out["mode"] == "on"
+        assert out["groups"][0]["name"] == "pool"
+        # metrics surface: node-group gauges + estimation counters
+        import urllib.request
+
+        with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert 'simulator_autoscaler_node_group_size{bound="max",group="pool"} 3' in text
+        assert "simulator_autoscaler_estimation_dispatches_total" in text
+        assert "simulator_commit_pods_per_s" in text
+        code, _ = _req(srv, "DELETE", "/api/v1/nodegroups/pool")
+        assert code == 200
+        code, _ = _req(srv, "GET", "/api/v1/nodegroups/pool")
+        assert code == 404
+    finally:
+        srv.shutdown()

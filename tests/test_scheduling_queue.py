@@ -155,6 +155,83 @@ def test_sync_drain_keeps_deterministic_retry_semantics():
     assert store.get("pods", "big")["spec"].get("nodeName") == "n1"
 
 
+def test_bulk_wave_node_events_drive_move_request_cycle():
+    """PR-1 audit: a node add/delete landing in a ClusterStore.bulk_update
+    wave must bump SchedulingQueue.move_seq and move unschedulable pods
+    exactly like N individual events — the batched dispatch coalesces the
+    LOCKING, never the events."""
+    from kube_scheduler_simulator_tpu.state.store import BULK_DELETE
+
+    clock = FakeClock()
+    store = ClusterStore()
+    store.create("nodes", mk_node("n0", cpu="1000m"))
+    svc = SchedulerService(store, tie_break="first", clock=clock)
+    svc.start_scheduler(None)
+    store.create("pods", mk_pod("big", cpu="8000m"))
+    svc.schedule_pending(max_rounds=1, respect_backoff=True)
+    assert svc.metrics()["queue_unschedulable"] == 1
+    seq_before = svc.queue.move_seq
+
+    # a bulk CREATE wave of two nodes: one event (and one move_seq bump)
+    # per node, exactly as two individual creates would produce
+    new = {
+        name: {
+            "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}},
+            "status": {"allocatable": {"cpu": "16000m", "memory": "8Gi", "pods": "10"}},
+        }
+        for name in ("bulk-a", "bulk-b")
+    }
+    n = store.bulk_update(
+        "nodes",
+        [(nm, None, lambda cur, nm=nm: new[nm] if cur is None else None) for nm in new],
+        allow_create=True,
+    )
+    assert n == 2
+    assert svc.queue.move_seq == seq_before + 2
+    # the wave moved the pod out of unschedulableQ (backoffQ until expiry)
+    assert svc.metrics()["queue_unschedulable"] == 0
+    clock.t = 1.5
+    svc.schedule_pending(max_rounds=1, respect_backoff=True)
+    assert store.get("pods", "big")["spec"].get("nodeName") in ("bulk-a", "bulk-b")
+
+    # a failed pod parked in unschedulableQ moves on a bulk node DELETE too
+    store.create("pods", mk_pod("big2", cpu="64000m"))
+    svc.schedule_pending(max_rounds=1, respect_backoff=True)
+    assert svc.metrics()["queue_unschedulable"] == 1
+    seq_before = svc.queue.move_seq
+    n = store.bulk_update(
+        "nodes", [("bulk-b", None, lambda cur: BULK_DELETE)], allow_delete=True
+    )
+    assert n == 1
+    assert svc.queue.move_seq == seq_before + 1
+    assert svc.metrics()["queue_unschedulable"] == 0
+
+
+def test_bulk_wave_modify_keeps_per_event_moves():
+    """The PR-1 MODIFY wave (the commit pipeline's bind path) dispatches
+    per-object events after the wave: pod binds forget queue entries and
+    spec changes request moves, one event at a time."""
+    store = ClusterStore()
+    store.create("nodes", mk_node("n0", cpu="10000m"))
+    svc = SchedulerService(store, tie_break="first")
+    svc.start_scheduler(None)
+    for i in range(3):
+        store.create("pods", mk_pod(f"p{i}", cpu="100m"))
+    seq_before = svc.queue.move_seq
+
+    def bind(nm):
+        def fn(cur):
+            spec = dict(cur.get("spec") or {})
+            spec["nodeName"] = "n0"
+            return {**cur, "metadata": dict(cur["metadata"]), "spec": spec}
+        return fn
+
+    n = store.bulk_update("pods", [(f"p{i}", "default", bind(f"p{i}")) for i in range(3)])
+    assert n == 3
+    # 3 spec-changing MODIFIED events → 3 move requests, not 1 coalesced
+    assert svc.queue.move_seq == seq_before + 3
+
+
 def test_deleted_pod_is_forgotten():
     clock = FakeClock()
     store = ClusterStore()

@@ -34,7 +34,7 @@ from kube_scheduler_simulator_tpu.utils.jseval import UNDEF, _native, to_str
 KINDS = [
     "pods", "nodes", "persistentvolumes", "persistentvolumeclaims",
     "storageclasses", "priorityclasses", "namespaces", "deployments",
-    "replicasets", "scenarios",
+    "replicasets", "scenarios", "nodegroups",
 ]
 
 
@@ -70,6 +70,7 @@ SCORED = {
 
 def _routes():
     routes = {("GET", f"/api/v1/resources/{k}"): {"items": []} for k in KINDS}
+    routes[("GET", "/api/v1/autoscaler")] = {"mode": "off"}
     routes[("GET", "/api/v1/resources/nodes")] = {"items": [_node("diff-node-1")]}
     routes[("GET", "/api/v1/resources/pods")] = {
         "items": [

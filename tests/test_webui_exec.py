@@ -22,7 +22,7 @@ from kube_scheduler_simulator_tpu.utils.jseval import ThrowSig
 KINDS = [
     "pods", "nodes", "persistentvolumes", "persistentvolumeclaims",
     "storageclasses", "priorityclasses", "namespaces", "deployments",
-    "replicasets", "scenarios",
+    "replicasets", "scenarios", "nodegroups",
 ]
 
 
@@ -32,6 +32,7 @@ def make_harness(pods=(), nodes=()):
         h.routes[("GET", f"/api/v1/resources/{k}")] = {"items": []}
     h.routes[("GET", "/api/v1/resources/nodes")] = {"items": list(nodes)}
     h.routes[("GET", "/api/v1/resources/pods")] = {"items": list(pods)}
+    h.routes[("GET", "/api/v1/autoscaler")] = {"mode": "off"}
     return h
 
 
