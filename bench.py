@@ -453,6 +453,172 @@ def run_autoscale(P_total=1500, seed_nodes=4, budget_s=240.0):
     }
 
 
+def run_preemption(N=200, fillers=800, preemptors=16, budget_s=300.0):
+    """cfg7: the vectorized preemption engine end-to-end (ISSUE 4).  A
+    churn-shaped round where high-priority pods must evict bound victims:
+    the batch path handles every PostFilter through the batched victim
+    search (preemption/) — the row must record ZERO preemption fallbacks
+    — against the all-sequential service on an identical store, whose
+    per-pod DefaultPreemption cycle is the old cost cliff."""
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+    def build():
+        rng = random.Random(11)
+        store = ClusterStore()
+        for i in range(N):
+            store.create("nodes", mk_node(i))
+        # victims: low-priority pods filling the first quarter of nodes
+        # nearly to capacity (mk_node allocates 8-64 cpu; keep it simple
+        # with big victims so preemptors must evict)
+        k = 0
+        for i in range(N // 4):
+            v = {
+                "metadata": {
+                    "name": f"victim-{i}",
+                    "creationTimestamp": f"2024-01-01T00:{k // 60:02d}:{k % 60:02d}Z",
+                },
+                "spec": {
+                    "nodeName": f"node-{i}",
+                    "priority": 0,
+                    "containers": [
+                        {"name": "c", "resources": {"requests": {"cpu": "62", "memory": "200Gi"}}}
+                    ],
+                },
+                "status": {"startTime": f"2024-01-01T01:00:{k % 60:02d}Z"},
+            }
+            store.create("pods", v)
+            k += 1
+        # fillers OUTRANK the preemptors: the preemption-needing pods ride
+        # at the queue tail (the churn shape BENCH_r05 cfg5 showed), so
+        # each mid-round restart re-runs only the short preemptor tail
+        # while the filler mass batches in one kernel run
+        for i in range(fillers):
+            p = mk_pod(i, rng)
+            p["spec"]["priority"] = 50
+            p["metadata"]["creationTimestamp"] = f"2024-01-02T00:{i // 60 % 60:02d}:{i % 60:02d}Z"
+            store.create("pods", p)
+        for i in range(preemptors):
+            p = {
+                "metadata": {
+                    "name": f"preemptor-{i}",
+                    "creationTimestamp": f"2024-01-02T01:00:{i % 60:02d}Z",
+                },
+                "spec": {
+                    "priority": 10,
+                    "nodeSelector": {"kubernetes.io/hostname": f"node-{i}"},
+                    "containers": [
+                        {"name": "c", "resources": {"requests": {"cpu": "60", "memory": "180Gi"}}}
+                    ],
+                },
+            }
+            store.create("pods", p)
+        return store
+
+    # Steady state is what a churn workload lives in, so the row reports
+    # the WARM batch wall (cold run populates the opt-in persistent CPU
+    # compile cache — batch_engine.enable_persistent_compilation_cache —
+    # and is reported alongside as wall_cold_s); the sequential
+    # comparator has no compile step, so it simply takes min-of-2
+    # against this host's ±30% single-shot noise.
+    os.environ.setdefault("KSS_COMPILE_CACHE_CPU", "1")
+
+    def run_batch():
+        store_b = build()
+        svc_b = SchedulerService(store_b, tie_break="first", use_batch="auto", batch_min_work=0)
+        svc_b.start_scheduler({"percentageOfNodesToScore": 100})
+        t0 = time.perf_counter()
+        svc_b.schedule_pending(max_rounds=2)
+        return time.perf_counter() - t0, store_b, svc_b
+
+    def run_seq():
+        store_s = build()
+        svc_s = SchedulerService(store_s, tie_break="first", use_batch="off")
+        svc_s.start_scheduler({"percentageOfNodesToScore": 100})
+        t0 = time.perf_counter()
+        svc_s.schedule_pending(max_rounds=2)
+        return time.perf_counter() - t0, store_s
+
+    cold_wall, _store_cold, _svc_cold = run_batch()
+    batch_wall, store_b, svc_b = min(run_batch(), run_batch(), key=lambda r: r[0])
+    seq_wall, store_s = min(run_seq(), run_seq(), key=lambda r: r[0])
+
+    # byte parity over the whole population (the acceptance contract)
+    mismatches = 0
+    for pod in store_s.list("pods"):
+        nm = pod["metadata"]["name"]
+        try:
+            other = store_b.get("pods", nm, pod["metadata"].get("namespace"))
+        except KeyError:
+            mismatches += 1
+            continue
+        if (pod["metadata"].get("annotations") or {}) != (
+            other["metadata"].get("annotations") or {}
+        ) or (pod["spec"].get("nodeName")) != (other["spec"].get("nodeName")):
+            mismatches += 1
+    m = svc_b.metrics()
+    return {
+        "config": "cfg7-preemption",
+        "nodes": N,
+        "pods": fillers + preemptors + N // 4,
+        "preemptors": preemptors,
+        "wall_s": round(batch_wall, 2),
+        "wall_cold_s": round(cold_wall, 2),
+        "seq_wall_s": round(seq_wall, 2),
+        "speedup_vs_seq": round(seq_wall / batch_wall, 1) if batch_wall > 0 else 0,
+        "preempt_nominations": m["preempt_nominations"],
+        "preempt_victims": m["preempt_victims"],
+        "preempt_dispatches": m["preempt_dispatches"],
+        "preempt_kernel_s": round(m["preempt_kernel_s"], 4),
+        "batch_restarts": m["batch_restarts"],
+        # the acceptance criterion: zero PostFilter work left the batch
+        # path — every victim search ran on the vectorized engine.  (The
+        # separate round_fallbacks column shows the nominee RESCHEDULING
+        # rounds, which are plain filter rounds the self-exclusion rule
+        # keeps sequential — not victim-search work.)
+        "post_filter_batch_fallbacks": dict(m["preempt_fallbacks"]),
+        "round_fallbacks": dict(svc_b.stats["batch_fallbacks"]),
+        "parity_mismatches": mismatches,
+        "parity_note": "annotations+bindings byte-compared over the full population",
+    }
+
+
+def run_cfg4_drift(n=5):
+    """VERDICT item 6: re-attest the cfg4 1.89->2.04 s drift — N repeated
+    measurements of the same wall_s metric the BENCH_r04/r05 rows report,
+    with median + spread, so one-off host noise can't masquerade as a
+    device-path regression."""
+    P, N, plugins, spread, interpod, _oracle = CONFIGS["cfg4-interpod"]
+    walls = []
+    devices = []
+    for _ in range(n):
+        row = run_config("cfg4-interpod", P, N, plugins, spread, interpod, 0)
+        walls.append(row["wall_s"])
+        devices.append(row["device_s"])
+    walls_sorted = sorted(walls)
+    median = walls_sorted[len(walls) // 2]
+    return {
+        "config": "cfg4-interpod-drift",
+        "runs": n,
+        "wall_s_runs": walls,
+        "device_s_runs": devices,
+        "wall_s_median": round(median, 4),
+        "wall_s_min": round(min(walls), 4),
+        "wall_s_max": round(max(walls), 4),
+        "wall_s_spread": round(max(walls) - min(walls), 4),
+        # drift verdict vs BENCH_r04 (1.89) / BENCH_r05 (2.04): when the
+        # same-code spread brackets the r4->r5 delta, the "regression"
+        # was host noise, not the r5 device-path changes
+        "r4_wall_s": 1.89,
+        "r5_wall_s": 2.04,
+        "verdict": (
+            "host noise: same-code spread covers the r4->r5 delta"
+            if max(walls) - min(walls) >= 2.04 - 1.89 or max(walls) < 1.89
+            else "spread does not cover the r4->r5 delta; bisect the r5 device path"
+        ),
+    }
+
+
 def _mean_annotation_bytes(store) -> int:
     total = n = 0
     for p in store.list("pods", copy_objects=False):
@@ -482,6 +648,7 @@ CHILD_CAP_S = {
     "cfg4-interpod": 300.0,
     "cfg5-churn-default-profile": 520.0,
     "cfg6-autoscale": 300.0,
+    "cfg7-preemption": 300.0,
 }
 WARM_CAP_S = 120.0
 PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_partial.json")
@@ -497,6 +664,8 @@ def _child_main(name: str, warm: bool, quick: bool) -> None:
             row = run_churn(budget_s=budget)
         elif name == "cfg6-autoscale":
             row = run_autoscale()
+        elif name == "cfg7-preemption":
+            row = run_preemption()
         else:
             P, N, plugins, spread, interpod, oracle = CONFIGS[name]
             if quick:
@@ -756,7 +925,20 @@ def main() -> None:
     ap.add_argument("--quick", action="store_true", help="small sweep (CI/dev)")
     ap.add_argument("--one", metavar="CONFIG", help="(internal) run one config in-process")
     ap.add_argument("--warm", action="store_true", help="(internal) measure warm-start compile only")
+    ap.add_argument(
+        "--preemption-report",
+        action="store_true",
+        help="run cfg7-preemption + the cfg4 drift re-attestation and write BENCH_preemption.json",
+    )
     args = ap.parse_args()
+
+    if args.preemption_report:
+        rows = [run_preemption(), run_cfg4_drift()]
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_preemption.json")
+        with open(path, "w") as f:
+            json.dump(rows, f, indent=1)
+        print(json.dumps(rows, indent=1))
+        return
 
     if args.one:
         _child_main(args.one, args.warm, args.quick)
@@ -938,6 +1120,9 @@ def main() -> None:
         maybe_midsweep_fallback()
         maybe_promote()
         run_one("cfg6-autoscale", CHILD_CAP_S["cfg6-autoscale"])
+        maybe_midsweep_fallback()
+        maybe_promote()
+        run_one("cfg7-preemption", CHILD_CAP_S["cfg7-preemption"])
         maybe_midsweep_fallback()
         # warm-start compile proof (VERDICT r3 #6): a SECOND process per
         # config hits the persistent XLA cache populated by the run above.

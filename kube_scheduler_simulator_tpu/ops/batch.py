@@ -51,6 +51,9 @@ class BatchConfig(NamedTuple):
     # scoringStrategy.resources: ((col, weight), ...) over the nz axis
     # (0 = cpu, 1 = memory) — upstream default is cpu:1, memory:1
     fit_resources: tuple = ((0, 1), (1, 1))
+    # RequestedToCapacityRatio shape: ((utilization, score·10), ...) points
+    # ascending in utilization (only read when fit_strategy selects it)
+    fit_shape: tuple = ()
     trace: bool = False
     # selectHost tie handling: "first" = first tied max in visit order;
     # "reservoir" = k-th tied max with k from the counter-keyed hash draw
@@ -439,6 +442,30 @@ def _mix32(x):
 def _floordiv(a, b):
     """Go integer division for non-negative operands, in floats."""
     return jnp.floor(a / jnp.where(b == 0, 1.0, b)) * (b != 0)
+
+
+def _truncdiv(a, b):
+    """Go integer division with truncation toward zero, in floats (the
+    broken-linear shape interpolation has negative numerators on
+    descending ramps, where floor and trunc differ)."""
+    return jnp.trunc(a / jnp.where(b == 0, 1.0, b)) * (b != 0)
+
+
+def _broken_linear(p, shape: tuple):
+    """helper.BuildBrokenLinearFunction over static (utilization, score)
+    points: clamp outside the range, Go-integer interpolation inside.
+    Descending-index sweep so the FIRST point with p <= utilization wins
+    (later writes overwrite earlier ones)."""
+    out = jnp.full_like(p, float(shape[-1][1]))
+    for i in range(len(shape) - 1, -1, -1):
+        u, s = shape[i]
+        if i == 0:
+            v = jnp.full_like(p, float(s))
+        else:
+            u0, s0 = shape[i - 1]
+            v = float(s0) + _truncdiv(float(s - s0) * (p - float(u0)), float(max(u - u0, 1)))
+        out = jnp.where(p <= float(u), v, out)
+    return out
 
 
 def _default_normalize(raw, feasible, reverse: bool):
@@ -1098,9 +1125,14 @@ def build_batch_fn(
 
             c, total = rot_cumsum(feasible)
             sampled = feasible & (c <= K)
-            # nodes actually visited: up to and including the K-th feasible
+            # nodes actually visited: up to and including the K-th feasible.
+            # dtype pin: under x64 jnp.sum promotes int32 to int64, which
+            # would widen the start-index carry and break the scan's
+            # carry-type invariant (x64 CPU + real sampling only).
             processed = jnp.where(
-                total >= K, jnp.sum(jnp.where(feasible & (c == K), r + 1, 0)), nt
+                total >= K,
+                jnp.sum(jnp.where(feasible & (c == K), r + 1, 0), dtype=jnp.int32),
+                nt,
             )
             count = jnp.minimum(total, K) * dp.pod_active[i]
         else:
@@ -1125,6 +1157,13 @@ def build_batch_fn(
                 a = dp.nz_alloc
                 if cfg.fit_strategy == "MostAllocated":
                     per_r = jnp.where((a > 0) & (req_nz <= a), _floordiv(req_nz * MAX_NODE_SCORE, a), 0.0)
+                elif cfg.fit_strategy == "RequestedToCapacityRatio":
+                    # piecewise-linear shape over the utilization ratio;
+                    # zero/over capacity evaluates the shape at 100, not 0
+                    util = jnp.where(
+                        (a > 0) & (req_nz <= a), _floordiv(req_nz * MAX_NODE_SCORE, a), 100.0
+                    )
+                    per_r = _broken_linear(util, cfg.fit_shape)
                 else:  # LeastAllocated
                     per_r = jnp.where((a > 0) & (req_nz <= a), _floordiv((a - req_nz) * MAX_NODE_SCORE, a), 0.0)
                 wsum = float(sum(w for _, w in cfg.fit_resources)) or 1.0

@@ -207,6 +207,7 @@ def encode(
     hard_pod_affinity_weight: int = 1,
     added_affinity: "Obj | None" = None,
     volumes: "dict[str, list[Obj]] | None" = None,
+    nominated: "list[tuple[Obj, str]] | None" = None,
 ) -> BatchProblem:
     """Encode a scheduling snapshot.
 
@@ -216,6 +217,18 @@ def encode(
     resource kinds the volume-plugin kernels resolve on the host
     (persistentvolumeclaims / persistentvolumes / storageclasses /
     csinodes, keyed by store kind); omitted kinds encode as empty.
+
+    ``nominated``: (pod, node_name) pairs for UNBOUND pods holding a
+    preemption nomination whose reservation every pending pod must
+    respect (upstream RunFilterPluginsWithNominatedPods).  Their resource
+    requests and pod count seed the FILTER state only (``requested0`` /
+    ``pod_count0``) — never ``nonzero0`` — because upstream scores nodes
+    without nominated pods.  Callers are responsible for the gate
+    (scheduler/service): every pending pod's priority must be <= every
+    nominee's, and neither side may carry ports/volumes/required
+    (anti-)affinity/required spread, so the filter-only, always-accounted
+    model is exact (Fit is monotone: passing WITH the nominee implies
+    passing without).
     """
     pr = BatchProblem()
     P, N = len(pending), len(nodes)
@@ -284,6 +297,17 @@ def encode(
             mem += nz_mem
         nonzero0[ni_i] = (cpu, mem)
         nz_alloc[ni_i] = (ni.allocatable.get(CPU, 0), ni.allocatable.get(MEMORY, 0))
+
+    if nominated:
+        name_to_idx = {nm: j for j, nm in enumerate(pr.node_names)}
+        for npod, nn in nominated:
+            j = name_to_idx.get(nn)
+            if j is None:
+                continue
+            pod_count0[j] += 1
+            for r, v in pod_resource_request(npod).items():
+                if r in res_idx:
+                    requested0[j, res_idx[r]] += v
 
     pod_req = np.zeros((P, R), dtype=np.int64)
     pod_nonzero = np.zeros((P, 2), dtype=np.int64)

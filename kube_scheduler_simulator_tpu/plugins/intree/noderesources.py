@@ -39,6 +39,34 @@ Obj = dict[str, Any]
 DEFAULT_MILLI_CPU_REQUEST = 100
 DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024
 
+# RequestedToCapacityRatio scoring (upstream noderesources/
+# requested_to_capacity_ratio.go): user shape scores are 0..10
+# (config.MaxCustomPriorityScore) and scale to the 0..100 node-score range.
+MAX_CUSTOM_PRIORITY_SCORE = 10
+
+
+def go_div(a: int, b: int) -> int:
+    """Go integer division (truncation toward zero — Python's ``//``
+    floors, which differs for negative numerators, and the broken-linear
+    shape interpolation has negative score deltas on descending ramps)."""
+    if b == 0:
+        return 0
+    q = abs(a) // abs(b)
+    return q if (a >= 0) == (b >= 0) else -q
+
+
+def broken_linear(p: int, shape: "tuple[tuple[int, int], ...]") -> int:
+    """helper.BuildBrokenLinearFunction: piecewise-linear interpolation
+    over (utilization, score) points with Go integer arithmetic; clamps
+    to the first/last point outside the shape's utilization range."""
+    for i, (u, s) in enumerate(shape):
+        if p <= u:
+            if i == 0:
+                return s
+            u0, s0 = shape[i - 1]
+            return s0 + go_div((s - s0) * (p - u0), u - u0)
+    return shape[-1][1]
+
 
 def pod_non_zero_request(pod: Obj) -> dict[str, int]:
     """cpu/memory request with per-container non-zero defaults (used by the
@@ -90,6 +118,20 @@ class NodeResourcesFit:
             {"name": MEMORY, "weight": 1},
         ]
         self.score_resources = [(r["name"], int(r.get("weight") or 1)) for r in resources]
+        # RequestedToCapacityRatio shape: (utilization, score*10) points,
+        # utilization ascending (upstream scales config scores 0..10 up to
+        # the 0..100 node-score range at build time).  The default ramp is
+        # the canonical bin-packing shape (score rises with utilization).
+        shape = (strategy.get("requestedToCapacityRatio") or {}).get("shape") or [
+            {"utilization": 0, "score": 0},
+            {"utilization": 100, "score": MAX_CUSTOM_PRIORITY_SCORE},
+        ]
+        self.rtcr_shape = tuple(
+            sorted(
+                (int(pt.get("utilization") or 0), int(pt.get("score") or 0) * (MAX_NODE_SCORE // MAX_CUSTOM_PRIORITY_SCORE))
+                for pt in shape
+            )
+        )
 
     # -- PreFilter: compute the effective request once per pod
     def pre_filter(self, state: CycleState, pod: Obj):
@@ -132,6 +174,12 @@ class NodeResourcesFit:
         return node_score // weight_sum, None
 
     def _score_one(self, requested: int, alloc: int) -> int:
+        if self.strategy_type == "RequestedToCapacityRatio":
+            # upstream resourceScoringFunction: over-capacity (or zero
+            # capacity) evaluates the shape at maxUtilization, NOT 0
+            if alloc == 0 or requested > alloc:
+                return broken_linear(100, self.rtcr_shape)
+            return broken_linear(requested * 100 // alloc, self.rtcr_shape)
         if alloc == 0:
             return 0
         if self.strategy_type == "MostAllocated":

@@ -1,0 +1,24 @@
+"""Vectorized preemption engine: upstream DefaultPreemption's victim
+search as one vmapped XLA dispatch over U unschedulable pods × N
+candidate nodes (the per-pod PostFilter loop was the last sequential
+island on the batch path — scheduler/service.py's old
+"finish a preemption-heavy round sequentially" cliff).
+
+Modules:
+
+- ``encode``: host-side encoding of the victim-search problem (per-node
+  MoreImportantPod-ordered victim slots, PDB match matrix, GCD-scaled
+  resource columns);
+- ``kernel``: the jitted search — greedy reprieve scan per (pod, node)
+  under vmap×vmap, PDB-violation classification by budget rank;
+- ``engine``: the round context (``prepare_round``/``decide``) plus the
+  supportability gates that keep the batched search byte-identical to
+  the sequential oracle (plugins/intree/queue_bind.DefaultPreemption).
+"""
+
+from kube_scheduler_simulator_tpu.preemption.engine import (  # noqa: F401
+    Decision,
+    PreemptionRound,
+    nomination_gate,
+    prepare_round,
+)

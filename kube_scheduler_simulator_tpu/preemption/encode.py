@@ -1,0 +1,174 @@
+"""Host-side encoding for the batched DefaultPreemption victim search.
+
+The sequential oracle (plugins/intree/queue_bind.DefaultPreemption)
+walks ``ni.pods`` per candidate node per unschedulable pod; this module
+lifts the same data into per-node victim SLOT tables the kernel can scan:
+
+- slots are ALL pods on the node with priority strictly below the
+  round's highest pending priority, stably sorted by MoreImportantPod
+  (priority desc, start time asc) — exactly ``sorted(lower, key=...)``
+  in the oracle, because a stable sort of a superset restricted to any
+  priority threshold equals the stable sort of the subset;
+- resource columns are the union of the fit-checked resources any
+  pending pod requests, GCD-scaled per column so the device floats stay
+  exact (the same trick ops/encode.py uses for the batch kernel);
+- PDB matching (namespace + label selector vs victim labels) becomes a
+  [N, V, PDB] bool matrix against the per-PDB ``disruptionsAllowed``
+  budget, reusing the matcher under ``utils/pdb.py``'s rule.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import numpy as np
+
+from kube_scheduler_simulator_tpu.models.podresources import (
+    is_fit_resource,
+    pod_resource_request,
+)
+from kube_scheduler_simulator_tpu.plugins.intree.queue_bind import (
+    DefaultPreemption,
+    pod_priority,
+)
+from kube_scheduler_simulator_tpu.utils.labels import match_label_selector
+
+Obj = dict[str, Any]
+
+# MoreImportantPod's timestamp rule comes FROM the oracle — one source of
+# truth, so the kernel's victim ordering can never drift from it
+_start_time = DefaultPreemption._start_time
+
+
+def fit_resource_axis(pods: list[Obj]) -> list[str]:
+    """The union of fit-checked resources any of ``pods`` requests with a
+    nonzero want — the only columns the Fit filter (and therefore the
+    victim search) ever compares."""
+    res: set[str] = set()
+    for p in pods:
+        for r, v in pod_resource_request(p).items():
+            if v > 0 and is_fit_resource(r):
+                res.add(r)
+    return sorted(res)
+
+
+def _req_vec(pod: Obj, res_idx: dict[str, int]) -> np.ndarray:
+    v = np.zeros(len(res_idx), dtype=np.int64)
+    for r, val in pod_resource_request(pod).items():
+        j = res_idx.get(r)
+        if j is not None:
+            v[j] = val
+    return v
+
+
+class PreemptionProblem:
+    """Encoded victim-search state for one batch kernel run."""
+
+    __slots__ = (
+        "node_names", "resource_names", "alloc", "base_req", "base_cnt",
+        "max_pods", "vreq", "vprio", "vstart", "vvalid", "vmatch",
+        "allowed", "victim_pods", "res_idx", "V", "PDB",
+    )
+
+    def __init__(self, node_names, resource_names):
+        self.node_names = node_names
+        self.resource_names = resource_names
+
+
+def encode_preemption(
+    node_infos: list[Any],
+    resource_names: list[str],
+    pdbs: list[Obj],
+    nominated: "list[tuple[Obj, str]] | None" = None,
+    max_pending_priority: int = 0,
+) -> PreemptionProblem:
+    """Build the per-node victim tables from the round snapshot's
+    NodeInfos (which already account this round's earlier commits the
+    service assumed — scheduler/service.py keeps them in step).
+
+    ``nominated``: unbound (pod, node) nominations every victim search
+    must respect as non-evictable usage (the oracle adds them to the
+    scratch NodeInfo via ``run_filter_plugins_silently(snapshot=...)``;
+    the caller's gate guarantees every nominee outranks every pending
+    pod, so they are unconditionally accounted).
+    """
+    N = len(node_infos)
+    R = len(resource_names)
+    res_idx = {r: j for j, r in enumerate(resource_names)}
+    pr = PreemptionProblem([ni.name for ni in node_infos], resource_names)
+    pr.res_idx = res_idx
+    pr.alloc = np.zeros((N, R), dtype=np.int64)
+    pr.base_req = np.zeros((N, R), dtype=np.int64)
+    pr.base_cnt = np.zeros(N, dtype=np.int64)
+    pr.max_pods = np.zeros(N, dtype=np.int64)
+
+    # victims: pods below the round's top pending priority, stably in
+    # MoreImportantPod order — slot order IS the oracle's scan order
+    victim_pods: list[list[Obj]] = []
+    for j, ni in enumerate(node_infos):
+        for r, v in ni.allocatable.items():
+            if r in res_idx:
+                pr.alloc[j, res_idx[r]] = v
+        for r, v in ni.requested.items():
+            if r in res_idx:
+                pr.base_req[j, res_idx[r]] = v
+        pr.base_cnt[j] = len(ni.pods)
+        pr.max_pods[j] = ni.allowed_pod_number()
+        lows = [p for p in ni.pods if pod_priority(p) < max_pending_priority]
+        lows.sort(key=lambda p: (-pod_priority(p), _start_time(p)))
+        victim_pods.append(lows)
+    for npod, nn in nominated or []:
+        try:
+            j = pr.node_names.index(nn)
+        except ValueError:
+            continue
+        pr.base_cnt[j] += 1
+        pr.base_req[j] += _req_vec(npod, res_idx)
+
+    V = max((len(v) for v in victim_pods), default=0)
+    pr.V = V
+    pr.victim_pods = victim_pods
+    pr.vreq = np.zeros((N, V, R), dtype=np.int64)
+    pr.vprio = np.zeros((N, V), dtype=np.int64)
+    pr.vvalid = np.zeros((N, V), dtype=bool)
+    # start-time RANK (global order over all slots): pickOneNodeForPreemption
+    # compares start-time STRINGS; equal strings must stay equal as ranks
+    starts = sorted({_start_time(p) for lows in victim_pods for p in lows})
+    start_rank = {s: k for k, s in enumerate(starts)}
+    pr.vstart = np.zeros((N, V), dtype=np.int64)
+    for j, lows in enumerate(victim_pods):
+        for s, p in enumerate(lows):
+            pr.vreq[j, s] = _req_vec(p, res_idx)
+            pr.vprio[j, s] = pod_priority(p)
+            pr.vstart[j, s] = start_rank[_start_time(p)]
+            pr.vvalid[j, s] = True
+
+    PDB = len(pdbs)
+    pr.PDB = PDB
+    pr.vmatch = np.zeros((N, V, PDB), dtype=bool)
+    pr.allowed = np.zeros(PDB, dtype=np.int64)
+    for k, pdb in enumerate(pdbs):
+        pr.allowed[k] = int(((pdb.get("status") or {}).get("disruptionsAllowed")) or 0)
+        pdb_ns = pdb["metadata"].get("namespace") or "default"
+        sel = (pdb.get("spec") or {}).get("selector")
+        for j, lows in enumerate(victim_pods):
+            for s, p in enumerate(lows):
+                if (p["metadata"].get("namespace") or "default") != pdb_ns:
+                    continue
+                if match_label_selector(sel, p["metadata"].get("labels") or {}):
+                    pr.vmatch[j, s, k] = True
+    return pr
+
+
+def gcd_scale_columns(columns: "list[np.ndarray]") -> None:
+    """Divide every array in ``columns`` by their joint GCD in place (the
+    ops/encode.py trick that keeps float32 device math exact; the greedy
+    reprieve scan is pure compares and sums, hence scale-invariant)."""
+    g = 0
+    for arr in columns:
+        if arr.size:
+            g = math.gcd(g, int(np.gcd.reduce(np.abs(arr.reshape(-1)), initial=0)))
+    g = g or 1
+    for arr in columns:
+        arr //= g

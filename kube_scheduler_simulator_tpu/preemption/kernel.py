@@ -1,0 +1,188 @@
+"""The batched victim-search kernel: DefaultPreemption's
+selectVictimsOnNode as one jitted vmap(U) × vmap(N) computation with a
+``lax.fori_loop`` greedy reprieve scan over the V victim slots.
+
+Per (pod u, node n), mirroring the oracle exactly
+(plugins/intree/queue_bind.DefaultPreemption._select_victims_on_node):
+
+1. ``lower``   — slots with priority strictly below u's;
+2. remove ALL of them, require u to fit (resource compares over the
+   columns u actually requests, plus the "Too many pods" count);
+3. classify each lower pod as PDB-violating by consuming the shared
+   per-PDB budget in slot (MoreImportantPod) order;
+4. greedy reprieve: violating group first, then non-violating, each in
+   slot order — re-add a pod iff u still fits afterwards; the pods that
+   stay out are the victims.
+
+Candidate ranking (pickOneNodeForPreemption's lexicographic criteria)
+runs on the host from the returned masks — priority sums need exact
+int64 the device dtype can't guarantee off-x64, and the [U, N] stat
+reduction is trivial numpy work.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@functools.lru_cache(maxsize=64)
+def build_preempt_fn(U: int, N: int, V: int, R: int, PDB: int, S: int):
+    """Compile the victim search for static dims: U pods × N nodes × V
+    victim slots × R resource columns × PDB budgets × S same-window
+    prefix commits (successes earlier in queue order whose usage pod u's
+    dry run must already see)."""
+
+    def per_node(alloc_n, usage_n, cnt_n, maxp_n, vreq_n, vprio_n, vvalid_n, vmatch_n,
+                 ucand_un, allowed, ureq_u, uprio_u):
+        lower = vvalid_n & (vprio_n < uprio_u)
+        n_lower = jnp.sum(lower.astype(alloc_n.dtype))
+        freed = jnp.sum(jnp.where(lower[:, None], vreq_n, 0.0), axis=0)
+        free0 = alloc_n - (usage_n - freed)
+        # want==0 columns are skipped by the oracle's Fit loop
+        fits0 = jnp.all((ureq_u <= free0) | (ureq_u <= 0))
+        fits0 = fits0 & (cnt_n - n_lower + 1.0 <= maxp_n)
+        cand0 = ucand_un & fits0 & (n_lower >= 1)
+
+        if PDB:
+            # budget rank in slot order over ALL lower pods: the s-th
+            # matching lower pod violates once the running count exceeds
+            # disruptionsAllowed (utils/pdb.violates_pdb's decrement)
+            m = vmatch_n & lower[:, None]
+            cum = jnp.cumsum(m.astype(jnp.int32), axis=0)
+            viol = jnp.any(vmatch_n & (cum > allowed[None, :]), axis=1) & lower
+        else:
+            viol = jnp.zeros(V, dtype=bool)
+
+        # reprieve order: violating first, each group in slot order —
+        # unique integer keys make the argsort order-deterministic
+        key = jnp.where(viol, 0, V) + jnp.arange(V, dtype=jnp.int32)
+        order = jnp.argsort(key)
+        vreq_ord = jnp.take(vreq_n, order, axis=0)
+        lower_ord = jnp.take(lower, order)
+
+        def body(t, carry):
+            readd, readd_cnt, victims_ord = carry
+            active = lax.dynamic_index_in_dim(lower_ord, t, keepdims=False)
+            row = lax.dynamic_index_in_dim(vreq_ord, t, keepdims=False)
+            new = readd + row
+            ok = jnp.all((ureq_u <= free0 - new) | (ureq_u <= 0)) & (
+                cnt_n - n_lower + readd_cnt + 2.0 <= maxp_n
+            )
+            rep = active & ok
+            readd = jnp.where(rep, new, readd)
+            readd_cnt = readd_cnt + jnp.where(rep, 1.0, 0.0)
+            victims_ord = lax.dynamic_update_index_in_dim(
+                victims_ord, active & ~ok, t, axis=0
+            )
+            return (readd, readd_cnt, victims_ord)
+
+        _readd, _cnt, victims_ord = lax.fori_loop(
+            0,
+            V,
+            body,
+            (
+                jnp.zeros((R,), dtype=alloc_n.dtype),
+                jnp.zeros((), dtype=alloc_n.dtype),
+                jnp.zeros((V,), dtype=bool),
+            ),
+        )
+        victims = jnp.zeros((V,), dtype=bool).at[order].set(victims_ord)
+        cand = cand0 & jnp.any(victims)
+        return cand, victims & cand, viol
+
+    per_nodes = jax.vmap(
+        per_node,
+        in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, None, None, None),
+    )
+
+    def per_pod(ucand_u, ureq_u, uprio_u, smask_u, alloc, base_req, base_cnt,
+                max_pods, vreq, vprio, vvalid, vmatch, allowed, sreq, snode):
+        if S:
+            extra_req = jnp.zeros((N, R), dtype=alloc.dtype).at[snode].add(
+                sreq * smask_u[:, None]
+            )
+            extra_cnt = jnp.zeros((N,), dtype=alloc.dtype).at[snode].add(
+                smask_u.astype(alloc.dtype)
+            )
+            usage = base_req + extra_req
+            cnt = base_cnt + extra_cnt
+        else:
+            usage = base_req
+            cnt = base_cnt
+        return per_nodes(
+            alloc, usage, cnt, max_pods, vreq, vprio, vvalid, vmatch,
+            ucand_u, allowed, ureq_u, uprio_u,
+        )
+
+    per_pods = jax.vmap(
+        per_pod,
+        in_axes=(0, 0, 0, 0) + (None,) * 11,
+    )
+
+    def fn(ucand, ureq, uprio, smask, alloc, base_req, base_cnt, max_pods,
+           vreq, vprio, vvalid, vmatch, allowed, sreq, snode):
+        cand, victims, viol = per_pods(
+            ucand, ureq, uprio, smask, alloc, base_req, base_cnt, max_pods,
+            vreq, vprio, vvalid, vmatch, allowed, sreq, snode,
+        )
+        return {"cand": cand, "victims": victims, "viol": viol}
+
+    return jax.jit(fn)
+
+
+def _f(x: np.ndarray) -> np.ndarray:
+    dt = np.float64 if jax.config.jax_enable_x64 else np.float32
+    return np.asarray(x, dtype=dt)
+
+
+def run_search(pr, ucand, ureq, uprio, smask, sreq, snode):
+    """Dispatch the search: pads U/V/S to buckets (the jit cache sees
+    O(log) shapes as rounds churn) and returns numpy masks trimmed back
+    to the true dims.  ``pr`` is the encoded PreemptionProblem (columns
+    already GCD-scaled by the engine)."""
+    from kube_scheduler_simulator_tpu.ops.encode import _bucket
+
+    U_true, N = ucand.shape
+    V_true, R, PDB = pr.V, len(pr.resource_names), pr.PDB
+    S_true = len(snode)
+    U = max(_bucket(U_true), 1)
+    V = max(_bucket(V_true), 1)
+    S = _bucket(S_true)
+
+    def pad(a, dim, size):
+        if a.shape[dim] == size:
+            return a
+        w = [(0, 0)] * a.ndim
+        w[dim] = (0, size - a.shape[dim])
+        return np.pad(a, w)
+
+    ucand_p = pad(np.asarray(ucand, dtype=bool), 0, U)
+    ureq_p = _f(pad(np.asarray(ureq), 0, U))
+    uprio_p = pad(np.asarray(uprio, dtype=np.int64), 0, U)
+    smask_p = pad(pad(np.asarray(smask, dtype=bool).reshape(U_true, S_true), 1, S), 0, U) if S else np.zeros((U, 0), dtype=bool)
+    sreq_p = _f(pad(np.asarray(sreq).reshape(S_true, R), 0, S)) if S else np.zeros((0, R))
+    snode_p = pad(np.asarray(snode, dtype=np.int32), 0, S) if S else np.zeros((0,), dtype=np.int32)
+
+    vreq_p = _f(pad(pr.vreq, 1, V))
+    vprio_p = pad(pr.vprio, 1, V)
+    vvalid_p = pad(pr.vvalid, 1, V)
+    vmatch_p = pad(pr.vmatch, 1, V)
+
+    fn = build_preempt_fn(U, N, V, R, PDB, S)
+    out = fn(
+        ucand_p, ureq_p, uprio_p, smask_p,
+        _f(pr.alloc), _f(pr.base_req), _f(pr.base_cnt), _f(pr.max_pods),
+        vreq_p, vprio_p, vvalid_p, vmatch_p,
+        np.asarray(pr.allowed, dtype=np.int32),
+        sreq_p, snode_p,
+    )
+    return {
+        "cand": np.asarray(out["cand"])[:U_true],
+        "victims": np.asarray(out["victims"])[:U_true, :, :V_true],
+        "viol": np.asarray(out["viol"])[:U_true, :, :V_true],
+    }

@@ -15,8 +15,10 @@ VolumeZone, VolumeRestrictions, EBS/GCE/AzureDisk limits, CSI
 NodeVolumeLimits (PVC/PV/StorageClass/CSINode lookups resolved at encode
 time).  ``supported()`` reports whether a workload/profile combination is
 fully covered; callers fall back to the sequential oracle
-(scheduler/framework_runner.py) otherwise.  Preemption (PostFilter) stays
-host-side and is not run by the batch pass.
+(scheduler/framework_runner.py) otherwise.  Preemption (PostFilter) for
+kernel-failed pods runs as its own vmapped victim-search dispatch
+(preemption/ — docs/preemption.md); only out-of-envelope pods take the
+sequential DefaultPreemption cycle.
 """
 
 from __future__ import annotations
@@ -844,6 +846,23 @@ class BatchResult:
         tr = self._tr()
         return {int(n) for n in tr["sids"][i] if n >= 0}
 
+    def fit_failed_ids(self, i: int) -> "np.ndarray":
+        """Visited node ids whose FIRST filter failure was NodeResourcesFit
+        — under the preemption engine's workload gates these are exactly
+        the non-UnschedulableAndUnresolvable nodes of the diagnosis, i.e.
+        DefaultPreemption's candidate set (preemption/engine.py)."""
+        tr = self._tr()
+        fp = tr["fail_plug"]
+        if fp is None or "NodeResourcesFit" not in self._engine.cfg.filters:
+            return np.empty(0, dtype=np.int64)
+        k = self._engine.cfg.filters.index("NodeResourcesFit")
+        ids = self._visited_ids(i)
+        cand = np.asarray(ids[fp[i][: len(ids)] == k], dtype=np.int64)
+        narrowed = self._prefilter_node_set(i)
+        if narrowed is not None and cand.size:
+            cand = cand[np.isin(cand, np.fromiter(narrowed, dtype=np.int64))]
+        return cand
+
     def _prefilter_node_set(self, i: int) -> "set[int] | None":
         """Node indices surviving PreFilter narrowing (NodeAffinity
         matchFields pinning restricts which nodes the cycle visits)."""
@@ -879,6 +898,7 @@ class BatchEngine:
         scores: "list[tuple[str, int]] | None" = None,
         fit_strategy: str = "LeastAllocated",
         fit_resources: "tuple | None" = None,
+        fit_shape: "tuple | None" = None,
         hard_pod_affinity_weight: int = 1,
         added_affinity: "Obj | None" = None,
         percentage_of_nodes_to_score: int = 100,
@@ -923,6 +943,7 @@ class BatchEngine:
             scores=tuple((s, w) for s, w in self.scores),
             fit_strategy=fit_strategy,
             fit_resources=tuple(fit_resources) if fit_resources else ((0, 1), (1, 1)),
+            fit_shape=tuple(fit_shape) if fit_shape else (),
             trace=trace,
             tie_break=tie_break,
             seed=seed,
@@ -959,6 +980,7 @@ class BatchEngine:
         ]
         fit_strategy = "LeastAllocated"
         fit_resources = None
+        fit_shape = None
         hard_w = 1
         added = None
         unsupported = None
@@ -973,7 +995,10 @@ class BatchEngine:
                 else:
                     unsupported = f"NodeResourcesFit scoringStrategy over {[r for r, _ in res]}"
                 if fit_strategy == "RequestedToCapacityRatio":
-                    unsupported = "NodeResourcesFit RequestedToCapacityRatio strategy"
+                    # piecewise-linear kernel over the same utilization
+                    # ratio (ops/batch._broken_linear); the shape is
+                    # static config, part of the compiled BatchConfig
+                    fit_shape = tuple(getattr(o, "rtcr_shape", ()) or ())
             elif o.name == "NodeResourcesBalancedAllocation":
                 res = getattr(o, "resources", ["cpu", "memory"])
                 if sorted(res) != ["cpu", "memory"]:
@@ -1005,6 +1030,7 @@ class BatchEngine:
             scores=scores,
             fit_strategy=fit_strategy,
             fit_resources=fit_resources,
+            fit_shape=fit_shape,
             hard_pod_affinity_weight=hard_w,
             added_affinity=added,
             percentage_of_nodes_to_score=framework.percentage_of_nodes_to_score,
@@ -1145,6 +1171,7 @@ class BatchEngine:
         base_counter: int = 0,
         start_index: int = 0,
         volumes: "dict[str, list[Obj]] | None" = None,
+        nominated: "list[tuple[Obj, str]] | None" = None,
     ) -> BatchResult:
         """One batch scheduling pass over ``pending`` (already in queue
         order).  Returns per-pod selections plus (trace mode) everything
@@ -1157,8 +1184,8 @@ class BatchEngine:
             import jax
 
             with jax.profiler.trace(self.profile_dir):
-                return self._schedule(nodes, all_pods, pending, namespaces, base_counter, start_index, volumes)
-        return self._schedule(nodes, all_pods, pending, namespaces, base_counter, start_index, volumes)
+                return self._schedule(nodes, all_pods, pending, namespaces, base_counter, start_index, volumes, nominated)
+        return self._schedule(nodes, all_pods, pending, namespaces, base_counter, start_index, volumes, nominated)
 
     def _prep(
         self,
@@ -1169,6 +1196,7 @@ class BatchEngine:
         base_counter: int,
         start_index: int,
         volumes: "dict[str, list[Obj]] | None",
+        nominated: "list[tuple[Obj, str]] | None" = None,
     ) -> dict:
         """Encode + pad + lower + place a round's problem; shared by the
         one-dispatch path (``_schedule``) and the pipelined windowed path
@@ -1186,6 +1214,7 @@ class BatchEngine:
             hard_pod_affinity_weight=self.hard_pod_affinity_weight,
             added_affinity=self.added_affinity,
             volumes=volumes if volumes is not None else self._volumes(),
+            nominated=nominated,
         )
         # mesh sharding needs the node axis divisible by the mesh's "nodes"
         # axis — pad it even with bucketing off
@@ -1316,9 +1345,10 @@ class BatchEngine:
         base_counter: int = 0,
         start_index: int = 0,
         volumes: "dict[str, list[Obj]] | None" = None,
+        nominated: "list[tuple[Obj, str]] | None" = None,
     ) -> BatchResult:
         return self._finish_prepped(
-            self._prep(nodes, all_pods, pending, namespaces, base_counter, start_index, volumes)
+            self._prep(nodes, all_pods, pending, namespaces, base_counter, start_index, volumes, nominated)
         )
 
     def schedule_waves(
@@ -1330,6 +1360,7 @@ class BatchEngine:
         base_counter: int = 0,
         start_index: int = 0,
         volumes: "dict[str, list[Obj]] | None" = None,
+        nominated: "list[tuple[Obj, str]] | None" = None,
         wave_pods: int = 512,
     ):
         """Pipelined round: yields (BatchResult, offset, count) per pod
@@ -1345,7 +1376,7 @@ class BatchEngine:
         consuming on a mid-round restart (abandoned windows' device work
         is simply discarded, as a full-scan restart would discard it)."""
         assert self.trace and self.mesh is None, "pipelined rounds are single-device trace rounds"
-        ctx = self._prep(nodes, all_pods, pending, namespaces, base_counter, start_index, volumes)
+        ctx = self._prep(nodes, all_pods, pending, namespaces, base_counter, start_index, volumes, nominated)
         pr, dims, cfg, ws0 = ctx["pr"], ctx["dims"], ctx["cfg"], ctx["ws0"]
         P = dims["P"]
         pend_n = len(pending)

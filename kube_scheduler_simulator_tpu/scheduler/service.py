@@ -165,6 +165,17 @@ class SchedulerService:
             "commit_waves": 0,
             "last_wave_commit_s": 0.0,
             "last_wave_pods": 0,
+            # vectorized preemption engine (preemption/): PostFilter work
+            # handled as batched victim-search dispatches instead of
+            # per-pod sequential cycles.  preempt_fallbacks counts the
+            # pods/rounds that still took the sequential DefaultPreemption
+            # path, by reason — zero on a fully-batched round.
+            "preempt_attempts": 0,
+            "preempt_nominations": 0,
+            "preempt_victims": 0,
+            "preempt_dispatches": 0,
+            "preempt_kernel_s": 0.0,
+            "preempt_fallbacks": {},
         }
         # guards batch_fallbacks against the metrics scrape thread
         self._stats_lock = threading.Lock()
@@ -673,6 +684,26 @@ class SchedulerService:
             self._count_fallback("below batch_min_work")
             return None
 
+        # Pending nominations (store-wide, not just this round's pods):
+        # a nominee IN the round must not account its own reservation —
+        # only the sequential cycle models that; a nominee OUTSIDE it
+        # (parked in backoff) is modeled as filter-only usage on its node
+        # when the gate holds (ops/encode.py ``nominated=``), else the
+        # round is sequential — the old code batched such rounds while
+        # silently ignoring the reservation.
+        from kube_scheduler_simulator_tpu.preemption import nomination_gate
+
+        noms = self._pending_nominations()
+        if noms:
+            pending_keys = {_pod_key(p) for p in pending_all}
+            if any(_pod_key(p) in pending_keys for p, _nn in noms):
+                self._count_fallback("nominated pods present (preemption in flight)")
+                return None
+            reason = nomination_gate(noms, pending_all)
+            if reason is not None:
+                self._count_fallback(f"nominations not batchable: {reason}")
+                return None
+
         # maximal same-profile runs, preserving queue order
         segments: list[tuple[Framework, list[Obj]]] = []
         for pod in pending_all:
@@ -710,7 +741,7 @@ class SchedulerService:
                     results[_pod_key(pod)] = self.schedule_one(pod, snapshot)
                 self.stats["commit_s"] += time.perf_counter() - tc
             else:
-                self._run_segment_batch(fw, eng, pending, nodes, volumes, results)
+                self._run_segment_batch(fw, eng, pending, nodes, volumes, results, noms)
                 any_batched = True
                 self._sync_rotation(fw)
         if any_batched:
@@ -726,6 +757,7 @@ class SchedulerService:
         nodes: list[Obj],
         volumes: "dict[str, list[Obj]]",
         results: dict,
+        nominated: "list[tuple[Obj, str]] | None" = None,
     ) -> None:
         seq_failures = bool(fw.plugins["post_filter"]) and self.use_batch != "force"
         point_names = {
@@ -734,6 +766,12 @@ class SchedulerService:
         }
         i = 0  # index of the tail's first pod within `pending`
         restarts = 0
+        # ROUND-START nominations only (already gated by the caller):
+        # the sequential oracle's Snapshot freezes its nominated map at
+        # round build, so nominations made MID-round by this round's own
+        # preemptions are invisible to later pods until the next round —
+        # the restart kernel runs must model exactly the same set.
+        noms = list(nominated or [])
         while i < len(pending):
             tail = pending[i:]
             args = (
@@ -746,6 +784,7 @@ class SchedulerService:
                 base_counter=fw.sched_counter,
                 start_index=fw.next_start_node_index,
                 volumes=volumes,
+                nominated=noms or None,
             )
             if self._pipeline_on() and self.mesh is None and len(tail) > self.commit_wave:
                 # pipelined round: window k+1's device execution overlaps
@@ -758,16 +797,32 @@ class SchedulerService:
                 windows = iter([(result, 0, len(tail))])
             snapshot = None
             restart_at = None
+            # batched-PostFilter context, built lazily at the run's first
+            # kernel failure (its victim tables read the snapshot AT BUILD
+            # TIME, so earlier windows' commits are already accounted)
+            pholder: "dict | None" = None
+            if seq_failures:
+                pholder = {
+                    "build": lambda: self._prepare_preemption(
+                        fw, eng, snapshot, nodes, tail, noms
+                    )
+                }
             for result, off, cnt in windows:
                 if snapshot is None:
                     # after the round's encode captured the cluster state
                     snapshot = self.build_snapshot()
+                    self._prune_mid_round_nominations(snapshot, noms)
                 restart_at = self._replay_window(
-                    result, i, off, cnt, snapshot, point_names, fw, seq_failures, results
+                    result, i, off, cnt, snapshot, point_names, fw, seq_failures, results, pholder
                 )
                 if restart_at is not None:
                     break  # abandon the remaining windows (state changed)
                 fw.next_start_node_index = result.final_start
+            pctx = (pholder or {}).get("ctx")
+            if pctx is not None:
+                with self._stats_lock:
+                    self.stats["preempt_dispatches"] += pctx.dispatches
+                    self.stats["preempt_kernel_s"] += pctx.kernel_s
             if restart_at is None:
                 break
             i = restart_at
@@ -775,9 +830,14 @@ class SchedulerService:
             if i >= len(pending):
                 break
             self.stats["batch_restarts"] += 1
-            if restarts >= self.batch_max_restarts:
-                # Preemption-heavy round: finish it sequentially (exact).
+            if pctx is None and restarts >= self.batch_max_restarts:
+                # Preemption-heavy round whose PostFilter work runs on the
+                # SEQUENTIAL path (the batched engine declined the round):
+                # finish it sequentially (exact).  With the batched engine
+                # active the loop is bounded by the queue itself — every
+                # restart strictly advances ``i``.
                 snapshot = self.build_snapshot()
+                self._prune_mid_round_nominations(snapshot, noms)
                 for pod in pending[i:]:
                     results[_pod_key(pod)] = self.schedule_one(pod, snapshot)
                 break
@@ -813,16 +873,31 @@ class SchedulerService:
         fw: Framework,
         seq_failures: bool,
         results: dict,
+        pholder: "dict | None" = None,
     ) -> "int | None":
         """Replay one kernel window's decisions in queue order.
         Successful pods accumulate into bulk-commit waves
-        (``_commit_batch_wave``); kernel failures run per pod (the exact
-        sequential cycle when the profile owns preemption).  Returns the
-        absolute pending-index to restart the kernel from after a
-        successful preemption, else None."""
+        (``_commit_batch_wave``); kernel failures commit from the trace
+        with their PostFilter resolved by the batched victim search
+        (preemption/), or run the exact sequential cycle when the round
+        or pod is outside the engine's envelope.  Returns the absolute
+        pending-index to restart the kernel from after a successful
+        preemption, else None."""
         window = result.pending
         sample_start = result.out["sample_start"]
         wave_js: list[int] = []
+        decisions: dict = {}
+        if (
+            seq_failures
+            and pholder is not None
+            and any(int(result.selected[j]) < 0 for j in range(cnt))
+        ):
+            # ONE vmapped victim-search dispatch covers every kernel
+            # failure of this window (context built at first use)
+            if "ctx" not in pholder:
+                pholder["ctx"] = pholder["build"]()
+            if pholder["ctx"] is not None:
+                decisions = pholder["ctx"].decide(result, off, cnt)
 
         def flush_wave() -> None:
             if not wave_js:
@@ -854,9 +929,28 @@ class SchedulerService:
                 fw.sched_counter += 1
                 self.stats["batch_pods"] += 1
             else:
+                dec = decisions.get(j)
+                if dec is not None and not isinstance(dec, str):
+                    # batched PostFilter: the failure trace commits from
+                    # the kernel result and the preemption decision (the
+                    # victim-search wave) applies inside the commit
+                    flush_wave()
+                    tc = time.perf_counter()
+                    res = self._commit_batch_pod(
+                        result, j, pod, snapshot, point_names, fw, preempt=dec
+                    )
+                    self.stats["commit_s"] += time.perf_counter() - tc
+                    fw.sched_counter += 1
+                    self.stats["batch_pods"] += 1
+                    results[key] = res
+                    if res.nominated_node:
+                        return base_i + off + j + 1
+                    continue
                 # Exact sequential cycle for this pod: same snapshot
                 # state (earlier commits assumed), same attempt counter
                 # and rotation start as the all-sequential round.
+                if isinstance(dec, str):
+                    self._count_preempt_fallback(dec)
                 flush_wave()
                 fw.next_start_node_index = int(sample_start[j])
                 tc = time.perf_counter()
@@ -866,12 +960,112 @@ class SchedulerService:
                 if res.nominated_node:
                     return base_i + off + j + 1
         flush_wave()
+        pctx = (pholder or {}).get("ctx")
+        if pctx is not None:
+            # later windows' dry runs must see this window's commits
+            for j in range(cnt):
+                if int(result.selected[j]) >= 0:
+                    pctx.note_success(off + j, int(result.selected[j]))
         return None
 
     def _count_fallback(self, reason: str) -> None:
         with self._stats_lock:
             fb = self.stats["batch_fallbacks"]
             fb[reason] = fb.get(reason, 0) + 1
+
+    def _count_preempt_fallback(self, reason: str) -> None:
+        with self._stats_lock:
+            fb = self.stats["preempt_fallbacks"]
+            fb[reason] = fb.get(reason, 0) + 1
+
+    def _prune_mid_round_nominations(
+        self, snapshot: "Snapshot", round_noms: "list[tuple[Obj, str]]"
+    ) -> None:
+        """Restrict a (re)built snapshot's nominated map to the ROUND-START
+        nominations: the sequential oracle builds ONE Snapshot per round,
+        so nominations made mid-round by this round's own preemptions are
+        invisible to later pods until the next round — a restart's fresh
+        snapshot must not leak them into the exact sequential fallbacks."""
+        keep = {
+            (p["metadata"].get("namespace", "default"), p["metadata"]["name"])
+            for p, _nn in round_noms
+        }
+        pruned: dict[str, list[Obj]] = {}
+        for nn, lst in snapshot.nominated.items():
+            kept = [
+                q
+                for q in lst
+                if (q["metadata"].get("namespace", "default"), q["metadata"]["name"]) in keep
+            ]
+            if kept:
+                pruned[nn] = kept
+        snapshot.nominated = pruned
+
+    def _pending_nominations(self) -> "list[tuple[Obj, str]]":
+        """Unbound pods carrying a preemption nomination, store-wide (the
+        queue may be holding them in backoff while their reservation must
+        still shape every other pod's filter runs)."""
+        from kube_scheduler_simulator_tpu.models.snapshot import has_pending_nomination
+
+        return [
+            (p, p["status"]["nominatedNodeName"])
+            for p in self.cluster_store.list("pods", copy_objects=False)
+            if has_pending_nomination(p)
+        ]
+
+    def _prepare_preemption(
+        self,
+        fw: Framework,
+        eng: Any,
+        snapshot: "Snapshot",
+        nodes: list[Obj],
+        tail: list[Obj],
+        noms: "list[tuple[Obj, str]]",
+    ) -> Any:
+        """Build the batched victim-search context for one kernel run, or
+        None (with a counted reason) — the round then keeps the exact
+        sequential PostFilter path."""
+        from kube_scheduler_simulator_tpu.preemption import prepare_round
+
+        if self._all_waiting_keys():
+            self._count_preempt_fallback("waiting pods parked at Permit")
+            return None
+        pctx, reason = prepare_round(
+            fw, eng, snapshot, self.cluster_store, nodes, tail, nominated=noms or None
+        )
+        if pctx is None and reason:
+            self._count_preempt_fallback(reason)
+        return pctx
+
+    def _apply_preemption_victims(self, decision: Any, snapshot: "Snapshot | None") -> None:
+        """Evict one decision's victims through the bulk-commit machinery:
+        ONE lock acquisition, per-victim DELETED events in the oracle's
+        eviction order (each drives the queue's moveRequestCycle exactly
+        as a per-victim ``store.delete`` loop would), then the oracle's
+        snapshot mutation so later pods in the round see the freed
+        capacity."""
+        from kube_scheduler_simulator_tpu.state.store import BULK_DELETE
+
+        self.cluster_store.bulk_update(
+            "pods",
+            [
+                (
+                    v["metadata"]["name"],
+                    v["metadata"].get("namespace", "default"),
+                    lambda cur: BULK_DELETE,
+                )
+                for v in decision.victims
+            ],
+            allow_delete=True,
+        )
+        if snapshot is not None:
+            ni = snapshot.get(decision.node_name)
+            if ni is not None:
+                for v in decision.victims:
+                    ni.remove_pod(v)
+        with self._stats_lock:
+            self.stats["preempt_nominations"] += 1
+            self.stats["preempt_victims"] += len(decision.victims)
 
     def metrics(self) -> dict[str, Any]:
         """Observability snapshot for the metrics endpoint (the reference
@@ -881,6 +1075,7 @@ class SchedulerService:
         eng = self._batch_engine
         with self._stats_lock:
             fallbacks = dict(self.stats["batch_fallbacks"])
+            preempt_fallbacks = dict(self.stats["preempt_fallbacks"])
         last_t = dict(eng.last_timings) if eng else {}
         # the fraction of the last pipelined round's device time hidden
         # under host commits (0 for un-pipelined rounds) — the bench's
@@ -902,6 +1097,13 @@ class SchedulerService:
                 self.stats["last_wave_pods"] / last_wave_s if last_wave_s > 1e-9 else 0.0
             ),
             "overlap_efficiency": overlap,
+            # vectorized preemption engine (preemption/)
+            "preempt_attempts": self.stats["preempt_attempts"],
+            "preempt_nominations": self.stats["preempt_nominations"],
+            "preempt_victims": self.stats["preempt_victims"],
+            "preempt_dispatches": self.stats["preempt_dispatches"],
+            "preempt_kernel_s": self.stats["preempt_kernel_s"],
+            "preempt_fallbacks": preempt_fallbacks,
             **self.queue.stats(),
             "engine_rounds": eng.rounds if eng else 0,
             "engine_compiles": eng.compiles if eng else 0,
@@ -1004,6 +1206,7 @@ class SchedulerService:
         snapshot: "Snapshot | None" = None,
         point_names: "dict[str, list[str]] | None" = None,
         fw: "Framework | None" = None,
+        preempt: Any = None,
     ) -> ScheduleResult:
         """Write one pod's batch trace into the result store (the same
         categories the wrapped plugins record, models/wrapped.py) and bind
@@ -1077,9 +1280,25 @@ class SchedulerService:
         diagnosis = result.diagnosis(i)
         from kube_scheduler_simulator_tpu.models.framework import Status
 
+        nominated_node = None
+        if preempt is not None:
+            # batched PostFilter (preemption/): victims delete BEFORE the
+            # annotation lands — the oracle's post_filter evicts, then the
+            # wrapped recorder writes the nomination over the diagnosis
+            # node set (models/wrapped.py:105-122)
+            with self._stats_lock:
+                self.stats["preempt_attempts"] += 1
+            if preempt.node_name:
+                self._apply_preemption_victims(preempt, snapshot)
+                nominated_node = preempt.node_name
+            plug = fw.plugins["post_filter"][0].original.name
+            rs.add_post_filter_result(
+                ns, name, nominated_node or "", plug, sorted(diagnosis.keys())
+            )
         res = ScheduleResult(
             diagnosis=diagnosis,
             status=Status.unschedulable(f"0/{result.problem.N_true} nodes are available"),
+            nominated_node=nominated_node,
         )
         self._record_failure(pod, res, attempt_move_seq)
         self.reflector.flush_pod(self.cluster_store, pod)

@@ -70,6 +70,26 @@ def render_metrics(di: Any) -> str:
     counter("wave_commit_seconds", "Host commit wall of the last bulk-commit wave.", round(m["wave_commit_s"], 6), typ="gauge")
     counter("commit_pods_per_s", "Pods committed per host-commit second (last wave).", round(m["commit_pods_per_s"], 3), typ="gauge")
     counter("overlap_efficiency", "Fraction of the last pipelined round's device time hidden under host commits (0 when un-pipelined).", round(m["overlap_efficiency"], 4), typ="gauge")
+    # vectorized preemption engine (preemption/): batched PostFilter work
+    counter("preemption_attempts_total", "Kernel-failed pods whose PostFilter ran on the batched victim-search engine.", m["preempt_attempts"])
+    counter("preemption_nominations_total", "Successful batched preemptions (nominatedNodeName set).", m["preempt_nominations"])
+    counter("preemption_victims_total", "Victims evicted by batched preemptions.", m["preempt_victims"])
+    counter("preemption_dispatches_total", "Vmapped victim-search dispatches (one per replay window with kernel failures).", m["preempt_dispatches"])
+    counter("preemption_kernel_seconds_total", "Cumulative victim-search kernel wall.", round(m["preempt_kernel_s"], 6))
+    for reason, n in sorted(m["preempt_fallbacks"].items()):
+        counter(
+            "preemption_fallbacks_total",
+            "PostFilter work that took the sequential DefaultPreemption path, by reason.",
+            n,
+            {"reason": reason},
+        )
+    if not m["preempt_fallbacks"]:
+        counter(
+            "preemption_fallbacks_total",
+            "PostFilter work that took the sequential DefaultPreemption path, by reason.",
+            0,
+            {"reason": "none"},
+        )
     counter("batch_compiles_total", "XLA compilations of the batch kernel (jit cache misses).", m["engine_compiles"])
     counter("batch_executable_cache_entries", "Compiled batch executables held in the jit cache.", m["engine_cache_entries"], typ="gauge")
     for phase, secs in sorted(m["engine_cum_timings"].items()):

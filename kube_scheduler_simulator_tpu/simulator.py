@@ -57,6 +57,16 @@ def start_simulator(config_path: "str | None" = None, use_batch: str = "auto", b
     logger.info(
         "simulator server started on :%d (kube API on :%s)", port, server.kube_api_port
     )
+    if cfg.etcd_url:
+        # accepted-but-inert compatibility knob: a reference compose file
+        # migrating here should hear that, not silence (docs/
+        # simulator-server-config.md; VERDICT r5 #8)
+        logger.warning(
+            "etcdURL=%r is accepted for reference compatibility but INERT: "
+            "this build has no etcd — state lives in the in-memory store; "
+            "use /api/v1/export and /api/v1/import for persistence",
+            cfg.etcd_url,
+        )
 
     if not block:
         return server

@@ -803,13 +803,17 @@ def test_batch_preemption_composition_byte_identical():
     svc_bat.start_scheduler(cfg)
     svc_bat.schedule_pending(max_rounds=2)
 
-    # round 1: the preemptor's failed attempt runs sequentially, the 999
-    # fillers commit via the kernel; round 2: the preemptor is NOMINATED,
-    # which reserves its node for other pods' filter runs — the kernel
-    # doesn't model nominations, so that round is sequential too
-    assert svc_bat.stats["sequential_pods"] == 2
-    assert svc_bat.stats["batch_pods"] == P - 1
+    # round 1: the preemptor's failure AND its victim search run on the
+    # batch path (preemption/ handles the PostFilter), so every pod of
+    # the round is a batch pod; round 2: the preemptor is NOMINATED and
+    # pending — a pod must not account its own reservation, so that
+    # round is sequential
+    assert svc_bat.stats["sequential_pods"] == 1
+    assert svc_bat.stats["batch_pods"] == P
     assert svc_bat.stats.get("batch_restarts", 0) == 1
+    assert svc_bat.stats["preempt_nominations"] == 1
+    assert svc_bat.stats["preempt_victims"] == 1
+    assert svc_bat.stats["preempt_fallbacks"] == {}
     assert "nominated pods present (preemption in flight)" in svc_bat.stats["batch_fallbacks"]
 
     # victim evicted in both paths

@@ -206,3 +206,19 @@ def test_raw_informer_loop_binds_pod(kube_port):
             break
     conn.close()
     assert bound == "wire-node"
+
+
+@pytest.mark.skipif(
+    __import__("importlib.util", fromlist=["util"]).find_spec("kubernetes") is None,
+    reason="real kubernetes package not importable — the authored transcripts remain the oracle "
+    "(scripts/run_tier1.sh runs the same recorder as a skip-if-absent step)",
+)
+def test_recorded_wire_matches_authored_transcripts(kube_port):
+    """Provenance hardening (VERDICT r5 #7): with the REAL official
+    client present, its captured wire traffic must match the authored
+    transcripts byte-for-byte on every pinned field."""
+    from tests.wire_client_shim import record_and_diff
+
+    diffs, compared = record_and_diff(f"http://127.0.0.1:{kube_port}", TRANSCRIPT_DIR)
+    assert compared > 0
+    assert not diffs, "\n".join(diffs)
