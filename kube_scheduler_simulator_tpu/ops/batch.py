@@ -514,6 +514,30 @@ NODE_AXIS_SPECS = {
 }
 
 
+def field_sharding(mesh, name: str, val, axis_name: str = "nodes"):
+    """The mesh sharding for one DeviceProblem field: node-axis fields
+    (NODE_AXIS_SPECS) shard their node axis, everything else replicates.
+    Shared by the whole-tree placement (shard_device_problem) and the
+    per-plane delta uploads (DevicePlacer), so the two can never disagree
+    about a field's layout."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axes = NODE_AXIS_SPECS.get(name)
+    if axes is None:
+        return NamedSharding(mesh, P())
+    nm = mesh.shape[axis_name]
+    ndim = getattr(val, "ndim", 1)
+    for ax in axes:
+        if val.shape[ax] % nm:
+            raise ValueError(
+                f"{name} axis {ax} ({val.shape[ax]}) not divisible by the "
+                f"{nm}-device mesh — pad the node axis to a multiple "
+                f"(BatchEngine does via pad_problem(node_multiple=...))"
+            )
+    parts = [axis_name if i in axes else None for i in range(max(ndim, 1))]
+    return NamedSharding(mesh, P(*parts))
+
+
 def shard_device_problem(dp: "DeviceProblem", mesh, axis_name: str = "nodes") -> "DeviceProblem":
     """Place a lowered DeviceProblem onto ``mesh`` with the NODE axis
     sharded — the tensor-parallel axis of this workload: every per-step
@@ -526,36 +550,202 @@ def shard_device_problem(dp: "DeviceProblem", mesh, axis_name: str = "nodes") ->
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    nm = mesh.shape[axis_name]
     replicated = NamedSharding(mesh, P())
-
-    def spec_for(name: str, val):
-        axes = NODE_AXIS_SPECS.get(name)
-        if axes is None:
-            return replicated
-        ndim = getattr(val, "ndim", 1)
-        for ax in axes:
-            if val.shape[ax] % nm:
-                raise ValueError(
-                    f"{name} axis {ax} ({val.shape[ax]}) not divisible by the "
-                    f"{nm}-device mesh — pad the node axis to a multiple "
-                    f"(BatchEngine does via pad_problem(node_multiple=...))"
-                )
-        parts = [axis_name if i in axes else None for i in range(max(ndim, 1))]
-        return NamedSharding(mesh, P(*parts))
-
     shardings = DeviceProblem(
         **{
             name: (
                 tuple(replicated for _ in val)
                 if isinstance(val, tuple)
-                else spec_for(name, val)
+                else field_sharding(mesh, name, val, axis_name)
             )
             for name, val in dp._asdict().items()
         }
     )
     # one pytree-level transfer instead of ~70 per-field dispatches
     return jax.device_put(dp, shardings)
+
+
+def tree_nbytes(dp: "DeviceProblem") -> int:
+    """Host bytes a full placement of ``dp`` would upload (ndarray leaves
+    only; traced scalars are noise) — the accounting for the non-cached
+    placement path."""
+    total = 0
+    for val in dp:
+        for leaf in (val if isinstance(val, tuple) else (val,)):
+            if isinstance(leaf, np.ndarray) and leaf.ndim:
+                total += leaf.nbytes
+    return total
+
+
+def _scatter_rows(buf, idx, rows):
+    return buf.at[idx].set(rows)
+
+
+# donating the stale buffer lets XLA update the plane in place; CPU has no
+# donation support (it would warn per call), so the copying variant serves
+# the virtual-mesh/test path
+_scatter_donate = jax.jit(_scatter_rows, donate_argnums=(0,))
+_scatter_copy = jax.jit(_scatter_rows)
+
+
+class DevicePlacer:
+    """Device-resident DeviceProblem: delta uploads across rounds.
+
+    The engine lowers a fresh host-side DeviceProblem every round, but on
+    a churn workload most planes are bytes-identical to the previous
+    round's — the node/class features only change when the CLUSTER
+    changes, not when pods churn.  This cache keeps the previous round's
+    device buffers (keyed by the static shape/config key the executables
+    are keyed by) and, per plane:
+
+    - byte-identical host plane      → reuse the resident buffer (0 bytes
+                                       uploaded);
+    - few changed rows (≤ ¼)         → ship (indices, rows) and apply a
+                                       small jitted scatter-update, the
+                                       stale buffer donated in place and
+                                       the sharding preserved (multichip
+                                       node-axis meshes keep working);
+    - otherwise / shape changed      → full upload, batched with every
+                                       other changed plane into ONE
+                                       ``jax.device_put`` (through a
+                                       tunneled TPU each dispatch pays the
+                                       full latency).
+
+    CARRY0_FIELDS are never cached: both kernel paths donate the initial
+    carry, so their buffers die inside the round by design.
+
+    ``bytes_uploaded`` counts actual H2D traffic (full planes + scatter
+    indices/rows); ``plane_reuses``/``scatter_updates``/``full_uploads``
+    break the decisions out for /metrics.
+    """
+
+    def __init__(self, mesh=None, axis_name: str = "nodes", max_keys: int = 2,
+                 scatter_max_frac: float = 0.25):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.max_keys = max_keys
+        self.scatter_max_frac = scatter_max_frac
+        self.bytes_uploaded = 0
+        self.plane_reuses = 0
+        self.scatter_updates = 0
+        self.full_uploads = 0
+        # key → {(field, sub): (host ndarray, device array)}
+        self._cache: "dict[Any, dict]" = {}
+        self._order: list = []
+
+    def _entry(self, key) -> dict:
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._cache[key] = {}
+            self._order.append(key)
+            while len(self._order) > self.max_keys:
+                self._cache.pop(self._order.pop(0), None)
+        else:
+            self._order.remove(key)
+            self._order.append(key)
+        return entry
+
+    def _scatter(self, cached_dev, idx, rows):
+        """Apply a row update to a resident plane, preserving its sharding.
+
+        The changed-row count is padded to a bucket boundary (repeating
+        the first index with its own new row — idempotent under set) so
+        the jitted update sees O(log) distinct K shapes instead of one
+        trace/compile per exact count, matching the repo's static-shape
+        bucketing convention."""
+        from kube_scheduler_simulator_tpu.ops.encode import _bucket
+
+        k = min(_bucket(len(idx)), cached_dev.shape[0])
+        if k > len(idx):
+            pad = k - len(idx)
+            idx = np.concatenate([idx, np.full(pad, idx[0], dtype=idx.dtype)])
+            rows = np.concatenate([rows, np.repeat(rows[:1], pad, axis=0)])
+        sharding = cached_dev.sharding
+        on_cpu = next(iter(cached_dev.devices())).platform == "cpu"
+        fn = _scatter_copy if on_cpu else _scatter_donate
+        out = fn(cached_dev, idx, rows)
+        if self.mesh is not None and out.sharding != sharding:
+            out = jax.device_put(out, sharding)
+        self.bytes_uploaded += idx.nbytes + rows.nbytes
+        self.scatter_updates += 1
+        return out
+
+    def place(self, dp: "DeviceProblem", key) -> "DeviceProblem":
+        """Place ``dp`` on device, reusing/delta-updating resident planes."""
+        entry = self._entry(key)
+        out: dict[str, Any] = {}
+        uploads: dict = {}      # (field, sub) → host value (one device_put)
+        scatters: list = []     # ((field, sub), cached_dev, idx, rows)
+        new_hosts: dict = {}    # (field, sub) → host ndarray (cache refresh)
+
+        def want(path, name, val):
+            """Route one leaf: reuse, scatter, or full upload."""
+            if not isinstance(val, np.ndarray) or val.ndim == 0 or name in CARRY0_FIELDS:
+                uploads[path] = val
+                if isinstance(val, np.ndarray) and val.ndim:
+                    self.bytes_uploaded += val.nbytes
+                return
+            new_hosts[path] = val
+            cached = entry.get(path)
+            if cached is not None:
+                host_old, dev_old = cached
+                if host_old.shape == val.shape and host_old.dtype == val.dtype:
+                    if val.size == 0:  # zero-width planes (e.g. identity key_oh)
+                        out_leaves[path] = dev_old
+                        self.plane_reuses += 1
+                        return
+                    diff = (val != host_old)
+                    if val.ndim > 1:
+                        diff = diff.reshape(val.shape[0], -1).any(axis=1)
+                    changed = np.nonzero(diff)[0]
+                    if changed.size == 0:
+                        out_leaves[path] = dev_old
+                        self.plane_reuses += 1
+                        return
+                    if changed.size <= max(1, int(val.shape[0] * self.scatter_max_frac)):
+                        scatters.append(
+                            (path, dev_old,
+                             changed.astype(np.int32),
+                             np.ascontiguousarray(val[changed]))
+                        )
+                        return
+            uploads[path] = val
+            self.bytes_uploaded += val.nbytes
+            self.full_uploads += 1
+
+        out_leaves: dict = {}
+        for name, val in dp._asdict().items():
+            if isinstance(val, tuple):
+                for i, leaf in enumerate(val):
+                    want((name, i), name, leaf)
+            else:
+                want((name, None), name, val)
+
+        if uploads:
+            if self.mesh is not None:
+                shardings = {
+                    path: field_sharding(self.mesh, path[0], val, self.axis_name)
+                    for path, val in uploads.items()
+                }
+                placed = jax.device_put(uploads, shardings)
+            else:
+                placed = jax.device_put(uploads)
+            out_leaves.update(placed)
+        for path, dev_old, idx, rows in scatters:
+            out_leaves[path] = self._scatter(dev_old, idx, rows)
+
+        # refresh the resident cache (lower() allocates fresh host arrays
+        # every round, so holding the references is safe)
+        for path, host in new_hosts.items():
+            entry[path] = (host, out_leaves[path])
+
+        # reassemble the namedtuple (tuple fields from their leaves)
+        for name, val in dp._asdict().items():
+            if isinstance(val, tuple):
+                out[name] = tuple(out_leaves[(name, i)] for i in range(len(val)))
+            else:
+                out[name] = out_leaves[(name, None)]
+        return DeviceProblem(**out)
 
 
 def build_compact_fn(

@@ -19,7 +19,6 @@ lifts the same data into per-node victim SLOT tables the kernel can scan:
 
 from __future__ import annotations
 
-import math
 from typing import Any
 
 import numpy as np
@@ -28,6 +27,7 @@ from kube_scheduler_simulator_tpu.models.podresources import (
     is_fit_resource,
     pod_resource_request,
 )
+from kube_scheduler_simulator_tpu.ops.encode import gcd_scale_columns
 from kube_scheduler_simulator_tpu.plugins.intree.queue_bind import (
     DefaultPreemption,
     pod_priority,
@@ -161,14 +161,13 @@ def encode_preemption(
     return pr
 
 
-def gcd_scale_columns(columns: "list[np.ndarray]") -> None:
-    """Divide every array in ``columns`` by their joint GCD in place (the
-    ops/encode.py trick that keeps float32 device math exact; the greedy
-    reprieve scan is pure compares and sums, hence scale-invariant)."""
-    g = 0
-    for arr in columns:
-        if arr.size:
-            g = math.gcd(g, int(np.gcd.reduce(np.abs(arr.reshape(-1)), initial=0)))
-    g = g or 1
-    for arr in columns:
-        arr //= g
+# gcd_scale_columns is re-exported from ops/encode.py: ONE implementation
+# keeps the incremental batch encoder and the victim-search encoder from
+# ever drifting on column scaling (tests/test_encode_incremental.py pins
+# the identity and the scaling semantics).
+__all__ = [
+    "PreemptionProblem",
+    "encode_preemption",
+    "fit_resource_axis",
+    "gcd_scale_columns",
+]

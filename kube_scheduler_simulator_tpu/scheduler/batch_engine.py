@@ -909,11 +909,23 @@ class BatchEngine:
         bucket: bool = True,
         profile_dir: "str | None" = None,
         mesh: Any = None,
+        incremental: "bool | str" = "auto",
     ):
         """``mesh``: a ``jax.sharding.Mesh`` with a "nodes" axis — the
         problem's node axis shards across the mesh's devices
         (ops/batch.shard_device_problem) and cross-node reductions become
-        XLA collectives over ICI.  None = single-device."""
+        XLA collectives over ICI.  None = single-device.
+
+        ``incremental``: delta re-encode across rounds — a host-side
+        EncodeCache (ops/encode.py) retains per-object encoded state so
+        unchanged-majority waves skip the O(all-pods) scans, and a
+        DevicePlacer (ops/batch.py) keeps unchanged planes resident on
+        device with small scatter-updates for row deltas.  Exactness
+        gates fall back to a cold full encode whenever the delta isn't
+        provably representable, so results are byte-identical either
+        way.  An explicit bool wins; under "auto" (default) the
+        ``KSS_ENCODE_INCREMENTAL`` env knob decides ("0" disables,
+        anything else — including unset — enables)."""
         self.filters = list(
             filters
             if filters is not None
@@ -948,6 +960,26 @@ class BatchEngine:
             tie_break=tie_break,
             seed=seed,
         )
+        # Incremental encode + device-resident problem (the steady-state
+        # churn hot path): an EXPLICIT bool argument wins (callers like
+        # bench cfg1-4 pin the cold path for row comparability); the
+        # KSS_ENCODE_INCREMENTAL env knob governs the "auto" default.
+        if isinstance(incremental, bool):
+            inc_on = incremental
+        else:
+            env = os.environ.get("KSS_ENCODE_INCREMENTAL", "").strip().lower()
+            if env in ("0", "off", "false", "no"):
+                inc_on = False
+            else:
+                inc_on = True
+        self.encode_cache = E.EncodeCache() if inc_on else None
+        self._placer = (
+            B.DevicePlacer(mesh=self.mesh) if inc_on else None
+        )
+        # H2D traffic on the non-cached placement path (the placer keeps
+        # its own counter); encode_full counter for cache-off engines
+        self._direct_bytes_uploaded = 0
+        self._encode_full_nocache = 0
         self._fn_cache: dict = {}
         # trace-compaction executables, keyed by (scan key, visited-width
         # bucket) — kept apart so _fn_cache counts scan executables only
@@ -970,7 +1002,10 @@ class BatchEngine:
     # ------------------------------------------------------------ factory
 
     @classmethod
-    def from_framework(cls, framework: Any, trace: bool = False, dtype=None, mesh=None) -> "BatchEngine":
+    def from_framework(
+        cls, framework: Any, trace: bool = False, dtype=None, mesh=None,
+        incremental: "bool | str" = "auto",
+    ) -> "BatchEngine":
         """Build from a scheduler Framework (same plugin set/weights/args
         the sequential path uses — guarantees config consistency)."""
         filters = [wp.original.name for wp in framework.plugins["filter"]]
@@ -1039,6 +1074,7 @@ class BatchEngine:
             tie_break=framework.tie_break,
             seed=framework.seed,
             mesh=mesh,
+            incremental=incremental,
         )
         eng._unsupported_config = unsupported
         eng._framework = framework
@@ -1206,16 +1242,29 @@ class BatchEngine:
         )
 
         t0 = time.perf_counter()
-        pr = E.encode(
-            nodes,
-            all_pods,
-            pending,
-            namespaces,
-            hard_pod_affinity_weight=self.hard_pod_affinity_weight,
-            added_affinity=self.added_affinity,
-            volumes=volumes if volumes is not None else self._volumes(),
-            nominated=nominated,
-        )
+        if self.encode_cache is not None:
+            pr = self.encode_cache.encode(
+                nodes,
+                all_pods,
+                pending,
+                namespaces,
+                hard_pod_affinity_weight=self.hard_pod_affinity_weight,
+                added_affinity=self.added_affinity,
+                volumes=volumes if volumes is not None else self._volumes(),
+                nominated=nominated,
+            )
+        else:
+            self._encode_full_nocache += 1
+            pr = E.encode(
+                nodes,
+                all_pods,
+                pending,
+                namespaces,
+                hard_pod_affinity_weight=self.hard_pod_affinity_weight,
+                added_affinity=self.added_affinity,
+                volumes=volumes if volumes is not None else self._volumes(),
+                nominated=nominated,
+            )
         # mesh sharding needs the node axis divisible by the mesh's "nodes"
         # axis — pad it even with bucketing off
         node_multiple = int(self.mesh.shape["nodes"]) if self.mesh is not None else 1
@@ -1245,22 +1294,31 @@ class BatchEngine:
             w = min(dims["N"], E_._bucket(max(int(sample_k), 1)))
             if w < dims["N"]:
                 ws0 = w
-        if self.mesh is not None:
-            # multi-chip: shard the node axis over the mesh; the jitted
-            # computation picks the shardings up from the placed arrays
-            # (donation is skipped — sharded carries would need matching
-            # output shardings to alias)
-            dp = B.shard_device_problem(dp, self.mesh)
-        else:
-            # ONE pytree-level H2D transfer — per-field dispatches each
-            # pay the full tunnel latency (lower() returns host arrays)
-            dp = jax.device_put(dp)
         key = (
             tuple(sorted(dims.items())),
             cfg,
             ws0,
             id(self.mesh) if self.mesh is not None else None,
         )
+        if self._placer is not None:
+            # device-resident problem: unchanged planes stay on device,
+            # small row deltas go up as jitted scatter-updates (sharded
+            # and unsharded alike), changed planes batch into one
+            # device_put — keyed by the same static shape key as the
+            # compiled executables
+            dp = self._placer.place(dp, key[0])
+        elif self.mesh is not None:
+            # multi-chip: shard the node axis over the mesh; the jitted
+            # computation picks the shardings up from the placed arrays
+            # (donation is skipped — sharded carries would need matching
+            # output shardings to alias)
+            self._direct_bytes_uploaded += B.tree_nbytes(dp)
+            dp = B.shard_device_problem(dp, self.mesh)
+        else:
+            # ONE pytree-level H2D transfer — per-field dispatches each
+            # pay the full tunnel latency (lower() returns host arrays)
+            self._direct_bytes_uploaded += B.tree_nbytes(dp)
+            dp = jax.device_put(dp)
         return dict(
             pr=pr, dp=dp, dims=dims, cfg=cfg, ws0=ws0, key=key,
             nodes=nodes, pending=pending, t0=t0, t1=t1,
@@ -1325,6 +1383,32 @@ class BatchEngine:
             np.int32(n_true),
         )
         return blob, manifest, raw_dtypes, WS
+
+    def encode_stats(self) -> dict:
+        """Incremental-encoder + device-upload counters (zeroed-shape when
+        the cache is disabled, with full encodes still counted) — the
+        service aggregates these across profile engines for /metrics."""
+        if self.encode_cache is not None:
+            s = {k: (dict(v) if isinstance(v, dict) else v) for k, v in self.encode_cache.stats.items()}
+        else:
+            # a deliberately disabled cache is not a gate fallback — full
+            # encodes show in the mode counter only, and the fallback
+            # family stays a pure exactness-gate signal
+            s = {
+                "encode_full_total": self._encode_full_nocache,
+                "encode_delta_total": 0,
+                "encode_rows_reencoded_total": 0,
+                "encode_fallbacks_by_reason": {},
+            }
+        if self._placer is not None:
+            s["device_bytes_uploaded_total"] = self._placer.bytes_uploaded
+            s["device_plane_reuses_total"] = self._placer.plane_reuses
+            s["device_scatter_updates_total"] = self._placer.scatter_updates
+        else:
+            s["device_bytes_uploaded_total"] = self._direct_bytes_uploaded
+            s["device_plane_reuses_total"] = 0
+            s["device_scatter_updates_total"] = 0
+        return s
 
     def _note_round(self, timings: dict) -> None:
         self.last_timings = timings

@@ -1083,7 +1083,26 @@ class SchedulerService:
         est = last_t.get("device_est_s", 0.0)
         overlap = max(0.0, min(1.0, 1.0 - last_t.get("device_s", 0.0) / est)) if est > 1e-9 else 0.0
         last_wave_s = self.stats["last_wave_commit_s"]
+        # incremental-encoder counters, aggregated across profile engines
+        enc = {
+            "encode_full_total": 0,
+            "encode_delta_total": 0,
+            "encode_rows_reencoded_total": 0,
+            "encode_fallbacks_by_reason": {},
+            "device_bytes_uploaded_total": 0,
+            "device_plane_reuses_total": 0,
+            "device_scatter_updates_total": 0,
+        }
+        for e in list(self._batch_engines.values()) or ([eng] if eng else []):
+            es = e.encode_stats()
+            for k in enc:
+                if k == "encode_fallbacks_by_reason":
+                    for reason, n in es.get(k, {}).items():
+                        enc[k][reason] = enc[k].get(reason, 0) + n
+                else:
+                    enc[k] += es.get(k, 0)
         return {
+            **enc,
             "batch_commits": self.stats["batch_commits"],
             "batch_pods": self.stats["batch_pods"],
             "batch_restarts": self.stats["batch_restarts"],

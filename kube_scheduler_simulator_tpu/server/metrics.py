@@ -90,6 +90,28 @@ def render_metrics(di: Any) -> str:
             0,
             {"reason": "none"},
         )
+    # incremental encoder + device-resident problem (delta re-encode
+    # across waves — ops/encode.EncodeCache + ops/batch.DevicePlacer)
+    counter("encode_rounds_total", "Encode passes, by mode (full cold encode vs incremental delta).", m["encode_full_total"], {"mode": "full"})
+    counter("encode_rounds_total", "Encode passes, by mode (full cold encode vs incremental delta).", m["encode_delta_total"], {"mode": "delta"})
+    counter("encode_rows_reencoded_total", "Per-object rows re-encoded on the delta path (changed bound pods + class-row cache misses).", m["encode_rows_reencoded_total"])
+    for reason, n in sorted(m["encode_fallbacks_by_reason"].items()):
+        counter(
+            "encode_fallbacks_total",
+            "Encode passes that fell back to a cold full encode, by exactness-gate reason.",
+            n,
+            {"reason": reason},
+        )
+    if not m["encode_fallbacks_by_reason"]:
+        counter(
+            "encode_fallbacks_total",
+            "Encode passes that fell back to a cold full encode, by exactness-gate reason.",
+            0,
+            {"reason": "none"},
+        )
+    counter("device_bytes_uploaded_total", "Host-to-device bytes actually shipped for problem placement (reused resident planes upload nothing).", m["device_bytes_uploaded_total"])
+    counter("device_plane_reuses_total", "Device-resident planes reused unchanged across rounds.", m["device_plane_reuses_total"])
+    counter("device_scatter_updates_total", "Resident planes updated in place via jitted row scatter-updates.", m["device_scatter_updates_total"])
     counter("batch_compiles_total", "XLA compilations of the batch kernel (jit cache misses).", m["engine_compiles"])
     counter("batch_executable_cache_entries", "Compiled batch executables held in the jit cache.", m["engine_cache_entries"], typ="gauge")
     for phase, secs in sorted(m["engine_cum_timings"].items()):

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Fast encode-parity smoke: incremental vs full over a tiny churn
+sequence, byte-compared — the tier-1 step that catches cache-invalidation
+bugs in ops/encode.EncodeCache without the slow markers.
+
+Drives a real SchedulerService twice (KSS_ENCODE_INCREMENTAL latched per
+engine) through create/schedule/delete/mutate waves on a fixed-clock
+store, then byte-compares every pod's binding and annotation trail AND
+asserts the delta path actually engaged (a silent full re-encode would
+otherwise mask invalidation bugs).  Exit 0 = parity; nonzero = diverged.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+
+
+def build(inc: bool):
+    os.environ["KSS_ENCODE_INCREMENTAL"] = "1" if inc else "0"
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+    store = ClusterStore(clock=lambda: 1700000000.0)
+    for i in range(12):
+        store.create(
+            "nodes",
+            {
+                "metadata": {
+                    "name": f"node-{i}",
+                    "labels": {
+                        "kubernetes.io/hostname": f"node-{i}",
+                        "topology.kubernetes.io/zone": f"z{i % 3}",
+                        "disk": "ssd" if i % 2 else "hdd",
+                    },
+                },
+                "status": {"allocatable": {"cpu": "8000m", "memory": "16Gi", "pods": "110"}},
+                "spec": {},
+            },
+        )
+    svc = SchedulerService(store, tie_break="first", use_batch="force", batch_min_work=1)
+    svc.start_scheduler(None)
+    svc._engine_for(svc.framework)  # latch the env knob into the engine
+    return svc, store
+
+
+def churn(svc, store, waves: int = 3):
+    rng = random.Random(5)
+    created = 0
+    for _ in range(waves):
+        for _ in range(30):
+            p = {
+                "metadata": {
+                    "name": f"pod-{created}",
+                    "namespace": "default",
+                    "labels": {"app": f"a{created % 3}"},
+                },
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "resources": {
+                                "requests": {"cpu": f"{100 + (created % 4) * 50}m", "memory": "128Mi"}
+                            },
+                        }
+                    ]
+                },
+            }
+            if created % 3 == 0:
+                p["spec"]["topologySpreadConstraints"] = [
+                    {
+                        "maxSkew": 2,
+                        "topologyKey": "topology.kubernetes.io/zone",
+                        "whenUnsatisfiable": "DoNotSchedule",
+                        "labelSelector": {"matchLabels": {"app": f"a{created % 3}"}},
+                    }
+                ]
+            if created % 4 == 0:
+                p["spec"]["nodeSelector"] = {"disk": "ssd"}
+            store.create("pods", p)
+            created += 1
+        svc.schedule_pending(max_rounds=2)
+        bound = [p for p in store.list("pods") if (p.get("spec") or {}).get("nodeName")]
+        for p in rng.sample(bound, max(1, len(bound) // 10)):
+            store.delete("pods", p["metadata"]["name"], p["metadata"].get("namespace"))
+        if bound:
+            t = rng.choice(bound)
+            try:
+                store.patch(
+                    "pods",
+                    t["metadata"]["name"],
+                    {"metadata": {"labels": {"app": "mut"}}},
+                    t["metadata"].get("namespace"),
+                )
+            except KeyError:
+                pass
+    out = {}
+    for p in store.list("pods"):
+        k = p["metadata"]["namespace"] + "/" + p["metadata"]["name"]
+        out[k] = (
+            (p.get("spec") or {}).get("nodeName"),
+            tuple(sorted((p["metadata"].get("annotations") or {}).items())),
+        )
+    return out
+
+
+def main() -> int:
+    svc1, store1 = build(inc=True)
+    svc0, store0 = build(inc=False)
+    d1 = churn(svc1, store1)
+    d0 = churn(svc0, store0)
+    m1 = svc1.metrics()
+    if d1.keys() != d0.keys():
+        print(f"encode-smoke: pod sets diverged ({len(d1)} vs {len(d0)})", file=sys.stderr)
+        return 1
+    bad = [k for k in sorted(d1) if d1[k] != d0[k]]
+    if bad:
+        print(f"encode-smoke: {len(bad)} pods diverged, first: {bad[0]}", file=sys.stderr)
+        return 1
+    if m1["encode_delta_total"] < 1:
+        print(
+            f"encode-smoke: delta path never engaged — fallbacks: "
+            f"{m1['encode_fallbacks_by_reason']}",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"encode-smoke OK: {len(d1)} pods byte-identical; "
+        f"delta={m1['encode_delta_total']} full={m1['encode_full_total']} "
+        f"rows={m1['encode_rows_reencoded_total']} "
+        f"uploaded={m1['device_bytes_uploaded_total']}B "
+        f"reuses={m1['device_plane_reuses_total']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,4 +11,9 @@ set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu pyt
 # package is importable, capture its actual wire traffic and diff it
 # against the authored transcripts (tests/wire_client_shim.py recorder).
 if ! timeout -k 10 120 env JAX_PLATFORMS=cpu python tests/wire_client_shim.py --record-diff; then rc=1; fi
+# Encode-parity smoke: a tiny churn sequence run with the incremental
+# encoder on vs off, byte-compared (bindings + annotations) with a
+# delta-path-engaged assertion — catches EncodeCache invalidation bugs
+# fast, without the slow markers (scripts/encode_smoke.py).
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/encode_smoke.py; then rc=1; fi
 exit $rc

@@ -1,0 +1,582 @@
+"""Incremental encoder (ops/encode.EncodeCache) + device-resident problem
+(ops/batch.DevicePlacer) — the ISSUE 5 delta re-encode path.
+
+The contract under test: whenever the cache's exactness gates hold, the
+seeded (delta) encode is VALUE-IDENTICAL to a cold full encode of the same
+snapshot — every BatchProblem array byte-equal — and the engine-level
+annotation/binding bytes are identical whether the incremental path is on
+or off.  The gates themselves must fall back (counted by reason) exactly
+when the delta is not representable, and the delta path must actually
+ENGAGE (counter-asserted) so a silent full re-encode can't masquerade as
+passing parity.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any
+
+import numpy as np
+import pytest
+
+from kube_scheduler_simulator_tpu.ops import batch as B
+from kube_scheduler_simulator_tpu.ops import encode as E
+
+Obj = dict[str, Any]
+
+
+# ------------------------------------------------------------ object makers
+
+class Cluster:
+    """Synthetic churnable cluster with store-like resourceVersions."""
+
+    def __init__(self, n_nodes: int, rng: random.Random):
+        self.rng = rng
+        self._rv = 0
+        self.nodes = [self.mk_node(i) for i in range(n_nodes)]
+        self.bound: dict[str, Obj] = {}
+        self.pending: list[Obj] = []
+        self._next = 0
+
+    def rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def mk_node(self, i: int) -> Obj:
+        labels = {
+            "kubernetes.io/hostname": f"node-{i}",
+            "topology.kubernetes.io/zone": f"z{i % 3}",
+            "disk": "ssd" if i % 2 else "hdd",
+        }
+        n: Obj = {
+            "metadata": {"name": f"node-{i}", "resourceVersion": self.rv(), "labels": labels},
+            "status": {
+                "allocatable": {"cpu": "16000m", "memory": "32Gi", "pods": "110"},
+                "images": [{"names": [f"img-{i % 2}"], "sizeBytes": 5_000_000 * (1 + i % 3)}],
+            },
+            "spec": {},
+        }
+        if i % 5 == 0:
+            n["spec"]["taints"] = [{"key": "spot", "value": "true", "effect": "PreferNoSchedule"}]
+        return n
+
+    def mk_pod(self, labels=None, node=None, term=False, pend_affinity=False) -> Obj:
+        i = self._next
+        self._next += 1
+        rng = self.rng
+        p: Obj = {
+            "metadata": {
+                "name": f"pod-{i}",
+                "namespace": "default",
+                "resourceVersion": self.rv(),
+                "labels": dict(labels) if labels else {"app": f"a{i % 4}"},
+            },
+            "spec": {
+                "containers": [
+                    {
+                        "name": "c",
+                        "image": f"img-{i % 2}",
+                        "resources": {
+                            "requests": {
+                                "cpu": f"{rng.choice([100, 250, 500])}m",
+                                "memory": f"{rng.choice([128, 256])}Mi",
+                            }
+                        },
+                    }
+                ]
+            },
+        }
+        if i % 4 == 0:
+            p["spec"]["nodeSelector"] = {"disk": "ssd"}
+        if i % 3 == 0:
+            p["spec"]["topologySpreadConstraints"] = [
+                {
+                    "maxSkew": 2,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": rng.choice(["DoNotSchedule", "ScheduleAnyway"]),
+                    "labelSelector": {"matchLabels": {"app": f"a{i % 4}"}},
+                }
+            ]
+        if i % 6 == 0:
+            p["spec"]["tolerations"] = [{"key": "spot", "operator": "Exists"}]
+        if pend_affinity and i % 2 == 0:
+            p["spec"]["affinity"] = {
+                "podAntiAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "weight": 7,
+                            "podAffinityTerm": {
+                                "labelSelector": {"matchLabels": {"app": f"a{i % 4}"}},
+                                "topologyKey": "kubernetes.io/hostname",
+                            },
+                        }
+                    ]
+                }
+            }
+        if term:
+            p["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+        if node is not None:
+            p["spec"]["nodeName"] = node
+        return p
+
+    def all_pods(self) -> list[Obj]:
+        return list(self.bound.values()) + self.pending
+
+    def churn(self, binds=8, deletes=3, mutates=1, new_pending=8, pend_affinity=False):
+        """One wave of add/delete/modify churn (label + usage mutations)."""
+        rng = self.rng
+        # bind a prefix of the pending set (fresh objects, bumped rv —
+        # what a store bind does).  Pods carrying inter-pod affinity stay
+        # pending: binding one would (correctly) gate the delta path for
+        # every later wave, and this test wants the delta ENGAGED while
+        # the pending side still exercises G>0 term groups.
+        stay, took = [], 0
+        for p in self.pending:
+            aff = p["spec"].get("affinity") or {}
+            if took >= binds or aff.get("podAffinity") or aff.get("podAntiAffinity"):
+                stay.append(p)
+                continue
+            took += 1
+            b = {
+                "metadata": {**p["metadata"], "resourceVersion": self.rv()},
+                "spec": {**p["spec"], "nodeName": f"node-{rng.randrange(len(self.nodes))}"},
+            }
+            if rng.random() < 0.1:
+                b["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+            self.bound[b["metadata"]["name"]] = b
+        self.pending = stay
+        for nm in rng.sample(sorted(self.bound), min(deletes, len(self.bound))):
+            del self.bound[nm]
+        for nm in rng.sample(sorted(self.bound), min(mutates, len(self.bound))):
+            old = self.bound[nm]
+            mut = {
+                "metadata": {
+                    **old["metadata"],
+                    "resourceVersion": self.rv(),
+                    "labels": {"app": rng.choice(["mut", "a0", "a1"])},
+                },
+                "spec": old["spec"],
+            }
+            self.bound[nm] = mut
+        self.pending += [
+            self.mk_pod(pend_affinity=pend_affinity) for _ in range(new_pending)
+        ]
+
+
+def assert_problem_equal(a: "E.BatchProblem", b: "E.BatchProblem", tag: str) -> None:
+    ka, kb = vars(a), vars(b)
+    assert ka.keys() == kb.keys(), (tag, set(ka) ^ set(kb))
+    for k in ka:
+        va, vb = ka[k], kb[k]
+        if isinstance(va, np.ndarray):
+            assert isinstance(vb, np.ndarray) and va.dtype == vb.dtype and va.shape == vb.shape, (
+                tag, k, getattr(vb, "dtype", None), getattr(vb, "shape", None),
+            )
+            assert np.array_equal(va, vb), (tag, k)
+        else:
+            assert va == vb, (tag, k, va, vb)
+
+
+# ----------------------------------------------------------- gcd parity
+
+def test_gcd_scale_columns_shared_and_exact():
+    """ONE implementation serves both encoders (identity pinned), and its
+    scaling divides every column by the joint GCD."""
+    from kube_scheduler_simulator_tpu.preemption import encode as PE
+
+    assert PE.gcd_scale_columns is E.gcd_scale_columns
+
+    rng = random.Random(11)
+    for _ in range(50):
+        g = rng.choice([1, 2, 5, 128, 1024, 1_000_000])
+        cols = [
+            np.array([rng.randrange(0, 50) * g for _ in range(rng.randrange(1, 8))], dtype=np.int64)
+            for _ in range(3)
+        ]
+        want = [c.copy() for c in cols]
+        joint = 0
+        import math
+
+        for c in cols:
+            for v in c:
+                joint = math.gcd(joint, int(abs(v)))
+        joint = joint or 1
+        E.gcd_scale_columns(cols)
+        for c, w in zip(cols, want):
+            assert np.array_equal(c, w // joint)
+    # multi-dim arrays (the preemption encoder scales [N,V] planes) and
+    # non-contiguous column views (the batch encoder scales [:, r] views)
+    m = np.array([[4, 8], [12, 0]], dtype=np.int64)
+    E.gcd_scale_columns([m])
+    assert np.array_equal(m, [[1, 2], [3, 0]])
+    plane = np.array([[6, 10], [9, 20]], dtype=np.int64)
+    E.gcd_scale_columns([plane[:, 0]])
+    assert np.array_equal(plane, [[2, 10], [3, 20]])
+
+
+# ------------------------------------------- randomized churn property test
+
+def test_encode_cache_randomized_churn_parity():
+    """Random add/delete/modify streams (bindings, deletions, label and
+    usage mutations, terminating flips, nomination churn): every wave the
+    cached encode must be value-identical to a cold full encode, and the
+    delta path must actually engage."""
+    for seed in (0, 1, 2):
+        rng = random.Random(seed)
+        cl = Cluster(10, rng)
+        cl.pending = [cl.mk_pod(pend_affinity=seed == 1) for _ in range(12)]
+        cache = E.EncodeCache()
+        for wave in range(6):
+            noms = None
+            if wave % 2 == 1:
+                noms = [(cl.mk_pod(), f"node-{rng.randrange(10)}")]
+            cold = E.encode(cl.nodes, cl.all_pods(), cl.pending, None, nominated=noms)
+            inc = cache.encode(cl.nodes, cl.all_pods(), cl.pending, None, nominated=noms)
+            assert_problem_equal(cold, inc, f"seed={seed} wave={wave}")
+            cl.churn(pend_affinity=seed == 1)
+        # the counter assertion: no silent full re-encode masking parity
+        assert cache.stats["encode_delta_total"] >= 4, cache.stats
+        assert cache.stats["encode_full_total"] == 1, cache.stats
+        assert cache.stats["encode_rows_reencoded_total"] > 0, cache.stats
+
+
+def test_encode_cache_gates_fall_back_by_reason():
+    """Each exactness gate must route to a counted cold full encode that
+    still matches byte-for-byte."""
+    rng = random.Random(7)
+    cl = Cluster(8, rng)
+    cl.pending = [cl.mk_pod() for _ in range(6)]
+    for _ in range(2):
+        cl.churn(binds=3, deletes=0, mutates=0, new_pending=3)
+    cache = E.EncodeCache()
+
+    def both(tag, **kw):
+        cold = E.encode(cl.nodes, cl.all_pods(), cl.pending, None, **kw)
+        inc = cache.encode(cl.nodes, cl.all_pods(), cl.pending, None, **kw)
+        assert_problem_equal(cold, inc, tag)
+
+    both("cold")
+    assert cache.stats["encode_fallbacks_by_reason"] == {"cold start": 1}
+    both("delta")
+    assert cache.stats["encode_delta_total"] == 1
+
+    # node change (label flip) → "node set changed"
+    cl.nodes[2] = cl.mk_node(2)
+    cl.nodes[2]["metadata"]["labels"]["disk"] = "nvme"
+    both("node-change")
+    assert cache.stats["encode_fallbacks_by_reason"]["node set changed"] == 1
+
+    # bound pod with inter-pod affinity → gated while present (a
+    # WORKLOAD gate: the cached state keeps maintaining itself, so no
+    # re-prime is paid and the gate clears the moment the pod leaves)
+    evil = cl.mk_pod(node="node-1")
+    evil["spec"]["affinity"] = {
+        "podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"labelSelector": {"matchLabels": {"app": "a1"}}, "topologyKey": "kubernetes.io/hostname"}
+            ]
+        }
+    }
+    cl.bound[evil["metadata"]["name"]] = evil
+    both("bound-affinity")
+    assert cache.stats["encode_fallbacks_by_reason"]["bound pods carry inter-pod affinity"] == 1
+    both("bound-affinity-again")
+    assert cache.stats["encode_fallbacks_by_reason"]["bound pods carry inter-pod affinity"] == 2
+    del cl.bound[evil["metadata"]["name"]]
+    both("affinity-gone")  # immediately back on the delta path
+    assert cache.stats["encode_delta_total"] == 2
+
+    # pending volumes → gated
+    vp = cl.mk_pod()
+    vp["spec"]["volumes"] = [{"name": "v", "persistentVolumeClaim": {"claimName": "c1"}}]
+    vols = {
+        "persistentvolumeclaims": [{"metadata": {"name": "c1", "namespace": "default"}, "spec": {"volumeName": "pv1"}}],
+        "persistentvolumes": [{"metadata": {"name": "pv1"}, "spec": {}}],
+    }
+    cl.pending.append(vp)
+    both("volumes", volumes=vols)
+    assert cache.stats["encode_fallbacks_by_reason"]["pending pods mount volumes"] == 1
+    cl.pending.pop()
+
+    # pending host ports → gated
+    pp = cl.mk_pod()
+    pp["spec"]["containers"][0]["ports"] = [{"containerPort": 80, "hostPort": 8080}]
+    cl.pending.append(pp)
+    both("ports")
+    assert cache.stats["encode_fallbacks_by_reason"]["pending pods carry host ports"] == 1
+    cl.pending.pop()
+
+    # config change → gated
+    both("config", hard_pod_affinity_weight=3)
+    assert cache.stats["encode_fallbacks_by_reason"]["plugin config changed"] == 1
+
+
+def test_encode_cache_without_resource_versions():
+    """Objects without resourceVersions (direct API users) fall back to
+    content signatures — churn parity must still hold."""
+    rng = random.Random(3)
+    cl = Cluster(6, rng)
+    for n in cl.nodes:
+        n["metadata"].pop("resourceVersion")
+    cl.pending = [cl.mk_pod() for _ in range(8)]
+    cache = E.EncodeCache()
+    for wave in range(4):
+        for p in cl.all_pods():
+            p["metadata"].pop("resourceVersion", None)
+        cold = E.encode(cl.nodes, cl.all_pods(), cl.pending, None)
+        inc = cache.encode(cl.nodes, cl.all_pods(), cl.pending, None)
+        assert_problem_equal(cold, inc, f"no-rv wave={wave}")
+        cl.churn(binds=4, deletes=1, mutates=1, new_pending=4)
+    assert cache.stats["encode_delta_total"] >= 2, cache.stats
+
+
+# -------------------------------------------------- engine-level byte parity
+
+def _mk_service(inc: bool):
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+    store = ClusterStore(clock=lambda: 1700000000.0)
+    for i in range(16):
+        store.create(
+            "nodes",
+            {
+                "metadata": {
+                    "name": f"node-{i}",
+                    "labels": {
+                        "kubernetes.io/hostname": f"node-{i}",
+                        "topology.kubernetes.io/zone": f"z{i % 3}",
+                        "disk": "ssd" if i % 2 else "hdd",
+                    },
+                },
+                "status": {"allocatable": {"cpu": "8000m", "memory": "16Gi", "pods": "110"}},
+                "spec": {},
+            },
+        )
+    svc = SchedulerService(store, tie_break="first", use_batch="force", batch_min_work=1)
+    svc.start_scheduler(None)
+    # build the (lazily-created) engines with the wanted incremental mode
+    # — deterministic regardless of the ambient env knob
+    import os
+
+    old = os.environ.get("KSS_ENCODE_INCREMENTAL")
+    os.environ["KSS_ENCODE_INCREMENTAL"] = "1" if inc else "0"
+    try:
+        svc._engine_for(svc.framework)
+    finally:
+        if old is None:
+            os.environ.pop("KSS_ENCODE_INCREMENTAL", None)
+        else:
+            os.environ["KSS_ENCODE_INCREMENTAL"] = old
+    return svc, store
+
+
+def _churn_service(svc, store, rng, waves=4):
+    created = 0
+    for wave in range(waves):
+        for _ in range(40):
+            p = {
+                "metadata": {
+                    "name": f"pod-{created}",
+                    "namespace": "default",
+                    "labels": {"app": f"a{created % 3}"},
+                },
+                "spec": {
+                    "containers": [
+                        {"name": "c", "resources": {"requests": {"cpu": f"{100 + (created % 4) * 50}m", "memory": "256Mi"}}}
+                    ]
+                },
+            }
+            if created % 3 == 0:
+                p["spec"]["topologySpreadConstraints"] = [
+                    {
+                        "maxSkew": 2,
+                        "topologyKey": "topology.kubernetes.io/zone",
+                        "whenUnsatisfiable": "DoNotSchedule",
+                        "labelSelector": {"matchLabels": {"app": f"a{created % 3}"}},
+                    }
+                ]
+            if created % 4 == 0:
+                p["spec"]["nodeSelector"] = {"disk": "ssd"}
+            store.create("pods", p)
+            created += 1
+        svc.schedule_pending(max_rounds=2)
+        bound = [p for p in store.list("pods") if (p.get("spec") or {}).get("nodeName")]
+        for p in rng.sample(bound, max(1, len(bound) // 10)):
+            store.delete("pods", p["metadata"]["name"], p["metadata"].get("namespace"))
+        if bound:
+            t = rng.choice(bound)
+            try:
+                store.patch(
+                    "pods", t["metadata"]["name"], {"metadata": {"labels": {"app": "mut"}}},
+                    t["metadata"].get("namespace"),
+                )
+            except KeyError:
+                pass
+    out = {}
+    for p in store.list("pods"):
+        k = p["metadata"]["namespace"] + "/" + p["metadata"]["name"]
+        out[k] = (
+            (p.get("spec") or {}).get("nodeName"),
+            tuple(sorted((p["metadata"].get("annotations") or {}).items())),
+        )
+    return out
+
+
+def test_engine_incremental_annotations_byte_identical():
+    """Service-level churn: bindings + annotation bytes identical with the
+    incremental path on vs off, and the delta path engaged (counters on
+    /metrics would show the same)."""
+    svc1, store1 = _mk_service(inc=True)
+    svc0, store0 = _mk_service(inc=False)
+    d1 = _churn_service(svc1, store1, random.Random(9))
+    d0 = _churn_service(svc0, store0, random.Random(9))
+    assert d1.keys() == d0.keys()
+    bad = [k for k in d1 if d1[k] != d0[k]]
+    assert not bad, bad[:3]
+    m1, m0 = svc1.metrics(), svc0.metrics()
+    assert m1["encode_delta_total"] >= 2, m1
+    assert m1["device_plane_reuses_total"] > 0, m1
+    assert m0["encode_delta_total"] == 0
+    assert m0["encode_full_total"] >= 2
+    # upload accounting: the delta path ships strictly less than the
+    # full-placement path for the same workload
+    assert 0 < m1["device_bytes_uploaded_total"] < m0["device_bytes_uploaded_total"], (m1, m0)
+
+
+# -------------------------------------------------------- device placer
+
+def test_device_placer_reuse_scatter_and_bytes():
+    """Direct DevicePlacer behavior: unchanged planes reuse the resident
+    buffer, small row deltas scatter, big deltas re-upload — and the
+    placed problem always computes the same kernel outputs as a fresh
+    device_put."""
+    rng = random.Random(4)
+    cl = Cluster(8, rng)
+    cl.pending = [cl.mk_pod() for _ in range(10)]
+    for _ in range(2):
+        cl.churn(binds=4, deletes=0, mutates=0, new_pending=4)
+
+    pr = E.encode(cl.nodes, cl.all_pods(), cl.pending, None)
+    pr = E.pad_problem(pr)
+    dp, dims = B.lower(pr)
+    placer = B.DevicePlacer()
+    key = tuple(sorted(dims.items()))
+    d1 = placer.place(dp, key)
+    first_bytes = placer.bytes_uploaded
+    assert first_bytes > 0 and placer.plane_reuses == 0
+
+    # identical problem again: every cacheable plane reuses
+    dp2, _dims = B.lower(pr)
+    d2 = placer.place(dp2, key)
+    assert placer.plane_reuses > 30
+    assert placer.bytes_uploaded - first_bytes < first_bytes / 2
+
+    # single-row mutation → scatter path, and the update must LAND
+    pr2 = E.encode(cl.nodes, cl.all_pods(), cl.pending, None)
+    pr2 = E.pad_problem(pr2)
+    pr2.node_unsched = pr2.node_unsched.copy()
+    pr2.node_unsched[3] = True
+    dp3, _ = B.lower(pr2)
+    before_scatters = placer.scatter_updates
+    d3 = placer.place(dp3, key)
+    assert placer.scatter_updates > before_scatters
+    assert bool(np.asarray(d3.node_unsched)[3]) is True
+    assert np.array_equal(np.asarray(d3.node_unsched), np.asarray(dp3.node_unsched))
+
+    # placed problems must compute identically to a plain device_put
+    cfg = B.BatchConfig(filters=("NodeResourcesFit",), scores=(("NodeResourcesFit", 1),))
+    fn = B.build_batch_fn(cfg, dims)
+    import jax
+
+    out_cached = np.asarray(fn(d3)["packed_pod"])
+    out_plain = np.asarray(fn(jax.device_put(dp3))["packed_pod"])
+    assert np.array_equal(out_cached, out_plain)
+
+
+def test_device_placer_mesh_sharding_preserved():
+    """Multichip dryrun for the delta path: scatter-updates and reuses on
+    a node-axis mesh keep the sharding, and sharded == unsharded
+    annotation bytes across consecutive (delta) rounds."""
+    import jax
+    from jax.sharding import Mesh
+
+    from kube_scheduler_simulator_tpu.scheduler.batch_engine import BatchEngine
+
+    devices = jax.local_devices(backend="cpu")
+    assert len(devices) >= 8, "conftest forces 8 virtual CPU devices"
+    mesh = Mesh(np.array(devices[:8]), ("nodes",))
+
+    rng = random.Random(12)
+    cl = Cluster(24, rng)
+    cl.pending = [cl.mk_pod() for _ in range(14)]
+    for _ in range(2):
+        cl.churn(binds=6, deletes=1, mutates=1, new_pending=6)
+
+    filters = ["NodeResourcesFit", "TaintToleration", "NodeAffinity", "PodTopologySpread"]
+    scores = [("NodeResourcesFit", 1), ("TaintToleration", 3), ("PodTopologySpread", 2)]
+    eng_plain = BatchEngine(filters=filters, scores=scores, trace=True, incremental=True)
+    with mesh:
+        eng_mesh = BatchEngine(filters=filters, scores=scores, trace=True, mesh=mesh, incremental=True)
+
+    for wave in range(3):
+        args = (cl.nodes, cl.all_pods(), cl.pending, [])
+        with jax.default_device(devices[0]):
+            r1 = eng_plain.schedule(*args)
+        with mesh:
+            r2 = eng_mesh.schedule(*args)
+        assert r1.selected_nodes == r2.selected_nodes, f"wave {wave}"
+        for i in range(len(cl.pending)):
+            assert r1.filter_annotation_json(i) == r2.filter_annotation_json(i), (wave, i)
+            s1, f1 = r1.score_annotations_json(i)
+            s2, f2 = r2.score_annotations_json(i)
+            assert s1 == s2 and f1 == f2, (wave, i)
+        # the resident planes of the mesh engine must STAY sharded over
+        # the mesh (a silently-replicated plane would still compute)
+        entry = eng_mesh._placer._cache[next(iter(eng_mesh._placer._cache))]
+        sharded = 0
+        for (name, _sub), (_h, dev) in entry.items():
+            if name in B.NODE_AXIS_SPECS and getattr(dev, "size", 0):
+                assert len(dev.sharding.device_set) == 8, name
+                sharded += 1
+        assert sharded > 0
+        cl.churn(binds=6, deletes=1, mutates=1, new_pending=6)
+
+    assert eng_mesh.encode_cache.stats["encode_delta_total"] >= 2
+    assert eng_mesh._placer.plane_reuses > 0
+
+
+def test_engine_restart_snapshot_churn_delta():
+    """The preemption restart-snapshot path: mid-round re-encodes (store
+    changed between kernel runs) must ride the delta path and stay
+    byte-identical — modeled here as back-to-back engine schedules with
+    store-like rv bumps in between."""
+    from kube_scheduler_simulator_tpu.scheduler.batch_engine import BatchEngine
+
+    rng = random.Random(21)
+    cl = Cluster(12, rng)
+    cl.pending = [cl.mk_pod() for _ in range(10)]
+    cl.churn(binds=5, deletes=0, mutates=0, new_pending=5)
+
+    eng = BatchEngine(
+        filters=["NodeResourcesFit", "NodeAffinity"],
+        scores=[("NodeResourcesFit", 1)],
+        trace=True,
+        incremental=True,
+    )
+    eng_cold = BatchEngine(
+        filters=["NodeResourcesFit", "NodeAffinity"],
+        scores=[("NodeResourcesFit", 1)],
+        trace=True,
+        incremental=False,
+    )
+    for restart in range(3):
+        args = (cl.nodes, cl.all_pods(), cl.pending, [])
+        r1 = eng.schedule(*args)
+        r2 = eng_cold.schedule(*args)
+        assert r1.selected_nodes == r2.selected_nodes
+        for i in range(len(cl.pending)):
+            assert r1.filter_annotation_json(i) == r2.filter_annotation_json(i), (restart, i)
+        # mid-round churn: victims deleted, a pod bound, tail re-runs
+        cl.churn(binds=2, deletes=2, mutates=0, new_pending=2)
+    assert eng.encode_cache.stats["encode_delta_total"] >= 2
