@@ -626,6 +626,164 @@ def run_preemption(N=200, fillers=800, preemptors=16, budget_s=300.0):
     }
 
 
+def run_gang(jobs=200, min_members=8, max_members=64, nodes=220, waves=5, seed=23):
+    """cfg8-gang (ISSUE 6): the gang engine end-to-end — ~``jobs``
+    distributed-training jobs of 8-64 members arriving in waves with
+    completion churn, every gang placed all-or-nothing by the batched
+    replay with the group-feasibility verdict executed as batched kernel
+    dispatches (one per replay window, NOT per group).
+
+    Two legs: a small batch-vs-sequential byte-parity sweep (the
+    acceptance contract at a size where the sequential oracle is
+    affordable), and the full-scale batch run (min-of-2 walls,
+    platform-tagged) whose counters prove the dispatch batching and the
+    zero-partial-groups invariant."""
+    import jax
+
+    from kube_scheduler_simulator_tpu.gang import gang_scheduler_config, partially_bound_groups
+    from kube_scheduler_simulator_tpu.gang.scenario import make_member as member
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+    def job_plan(rng):
+        return [rng.randint(min_members, max_members) for _ in range(jobs)]
+
+    def churn(store, svc, plan):
+        """Jobs arrive in ``waves`` waves; each wave schedules, then the
+        previous wave's jobs complete (pods + groups deleted)."""
+        partial = 0
+        per_wave = max(len(plan) // waves, 1)
+        prev: list[tuple[str, int]] = []
+        for w in range(waves):
+            batch = plan[w * per_wave : (w + 1) * per_wave] if w < waves - 1 else plan[(waves - 1) * per_wave :]
+            cur = []
+            for j, members in enumerate(batch):
+                g = f"job-{w}-{j}"
+                store.create(
+                    "podgroups",
+                    {"metadata": {"name": g}, "spec": {"minMember": members, "scheduleTimeoutSeconds": 600}},
+                )
+                for m in range(members):
+                    store.create("pods", member(f"{g}-m{m}", g))
+                cur.append((g, members))
+            svc.schedule_pending(max_rounds=3)
+            partial += len(partially_bound_groups(store))
+            for g, members in prev:
+                for m in range(members):
+                    try:
+                        store.delete("pods", f"{g}-m{m}")
+                    except KeyError:
+                        pass
+                store.delete("podgroups", g)
+            prev = cur
+        return partial
+
+    # --- parity leg: batch vs sequential oracle, full byte compare
+    def parity_build():
+        store = ClusterStore(clock=lambda: 0.0)
+        store.create("namespaces", {"metadata": {"name": "default"}})
+        for i in range(40):
+            store.create("nodes", mk_node(i))
+        return store
+
+    rng = random.Random(seed)
+    small_plan = [rng.randint(2, 8) for _ in range(24)]
+    s_seq = parity_build()
+    svc_seq = SchedulerService(s_seq, tie_break="first", use_batch="off")
+    svc_seq.start_scheduler(gang_scheduler_config())
+    churn(s_seq, svc_seq, small_plan)
+    s_bat = parity_build()
+    svc_bat = SchedulerService(s_bat, tie_break="first", use_batch="auto", batch_min_work=0)
+    svc_bat.start_scheduler(gang_scheduler_config())
+    churn(s_bat, svc_bat, small_plan)
+    mismatches = 0
+    for p in s_seq.list("pods"):
+        nm = p["metadata"]["name"]
+        try:
+            q = s_bat.get("pods", nm, p["metadata"].get("namespace"))
+        except KeyError:
+            mismatches += 1
+            continue
+        if (p["metadata"].get("annotations") or {}) != (q["metadata"].get("annotations") or {}) or (
+            p["spec"].get("nodeName") != q["spec"].get("nodeName")
+        ):
+            mismatches += 1
+
+    # --- scale leg: min-of-2 batch walls at the full job count
+    plan = job_plan(random.Random(seed + 1))
+
+    def run_scale():
+        store = ClusterStore(clock=lambda: 0.0)
+        store.create("namespaces", {"metadata": {"name": "default"}})
+        for i in range(nodes):
+            store.create("nodes", mk_node(i))
+        svc = SchedulerService(store, tie_break="first", use_batch="auto", batch_min_work=0)
+        svc.start_scheduler(gang_scheduler_config())
+        t0 = time.perf_counter()
+        partial = churn(store, svc, plan)
+        return time.perf_counter() - t0, store, svc, partial
+
+    (wall, store, svc, partial) = min(run_scale(), run_scale(), key=lambda r: r[0])
+    m = svc.metrics()
+
+    # --- one standalone feasibility-scan dispatch over a fresh job set
+    # (the G×N all-or-nothing kernel the preview endpoint serves)
+    from kube_scheduler_simulator_tpu.gang.encode import encode_feasibility
+    from kube_scheduler_simulator_tpu.gang.kernel import run_feasibility
+    from kube_scheduler_simulator_tpu.models.nodeinfo import build_node_infos
+
+    nis = build_node_infos(
+        store.list("nodes", copy_objects=False), store.list("pods", copy_objects=False)
+    )
+    frng = random.Random(seed + 2)
+    feas_groups = [
+        [member(f"f{g}-m{m}", f"f{g}") for m in range(frng.randint(min_members, max_members))]
+        for g in range(64)
+    ]
+    t0 = time.perf_counter()
+    feas = run_feasibility(
+        encode_feasibility(feas_groups, ["topology.kubernetes.io/zone"] * len(feas_groups), nis)
+    )
+    feas_s = time.perf_counter() - t0
+
+    scheduled = sum(
+        1 for p in store.list("pods", copy_objects=False) if (p.get("spec") or {}).get("nodeName")
+    )
+    return {
+        "config": "cfg8-gang",
+        "kernel_platform": jax.default_backend(),
+        "jobs": len(plan),
+        "members_range": [min_members, max_members],
+        "gang_pods": sum(plan),
+        "nodes": nodes,
+        "waves": waves,
+        "wall_s": round(wall, 2),
+        "pods_per_s": round(scheduled / wall) if wall > 0 else 0,
+        # the acceptance counters: feasibility batched per WINDOW, groups
+        # released whole, nothing partially bound, kernel never disagreed
+        "gang_rounds": m["gang_rounds"],
+        "gang_released_groups": m["gang_released_groups"],
+        "gang_released_pods": m["gang_released_pods"],
+        "gang_parked": m["gang_parked"],
+        "gang_kernel_dispatches": m["gang_kernel_dispatches"],
+        "gang_kernel_s": round(m["gang_kernel_s"], 4),
+        "gang_verdict_mismatches": m["gang_verdict_mismatch"],
+        "gang_fallbacks": dict(m["gang_fallbacks"]),
+        "partially_bound_groups": partial,
+        "feasibility_scan": {
+            "groups": len(feas_groups),
+            "nodes": len(nis),
+            "wall_s": round(feas_s, 4),
+            "feasible": int(feas["feasible"].sum()),
+        },
+        "parity_mismatches": mismatches,
+        "parity_note": (
+            "annotations+bindings byte-compared batch-vs-oracle over the "
+            f"{len(small_plan)}-job churn sweep"
+        ),
+    }
+
+
 def run_cfg4_drift(n=5):
     """VERDICT item 6: re-attest the cfg4 1.89->2.04 s drift — N repeated
     measurements of the same wall_s metric the BENCH_r04/r05 rows report,
@@ -1065,7 +1223,23 @@ def main() -> None:
         action="store_true",
         help="run the cfg5-churn-incremental comparison (full vs incremental encode) and write BENCH_encode.json",
     )
+    ap.add_argument(
+        "--gang-report",
+        action="store_true",
+        help="run cfg8-gang (training-job churn on the gang engine) and write BENCH_gang.json",
+    )
     args = ap.parse_args()
+
+    if args.gang_report:
+        if args.quick:
+            rows = [run_gang(jobs=24, min_members=2, max_members=8, nodes=40, waves=3)]
+        else:
+            rows = [run_gang()]
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_gang.json")
+        with open(path, "w") as f:
+            json.dump(rows, f, indent=1)
+        print(json.dumps(rows, indent=1))
+        return
 
     if args.encode_report:
         rows = [run_encode_report()]

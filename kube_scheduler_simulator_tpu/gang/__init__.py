@@ -1,0 +1,43 @@
+"""Gang scheduling engine: all-or-nothing PodGroup placement.
+
+Modules:
+
+- ``podgroups``: the PodGroup store kind — admission/validation, the
+  coscheduling membership label, and the quorum/minResources gates both
+  scheduling paths share (served at ``/api/v1/podgroups``);
+- ``plugin``: the Coscheduling oracle plugin (PreFilter quorum gate,
+  Permit gang parking/release over the WaitingPod machinery, PostFilter
+  + Unreserve all-or-nothing rejection cascades);
+- ``encode`` / ``kernel``: the XLA gang kernels — group-membership
+  vectors and topology-label planes feed a per-replay-window verdict
+  dispatch plus a vmapped greedy all-or-nothing feasibility scan over G
+  groups × N nodes, and a group-granularity victim search reusing
+  preemption/kernel.py;
+- ``engine``: the batched gang replay (park / atomic wave release /
+  window verdict) with counted exactness-gate fallbacks;
+- ``scenario``: the distributed-training scenario family (gangs with
+  arrival/completion churn) the bench and tests replay.
+"""
+
+# engine/kernel (and their jax dependency) load lazily — the registry
+# imports gang.plugin on every service build, and non-batch callers must
+# not pay the jax import for it
+from kube_scheduler_simulator_tpu.gang.podgroups import (  # noqa: F401
+    POD_GROUP_LABEL,
+    gang_batch_enabled,
+    gang_scheduler_config,
+    gang_scheduler_profile,
+    group_gate,
+    group_info,
+    group_status,
+    partially_bound_groups,
+    pod_group_name,
+    validate_pod_group,
+)
+
+
+def prepare_round(*args, **kwargs):
+    """Lazy forwarder to :func:`gang.engine.prepare_round` (jax import)."""
+    from kube_scheduler_simulator_tpu.gang.engine import prepare_round as _prepare
+
+    return _prepare(*args, **kwargs)

@@ -1,0 +1,253 @@
+"""The XLA gang kernels: batched all-or-nothing group feasibility.
+
+Two jitted entry points, both vmapped over the GROUP axis — structurally
+"one more vmap axis" on the batch-scorer/victim-search machinery:
+
+- ``run_window_verdict`` — ONE dispatch per replay window (not per
+  group): group-membership vectors over the main kernel's per-member
+  selections plus topology-label planes answer, for all G groups at
+  once, (a) all-or-nothing placement (no member failed, quorum met) and
+  (b) the topology-packing metric (distinct topology domains the placed
+  members span — fewer is better packed).
+- ``run_feasibility`` — the vmapped greedy scan: per group, place the
+  member slots over the node axis all-or-nothing on free capacity,
+  preferring nodes whose topology domain the group already uses (the
+  packing rule), mirroring the victim-search kernel's fori-scan shape.
+
+``group_victim_search`` reuses ``preemption/kernel.run_search`` at group
+granularity: the group's aggregate request becomes the preemptor row, so
+one dispatch answers "which single node could host the whole gang after
+evictions" for every infeasible group at once (an estimation surface,
+like the autoscaler's — it never drives placement decisions).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kube_scheduler_simulator_tpu.ops.encode import _bucket
+
+Obj = dict[str, Any]
+
+
+# ------------------------------------------------------------ window verdict
+
+
+@functools.lru_cache(maxsize=64)
+def build_verdict_fn(G: int, K: int, N: int, D: int):
+    """Compile the per-window verdict for static dims: G groups × K gang
+    member slots × N nodes × D topology domains."""
+
+    def fn(gid, node, dom, prior_bound, min_member):
+        # gid[K] int32 (-1 pads), node[K] int32 (-1 = member failed),
+        # dom[G, N] int32, prior_bound[G] int32, min_member[G] int32
+        valid = gid >= 0
+        placed = valid & (node >= 0)
+        failed = valid & (node < 0)
+        gsel = jnp.where(valid, gid, 0)
+        cnt = jnp.zeros((G,), jnp.int32).at[gsel].add(placed.astype(jnp.int32))
+        nfail = jnp.zeros((G,), jnp.int32).at[gsel].add(failed.astype(jnp.int32))
+        all_ok = (nfail == 0) & ((cnt + prior_bound) >= min_member)
+        # distinct topology domains spanned by the placed members
+        dm = dom[gsel, jnp.clip(node, 0)]  # [K]
+        used = jnp.zeros((G, D), bool).at[gsel, jnp.clip(dm, 0)].max(placed)
+        distinct = used.sum(axis=-1).astype(jnp.int32)
+        return {"feasible": all_ok, "distinct_domains": distinct, "placed": cnt}
+
+    return jax.jit(fn)
+
+
+def run_window_verdict(
+    gid: np.ndarray,
+    node: np.ndarray,
+    dom: np.ndarray,
+    prior_bound: np.ndarray,
+    min_member: np.ndarray,
+    D: int,
+) -> dict:
+    """Dispatch the window verdict (the G/K/N axes padded to buckets so
+    churning windows AND churning node counts — autoscaled clusters —
+    reuse compiled executables); returns numpy arrays trimmed to the
+    true group count."""
+    G_true, N_true = dom.shape
+    K_true = len(gid)
+    G = max(_bucket(G_true), 1)
+    K = max(_bucket(K_true), 1)
+    N = max(_bucket(N_true), 1)
+
+    def pad(a, dim, size, fill=0):
+        if a.shape[dim] == size:
+            return a
+        w = [(0, 0)] * a.ndim
+        w[dim] = (0, size - a.shape[dim])
+        return np.pad(a, w, constant_values=fill)
+
+    fn = build_verdict_fn(G, K, N, max(D, 1))
+    out = fn(
+        pad(np.asarray(gid, np.int32), 0, K, fill=-1),
+        pad(np.asarray(node, np.int32), 0, K, fill=-1),
+        # padded node columns are never referenced: member node ids are
+        # always < N_true (or -1)
+        pad(pad(np.asarray(dom, np.int32), 1, N), 0, G),
+        pad(np.asarray(prior_bound, np.int32), 0, G),
+        pad(np.asarray(min_member, np.int32), 0, G),
+    )
+    return {k: np.asarray(v)[:G_true] for k, v in out.items()}
+
+
+# --------------------------------------------------------- feasibility scan
+
+
+@functools.lru_cache(maxsize=64)
+def build_feasibility_fn(G: int, M: int, N: int, R: int, D: int):
+    """Compile the greedy all-or-nothing scan: vmap over G groups, a
+    lax.scan over the M member slots per group (the victim-search
+    kernel's shape with the scan running FORWARD over placements)."""
+
+    def per_group(req_m, valid_m, free0, cnt_free0, dom_n):
+        # req_m[M,R], valid_m[M], free0[N,R], cnt_free0[N], dom_n[N]
+        def step(carry, inp):
+            free, cnt_free, used_dom, ok = carry
+            req, valid = inp
+            fits = jnp.all(req[None, :] <= free, axis=-1) & (cnt_free >= 1)
+            packed = used_dom[dom_n]  # node's domain already used by the group
+            # rank: fits-and-packed (2) > fits (1) > infeasible (0);
+            # argmax picks the FIRST max → lowest node index tie-break
+            rank = jnp.where(fits, 1 + packed.astype(jnp.int32), 0)
+            pick = jnp.argmax(rank)
+            can = fits.any() | ~valid
+            place = valid & fits.any()
+            one = (jnp.arange(N) == pick) & place
+            free = free - jnp.where(one[:, None], req[None, :], 0)
+            cnt_free = cnt_free - one.astype(cnt_free.dtype)
+            used_dom = used_dom.at[dom_n[pick]].max(place)
+            sel = jnp.where(place, pick.astype(jnp.int32), jnp.int32(-1))
+            return (free, cnt_free, used_dom, ok & can), sel
+
+        (free, cnt_free, used_dom, ok), sel = lax.scan(
+            step,
+            (free0, cnt_free0, jnp.zeros((D,), bool), jnp.bool_(True)),
+            (req_m, valid_m),
+        )
+        distinct = used_dom.sum().astype(jnp.int32)
+        return ok, distinct, sel
+
+    per_groups = jax.vmap(per_group, in_axes=(0, 0, None, None, 0))
+
+    def fn(req, valid, free, cnt_free, dom):
+        ok, distinct, sel = per_groups(req, valid, free, cnt_free, dom)
+        return {"feasible": ok, "distinct_domains": distinct, "assignment": sel}
+
+    return jax.jit(fn)
+
+
+def _f(x: np.ndarray) -> np.ndarray:
+    dt = np.float64 if jax.config.jax_enable_x64 else np.float32
+    return np.asarray(x, dtype=dt)
+
+
+def run_feasibility(pr: Any) -> dict:
+    """Dispatch the all-or-nothing scan for an encoded
+    :class:`~kube_scheduler_simulator_tpu.gang.encode.GangFeasibilityProblem`;
+    one vmapped dispatch covers every group."""
+    G_true, M_true, R = pr.req.shape
+    N_true = pr.free.shape[0]
+    G = max(_bucket(G_true), 1)
+    M = max(_bucket(M_true), 1)
+    N = max(_bucket(N_true), 1)
+    D = max(int(pr.D), 1)
+
+    def pad(a, dim, size):
+        if a.shape[dim] == size:
+            return a
+        w = [(0, 0)] * a.ndim
+        w[dim] = (0, size - a.shape[dim])
+        return np.pad(a, w)
+
+    fn = build_feasibility_fn(G, M, N, R, D)
+    out = fn(
+        _f(pad(pad(pr.req, 1, M), 0, G)),
+        pad(pad(np.asarray(pr.valid, bool), 1, M), 0, G),
+        # padded nodes carry zero free capacity and a zero pod budget, so
+        # the scan can never place a member on one
+        _f(pad(pr.free, 0, N)),
+        _f(pad(pr.cnt_free, 0, N)),
+        pad(pad(np.asarray(pr.dom, np.int32), 1, N), 0, G),
+    )
+    return {
+        "feasible": np.asarray(out["feasible"])[:G_true],
+        "distinct_domains": np.asarray(out["distinct_domains"])[:G_true],
+        "assignment": np.asarray(out["assignment"])[:G_true, :M_true],
+    }
+
+
+# ----------------------------------------------------- group victim search
+
+
+def group_victim_search(
+    node_infos: list[Any],
+    groups: "list[tuple[list[Obj], int]]",
+    pdbs: "list[Obj] | None" = None,
+) -> list[dict]:
+    """Group-granularity victim search reusing preemption/kernel: each
+    group's AGGREGATE member request is one preemptor row, so a single
+    vmapped dispatch answers, per group, which single node could host the
+    whole gang after evicting lower-priority pods (and whom).
+
+    ``groups``: [(unbound member pods, group priority)].  Returns one
+    dict per group: ``{"node": name | None, "victims": [pod names]}`` —
+    an ESTIMATION surface (podgroups preview / bench), never a placement
+    decision, exactly like the autoscaler's estimation kernel."""
+    from kube_scheduler_simulator_tpu.preemption import encode as PE
+    from kube_scheduler_simulator_tpu.preemption import kernel as PK
+
+    if not groups:
+        return []
+    all_members = [p for ms, _prio in groups for p in ms]
+    resource_names = PE.fit_resource_axis(all_members) or ["cpu"]
+    res_idx = {r: j for j, r in enumerate(resource_names)}
+    max_prio = max((prio for _ms, prio in groups), default=0)
+    pr = PE.encode_preemption(node_infos, resource_names, pdbs or [], max_pending_priority=max_prio)
+    U, N, R = len(groups), len(node_infos), len(resource_names)
+    ureq = np.zeros((U, R), dtype=np.int64)
+    uprio = np.zeros(U, dtype=np.int64)
+    for u, (ms, prio) in enumerate(groups):
+        for p in ms:
+            ureq[u] += PE._req_vec(p, res_idx)
+        uprio[u] = prio
+    for r in range(R):
+        PE.gcd_scale_columns([pr.alloc[:, r], pr.base_req[:, r], pr.vreq[:, :, r], ureq[:, r]])
+    if pr.V == 0:
+        return [{"node": None, "victims": []} for _ in groups]
+    ucand = np.ones((U, N), dtype=bool)
+    masks = PK.run_search(
+        pr, ucand, ureq, uprio,
+        np.zeros((U, 0), dtype=bool), np.zeros((0, R), dtype=np.int64),
+        np.zeros((0,), dtype=np.int32),
+    )
+    out = []
+    for u in range(U):
+        ids = np.nonzero(masks["cand"][u])[0]
+        if ids.size == 0:
+            out.append({"node": None, "victims": []})
+            continue
+        # fewest victims, then lowest node index — a preview ranking (the
+        # exact pickOneNodeForPreemption criteria live in preemption/)
+        nv = masks["victims"][u].sum(axis=-1)
+        best = int(min(ids, key=lambda n: (int(nv[n]), int(n))))
+        sl = np.nonzero(masks["victims"][u, best])[0]
+        out.append(
+            {
+                "node": pr.node_names[best],
+                "victims": [
+                    pr.victim_pods[best][int(s)]["metadata"]["name"] for s in sl
+                ],
+            }
+        )
+    return out

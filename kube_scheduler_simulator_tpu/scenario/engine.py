@@ -51,6 +51,28 @@ class ScenarioError(Exception):
     pass
 
 
+class ScenarioClock:
+    """Deterministic timeline clock for scenario replay.
+
+    Construct a SchedulerService with ``clock=ScenarioClock()`` and the
+    scheduling queue's backoff AND every framework's Permit deadlines run
+    on scenario time instead of ``time.monotonic()``: the engine advances
+    it by ``spec.stepSeconds`` (default 1.0) per MajorStep boundary, so
+    gang ``scheduleTimeoutSeconds`` expiry replays byte-deterministically
+    — the same Scenario always expires the same waits at the same steps
+    (KEP-140 determinism rules, README.md:600-610)."""
+
+    def __init__(self, start: float = 0.0):
+        self.now = float(start)
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> float:
+        self.now += float(dt)
+        return self.now
+
+
 def _major_of(step: Any) -> int:
     """An operation's MajorStep — the KEP's ``step: {major: N}`` shape
     (README.md:176-183) or a bare int."""
@@ -157,7 +179,18 @@ class ScenarioEngine:
         minor = 0
         done = False
         auto_id = 0
+        # a scenario-timeline clock (ScenarioClock on the scheduler
+        # service) advances per MajorStep: Permit deadlines — gang
+        # scheduleTimeoutSeconds — expire on deterministic replay time
+        clk = getattr(self.scheduler, "_clock", None)
+        step_seconds = float(spec.get("stepSeconds") or 1.0)
+        prev_major: "int | None" = None
         for major in sorted(by_major):
+            if prev_major is not None and hasattr(clk, "advance"):
+                # MajorSteps are a timeline: simulated time advances by
+                # the major DELTA (a jump from major 1 to 4 is 3 steps)
+                clk.advance((major - prev_major) * step_seconds)
+            prev_major = major
             minor = 0
             events: list[Obj] = []
             timeline[str(major)] = events

@@ -1049,11 +1049,14 @@ class BatchEngine:
             p: [wp.original.name for wp in framework.plugins[p]]
             for p in ("queue_sort", "reserve", "permit", "pre_bind", "bind", "post_bind")
         }
-        if point_names["permit"]:
+        # the ONE permit plugin with a batch replay is the Coscheduling
+        # gang oracle (gang/engine.py parks/releases its decisions); any
+        # other permit plugin keeps the round sequential
+        if point_names["permit"] and point_names["permit"] != ["Coscheduling"]:
             unsupported = unsupported or f"permit plugins {point_names['permit']}"
         if point_names["bind"] != ["DefaultBinder"]:
             unsupported = unsupported or f"bind plugins {point_names['bind']}"
-        if not set(point_names["reserve"]) <= {"VolumeBinding"}:
+        if not set(point_names["reserve"]) <= {"VolumeBinding", "Coscheduling"}:
             unsupported = unsupported or f"reserve plugins {point_names['reserve']}"
         if not set(point_names["pre_bind"]) <= {"VolumeBinding"}:
             unsupported = unsupported or f"preBind plugins {point_names['pre_bind']}"

@@ -124,6 +124,7 @@ class Framework:
         seed: int = 0,
         profile_name: str = "default-scheduler",
         tie_break: str = "reservoir",
+        clock: "Any | None" = None,
     ):
         self.plugins = {p: list(plugins.get(p, [])) for p in self.EXTENSION_POINTS}
         self.handle = handle
@@ -139,6 +140,17 @@ class Framework:
         self.profile_name = profile_name
         # pods parked at Permit (key → WaitingPod); see allow_waiting_pod
         self.waiting_pods: dict[str, WaitingPod] = {}
+        # injectable time source for Permit deadlines: scenario replay
+        # drives a deterministic timeline clock through here so gang
+        # scheduleTimeoutSeconds expiry replays byte-identically
+        import time as _time
+
+        self.clock = clock or _time.monotonic
+        # waiting pods RESOLVED (allowed-and-bound or rejected) since the
+        # service last drained — fills whether the resolution came from a
+        # service call or a PLUGIN cascade (gang release/rejection), so
+        # the service can record failures it would otherwise never see
+        self.resolved_waiting: list[tuple[Obj, "ScheduleResult"]] = []
         # "reservoir" = upstream selectHost semantics (uniform over tied
         # maxima), made deterministic via a counter-keyed hash draw shared
         # with the batch kernel; "first" = first-max in visit order,
@@ -304,9 +316,7 @@ class Framework:
                 self._unreserve(state, pod, selected)
                 return ScheduleResult(status=status, diagnosis=diagnosis)
         if wait_timeouts:
-            import time as _time
-
-            waiting = WaitingPod(pod, selected, state, wait_timeouts, _time.monotonic())
+            waiting = WaitingPod(pod, selected, state, wait_timeouts, self.clock())
             self.waiting_pods[waiting.key] = waiting
             return ScheduleResult(diagnosis=diagnosis, waiting_on=selected)
 
@@ -411,22 +421,26 @@ class Framework:
         if wp.pending:
             return None
         del self.waiting_pods[wp.key]
-        return self._finish_binding(wp.state, wp.pod, wp.node_name, {}, [], None)
+        res = self._finish_binding(wp.state, wp.pod, wp.node_name, {}, [], None)
+        self.resolved_waiting.append((wp.pod, res))
+        return res
 
     def reject_waiting_pod(self, namespace: str, name: str, message: str = "rejected") -> "ScheduleResult | None":
         """upstream waitingPod.Reject: unreserve and fail the pod."""
         wp = self.waiting_pods.pop(f"{namespace}/{name}", None)
         if wp is None:
             return None
+        # the pod is already out of the map, so plugin cascades triggered
+        # by this unreserve (gang teardown) terminate
         self._unreserve(wp.state, wp.pod, wp.node_name)
-        return ScheduleResult(status=Status.unschedulable(message))
+        res = ScheduleResult(status=Status.unschedulable(message))
+        self.resolved_waiting.append((wp.pod, res))
+        return res
 
     def expire_waiting_pods(self, now: "float | None" = None) -> dict[str, ScheduleResult]:
         """Reject every waiting pod whose earliest permit deadline passed
         (upstream rejects on timer expiry)."""
-        import time as _time
-
-        now = _time.monotonic() if now is None else now
+        now = self.clock() if now is None else now
         out: dict[str, ScheduleResult] = {}
         for key in [k for k, w in self.waiting_pods.items() if w.earliest_deadline() <= now]:
             ns, name = key.split("/", 1)

@@ -94,6 +94,10 @@ class SchedulerService:
         self.cluster_store = cluster_store
         self.seed = seed
         self.tie_break = tie_break
+        # injectable time source shared by the scheduling queue AND every
+        # framework's Permit deadlines (scenario replay passes a
+        # deterministic timeline clock; None = time.monotonic)
+        self._clock = clock
         self.use_batch = use_batch
         # jax.sharding.Mesh for multi-chip rounds: every profile engine
         # shards its node axis over it (SURVEY §2.5 scaling axis)
@@ -176,6 +180,21 @@ class SchedulerService:
             "preempt_dispatches": 0,
             "preempt_kernel_s": 0.0,
             "preempt_fallbacks": {},
+            # gang engine (gang/): all-or-nothing PodGroup placement on
+            # the batch path.  gang_fallbacks counts the rounds that took
+            # the sequential Coscheduling oracle instead, by reason;
+            # gang_verdict_mismatch must stay 0 (device-vs-host check).
+            "gang_rounds": 0,
+            "gang_parked": 0,
+            "gang_released_groups": 0,
+            "gang_released_pods": 0,
+            "gang_kernel_dispatches": 0,
+            "gang_kernel_s": 0.0,
+            "gang_verdict_mismatch": 0,
+            "gang_fallbacks": {},
+            # permit waits that expired (deadline passed) and were
+            # rejected by process_waiting_pods
+            "permit_wait_expired": 0,
         }
         # guards batch_fallbacks against the metrics scrape thread
         self._stats_lock = threading.Lock()
@@ -457,6 +476,7 @@ class SchedulerService:
             seed=self.seed,
             profile_name=profile.get("schedulerName") or "default-scheduler",
             tie_break=self.tie_break,
+            clock=self._clock,
         )
         # each profile records into ITS OWN result store (per-profile
         # plugin sets and weights); the shared reflector merges per pod.
@@ -595,14 +615,7 @@ class SchedulerService:
         for fw in self.frameworks.values():
             res = fw.allow_waiting_pod(namespace, name, plugin)
             if res is not None:
-                seq = self._wait_move_seq.pop(f"{namespace}/{name}", None)
-                if not res.success:
-                    # the deferred bind cycle failed (e.g. binder webhook
-                    # down) — record it like any scheduling failure
-                    try:
-                        self._record_failure(self.cluster_store.get("pods", name, namespace), res, seq)
-                    except KeyError:
-                        pass
+                self._drain_resolved_waiting()
                 self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
                 return res
         return None
@@ -612,12 +625,7 @@ class SchedulerService:
         for fw in self.frameworks.values():
             res = fw.reject_waiting_pod(namespace, name, message)
             if res is not None:
-                seq = self._wait_move_seq.pop(f"{namespace}/{name}", None)
-                try:
-                    pod = self.cluster_store.get("pods", name, namespace)
-                    self._record_failure(pod, res, seq)
-                except KeyError:
-                    pass
+                self._drain_resolved_waiting()
                 self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
                 return res
         return None
@@ -626,19 +634,38 @@ class SchedulerService:
         """Expire waiting pods whose permit deadline passed, recording the
         rejection like any scheduling failure (schedule_pending and the
         background loop call this; tests drive it with an explicit
-        ``now``)."""
+        ``now``).  Plugin cascades triggered by an expiry's unreserve —
+        a gang member's timeout rejecting its whole group — resolve more
+        pods than the expiry set; the drain records them all."""
         expired: dict[str, ScheduleResult] = {}
         for fw in self.frameworks.values():
-            if not fw.waiting_pods:
-                continue
-            by_key = {key: w.pod for key, w in fw.waiting_pods.items()}
-            fw_expired = fw.expire_waiting_pods(now)
-            for key, res in fw_expired.items():
-                self._record_failure(by_key[key], res, self._wait_move_seq.pop(key, None))
-            expired.update(fw_expired)
+            if fw.waiting_pods:
+                expired.update(fw.expire_waiting_pods(now))
         if expired:
+            with self._stats_lock:
+                self.stats["permit_wait_expired"] += len(expired)
+        if self._drain_resolved_waiting():
             self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
         return expired
+
+    def _drain_resolved_waiting(self) -> int:
+        """Record every waiting-pod resolution the frameworks collected
+        since the last drain (service calls AND plugin cascades): pop the
+        wait-start move_seq, record failures like any scheduling failure.
+        Successful resolutions need no record — the reference's allow
+        path is silent too.  Returns the number drained (callers flush
+        the reflector when nonzero)."""
+        drained = 0
+        for fw in self.frameworks.values():
+            if not fw.resolved_waiting:
+                continue
+            resolved, fw.resolved_waiting = fw.resolved_waiting, []
+            for pod, res in resolved:
+                drained += 1
+                seq = self._wait_move_seq.pop(_pod_key(pod), None)
+                if not res.success:
+                    self._record_failure(pod, res, seq)
+        return drained
 
     # ------------------------------------------------------------ batch path
 
@@ -726,6 +753,21 @@ class SchedulerService:
                 # segments — those are cheaper on the sequential cycle
                 # than on a kernel dispatch each
                 ok, why = False, "segment below batch_min_work"
+            gang_ctx = None
+            if ok and fw.plugins["permit"]:
+                # a permit-bearing profile only passes supported() when
+                # its permit point is exactly the Coscheduling oracle —
+                # the gang round context replays its decisions; gate
+                # failures (quorum, missing group, knob off) take the
+                # exact sequential oracle, counted per reason
+                from kube_scheduler_simulator_tpu.gang import prepare_round as gang_prepare
+
+                gang_ctx, gang_why = gang_prepare(self, fw, eng, pending, nodes)
+                if gang_ctx is None:
+                    with self._stats_lock:
+                        gf = self.stats["gang_fallbacks"]
+                        gf[gang_why] = gf.get(gang_why, 0) + 1
+                    ok, why = False, f"gang: {gang_why}"
             if not ok:
                 if len(segments) == 1:
                     # the common single-profile round: fall back to the
@@ -741,7 +783,11 @@ class SchedulerService:
                     results[_pod_key(pod)] = self.schedule_one(pod, snapshot)
                 self.stats["commit_s"] += time.perf_counter() - tc
             else:
-                self._run_segment_batch(fw, eng, pending, nodes, volumes, results, noms)
+                if gang_ctx is not None and gang_ctx.engaged:
+                    self.stats["gang_rounds"] += 1
+                self._run_segment_batch(
+                    fw, eng, pending, nodes, volumes, results, noms, gang_ctx
+                )
                 any_batched = True
                 self._sync_rotation(fw)
         if any_batched:
@@ -758,11 +804,12 @@ class SchedulerService:
         volumes: "dict[str, list[Obj]]",
         results: dict,
         nominated: "list[tuple[Obj, str]] | None" = None,
+        gang_ctx: Any = None,
     ) -> None:
         seq_failures = bool(fw.plugins["post_filter"]) and self.use_batch != "force"
         point_names = {
             p: [wp.original.name for wp in fw.plugins[p]]
-            for p in ("pre_filter", "pre_score", "reserve", "pre_bind", "bind")
+            for p in ("pre_filter", "pre_score", "reserve", "permit", "pre_bind", "bind")
         }
         i = 0  # index of the tail's first pod within `pending`
         restarts = 0
@@ -813,7 +860,8 @@ class SchedulerService:
                     snapshot = self.build_snapshot()
                     self._prune_mid_round_nominations(snapshot, noms)
                 restart_at = self._replay_window(
-                    result, i, off, cnt, snapshot, point_names, fw, seq_failures, results, pholder
+                    result, i, off, cnt, snapshot, point_names, fw, seq_failures,
+                    results, pholder, gang_ctx
                 )
                 if restart_at is not None:
                     break  # abandon the remaining windows (state changed)
@@ -874,17 +922,24 @@ class SchedulerService:
         seq_failures: bool,
         results: dict,
         pholder: "dict | None" = None,
+        gang_ctx: Any = None,
     ) -> "int | None":
         """Replay one kernel window's decisions in queue order.
         Successful pods accumulate into bulk-commit waves
-        (``_commit_batch_wave``); kernel failures commit from the trace
-        with their PostFilter resolved by the batched victim search
-        (preemption/), or run the exact sequential cycle when the round
-        or pod is outside the engine's envelope.  Returns the absolute
+        (``_commit_batch_wave``); gang members park / release through the
+        gang round context (gang/engine.py) instead of committing
+        individually; kernel failures commit from the trace with their
+        PostFilter resolved by the batched victim search (preemption/),
+        or run the exact sequential cycle when the round or pod is
+        outside the engine's envelope.  Returns the absolute
         pending-index to restart the kernel from after a successful
         preemption, else None."""
         window = result.pending
         sample_start = result.out["sample_start"]
+        if gang_ctx is not None:
+            # ONE gang-kernel dispatch per replay window: all groups'
+            # all-or-nothing verdict + topology-packing metric
+            gang_ctx.note_window(result, cnt)
         wave_js: list[int] = []
         decisions: dict = {}
         if (
@@ -917,6 +972,28 @@ class SchedulerService:
             pod = window[j]
             key = _pod_key(pod)
             if int(result.selected[j]) >= 0:
+                gk = gang_ctx.group_of(pod) if gang_ctx is not None else None
+                if gk is not None:
+                    # gang member: park at Permit (or release the whole
+                    # gang when this member completes the quorum) —
+                    # earlier non-gang commits flush first so the store
+                    # state matches the sequential oracle's at this pod
+                    flush_wave()
+                    node_name = result.node_names[int(result.selected[j])]
+                    tc = time.perf_counter()
+                    if gang_ctx.completes(gk):
+                        res = gang_ctx.commit_release(
+                            result, j, pod, node_name, snapshot, point_names
+                        )
+                    else:
+                        res = gang_ctx.park(
+                            result, j, pod, node_name, snapshot, point_names
+                        )
+                    self.stats["commit_s"] += time.perf_counter() - tc
+                    results[key] = res
+                    fw.sched_counter += 1
+                    self.stats["batch_pods"] += 1
+                    continue
                 wave_js.append(j)
                 if len(wave_js) >= self.commit_wave:
                     flush_wave()
@@ -1076,6 +1153,7 @@ class SchedulerService:
         with self._stats_lock:
             fallbacks = dict(self.stats["batch_fallbacks"])
             preempt_fallbacks = dict(self.stats["preempt_fallbacks"])
+            gang_fallbacks = dict(self.stats["gang_fallbacks"])
         last_t = dict(eng.last_timings) if eng else {}
         # the fraction of the last pipelined round's device time hidden
         # under host commits (0 for un-pipelined rounds) — the bench's
@@ -1123,6 +1201,18 @@ class SchedulerService:
             "preempt_dispatches": self.stats["preempt_dispatches"],
             "preempt_kernel_s": self.stats["preempt_kernel_s"],
             "preempt_fallbacks": preempt_fallbacks,
+            # gang engine (gang/): all-or-nothing PodGroup placement
+            "gang_rounds": self.stats["gang_rounds"],
+            "gang_parked": self.stats["gang_parked"],
+            "gang_released_groups": self.stats["gang_released_groups"],
+            "gang_released_pods": self.stats["gang_released_pods"],
+            "gang_kernel_dispatches": self.stats["gang_kernel_dispatches"],
+            "gang_kernel_s": self.stats["gang_kernel_s"],
+            "gang_verdict_mismatch": self.stats["gang_verdict_mismatch"],
+            "gang_fallbacks": gang_fallbacks,
+            # Permit wait machinery, live (the gauge) and cumulative
+            "waiting_pods": len(self._all_waiting_keys()),
+            "permit_wait_expired": self.stats["permit_wait_expired"],
             **self.queue.stats(),
             "engine_rounds": eng.rounds if eng else 0,
             "engine_compiles": eng.compiles if eng else 0,
@@ -1163,6 +1253,11 @@ class SchedulerService:
         pf_status = {pn: SUCCESS_MESSAGE for pn in pf_names}
         pre_score = {pn: SUCCESS_MESSAGE for pn in point_names["pre_score"]}
         reserve = {pn: SUCCESS_MESSAGE for pn in point_names["reserve"]}
+        # a gang profile's wrapped Permit records success + "0s" for
+        # singleton pods (the Coscheduling oracle returns (None, 0))
+        permit_names = point_names.get("permit") or []
+        permit = {pn: SUCCESS_MESSAGE for pn in permit_names}
+        permit_to = {pn: "0s" for pn in permit_names}
         prebind = {pn: SUCCESS_MESSAGE for pn in point_names["pre_bind"]}
         bind = {point_names["bind"][0]: SUCCESS_MESSAGE} if point_names["bind"] else None
         entries: list[tuple[str, str, dict]] = []
@@ -1191,6 +1286,9 @@ class SchedulerService:
                 # a profile with no reserve plugins leaves it unset
                 cats["selectedNode"] = node_name
                 cats["reserve"] = reserve
+            if permit:
+                cats["permit"] = permit
+                cats["permitTimeout"] = permit_to
             if prebind:
                 cats["prebind"] = prebind
             if bind:
@@ -1246,7 +1344,7 @@ class SchedulerService:
         if point_names is None:
             point_names = {
                 p: [wp.original.name for wp in fw.plugins[p]]
-                for p in ("pre_filter", "pre_score", "reserve", "pre_bind", "bind")
+                for p in ("pre_filter", "pre_score", "reserve", "permit", "pre_bind", "bind")
             }
         ns = pod["metadata"].get("namespace", "default")
         name = pod["metadata"]["name"]
@@ -1281,6 +1379,10 @@ class SchedulerService:
                 rs.add_selected_node(ns, name, node_name)
             for pn in point_names["reserve"]:
                 rs.add_reserve_result(ns, name, pn, SUCCESS_MESSAGE)
+            for pn in point_names.get("permit") or []:
+                # the gang profile's Coscheduling permit returns (None, 0)
+                # for singleton pods — success, "0s" timeout
+                rs.add_permit_result(ns, name, pn, SUCCESS_MESSAGE, 0)
             for pn in point_names["pre_bind"]:
                 rs.add_pre_bind_result(ns, name, pn, SUCCESS_MESSAGE)
             if point_names["bind"]:
@@ -1332,6 +1434,10 @@ class SchedulerService:
         result = fw.schedule_one(pod, snapshot)
         self._sync_rotation(fw)
         self.stats["sequential_pods"] += 1
+        # gang cascades inside the cycle (Coscheduling permit releases /
+        # post-filter rejections) resolve OTHER waiting pods — record
+        # their outcomes before the flush
+        self._drain_resolved_waiting()
         if result.waiting_on:
             # the attempt continues through the Permit wait: events fired
             # while parked must count if the wait ends in failure

@@ -90,6 +90,31 @@ def render_metrics(di: Any) -> str:
             0,
             {"reason": "none"},
         )
+    # gang engine (gang/): all-or-nothing PodGroup placement
+    counter("gang_rounds_total", "Batch rounds with the gang replay engaged (PodGroups present).", m["gang_rounds"])
+    counter("gang_parked_pods_total", "Gang members parked at Permit by the batch replay.", m["gang_parked"])
+    counter("gang_released_groups_total", "PodGroups released as atomic all-or-nothing waves.", m["gang_released_groups"])
+    counter("gang_released_pods_total", "Gang members bound through atomic release waves.", m["gang_released_pods"])
+    counter("gang_kernel_dispatches_total", "Gang-kernel verdict dispatches (one per replay window, not per group).", m["gang_kernel_dispatches"])
+    counter("gang_kernel_seconds_total", "Cumulative gang-kernel wall.", round(m["gang_kernel_s"], 6))
+    counter("gang_verdict_mismatch_total", "Device-vs-host gang verdict disagreements (nonzero = bug).", m["gang_verdict_mismatch"])
+    for reason, n in sorted(m["gang_fallbacks"].items()):
+        counter(
+            "gang_fallbacks_total",
+            "Gang rounds that took the sequential Coscheduling oracle, by reason.",
+            n,
+            {"reason": reason},
+        )
+    if not m["gang_fallbacks"]:
+        counter(
+            "gang_fallbacks_total",
+            "Gang rounds that took the sequential Coscheduling oracle, by reason.",
+            0,
+            {"reason": "none"},
+        )
+    # Permit wait machinery (waiting-pod map)
+    counter("waiting_pods", "Pods parked at Permit holding a reservation.", m["waiting_pods"], typ="gauge")
+    counter("permit_wait_expired_total", "Permit waits rejected on deadline expiry.", m["permit_wait_expired"])
     # incremental encoder + device-resident problem (delta re-encode
     # across waves — ops/encode.EncodeCache + ops/batch.DevicePlacer)
     counter("encode_rounds_total", "Encode passes, by mode (full cold encode vs incremental delta).", m["encode_full_total"], {"mode": "full"})

@@ -56,6 +56,7 @@ Obj = dict[str, Any]
 _EXTENDER_RE = re.compile(r"^/api/v1/extender/(filter|prioritize|preempt|bind)/(\d+)$")
 _RESOURCE_RE = re.compile(r"^/api/v1/resources/([a-z]+)(?:/([^/]+))?$")
 _NODEGROUP_RE = re.compile(r"^/api/v1/nodegroups(?:/([^/]+))?$")
+_PODGROUP_RE = re.compile(r"^/api/v1/podgroups(?:/([^/]+))?$")
 
 
 class SimulatorServer:
@@ -273,6 +274,34 @@ def _make_handler(server: SimulatorServer):
                     else:
                         g = di.cluster_store.get("nodegroups", name)
                         self._send_json(200, self._group_with_status(g))
+                elif m := _PODGROUP_RE.match(url.path):
+                    from kube_scheduler_simulator_tpu.gang import group_status
+
+                    name = m.group(1)
+                    ns = (q.get("namespace") or [None])[0]
+                    fw = di.scheduler_service().framework
+                    if name is None:
+                        items = []
+                        for g in di.cluster_store.list("podgroups", ns):
+                            out = dict(g)
+                            out["status"] = group_status(di.cluster_store, fw, g)
+                            items.append(out)
+                        self._send_json(200, {"items": items})
+                    else:
+                        g = di.cluster_store.get("podgroups", name, ns)
+                        out = dict(g)
+                        out["status"] = group_status(di.cluster_store, fw, g)
+                        if (q.get("preview") or [""])[0] in ("1", "true"):
+                            # gang-kernel feasibility + victim-search
+                            # preview (estimation only, jax import lazy)
+                            from kube_scheduler_simulator_tpu.gang.engine import (
+                                group_preview,
+                            )
+
+                            out["status"]["preview"] = group_preview(
+                                di.cluster_store, g
+                            )
+                        self._send_json(200, out)
                 elif url.path == "/api/v1/export":
                     self._send_json(200, di.snapshot_service().snap())
                 elif url.path == "/api/v1/listwatchresources":
@@ -359,6 +388,14 @@ def _make_handler(server: SimulatorServer):
                     body = self._body() or {}
                     validate_node_group(body)
                     self._send_json(201, di.cluster_store.create("nodegroups", body))
+                elif (m := _PODGROUP_RE.match(url.path)) and not m.group(1):
+                    # the dedicated route ADMITS (validates) pod groups —
+                    # the generic resources route stores them raw
+                    from kube_scheduler_simulator_tpu.gang import validate_pod_group
+
+                    body = self._body() or {}
+                    validate_pod_group(body)
+                    self._send_json(201, di.cluster_store.create("podgroups", body))
                 elif m := _RESOURCE_RE.match(url.path):
                     kind = m.group(1)
                     if kind not in KINDS or kind in server.disabled_kinds:
@@ -405,6 +442,12 @@ def _make_handler(server: SimulatorServer):
                     # deleting a group stops future scaling; its nodes stay
                     # (drain them first via scale-down, or delete directly)
                     di.cluster_store.delete("nodegroups", m.group(1))
+                    self._send_empty(200)
+                elif (m := _PODGROUP_RE.match(url.path)) and m.group(1):
+                    # deleting a PodGroup orphans its member pods — they
+                    # fail the PreFilter gate until the group is recreated
+                    ns = (q.get("namespace") or [None])[0]
+                    di.cluster_store.delete("podgroups", m.group(1), ns)
                     self._send_empty(200)
                 elif m := _RESOURCE_RE.match(url.path):
                     kind, name = m.group(1), m.group(2)

@@ -233,6 +233,14 @@ spec:
         memory: 32Gi
         pods: "110"
 """,
+    "podgroups": """metadata:
+  generateName: podgroup-
+  namespace: default
+spec:
+  minMember: 4
+  scheduleTimeoutSeconds: 300
+  topologyPackKey: topology.kubernetes.io/zone
+""",
     "scenarios": """metadata:
   generateName: scenario-
   namespace: default

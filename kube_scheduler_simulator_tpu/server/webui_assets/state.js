@@ -1,4 +1,4 @@
-const KINDS = ["pods","nodes","persistentvolumes","persistentvolumeclaims","storageclasses","priorityclasses","namespaces","deployments","replicasets","scenarios","nodegroups"];
+const KINDS = ["pods","nodes","persistentvolumes","persistentvolumeclaims","storageclasses","priorityclasses","namespaces","deployments","replicasets","scenarios","nodegroups","podgroups"];
 const state = Object.fromEntries(KINDS.map(k=>[k,{}]));
 const dlg = document.getElementById("dlg");
 const key = o => (o.metadata.namespace? o.metadata.namespace+"/" : "") + o.metadata.name;

@@ -30,6 +30,19 @@ const TABLE_COLS = {
                   n=>((n.metadata||{}).labels||{})["scheduler-simulator/nodegroup"]===o.metadata.name).length],
                ["priority", o=>(o.spec||{}).priority||0],
                ["template cpu", o=>{try{return o.spec.template.status.allocatable.cpu}catch(e){return ""}}]],
+  // gang PodGroups: member/bound counts from the LIVE watched pod state
+  // (the poll serves raw stored groups; /api/v1/podgroups adds status)
+  podgroups: [["namespace", o=>(o.metadata||{}).namespace||""], ["name", o=>o.metadata.name],
+              ["minMember", o=>(o.spec||{}).minMember||1],
+              ["members", o=>Object.values(state.pods).filter(
+                 p=>((p.metadata||{}).labels||{})["pod-group.scheduling.sigs.k8s.io"]===o.metadata.name
+                    && ((p.metadata||{}).namespace||"default")===((o.metadata||{}).namespace||"default")).length],
+              ["bound", o=>Object.values(state.pods).filter(
+                 p=>((p.metadata||{}).labels||{})["pod-group.scheduling.sigs.k8s.io"]===o.metadata.name
+                    && ((p.metadata||{}).namespace||"default")===((o.metadata||{}).namespace||"default")
+                    && (p.spec||{}).nodeName).length],
+              ["timeout", o=>(o.spec||{}).scheduleTimeoutSeconds||""],
+              ["packKey", o=>(o.spec||{}).topologyPackKey||""]],
 };
 function renderTables() {
   const root = document.getElementById("tables");

@@ -34,7 +34,7 @@ async function watchLoop() {
 async function pollWorkloads() {
   for (;;) {
     try {
-      for (const k of ["deployments", "replicasets", "scenarios", "nodegroups"]) {
+      for (const k of ["deployments", "replicasets", "scenarios", "nodegroups", "podgroups"]) {
         const lst = await api("GET", `/api/v1/resources/${k}`);
         state[k] = {};
         for (const o of lst.items) state[k][key(o)] = o;

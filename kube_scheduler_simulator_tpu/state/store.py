@@ -72,12 +72,16 @@ KINDS: tuple[str, ...] = (
     # simulated cluster-autoscaler can scale between minSize and maxSize;
     # cluster-scoped, like the real CA's cloud-provider node groups
     "nodegroups",
+    # gang-engine PodGroups (gang/): all-or-nothing co-scheduling units
+    # in the scheduler-plugins coscheduling CRD shape
+    # (scheduling.x-k8s.io/v1alpha1), namespaced like their member pods
+    "podgroups",
 )
 NAMESPACED_KINDS: frozenset[str] = frozenset(
     {
         "pods", "persistentvolumeclaims", "deployments", "replicasets",
         "poddisruptionbudgets", "scenarios", "simulators",
-        "schedulersimulations", "events",
+        "schedulersimulations", "events", "podgroups",
     }
 )
 
@@ -98,6 +102,7 @@ KIND_NAMES: dict[str, str] = {
     "schedulersimulations": "SchedulerSimulation",
     "events": "Event",
     "nodegroups": "NodeGroup",
+    "podgroups": "PodGroup",
 }
 
 EVENT_ADDED = "ADDED"

@@ -16,4 +16,8 @@ if ! timeout -k 10 120 env JAX_PLATFORMS=cpu python tests/wire_client_shim.py --
 # delta-path-engaged assertion — catches EncodeCache invalidation bugs
 # fast, without the slow markers (scripts/encode_smoke.py).
 if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/encode_smoke.py; then rc=1; fi
+# Gang-parity smoke: a training-job churn sweep on the batched gang
+# replay byte-compared against the sequential Coscheduling oracle, with
+# engaged/atomic/batched-dispatch assertions (scripts/gang_smoke.py).
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/gang_smoke.py; then rc=1; fi
 exit $rc

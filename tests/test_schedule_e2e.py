@@ -589,6 +589,130 @@ class TestWaitingPods:
         assert "timeout" in cond["message"]
 
 
+    def test_multi_plugin_shortest_timeout_wins(self):
+        """Two permit plugins waiting: the EARLIEST per-plugin deadline
+        expires the pod (upstream starts one timer per Wait status), at
+        exactly the deadline boundary."""
+        t = [0.0]
+        store = ClusterStore()
+        store.create("nodes", make_node("node-1"))
+        svc = SchedulerService(store, tie_break="first", clock=lambda: t[0])
+        svc.set_out_of_tree_registries(
+            {
+                "GateA": lambda args, handle: self._gate("GateA", 30.0),
+                "GateB": lambda args, handle: self._gate("GateB", 60.0),
+            }
+        )
+        svc.start_scheduler(self._permit_cfg(["GateA", "GateB"]))
+        store.create("pods", make_pod("gated"))
+        svc.schedule_pending(max_rounds=1)
+        wp = svc.framework.get_waiting_pod("default", "gated")
+        assert wp.pending_plugins() == {"GateA", "GateB"}
+        assert wp.earliest_deadline() == 30.0
+        t[0] = 29.999
+        assert svc.process_waiting_pods() == {}
+        t[0] = 30.0
+        assert set(svc.process_waiting_pods()) == {"default/gated"}
+        assert svc.stats["permit_wait_expired"] == 1
+        # allowing ONE of two plugins cancels its timer; the other holds
+        store.create("pods", make_pod("gated2"))
+        t[0] = 100.0
+        svc.schedule_pending(max_rounds=1)
+        svc.allow_waiting_pod("default", "gated2", "GateA")
+        wp2 = svc.framework.get_waiting_pod("default", "gated2")
+        assert wp2.pending_plugins() == {"GateB"}
+        assert wp2.earliest_deadline() == 160.0
+
+    def test_timeout_clamped_to_permit_max(self):
+        """Oversized (and zero) plugin timeouts clamp to the upstream
+        15 min maximum; expiry fires at exactly the clamp boundary."""
+        from kube_scheduler_simulator_tpu.scheduler.framework_runner import (
+            MAX_PERMIT_TIMEOUT_S,
+        )
+
+        t = [0.0]
+        store = ClusterStore()
+        store.create("nodes", make_node("node-1"))
+        svc = SchedulerService(store, tie_break="first", clock=lambda: t[0])
+        svc.set_out_of_tree_registries(
+            {"GateHuge": lambda args, handle: self._gate("GateHuge", 10.0**9)}
+        )
+        svc.start_scheduler(self._permit_cfg(["GateHuge"]))
+        store.create("pods", make_pod("gated"))
+        svc.schedule_pending(max_rounds=1)
+        wp = svc.framework.get_waiting_pod("default", "gated")
+        assert wp.earliest_deadline() == MAX_PERMIT_TIMEOUT_S
+        t[0] = MAX_PERMIT_TIMEOUT_S - 0.001
+        assert svc.process_waiting_pods() == {}
+        t[0] = MAX_PERMIT_TIMEOUT_S
+        assert set(svc.process_waiting_pods()) == {"default/gated"}
+
+    def test_unreserve_runs_for_expired_waiting_pod(self):
+        """Permit expiry rejects through the unreserve chain — reserve
+        plugins see the teardown (upstream rejects via unreservePlugins)."""
+        calls = []
+
+        class Reserver:
+            name = "Reserver"
+
+            def reserve(self, state, pod, node_name):
+                return None
+
+            def unreserve(self, state, pod, node_name):
+                calls.append((pod["metadata"]["name"], node_name))
+
+        t = [0.0]
+        store = ClusterStore()
+        store.create("nodes", make_node("node-1"))
+        svc = SchedulerService(store, tie_break="first", clock=lambda: t[0])
+        svc.set_out_of_tree_registries(
+            {
+                "GateC": lambda args, handle: self._gate("GateC", 60.0),
+                "Reserver": lambda args, handle: Reserver(),
+            }
+        )
+        svc.start_scheduler(self._permit_cfg(["GateC", "Reserver"]))
+        store.create("pods", make_pod("gated"))
+        svc.schedule_pending(max_rounds=1)
+        assert calls == []
+        t[0] = 60.0
+        svc.process_waiting_pods()
+        assert calls == [("gated", "node-1")]
+
+    @staticmethod
+    def _gate(name, timeout):
+        from kube_scheduler_simulator_tpu.models.framework import Status
+
+        class Gate:
+            def permit(self, state, pod, node_name):
+                return Status.wait("gated"), timeout
+
+        g = Gate()
+        g.name = name
+        return g
+
+    @staticmethod
+    def _permit_cfg(extra):
+        return {
+            "profiles": [
+                {
+                    "schedulerName": "default-scheduler",
+                    "plugins": {
+                        "multiPoint": {
+                            "enabled": [
+                                {"name": "PrioritySort"},
+                                {"name": "NodeResourcesFit"},
+                                *({"name": n} for n in extra),
+                                {"name": "DefaultBinder"},
+                            ],
+                            "disabled": [{"name": "*"}],
+                        }
+                    },
+                }
+            ],
+            "percentageOfNodesToScore": 100,
+        }
+
     def test_waiting_pod_holds_its_reservation(self):
         """A parked pod's capacity must stay reserved (upstream keeps
         assumed pods in the cache until bound) — another pod must not

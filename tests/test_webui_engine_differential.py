@@ -34,7 +34,7 @@ from kube_scheduler_simulator_tpu.utils.jseval import UNDEF, _native, to_str
 KINDS = [
     "pods", "nodes", "persistentvolumes", "persistentvolumeclaims",
     "storageclasses", "priorityclasses", "namespaces", "deployments",
-    "replicasets", "scenarios", "nodegroups",
+    "replicasets", "scenarios", "nodegroups", "podgroups",
 ]
 
 

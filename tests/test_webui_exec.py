@@ -22,7 +22,7 @@ from kube_scheduler_simulator_tpu.utils.jseval import ThrowSig
 KINDS = [
     "pods", "nodes", "persistentvolumes", "persistentvolumeclaims",
     "storageclasses", "priorityclasses", "namespaces", "deployments",
-    "replicasets", "scenarios", "nodegroups",
+    "replicasets", "scenarios", "nodegroups", "podgroups",
 ]
 
 
