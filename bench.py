@@ -862,17 +862,12 @@ def run_encode_report(P_total=2400, N=600, waves=4, seed_bound=4200, runs=3):
         else:
             os.environ["KSS_ENCODE_INCREMENTAL"] = prev
 
-    def dump(store):
-        out = {}
-        for p in store.list("pods", copy_objects=False):
-            k = p["metadata"].get("namespace", "default") + "/" + p["metadata"]["name"]
-            out[k] = (
-                (p.get("spec") or {}).get("nodeName"),
-                tuple(sorted((p["metadata"].get("annotations") or {}).items())),
-            )
-        return out
+    from kube_scheduler_simulator_tpu.utils.parity import pod_parity_state
 
-    da, db = dump(full_store), dump(inc_store)
+    # include_conditions=False: the encode report's recorded surface
+    # (bindings + annotations) — the stream report compares conditions too
+    da = pod_parity_state(full_store, include_conditions=False)
+    db = pod_parity_state(inc_store, include_conditions=False)
     mismatches = sum(1 for k in set(da) | set(db) if da.get(k) != db.get(k))
     f_enc, i_enc = full_row["wave_encode_s"], inc_row["wave_encode_s"]
     # wave 1 is the cold prime for both modes; waves 2+ are the
@@ -903,6 +898,195 @@ def run_encode_report(P_total=2400, N=600, waves=4, seed_bound=4200, runs=3):
         "parity_note": (
             "annotations+bindings byte-compared between the full-encode and "
             "incremental final stores over the full population"
+        ),
+    }
+
+
+def run_stream_report(
+    N=600, per_tick=100, ticks=320, seed_bound=6000, runs=2, quick=False
+):
+    """cfg9-stream: sustained throughput over a continuous churn stream —
+    the streaming wave pipeline (scheduler/stream.py) vs the pre-existing
+    sequential round loop (``schedule_pending`` per arrival tick), min-of-N
+    walls per mode, final stores byte-compared — the ISSUE 7 acceptance row.
+
+    The workload is the steady-state shape the streamed path is judged on:
+    a standing bound population of ``seed_bound`` pods with ``per_tick``
+    arrivals AND ``per_tick`` deletions of settled bound pods every tick
+    (a live cluster churns at the margin of a large bound set), so every
+    wave is unchanged-majority for the delta encoder and the executable
+    shapes stay cached.  Each mode primes one tick first (compile + cold
+    encode — identical fixed costs the sustained number must not dilute),
+    then times the ``ticks``-tick stream; at the default sizing the
+    streamed run sustains ≥60 s of wall.  Three modes:
+
+    - ``sequential``: feed one tick, drain it with ``schedule_pending``,
+      repeat — the repo's round-oriented path (snapshot freeze per round).
+    - ``stream_off``: the StreamSession admission loop with the overlap
+      disabled — isolates the structural win (no per-round snapshot) from
+      the overlap win.
+    - ``streamed``: the full pipeline — wave k+1's encode/upload/dispatch
+      overlapping wave k's in-flight kernel and commit.
+
+    All three replay the SAME deterministic tick feed, so the final
+    stores must match byte-for-byte (bindings + annotations + conditions);
+    deletions only touch pods settled ≥2 ticks, which both pipeline
+    phases have committed."""
+    import collections
+
+    import jax
+
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+    if quick:
+        ticks, seed_bound = 24, 1500
+
+    def stamp(p, i):
+        p["metadata"]["creationTimestamp"] = (
+            f"2024-03-01T{i // 3600 % 24:02d}:{i // 60 % 60:02d}:{i % 60:02d}Z"
+        )
+        return p
+
+    def build():
+        rng = random.Random(7)
+        store = ClusterStore(clock=lambda: 1700000000.0)
+        for i in range(N):
+            store.create("nodes", mk_node(i))
+        settled = collections.deque()
+        for i in range(seed_bound):
+            p = stamp(mk_pod(1_000_000 + i, rng, spread=i % 3 == 0), i)
+            p["metadata"]["name"] = f"seed-{i}"
+            p["spec"]["nodeName"] = f"node-{i % N}"
+            store.create("pods", p)
+            settled.append(f"seed-{i}")
+        svc = SchedulerService(store, tie_break="first", use_batch="force")
+        svc.start_scheduler(None)
+        return svc, store, settled
+
+    def steady_feed(store, settled, n_ticks, start):
+        """``n_ticks`` of churn: per_tick deterministic arrivals plus
+        per_tick deletions of pods settled ≥2 ticks (committed in every
+        mode by then — a streamed feed runs one commit earlier than the
+        round loop)."""
+        rng = random.Random(11 + start)
+        state = {"created": start}
+
+        def feed(tick: int) -> bool:
+            if tick >= n_ticks:
+                return False
+            fresh = []
+            for _ in range(per_tick):
+                i = state["created"]
+                state["created"] += 1
+                store.create(
+                    "pods", stamp(mk_pod(i, rng, spread=i % 3 == 0), seed_bound + i)
+                )
+                fresh.append(f"pod-{i}")
+            for _ in range(min(per_tick, max(0, len(settled) - 2 * per_tick))):
+                nm = settled.popleft()
+                try:
+                    store.delete("pods", nm, "default")
+                except KeyError:
+                    pass
+            settled.extend(fresh)
+            return True
+
+        return feed
+
+    def run_mode(mode: str):
+        svc, store, settled = build()
+        # prime tick: compile + cold encode through the mode's own path
+        if mode == "sequential":
+            f = steady_feed(store, settled, 1, 0)
+            f(0)
+            svc.schedule_pending()
+        else:
+            svc.schedule_stream(
+                feed=steady_feed(store, settled, 1, 0),
+                streaming=(mode == "streamed"),
+            )
+        t0 = time.perf_counter()
+        if mode == "sequential":
+            feed = steady_feed(store, settled, ticks, per_tick)
+            tick, alive, results = 0, True, {}
+            while alive:
+                alive = feed(tick)
+                tick += 1
+                results.update(svc.schedule_pending())
+        else:
+            results = svc.schedule_stream(
+                feed=steady_feed(store, settled, ticks, per_tick),
+                streaming=(mode == "streamed"),
+            )
+        wall = time.perf_counter() - t0
+        ok = sum(1 for r in results.values() if r.success)
+        return wall, ok, svc.metrics(), store
+
+    from kube_scheduler_simulator_tpu.utils.parity import pod_parity_state as dump
+
+    rows: dict = {}
+    stores: dict = {}
+    metrics: dict = {}
+    for mode in ("sequential", "stream_off", "streamed"):
+        for _ in range(runs):
+            wall, ok, m, store = run_mode(mode)
+            rows.setdefault(mode, []).append((wall, ok))
+            # keep the store/metrics of the MIN-WALL run so the
+            # published overlap/stall/efficiency describe the same
+            # execution the headline speedup is computed from (the
+            # stores are interchangeable — the feed is deterministic)
+            if wall == min(w for w, _ in rows[mode]):
+                stores[mode] = store
+                metrics[mode] = m
+
+    walls = {mode: min(w for w, _ in rs) for mode, rs in rows.items()}
+    scheduled = {mode: rs[0][1] for mode, rs in rows.items()}
+    m1 = metrics["streamed"]
+    boundary = m1["stream_overlap_s"] + m1["stream_stall_s"]
+    dumps = {mode: dump(s) for mode, s in stores.items()}
+    keys = set().union(*(d.keys() for d in dumps.values()))
+
+    def mismatches(a, b):
+        return sum(1 for k in keys if dumps[a].get(k) != dumps[b].get(k))
+
+    return {
+        "config": "cfg9-stream",
+        "kernel_platform": jax.default_backend(),
+        "nodes": N,
+        "seed_bound": seed_bound,
+        "per_tick": per_tick,
+        "ticks": ticks,
+        "runs_per_mode": runs,
+        "scheduled": scheduled["streamed"],
+        "wall_s_sequential": round(walls["sequential"], 2),
+        "wall_s_stream_off": round(walls["stream_off"], 2),
+        "wall_s_streamed": round(walls["streamed"], 2),
+        # sustained service throughput, prime/compile excluded
+        "pods_per_s_sequential": round(scheduled["sequential"] / walls["sequential"], 1),
+        "pods_per_s_stream_off": round(scheduled["stream_off"] / walls["stream_off"], 1),
+        "pods_per_s_streamed": round(scheduled["streamed"] / walls["streamed"], 1),
+        # the acceptance threshold: streamed ≥ 1.3x the sequential round
+        # loop on this unchanged-majority churn stream
+        "stream_speedup_vs_sequential": round(walls["sequential"] / walls["streamed"], 2),
+        "stream_speedup_vs_stream_off": round(walls["stream_off"] / walls["streamed"], 2),
+        "stream_waves_total": m1["stream_waves_total"],
+        "stream_pods_total": m1["stream_pods_total"],
+        "stream_overlap_s": round(m1["stream_overlap_s"], 3),
+        "stream_stall_s": round(m1["stream_stall_s"], 3),
+        # fraction of the streamed pipeline's wave-boundary host time
+        # spent on hidden work (encode/commit under an in-flight kernel)
+        # rather than blocked on the device
+        "overlap_efficiency": round(m1["stream_overlap_s"] / boundary, 3) if boundary > 0 else 0.0,
+        "stream_drains_by_reason": m1["stream_drains_by_reason"],
+        "encode_delta_total": m1["encode_delta_total"],
+        "parity_pods_compared": len(keys),
+        "parity_mismatches_streamed_vs_sequential": mismatches("streamed", "sequential"),
+        "parity_mismatches_stream_off_vs_sequential": mismatches("stream_off", "sequential"),
+        "parity_note": (
+            "bindings+annotations+conditions byte-compared across the three "
+            "modes' final stores over the full population (same deterministic "
+            "tick feed)"
         ),
     }
 
@@ -1228,7 +1412,20 @@ def main() -> None:
         action="store_true",
         help="run cfg8-gang (training-job churn on the gang engine) and write BENCH_gang.json",
     )
+    ap.add_argument(
+        "--stream-report",
+        action="store_true",
+        help="run cfg9-stream (streamed vs sequential sustained churn throughput) and write BENCH_stream.json",
+    )
     args = ap.parse_args()
+
+    if args.stream_report:
+        rows = [run_stream_report(quick=args.quick)]
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_stream.json")
+        with open(path, "w") as f:
+            json.dump(rows, f, indent=1)
+        print(json.dumps(rows, indent=1))
+        return
 
     if args.gang_report:
         if args.quick:

@@ -588,6 +588,29 @@ _scatter_donate = jax.jit(_scatter_rows, donate_argnums=(0,))
 _scatter_copy = jax.jit(_scatter_rows)
 
 
+def placer_scatter_frac(default: float = 0.25) -> float:
+    """The placer's ≤frac-changed scatter-update threshold, from the
+    ``KSS_PLACER_SCATTER_FRAC`` env knob (default 0.25 — ship row deltas
+    as a jitted scatter while at most a quarter of the plane's rows
+    changed, full re-upload past that).  Validated hard: an unparseable
+    or out-of-range value raises instead of silently running with a
+    threshold the operator didn't set."""
+    import os
+
+    raw = os.environ.get("KSS_PLACER_SCATTER_FRAC")
+    if raw is None or not raw.strip():
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"KSS_PLACER_SCATTER_FRAC must be a float in (0, 1], got {raw!r}"
+        ) from None
+    if not 0.0 < v <= 1.0:
+        raise ValueError(f"KSS_PLACER_SCATTER_FRAC must be in (0, 1], got {raw!r}")
+    return v
+
+
 class DevicePlacer:
     """Device-resident DeviceProblem: delta uploads across rounds.
 
@@ -617,14 +640,29 @@ class DevicePlacer:
     ``bytes_uploaded`` counts actual H2D traffic (full planes + scatter
     indices/rows); ``plane_reuses``/``scatter_updates``/``full_uploads``
     break the decisions out for /metrics.
+
+    ``place(..., bank=)`` selects one of several independent resident
+    plane SETS per shape key — the streaming pipeline's double buffer.
+    A streamed round k+1 places into the bank wave k-1 used (the banks
+    alternate per wave), so its scatter-updates never donate a buffer
+    wave k's still-in-flight kernel reads; the bank's host arrays are
+    one wave staler, which on a churn workload still leaves the large
+    majority of planes byte-identical.  Bank 0 with no alternation is
+    the pre-streaming behavior, unchanged.
+
+    ``scatter_max_frac`` defaults from the ``KSS_PLACER_SCATTER_FRAC``
+    env knob (see :func:`placer_scatter_frac`); an explicit argument
+    wins.
     """
 
     def __init__(self, mesh=None, axis_name: str = "nodes", max_keys: int = 2,
-                 scatter_max_frac: float = 0.25):
+                 scatter_max_frac: "float | None" = None):
         self.mesh = mesh
         self.axis_name = axis_name
         self.max_keys = max_keys
-        self.scatter_max_frac = scatter_max_frac
+        self.scatter_max_frac = (
+            placer_scatter_frac() if scatter_max_frac is None else scatter_max_frac
+        )
         self.bytes_uploaded = 0
         self.plane_reuses = 0
         self.scatter_updates = 0
@@ -633,16 +671,25 @@ class DevicePlacer:
         self._cache: "dict[Any, dict]" = {}
         self._order: list = []
 
-    def _entry(self, key) -> dict:
-        entry = self._cache.get(key)
-        if entry is None:
-            entry = self._cache[key] = {}
+    def _entry(self, key, bank: int = 0) -> dict:
+        """The resident plane dict for ``(key, bank)``.  The LRU budget
+        (``max_keys``) counts distinct SHAPE keys — banks nest under
+        their key and are evicted with it — so a non-streaming engine
+        (bank 0 only) retains exactly as many plane sets as before
+        streaming existed, and memory grows with banks only when the
+        pipeline actually alternates them."""
+        banks = self._cache.get(key)
+        if banks is None:
+            banks = self._cache[key] = {}
             self._order.append(key)
             while len(self._order) > self.max_keys:
                 self._cache.pop(self._order.pop(0), None)
         else:
             self._order.remove(key)
             self._order.append(key)
+        entry = banks.get(bank)
+        if entry is None:
+            entry = banks[bank] = {}
         return entry
 
     def _scatter(self, cached_dev, idx, rows):
@@ -670,9 +717,11 @@ class DevicePlacer:
         self.scatter_updates += 1
         return out
 
-    def place(self, dp: "DeviceProblem", key) -> "DeviceProblem":
-        """Place ``dp`` on device, reusing/delta-updating resident planes."""
-        entry = self._entry(key)
+    def place(self, dp: "DeviceProblem", key, bank: int = 0) -> "DeviceProblem":
+        """Place ``dp`` on device, reusing/delta-updating resident planes.
+        ``bank`` selects the resident plane set (double-buffer lane) —
+        diffs and scatter-donations only ever touch that bank's buffers."""
+        entry = self._entry(key, int(bank))
         out: dict[str, Any] = {}
         uploads: dict = {}      # (field, sub) → host value (one device_put)
         scatters: list = []     # ((field, sub), cached_dev, idx, rows)

@@ -1466,12 +1466,23 @@ class EncodeCache:
     """
 
     def __init__(self, max_class_stale_factor: int = 4):
+        import threading
+
         self.stats = {
             "encode_full_total": 0,
             "encode_delta_total": 0,
             "encode_rows_reencoded_total": 0,
             "encode_fallbacks_by_reason": {},
         }
+        # Serializes every encode() against every other encode(): the
+        # streaming pipeline runs the diff off the commit thread (wave
+        # k+1's encode while wave k commits), and the
+        # fingerprint tables (bound/cls_index/node_cls_counts/...) are
+        # read-modify-write state — two interleaved _apply_bound_delta
+        # passes double-apply entries and corrupt the aggregates
+        # (tests/test_stream.py pins mutual exclusion + a churn stress).
+        # RLock: the seeded encode() call re-enters cache methods.
+        self._lock = threading.RLock()
         self._primed = False
         self._max_stale = max_class_stale_factor
         # request parsing memo (containers/initContainers/overhead sig →
@@ -1523,7 +1534,44 @@ class EncodeCache:
         a bound pod holding inter-pod affinity — therefore costs the
         cold encode plus a cheap fingerprint diff per wave, and the first
         wave after the gate clears goes straight back to the delta path.
+
+        Thread safety: the whole pass (gates, bound diff, seeded/cold
+        encode) holds ``self._lock`` — concurrent callers (a streaming
+        prep thread racing a sequential drain, or two profile rounds)
+        serialize instead of interleaving read-modify-write passes over
+        the fingerprint tables.
         """
+        with self._lock:
+            return self._encode_locked(
+                nodes, all_pods, pending, namespaces,
+                hard_pod_affinity_weight, added_affinity, volumes, nominated,
+            )
+
+    def stats_snapshot(self) -> dict:
+        """A copy of the counters, readable while an encode is in
+        flight: the top-level keys are fixed at construction (values
+        only ever replaced, ints atomically under the GIL) and the
+        fallback-reason dict is published copy-on-write (never mutated
+        in place), so the metrics scrape thread never queues behind a
+        multi-second cold encode holding the encode lock.  Monotone
+        counters may be one in-flight encode apart from each other —
+        fine for a scrape, which only needs each counter individually
+        intact."""
+        return {
+            k: (dict(v) if isinstance(v, dict) else v) for k, v in self.stats.items()
+        }
+
+    def _encode_locked(
+        self,
+        nodes: list[Obj],
+        all_pods: list[Obj],
+        pending: list[Obj],
+        namespaces: "list[Obj] | None",
+        hard_pod_affinity_weight: int,
+        added_affinity: "Obj | None",
+        volumes: "dict[str, list[Obj]] | None",
+        nominated: "list[tuple[Obj, str]] | None",
+    ) -> BatchProblem:
         self._trim_memos()
         state_reason = self._state_gate(nodes, hard_pod_affinity_weight, added_affinity)
         workload_reason = None
@@ -1545,7 +1593,10 @@ class EncodeCache:
             return pr
         fb = self.stats["encode_fallbacks_by_reason"]
         reason = state_reason or workload_reason
-        fb[reason] = fb.get(reason, 0) + 1
+        # copy-on-write publish: stats_snapshot() reads this dict
+        # WITHOUT the encode lock, so the published value is never
+        # mutated in place
+        self.stats["encode_fallbacks_by_reason"] = {**fb, reason: fb.get(reason, 0) + 1}
         ni = None
         if state_reason is not None:
             # prime FIRST (emptying any stale row caches), then let the

@@ -1236,10 +1236,14 @@ class BatchEngine:
         start_index: int,
         volumes: "dict[str, list[Obj]] | None",
         nominated: "list[tuple[Obj, str]] | None" = None,
+        bank: int = 0,
     ) -> dict:
         """Encode + pad + lower + place a round's problem; shared by the
-        one-dispatch path (``_schedule``) and the pipelined windowed path
-        (``schedule_waves``)."""
+        one-dispatch path (``_schedule``), the pipelined windowed path
+        (``schedule_waves``) and the streaming pipeline
+        (``schedule_async``).  ``bank`` selects the DevicePlacer's
+        resident plane set — streamed rounds alternate banks so a wave's
+        uploads never touch buffers the in-flight wave still reads."""
         from kube_scheduler_simulator_tpu.scheduler.framework_runner import (
             num_feasible_nodes_to_find,
         )
@@ -1309,7 +1313,7 @@ class BatchEngine:
             # and unsharded alike), changed planes batch into one
             # device_put — keyed by the same static shape key as the
             # compiled executables
-            dp = self._placer.place(dp, key[0])
+            dp = self._placer.place(dp, key[0], bank=bank)
         elif self.mesh is not None:
             # multi-chip: shard the node axis over the mesh; the jitted
             # computation picks the shardings up from the placed arrays
@@ -1392,7 +1396,7 @@ class BatchEngine:
         the cache is disabled, with full encodes still counted) — the
         service aggregates these across profile engines for /metrics."""
         if self.encode_cache is not None:
-            s = {k: (dict(v) if isinstance(v, dict) else v) for k, v in self.encode_cache.stats.items()}
+            s = self.encode_cache.stats_snapshot()
         else:
             # a deliberately disabled cache is not a gate fallback — full
             # encodes show in the mode counter only, and the fallback
@@ -1588,6 +1592,44 @@ class BatchEngine:
         )
         return BatchResult(self, ctx["pending"], out, pr, ctx["nodes"])
 
+    def schedule_async(
+        self,
+        nodes: list[Obj],
+        all_pods: list[Obj],
+        pending: list[Obj],
+        namespaces: "list[Obj] | None" = None,
+        base_counter: int = 0,
+        start_index: int = 0,
+        volumes: "dict[str, list[Obj]] | None" = None,
+        nominated: "list[tuple[Obj, str]] | None" = None,
+        bank: int = 0,
+    ) -> "PendingBatch":
+        """Dispatch one batch pass WITHOUT blocking on its results — the
+        streaming pipeline's producer (scheduler/stream.py): wave k+1's
+        encode, upload and kernel dispatch all run while wave k's commit
+        is still forming on the host.  Same envelope as the other trace
+        paths (single-device trace rounds); shares the one-dispatch
+        executable cache with plain ``schedule()`` rounds.  The returned
+        :class:`PendingBatch` is consumed in two blocking steps:
+        ``decisions()`` (tiny packed fetch, compaction dispatched), then
+        ``result()`` (trace blob fetch + reconstruction)."""
+        assert self.trace and self.mesh is None, (
+            "streamed rounds are single-device trace rounds"
+        )
+        ctx = self._prep(
+            nodes, all_pods, pending, namespaces, base_counter, start_index,
+            volumes, nominated, bank=bank,
+        )
+        t2 = time.perf_counter()
+        key = ctx["key"]
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            fn = B.build_batch_fn(ctx["cfg"], ctx["dims"], donate=True, ws0=ctx["ws0"])
+            self._fn_cache[key] = fn
+            self.compiles += 1
+        out_dev = fn(ctx.pop("dp"))
+        return PendingBatch(self, ctx, out_dev, t2)
+
     # ----------------------------------------------------- trace helpers
 
     def filter_message(self, result: BatchResult, i: int, n: int, plugin: str, code: int) -> str:
@@ -1618,3 +1660,100 @@ class BatchEngine:
         # affinity plays no role there).
         result, _status = na.NodeAffinity(None).pre_filter(CycleState(), pod)
         return None if result is None else result.node_names
+
+
+class PendingBatch:
+    """One DISPATCHED batch round whose results haven't been fetched —
+    the streaming pipeline's in-flight unit (``BatchEngine.schedule_async``).
+
+    Two blocking steps, deliberately split so the stream can interleave
+    host and device work:
+
+    - ``decisions()`` blocks on the scan's packed per-pod outputs (one
+      tiny [5,P] int32 fetch) and then dispatches the trace compaction
+      asynchronously — the caller learns every node selection and the
+      round's ``final_start`` while the compaction (and any wave
+      dispatched after it) queues on the device.  Everything the NEXT
+      wave's encode needs (which pods bound where, the rotation start,
+      the attempt-counter advance) is known here, before a single
+      annotation byte is formatted.
+    - ``result()`` blocks on the compaction blob, reconstructs the
+      compact trace and returns the :class:`BatchResult` the commit path
+      formats — typically called while the next wave's kernel is already
+      in flight.
+
+    The device wait the host actually PAID (both blocking points) lands
+    in the engine's round timings at ``result()`` time, so streamed
+    rounds report ``device_s`` with hidden windows excluded, exactly
+    like ``schedule_waves``."""
+
+    def __init__(self, engine: "BatchEngine", ctx: dict, out_dev: dict, t2: float):
+        self._eng = engine
+        self._ctx = ctx
+        self._out_dev: "dict | None" = out_dev
+        self._t2 = t2
+        self._dev_wait = 0.0
+        self._out: "dict | None" = None
+        self._blob = None
+        self._result: "BatchResult | None" = None
+        self.pending: list[Obj] = ctx["pending"]
+
+    def decisions(self) -> dict:
+        """Packed per-pod outputs (selected/feasible_count/sample_*/
+        final_start), blocking on the scan only; the trace compaction is
+        dispatched (not fetched) before returning."""
+        if self._out is None:
+            assert self._out_dev is not None
+            tw = time.perf_counter()
+            packed = np.asarray(self._out_dev["packed_pod"])
+            self._dev_wait += time.perf_counter() - tw
+            ctx = self._ctx
+            self._out = self._eng._packed_out(packed)
+            self._blob, self._manifest, self._raw_dtypes, self._WS = (
+                self._eng._compact_dispatch(
+                    ctx["cfg"], ctx["dims"], ctx["key"], ctx["ws0"],
+                    self._out_dev, packed, ctx["pr"].N_true,
+                )
+            )
+        return self._out
+
+    @property
+    def selected(self) -> "np.ndarray":
+        return np.asarray(self.decisions()["selected"])
+
+    @property
+    def final_start(self) -> int:
+        return int(np.asarray(self.decisions()["final_start"]))
+
+    @property
+    def node_names(self) -> list[str]:
+        return self._ctx["pr"].node_names
+
+    def result(self) -> BatchResult:
+        """Fetch the compacted trace and build the BatchResult (blocks)."""
+        if self._result is None:
+            out = dict(self.decisions())
+            eng, ctx = self._eng, self._ctx
+            tw = time.perf_counter()
+            fetched = B.unpack_compact_blob(np.asarray(self._blob), self._manifest)
+            self._dev_wait += time.perf_counter() - tw
+            out["trace"] = B.reconstruct_trace(
+                ctx["cfg"], fetched, out["sample_start"], out["sample_processed"],
+                ctx["pr"].N_true, out["feasible_count"], self._raw_dtypes,
+                len(ctx["pending"]), self._WS,
+            )
+            t3 = time.perf_counter()
+            eng._note_round(
+                {
+                    "encode_s": ctx["t1"] - ctx["t0"],
+                    "lower_s": self._t2 - ctx["t1"],
+                    # blocked device wait only — device time hidden under
+                    # host work never shows up here
+                    "device_s": self._dev_wait,
+                    "total_s": t3 - ctx["t0"],
+                }
+            )
+            self._result = BatchResult(eng, ctx["pending"], out, ctx["pr"], ctx["nodes"])
+            self._out_dev = None  # release the round's device references
+            self._blob = None
+        return self._result

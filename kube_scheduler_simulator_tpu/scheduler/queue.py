@@ -212,6 +212,14 @@ class SchedulingQueue:
                     out.add(key)
         return out
 
+    def has_unschedulable(self) -> bool:
+        """Any pod parked in unschedulableQ right now?  O(1) — the
+        streaming pipeline's overlap gate polls this at every wave
+        boundary (a parked pod could be reactivated by the in-flight
+        wave's commit events, so the boundary must serialize)."""
+        with self._lock:
+            return self._unschedulable > 0
+
     def next_wakeup_in(self) -> "float | None":
         """Seconds until the earliest backoff expiry (None = nothing
         waiting) — the background loop's sleep bound."""

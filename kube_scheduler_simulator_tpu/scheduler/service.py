@@ -195,6 +195,16 @@ class SchedulerService:
             # permit waits that expired (deadline passed) and were
             # rejected by process_waiting_pods
             "permit_wait_expired": 0,
+            # streaming wave pipeline (scheduler/stream.py): waves
+            # committed through the overlapped path, host seconds spent
+            # while a kernel was in flight (overlap) vs blocked waiting
+            # on the device (stall), and the exactness fallbacks that
+            # drained the pipeline to the sequential path, by reason
+            "stream_waves": 0,
+            "stream_pods": 0,
+            "stream_overlap_s": 0.0,
+            "stream_stall_s": 0.0,
+            "stream_drains": {},
         }
         # guards batch_fallbacks against the metrics scrape thread
         self._stats_lock = threading.Lock()
@@ -606,6 +616,38 @@ class SchedulerService:
             if gc_was_enabled:
                 gc.enable()
         return results
+
+    def schedule_stream(
+        self,
+        feed: "Callable[[int], bool] | None" = None,
+        duration_s: "float | None" = None,
+        max_waves: "int | None" = None,
+        wave_pods: "int | None" = None,
+        streaming: "bool | None" = None,
+        idle_sleep_s: float = 0.002,
+    ) -> dict[str, ScheduleResult]:
+        """Continuous streaming drain (scheduler/stream.py): a wave
+        pipeline where wave k+1's encode/upload/dispatch overlaps wave
+        k's in-flight kernel and host commit, fed by an admission queue
+        drained fresh every wave instead of a frozen per-round pending
+        snapshot.  Commit order and bytes are identical to the serial
+        path; out-of-envelope waves (gang, nominations, preemption,
+        node/config changes, unsupported workloads) drain to
+        ``schedule_pending`` and are counted in
+        ``stream_drains_by_reason``.  ``streaming=None`` resolves the
+        ``KSS_STREAM_PIPELINE`` knob (default on); False keeps the same
+        admission loop strictly serial (the bench's A/B baseline)."""
+        from kube_scheduler_simulator_tpu.scheduler.stream import StreamSession
+
+        return StreamSession(
+            self,
+            feed=feed,
+            duration_s=duration_s,
+            max_waves=max_waves,
+            wave_pods=wave_pods,
+            streaming=streaming,
+            idle_sleep_s=idle_sleep_s,
+        ).run()
 
     def allow_waiting_pod(self, namespace: str, name: str, plugin: str) -> "ScheduleResult | None":
         """Approve a waiting pod on ``plugin``'s behalf; when that was the
@@ -1154,6 +1196,7 @@ class SchedulerService:
             fallbacks = dict(self.stats["batch_fallbacks"])
             preempt_fallbacks = dict(self.stats["preempt_fallbacks"])
             gang_fallbacks = dict(self.stats["gang_fallbacks"])
+            stream_drains = dict(self.stats["stream_drains"])
         last_t = dict(eng.last_timings) if eng else {}
         # the fraction of the last pipelined round's device time hidden
         # under host commits (0 for un-pipelined rounds) — the bench's
@@ -1210,6 +1253,12 @@ class SchedulerService:
             "gang_kernel_s": self.stats["gang_kernel_s"],
             "gang_verdict_mismatch": self.stats["gang_verdict_mismatch"],
             "gang_fallbacks": gang_fallbacks,
+            # streaming wave pipeline (scheduler/stream.py)
+            "stream_waves_total": self.stats["stream_waves"],
+            "stream_pods_total": self.stats["stream_pods"],
+            "stream_overlap_s": self.stats["stream_overlap_s"],
+            "stream_stall_s": self.stats["stream_stall_s"],
+            "stream_drains_by_reason": stream_drains,
             # Permit wait machinery, live (the gauge) and cumulative
             "waiting_pods": len(self._all_waiting_keys()),
             "permit_wait_expired": self.stats["permit_wait_expired"],

@@ -1,0 +1,491 @@
+"""The streaming wave pipeline: overlap wave k+1's encode/upload/dispatch
+with wave k's in-flight kernel and host commit, fed by a continuously
+draining admission queue.
+
+Before this module the batch path was round-oriented: freeze a pending
+snapshot, encode, dispatch, block, commit — host idle while the kernel
+runs, device idle while the host formats annotations.  A StreamSession
+dissolves the round boundary:
+
+- **Admission** drains the scheduling queue fresh at every wave (pods
+  that arrived while the previous wave was in flight join the very next
+  encode) instead of freezing one pending set per round.
+- **Overlap**: as soon as wave k's packed decisions are fetched (a tiny
+  [5,P] int32 read — ``PendingBatch.decisions()``), wave k+1 is admitted,
+  delta-encoded against a synthesized view of the store with wave k's
+  placements applied, uploaded into the *other* DevicePlacer bank, and
+  dispatched.  Wave k's trace fetch, annotation materialization and
+  ``add_wave_results``/``flush_wave`` then run while wave k+1's kernel is
+  in flight.
+- **Exactness**: commit order is strict (wave k commits fully before any
+  of wave k+1), the next wave's ``base_counter``/``start_index`` are the
+  values the sequential path would have reached (every attempted pod
+  advances the counter by one; the rotation start is wave k's
+  ``final_start``), and the synthesized encode view differs from the
+  post-commit store only in fields the encoder ignores (resourceVersion
+  bumps, status conditions, annotations) — so a streamed run's
+  annotation bytes are byte-identical to the serial path's
+  (tests/test_stream.py, scripts/stream_smoke.py).
+
+Anything outside that envelope **drains the pipeline**, counted per
+reason in ``stream_drains_by_reason``.  Most reasons route the wave to
+the sequential path — gang profiles / parked waiting pods ("gang" — a
+GangRound's atomic commit must never interleave with a streamed wave),
+pending preemption nominations, multi-profile rounds, unsupported
+workloads, and kernel failures on profiles whose PostFilter could
+preempt (a successful preemption rewrites cluster state mid-round);
+those waves run through ``SchedulerService.schedule_pending`` — the
+pre-existing exact machinery — and streaming resumes at the next wave.
+Three gates only SERIALIZE the streamed boundary: a mid-stream
+node/config change commits wave k first and re-dispatches the gated
+pods streamed against the settled store; force-mode kernel failures
+stream their commit but hold the next admission until after it (so the
+failed pods' requeue lands on the serial cadence); and a pod parked in
+unschedulableQ holds the overlap admission until wave k's commit has
+fired its events (binds move_all parked pods — an admission taken
+before the commit could miss the reactivation the serial cadence would
+see).  All three still count a drain event — the counter tracks
+pipeline serialization points, not sequential-path rounds.
+
+``KSS_STREAM_PIPELINE=0`` (or ``streaming=False``) keeps the admission
+loop but runs every wave strictly serially — the A/B baseline the bench
+compares against (``bench.py --stream-report``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+from kube_scheduler_simulator_tpu.utils.keys import pod_key as _pod_key
+
+Obj = dict[str, Any]
+
+
+def stream_pipeline_enabled(default: bool = True) -> bool:
+    """Resolve the ``KSS_STREAM_PIPELINE`` env knob ("0"/"off"/"false"/
+    "no" disables the overlap; anything else — including unset — keeps
+    the default)."""
+    import os
+
+    env = os.environ.get("KSS_STREAM_PIPELINE", "").strip().lower()
+    if env in ("0", "off", "false", "no"):
+        return False
+    return default
+
+
+class StreamSession:
+    """One continuous streaming run over a SchedulerService.
+
+    ``feed``: called once per admission tick with the tick index; it may
+    create/delete store objects (the arrival stream) and returns False
+    when the source is exhausted (the session then runs until the queue
+    and the pipeline are empty).  ``duration_s`` bounds the admission
+    phase by wall clock instead (external feeder thread); ``max_waves``
+    bounds the streamed wave count; ``wave_pods`` caps the pods admitted
+    per wave (None = drain everything ready).  ``streaming`` overrides
+    the ``KSS_STREAM_PIPELINE`` knob."""
+
+    def __init__(
+        self,
+        service: Any,
+        feed: "Callable[[int], bool] | None" = None,
+        duration_s: "float | None" = None,
+        max_waves: "int | None" = None,
+        wave_pods: "int | None" = None,
+        streaming: "bool | None" = None,
+        idle_sleep_s: float = 0.002,
+        gc_every_waves: int = 32,
+    ):
+        self.svc = service
+        self.feed = feed
+        self.duration_s = duration_s
+        self.max_waves = max_waves
+        self.wave_pods = wave_pods
+        self.streaming = (
+            stream_pipeline_enabled() if streaming is None else bool(streaming)
+        )
+        self.idle_sleep_s = idle_sleep_s
+        # gc is disabled for the whole session (a collection pause
+        # mid-wave would serialize the pipeline at a random point), but a
+        # long stream allocates continuously and unswept garbage degrades
+        # every allocation — so collect at wave BOUNDARIES, every this
+        # many commits, where the pause overlaps the next wave's
+        # in-flight kernel instead of splitting a wave
+        self.gc_every_waves = gc_every_waves
+        self._commits_since_gc = 0
+        # waves committed by THIS session — ``max_waves`` is a
+        # per-session budget, while stats["stream_waves"] accumulates
+        # over the service's whole lifetime (a second session on the
+        # same service must not inherit the first one's spend)
+        self._session_waves = 0
+        self.results: dict[str, Any] = {}
+        self._feed_alive = feed is not None
+        self._tick = 0
+        self._t0 = 0.0
+        # set when an overlap admission was GATED: its pods were drained
+        # from the queue conceptually but not dispatched — the next
+        # admission re-drains them without consuming a new feed tick, so
+        # wave composition stays aligned with the serial cadence
+        self._feed_hold = False
+
+    # ------------------------------------------------------------- stats
+
+    def _count_drain(self, reason: str) -> None:
+        with self.svc._stats_lock:
+            d = self.svc.stats["stream_drains"]
+            d[reason] = d.get(reason, 0) + 1
+
+    def _note_wave(self, cnt: int) -> None:
+        self._session_waves += 1
+        self.svc.stats["stream_waves"] += 1
+        self.svc.stats["stream_pods"] += cnt
+
+    # --------------------------------------------------------- admission
+
+    def _admitting(self) -> bool:
+        """Is the arrival stream still open?"""
+        if self.duration_s is not None:
+            return time.perf_counter() - self._t0 < self.duration_s
+        return self._feed_alive
+
+    def _admit(self, exclude: "frozenset[str] | set[str]") -> list[Obj]:
+        """One admission tick: pull the feed, expire permits, and drain
+        everything the queue allows minus the in-flight wave."""
+        svc = self.svc
+        if self._feed_hold:
+            # re-draining a gated admission: its feed tick already fired
+            self._feed_hold = False
+        elif self._feed_alive and self.feed is not None and (
+            self.duration_s is None
+            or time.perf_counter() - self._t0 < self.duration_s
+        ):
+            self._feed_alive = bool(self.feed(self._tick))
+            self._tick += 1
+        svc.process_waiting_pods()
+        cands = svc._ready_pending(respect_backoff=False)
+        if exclude:
+            cands = [p for p in cands if _pod_key(p) not in exclude]
+        pending = svc.framework.sort_pods(cands)
+        if self.wave_pods is not None:
+            pending = pending[: self.wave_pods]
+        return pending
+
+    # ------------------------------------------------------------- gates
+
+    def _gate(
+        self, pending: list[Obj], nodes: list[Obj]
+    ) -> "tuple[str | None, dict | None]":
+        """``(reason, volumes)``: why this wave must take the sequential
+        path (reason None = streamable), plus the volume listing the
+        supported() check already paid for — handed to the immediately
+        following dispatch so the store isn't scanned twice per wave.
+        Mirrors _schedule_pending_batch's envelope, but conservatively:
+        a streamed wave must be committable from its trace alone."""
+        svc = self.svc
+        fw = svc.framework
+        if svc.use_batch not in ("auto", "force"):
+            return "batch disabled", None
+        if any(svc.framework_for(p) is not fw for p in pending):
+            return "multi-profile", None
+        # gang profiles park members at Permit and commit whole groups
+        # atomically — a GangRound must never interleave with a streamed
+        # wave's commit, so both the profile shape and any already-parked
+        # waiting pod drain the pipeline
+        if fw.plugins["permit"] or svc._all_waiting_keys():
+            return "gang", None
+        if svc._pending_nominations():
+            return "nominated pods", None
+        eng = svc._engine_for(fw)
+        if eng.mesh is not None or not eng.trace:
+            # schedule_async only speaks single-device trace rounds —
+            # multi-chip (and trace-less estimation engines) take the
+            # pre-existing exact path
+            return "multi-chip", None
+        if (
+            svc.use_batch == "auto"
+            and len(pending) * max(len(nodes), 1) < svc.batch_min_work
+        ):
+            return "below batch_min_work", None
+        volumes = eng._volumes()
+        ok, why = eng.supported(pending, nodes, volumes=volumes)
+        if not ok:
+            return f"unsupported: {why}", None
+        return None, volumes
+
+    @staticmethod
+    def _node_fp(nodes: list[Obj]) -> tuple:
+        return tuple(
+            (n["metadata"]["name"], n["metadata"].get("resourceVersion"))
+            for n in nodes
+        )
+
+    # ---------------------------------------------------------- pipeline
+
+    def _view_pods(self, binds: "dict[str, str]") -> list[Obj]:
+        """The store's pods with the in-flight wave's placements applied
+        as synthesized binds — what the next wave's encode must see.
+        Differs from the post-commit store only in resourceVersion (a
+        pure cache key: the delta encoder re-checks such rows and
+        produces identical values) and status/annotation fields the
+        encoder never reads."""
+        pods = self.svc.cluster_store.list("pods", copy_objects=False)
+        if not binds:
+            return pods
+        out = []
+        for p in pods:
+            nn = binds.get(_pod_key(p))
+            if nn is not None and not (p.get("spec") or {}).get("nodeName"):
+                out.append({**p, "spec": {**(p.get("spec") or {}), "nodeName": nn}})
+            else:
+                out.append(p)
+        return out
+
+    def _dispatch(
+        self,
+        pending: list[Obj],
+        nodes: list[Obj],
+        base_counter: int,
+        start_index: int,
+        bank: int,
+        volumes: "dict | None",
+        binds: "dict[str, str] | None" = None,
+    ) -> dict:
+        """Encode + upload + dispatch one wave (non-blocking); returns
+        the in-flight record the commit step consumes.  ``volumes`` is
+        the listing the gate's supported() check already built."""
+        svc = self.svc
+        fw = svc.framework
+        eng = svc._engine_for(fw)
+        pb = eng.schedule_async(
+            nodes,
+            self._view_pods(binds or {}),
+            pending,
+            svc.cluster_store.list("namespaces", copy_objects=False),
+            base_counter=base_counter,
+            start_index=start_index,
+            volumes=volumes if volumes is not None else eng._volumes(),
+            bank=bank,
+        )
+        return {
+            "pb": pb,
+            "fw": fw,
+            "keys": {_pod_key(p) for p in pending},
+            "node_fp": self._node_fp(nodes),
+        }
+
+    def _seq_failures(self) -> bool:
+        """Would the serial path route kernel failures through PostFilter
+        (preemption)?  Mirrors _run_segment_batch's seq_failures."""
+        fw = self.svc.framework
+        return bool(fw.plugins["post_filter"]) and self.svc.use_batch != "force"
+
+    def _commit(self, flight: dict, overlapped: bool) -> None:
+        """Commit one streamed wave in strict order: trace fetch,
+        annotation materialization, bulk result-store fill, bind +
+        reflector flush — byte-identical to the serial batch round
+        (the commit runs through the very same _replay_window /
+        _commit_batch_wave machinery)."""
+        svc = self.svc
+        fw = flight["fw"]
+        pb = flight["pb"]
+        t0 = time.perf_counter()
+        dev0 = pb._dev_wait
+        result = pb.result()  # blocks on the compaction blob only
+        # seconds of that window spent BLOCKED on the device (the blob
+        # fetch) are a stall, not hidden work — keep them out of the
+        # overlap bucket so overlap_efficiency stays honest
+        dev_wait = pb._dev_wait - dev0
+        svc.stats["stream_stall_s"] += dev_wait
+        cnt = len(pb.pending)
+        point_names = {
+            p: [wp.original.name for wp in fw.plugins[p]]
+            for p in ("pre_filter", "pre_score", "reserve", "permit", "pre_bind", "bind")
+        }
+        restart = svc._replay_window(
+            result, 0, 0, cnt, None, point_names, fw,
+            False,  # kernel failures commit from the trace (gated earlier)
+            self.results, None, None,
+        )
+        assert restart is None, "streamed waves never request kernel restarts"
+        fw.next_start_node_index = result.final_start
+        svc._sync_rotation(fw)
+        svc.stats["batch_commits"] += 1
+        self._note_wave(cnt)
+        dt = time.perf_counter() - t0
+        if overlapped:
+            # host seconds spent while the NEXT wave's kernel was in
+            # flight — the pipeline's hidden work (minus the stalled part)
+            self.svc.stats["stream_overlap_s"] += max(dt - dev_wait, 0.0)
+
+    def _maybe_gc(self) -> None:
+        """Bounded-garbage sweep: a full collection every
+        ``gc_every_waves`` committed waves, always at a wave boundary (a
+        kernel may be in flight — the pause hides in the device shadow;
+        what it must never do is land mid-wave via the allocator)."""
+        self._commits_since_gc += 1
+        if self._commits_since_gc >= self.gc_every_waves:
+            self._commits_since_gc = 0
+            import gc
+
+            gc.collect()
+
+    def _drain_round(self, reason: "str | None") -> None:
+        """Drain the (empty) pipeline to the sequential path: one full
+        pre-existing scheduling round with its exact preemption / gang /
+        nomination machinery, counted per reason."""
+        if reason is not None:
+            self._count_drain(reason)
+        self.results.update(self.svc.schedule_pending(max_rounds=1))
+        self._maybe_gc()
+
+    # --------------------------------------------------------------- run
+
+    def run(self) -> dict[str, Any]:
+        svc = self.svc
+        assert svc.framework is not None, "scheduler not started"
+        import gc
+
+        self._t0 = time.perf_counter()
+        gc_was_enabled = gc.isenabled()
+        if gc_was_enabled:
+            gc.disable()
+        try:
+            self._loop()
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+            svc.reflector.flush_all(
+                svc.cluster_store, skip_keys=svc._all_waiting_keys()
+            )
+        return self.results
+
+    def _waves_left(self, in_flight: int = 0) -> bool:
+        """May another streamed wave be DISPATCHED?  ``in_flight`` counts
+        dispatched-but-uncommitted waves (the overlap prefetch point has
+        one), which the committed-wave counter hasn't seen yet."""
+        return (
+            self.max_waves is None
+            or self._session_waves + in_flight < self.max_waves
+        )
+
+    def _loop(self) -> None:
+        svc = self.svc
+        flight: "dict | None" = None  # the in-flight wave
+        bank = 0
+        while True:
+            if flight is None:
+                # pipeline empty: admit and dispatch without overlap.
+                # The wave budget is checked BEFORE the admission tick —
+                # _admit() pulls the feed (side effects in the store), and
+                # a capped session must not consume a tick it will never
+                # schedule (pause/resume callers would lose one tick of
+                # arrivals).
+                if not self._waves_left():
+                    break
+                pending = self._admit(frozenset())
+                if not pending:
+                    if not self._admitting():
+                        break
+                    time.sleep(self.idle_sleep_s)
+                    continue
+                nodes = svc.cluster_store.list("nodes", copy_objects=False)
+                gate, volumes = self._gate(pending, nodes)
+                if gate is not None:
+                    self._drain_round(gate)
+                    continue
+                fw = svc.framework
+                flight = self._dispatch(
+                    pending, nodes, fw.sched_counter,
+                    fw.next_start_node_index, bank, volumes,
+                )
+                continue
+
+            # a wave is in flight: learn its decisions (tiny fetch)
+            pb = flight["pb"]
+            t0 = time.perf_counter()
+            pb.decisions()
+            svc.stats["stream_stall_s"] += time.perf_counter() - t0
+            n_fail = int((pb.selected[: len(pb.pending)] < 0).sum())
+            if n_fail and self._seq_failures():
+                # a PostFilter could preempt (victim deletes, restarts):
+                # outside the streamable envelope.  Nothing of this wave
+                # has been committed — abandon its device work and hand
+                # the SAME pods to the exact sequential-path round.
+                flight = None
+                self._drain_round("kernel failures (preemption path)")
+                continue
+            if n_fail and self.streaming:
+                # trace-committable failures (force mode / no PostFilter)
+                # still stream their commit, but the BOUNDARY serializes:
+                # a failed pod re-enters the queue at its commit, and the
+                # next admission must observe that requeue exactly when
+                # the serial path would — overlapping it would retry the
+                # pod one wave late.  Commit first, admit after.
+                self._count_drain("kernel failures")
+                self._commit(flight, overlapped=False)
+                flight = None
+                self._maybe_gc()
+                continue
+
+            next_flight: "dict | None" = None
+            if (
+                self.streaming
+                and self._waves_left(in_flight=1)
+                and svc.queue.has_unschedulable()
+            ):
+                # a pod parked in unschedulableQ could be reactivated by
+                # wave k's commit events (binds fire move_all) — the
+                # serial cadence admits it into wave k+1, so an overlap
+                # admission taken BEFORE the commit would miss it and
+                # shift wave composition.  Serialize this boundary:
+                # commit first, admit on the next pipeline-empty pass
+                # (no feed tick is consumed here).
+                self._count_drain("unschedulable requeue")
+            elif self.streaming and self._waves_left(in_flight=1):
+                pending2 = self._admit(flight["keys"])
+                if pending2:
+                    nodes = svc.cluster_store.list("nodes", copy_objects=False)
+                    gate, volumes = self._gate(pending2, nodes)
+                    if gate is None and self._node_fp(nodes) != flight["node_fp"]:
+                        # the cluster changed under the in-flight wave:
+                        # drain the pipeline (commit first, re-encode on
+                        # the settled store) — counted here because the
+                        # re-admission will see a CONSISTENT node set and
+                        # stream normally
+                        gate = "node/config change"
+                        self._count_drain(gate)
+                    if gate is None:
+                        # overlap: wave k+1's encode + upload + dispatch
+                        # runs against wave k's synthesized placements,
+                        # into the other placer bank, with the counters
+                        # the serial path would reach after wave k
+                        sel = pb.selected
+                        binds = {}
+                        for j, p in enumerate(pb.pending):
+                            s = int(sel[j])
+                            if s >= 0:
+                                binds[_pod_key(p)] = pb.node_names[s]
+                        fw = flight["fw"]
+                        t0 = time.perf_counter()
+                        bank ^= 1
+                        next_flight = self._dispatch(
+                            pending2, nodes,
+                            fw.sched_counter + len(pb.pending),
+                            pb.final_start, bank, volumes, binds=binds,
+                        )
+                        svc.stats["stream_overlap_s"] += time.perf_counter() - t0
+                    else:
+                        # gated waves are NOT admitted into the overlap;
+                        # the next pipeline-empty iteration re-drains the
+                        # SAME pods (feed tick held) and routes them —
+                        # through _drain_round for sequential-path gates,
+                        # or a fresh streamed dispatch after a node change
+                        self._feed_hold = True
+
+            # commit wave k — overlapping wave k+1's in-flight kernel
+            # when one was dispatched (serial mode never prefetches, so
+            # the same commit machinery runs un-overlapped)
+            self._commit(flight, overlapped=next_flight is not None)
+            flight = next_flight
+            self._maybe_gc()

@@ -112,6 +112,26 @@ def render_metrics(di: Any) -> str:
             0,
             {"reason": "none"},
         )
+    # streaming wave pipeline (scheduler/stream.py): wave k+1's
+    # encode/upload/dispatch overlapped with wave k's kernel + commit
+    counter("stream_waves_total", "Waves committed through the streaming pipeline's overlapped path.", m["stream_waves_total"])
+    counter("stream_pods_total", "Pods committed by streamed waves.", m["stream_pods_total"])
+    counter("stream_overlap_seconds_total", "Host seconds spent encoding/committing while a streamed kernel was in flight (hidden work).", round(m["stream_overlap_s"], 6))
+    counter("stream_stall_seconds_total", "Host seconds blocked waiting on a streamed wave's device results.", round(m["stream_stall_s"], 6))
+    for reason, n in sorted(m["stream_drains_by_reason"].items()):
+        counter(
+            "stream_drains_total",
+            "Pipeline drains by exactness-gate reason (most reasons route the wave to the sequential path; kernel-failure and node-change gates only serialize the streamed boundary).",
+            n,
+            {"reason": reason},
+        )
+    if not m["stream_drains_by_reason"]:
+        counter(
+            "stream_drains_total",
+            "Pipeline drains by exactness-gate reason (most reasons route the wave to the sequential path; kernel-failure and node-change gates only serialize the streamed boundary).",
+            0,
+            {"reason": "none"},
+        )
     # Permit wait machinery (waiting-pod map)
     counter("waiting_pods", "Pods parked at Permit holding a reservation.", m["waiting_pods"], typ="gauge")
     counter("permit_wait_expired_total", "Permit waits rejected on deadline expiry.", m["permit_wait_expired"])

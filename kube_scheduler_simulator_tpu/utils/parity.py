@@ -1,0 +1,31 @@
+"""The shared byte-parity comparator.
+
+Every harness that byte-compares two scheduler runs (the stream/encode
+bench reports, the stream-parity smoke, the stream test suite) must
+compare the SAME per-pod surface — a comparator copy that drifts (say,
+one of them stops looking at failure conditions) would let a parity
+regression in the uncompared field pass some checks and fail others.
+This module is that single definition.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def pod_parity_state(store: Any, include_conditions: bool = True) -> dict:
+    """Per-pod byte-comparable state over ``store``'s pods: the binding
+    (``spec.nodeName``), the full sorted annotation trail, and — unless
+    ``include_conditions=False`` (the encode report's historical
+    surface) — the failure conditions."""
+    out: dict = {}
+    for p in store.list("pods", copy_objects=False):
+        k = p["metadata"].get("namespace", "default") + "/" + p["metadata"]["name"]
+        row = (
+            (p.get("spec") or {}).get("nodeName"),
+            tuple(sorted((p["metadata"].get("annotations") or {}).items())),
+        )
+        if include_conditions:
+            row += (str((p.get("status") or {}).get("conditions")),)
+        out[k] = row
+    return out

@@ -20,4 +20,8 @@ if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/encode_smoke.py; the
 # replay byte-compared against the sequential Coscheduling oracle, with
 # engaged/atomic/batched-dispatch assertions (scripts/gang_smoke.py).
 if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/gang_smoke.py; then rc=1; fi
+# Stream-parity smoke: the streaming wave pipeline vs the strictly
+# sequential path over a 3-wave churn scenario, byte-compared with
+# engaged/overlapped assertions (scripts/stream_smoke.py).
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/stream_smoke.py; then rc=1; fi
 exit $rc

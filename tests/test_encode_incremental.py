@@ -533,7 +533,7 @@ def test_device_placer_mesh_sharding_preserved():
             assert s1 == s2 and f1 == f2, (wave, i)
         # the resident planes of the mesh engine must STAY sharded over
         # the mesh (a silently-replicated plane would still compute)
-        entry = eng_mesh._placer._cache[next(iter(eng_mesh._placer._cache))]
+        entry = eng_mesh._placer._cache[next(iter(eng_mesh._placer._cache))][0]
         sharded = 0
         for (name, _sub), (_h, dev) in entry.items():
             if name in B.NODE_AXIS_SPECS and getattr(dev, "size", 0):
@@ -580,3 +580,97 @@ def test_engine_restart_snapshot_churn_delta():
         # mid-round churn: victims deleted, a pod bound, tail re-runs
         cl.churn(binds=2, deletes=2, mutates=0, new_pending=2)
     assert eng.encode_cache.stats["encode_delta_total"] >= 2
+
+
+# ----------------------------------------- scatter threshold + banks
+
+def _small_problem():
+    rng = random.Random(8)
+    cl = Cluster(8, rng)
+    cl.pending = [cl.mk_pod() for _ in range(10)]
+    cl.churn(binds=4, deletes=0, mutates=0, new_pending=4)
+    pr = E.encode(cl.nodes, cl.all_pods(), cl.pending, None)
+    pr = E.pad_problem(pr)
+    return B.lower(pr)
+
+
+def test_placer_scatter_frac_env_knob_validated(monkeypatch):
+    """KSS_PLACER_SCATTER_FRAC: parsed + range-checked at construction,
+    default unchanged when unset, explicit argument wins."""
+    import pytest
+
+    monkeypatch.delenv("KSS_PLACER_SCATTER_FRAC", raising=False)
+    assert B.DevicePlacer().scatter_max_frac == 0.25
+    monkeypatch.setenv("KSS_PLACER_SCATTER_FRAC", "0.5")
+    assert B.DevicePlacer().scatter_max_frac == 0.5
+    # explicit argument beats the env
+    assert B.DevicePlacer(scatter_max_frac=0.125).scatter_max_frac == 0.125
+    for bad in ("abc", "0", "-0.1", "1.5"):
+        monkeypatch.setenv("KSS_PLACER_SCATTER_FRAC", bad)
+        with pytest.raises(ValueError):
+            B.DevicePlacer()
+
+
+def test_placer_scatter_frac_both_regimes(monkeypatch):
+    """The same 2-row delta scatters under the default threshold and
+    full-uploads under a tightened KSS_PLACER_SCATTER_FRAC — and the
+    placed planes are correct in BOTH regimes."""
+    dp, dims = _small_problem()
+    key = tuple(sorted(dims.items()))
+
+    def mutate(dp):
+        # flip two rows of an [N]-plane (2/8 = 0.25 of the node axis)
+        arr = np.asarray(dp.node_unsched).copy()
+        arr[1] = ~arr[1]
+        arr[5] = ~arr[5]
+        return dp._replace(node_unsched=arr)
+
+    # default 0.25: 2 changed rows <= int(8 * 0.25) -> scatter path
+    monkeypatch.delenv("KSS_PLACER_SCATTER_FRAC", raising=False)
+    placer = B.DevicePlacer()
+    placer.place(dp, key)
+    d2 = placer.place(mutate(dp), key)
+    assert placer.scatter_updates >= 1
+    assert np.array_equal(np.asarray(d2.node_unsched), np.asarray(mutate(dp).node_unsched))
+
+    # tightened 0.05: int(8 * 0.05) = 0 -> max(1, 0) = 1 < 2 changed
+    # rows -> the SAME delta takes the full-upload path
+    monkeypatch.setenv("KSS_PLACER_SCATTER_FRAC", "0.05")
+    tight = B.DevicePlacer()
+    tight.place(dp, key)
+    before_full = tight.full_uploads
+    d3 = tight.place(mutate(dp), key)
+    assert tight.scatter_updates == 0
+    assert tight.full_uploads > before_full
+    assert np.array_equal(np.asarray(d3.node_unsched), np.asarray(mutate(dp).node_unsched))
+
+    # widened 1.0: even a majority-changed plane scatters
+    monkeypatch.setenv("KSS_PLACER_SCATTER_FRAC", "1.0")
+    wide = B.DevicePlacer()
+    wide.place(dp, key)
+    arr = np.asarray(dp.node_unsched).copy()
+    arr[:6] = ~arr[:6]
+    d4 = wide.place(dp._replace(node_unsched=arr), key)
+    assert wide.scatter_updates >= 1
+    assert np.array_equal(np.asarray(d4.node_unsched), arr)
+
+
+def test_placer_banks_are_independent_plane_sets():
+    """The streaming double buffer: bank 1 never reuses/donates bank 0's
+    resident planes, and each bank diffs against its own last contents."""
+    dp, dims = _small_problem()
+    key = tuple(sorted(dims.items()))
+    placer = B.DevicePlacer()
+    placer.place(dp, key, bank=0)
+    first_bytes = placer.bytes_uploaded
+    assert placer.plane_reuses == 0
+
+    # same problem into the OTHER bank: nothing to reuse there
+    placer.place(dp, key, bank=1)
+    assert placer.plane_reuses == 0
+    assert placer.bytes_uploaded >= 2 * first_bytes * 0.9
+
+    # back to bank 0: full reuse against ITS resident set
+    reuse_before = placer.plane_reuses
+    placer.place(dp, key, bank=0)
+    assert placer.plane_reuses > reuse_before + 20

@@ -1,0 +1,569 @@
+"""Streaming wave pipeline (scheduler/stream.py): overlap + admission
+queue + exactness drains.
+
+The contract under test: a streamed run — wave k+1's encode/upload/
+dispatch overlapped with wave k's in-flight kernel and commit, admission
+drained fresh per wave — produces BYTE-identical bindings, annotations
+and failure conditions to the strictly serial path (and to plain
+``schedule_pending`` ticks), with the out-of-envelope cases (gang parks,
+pending nominations, preemption-capable kernel failures, mid-stream
+node changes) draining the pipeline to the sequential path, counted per
+reason in ``stream_drains_by_reason``.  Plus the EncodeCache mutation-
+safety pin: the fingerprint tables are lock-serialized now that diffing
+runs off the commit thread.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from kube_scheduler_simulator_tpu.ops import encode as E
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.scheduler.stream import StreamSession
+from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+Obj = dict[str, Any]
+
+
+# ---------------------------------------------------------------- makers
+
+def mk_node(i: int, cpu_m: int = 16000) -> Obj:
+    return {
+        "metadata": {
+            "name": f"node-{i}",
+            "labels": {
+                "kubernetes.io/hostname": f"node-{i}",
+                "topology.kubernetes.io/zone": f"z{i % 3}",
+                "disk": "ssd" if i % 2 else "hdd",
+            },
+        },
+        "status": {"allocatable": {"cpu": f"{cpu_m}m", "memory": "32Gi", "pods": "110"}},
+        "spec": {},
+    }
+
+
+def mk_pod(i: int, giant: bool = False) -> Obj:
+    p: Obj = {
+        "metadata": {
+            "name": f"pod-{i}",
+            "namespace": "default",
+            "labels": {"app": f"a{i % 3}"},
+            # deterministic stamps: PrioritySort tie-breaks on
+            # creationTimestamp, and cross-run byte-compares need a
+            # stable queue order
+            "creationTimestamp": (
+                f"2024-03-01T{i // 3600 % 24:02d}:{i // 60 % 60:02d}:{i % 60:02d}Z"
+            ),
+        },
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "resources": {
+                        "requests": {
+                            "cpu": "900000m" if giant else f"{100 + (i % 4) * 50}m",
+                            "memory": "128Mi",
+                        }
+                    },
+                }
+            ]
+        },
+    }
+    if i % 4 == 0:
+        p["spec"]["nodeSelector"] = {"disk": "ssd"}
+    if i % 3 == 0:
+        p["spec"]["topologySpreadConstraints"] = [
+            {
+                "maxSkew": 2,
+                "topologyKey": "topology.kubernetes.io/zone",
+                "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": {"matchLabels": {"app": f"a{i % 3}"}},
+            }
+        ]
+    return p
+
+
+def new_store(n_nodes: int = 24) -> ClusterStore:
+    store = ClusterStore(clock=lambda: 1700000000.0)
+    for i in range(n_nodes):
+        store.create("nodes", mk_node(i))
+    return store
+
+
+def new_service(store: ClusterStore, use_batch: str = "force") -> SchedulerService:
+    svc = SchedulerService(store, tie_break="first", use_batch=use_batch, batch_min_work=1)
+    svc.start_scheduler(None)
+    return svc
+
+
+def pod_state(store: ClusterStore) -> dict:
+    """Byte-comparable per-pod state: binding + the full annotation
+    trail + failure conditions (the shared comparator — bench reports
+    and the smoke compare the same surface)."""
+    from kube_scheduler_simulator_tpu.utils.parity import pod_parity_state
+
+    return pod_parity_state(store)
+
+
+def churn_feed(store: ClusterStore, ticks: int, per_tick: int = 36, seed: int = 11,
+               giants_at: "set[int] | None" = None, add_node_at: "int | None" = None):
+    """Deterministic churn: ``per_tick`` creations per tick plus deletes
+    drawn from pods created >= 2 ticks ago — pods both pipeline phases
+    agree are settled (the streamed feed runs one commit earlier than
+    the serial one, so deleting younger pods would legitimately change
+    the workload itself)."""
+    rng = random.Random(seed)
+    giants_at = giants_at or set()
+
+    def feed(tick: int) -> bool:
+        if tick >= ticks:
+            return False
+        for i in range(tick * per_tick, (tick + 1) * per_tick):
+            store.create("pods", mk_pod(i, giant=(tick in giants_at and i == tick * per_tick)))
+        if add_node_at is not None and tick == add_node_at:
+            store.create("nodes", mk_node(900 + tick))
+        if tick >= 2:
+            settled = [f"pod-{i}" for i in range((tick - 1) * per_tick)]
+            for nm in rng.sample(settled, 5):
+                with contextlib.suppress(KeyError):
+                    store.delete("pods", nm, "default")
+        return True
+
+    return feed
+
+
+def run_session(streaming: bool, use_batch: str = "force", seed: int = 11,
+                ticks: int = 4, giants_at=None, add_node_at=None, n_nodes: int = 24):
+    store = new_store(n_nodes)
+    svc = new_service(store, use_batch=use_batch)
+    svc.schedule_stream(
+        feed=churn_feed(store, ticks, seed=seed, giants_at=giants_at, add_node_at=add_node_at),
+        streaming=streaming,
+    )
+    return store, svc
+
+
+# ---------------------------------------------------------------- parity
+
+class TestStreamParity:
+    def test_randomized_churn_parity_streamed_vs_serial(self):
+        """The acceptance bar: annotation bytes byte-identical between
+        streamed and serial runs of the same randomized churn, zero
+        mismatches, with the overlap demonstrably engaged."""
+        for seed in (11, 29):
+            s1, svc1 = run_session(True, seed=seed)
+            s0, svc0 = run_session(False, seed=seed)
+            d1, d0 = pod_state(s1), pod_state(s0)
+            assert d1.keys() == d0.keys()
+            bad = [k for k in d1 if d1[k] != d0[k]]
+            assert not bad, f"seed {seed}: {len(bad)} pods diverged, first {bad[:1]}"
+            m1 = svc1.metrics()
+            assert m1["stream_waves_total"] >= 3
+            assert m1["stream_pods_total"] > 0
+            # the pipeline actually overlapped host work with in-flight
+            # kernels (serial mode by construction reports none)
+            assert m1["stream_overlap_s"] > 0.0
+            assert svc0.metrics()["stream_overlap_s"] == 0.0
+            # and the incremental encoder rode along
+            assert m1["encode_delta_total"] >= 1
+
+    def test_parity_vs_schedule_pending_ticks(self):
+        """Streamed run vs the PRE-EXISTING path: one schedule_pending
+        round per feed tick — ties the stream to the proven machinery,
+        not just to its own serial mode."""
+        s1, _svc1 = run_session(True, seed=17)
+        s0 = new_store()
+        svc0 = new_service(s0)
+        feed = churn_feed(s0, 4, seed=17)
+        t = 0
+        while feed(t):
+            svc0.schedule_pending(max_rounds=1)
+            t += 1
+        d1, d0 = pod_state(s1), pod_state(s0)
+        assert d1.keys() == d0.keys()
+        bad = [k for k in d1 if d1[k] != d0[k]]
+        assert not bad, f"{len(bad)} pods diverged vs schedule_pending, first {bad[:1]}"
+
+    def test_failure_traces_stream_in_force_mode(self):
+        """Kernel failures without a PostFilter commit from the trace in
+        queue order mid-stream — byte-identical to the serial path, with
+        the failed pod carrying the sequential-shaped condition.  The
+        boundary after a failure serializes (a failed pod's requeue
+        happens at its commit, which the next admission must observe),
+        counted as a "kernel failures" drain; the wave itself still
+        commits through the streamed machinery."""
+        s1, svc1 = run_session(True, giants_at={1})
+        s0, _ = run_session(False, giants_at={1})
+        assert pod_state(s1) == pod_state(s0)
+        giant = s1.get("pods", "pod-36", "default")
+        assert not (giant.get("spec") or {}).get("nodeName")
+        conds = (giant.get("status") or {}).get("conditions") or []
+        assert conds and conds[0]["reason"] == "Unschedulable"
+        m = svc1.metrics()
+        assert m["stream_drains_by_reason"].get("kernel failures", 0) >= 1
+        assert "kernel failures (preemption path)" not in m["stream_drains_by_reason"]
+        assert m["stream_waves_total"] >= 3
+
+
+# ---------------------------------------------------------------- drains
+
+class TestStreamDrains:
+    def test_kernel_failure_drains_to_sequential_path(self):
+        """With a PostFilter in the profile (auto mode), a wave with a
+        kernel failure is abandoned UNCOMMITTED and its pods re-run
+        through schedule_pending — preemption may rewrite cluster state,
+        which the already-encoded next wave must never observe."""
+        s1, svc1 = run_session(True, use_batch="auto", giants_at={1})
+        s0, _svc0 = run_session(False, use_batch="auto", giants_at={1})
+        assert pod_state(s1) == pod_state(s0)
+        m = svc1.metrics()
+        assert m["stream_drains_by_reason"].get("kernel failures (preemption path)", 0) >= 1
+        # the stream recovered: later waves streamed again
+        assert m["stream_waves_total"] >= 1
+
+    def test_gang_waves_never_stream(self):
+        """GangRound waves must drain the pipeline before their atomic
+        commit: with the Coscheduling profile every wave takes the
+        sequential path (stream_drains reason "gang"), no streamed
+        commit ever interleaves with a gang park, and the all-or-nothing
+        bar holds."""
+        from kube_scheduler_simulator_tpu.gang import (
+            POD_GROUP_LABEL,
+            gang_scheduler_config,
+            partially_bound_groups,
+        )
+
+        store = ClusterStore(clock=lambda: 0.0)
+        store.create("namespaces", {"metadata": {"name": "default"}})
+        for i in range(12):
+            store.create("nodes", mk_node(i))
+        svc = SchedulerService(store, tie_break="first", use_batch="force", batch_min_work=0)
+        svc.start_scheduler(gang_scheduler_config())
+        store.create(
+            "podgroups",
+            {"metadata": {"name": "g"}, "spec": {"minMember": 3, "scheduleTimeoutSeconds": 120}},
+        )
+
+        committed_with_parked: list[int] = []
+        orig_commit = StreamSession._commit
+
+        def spying_commit(self, flight, overlapped):
+            committed_with_parked.append(len(self.svc._all_waiting_keys()))
+            return orig_commit(self, flight, overlapped)
+
+        def feed(tick: int) -> bool:
+            if tick >= 3:
+                return False
+            for i in range(tick * 8, (tick + 1) * 8):
+                store.create("pods", mk_pod(i))
+            if tick == 1:
+                for j in range(3):
+                    m = mk_pod(600 + j)
+                    m["metadata"]["labels"][POD_GROUP_LABEL] = "g"
+                    store.create("pods", m)
+            return True
+
+        StreamSession._commit = spying_commit
+        try:
+            svc.schedule_stream(feed=feed, streaming=True)
+        finally:
+            StreamSession._commit = orig_commit
+        m = svc.metrics()
+        assert m["stream_drains_by_reason"].get("gang", 0) >= 3
+        # a permit-bearing profile never streams a wave, so no streamed
+        # commit can interleave with a gang park
+        assert m["stream_waves_total"] == 0
+        assert all(n == 0 for n in committed_with_parked)
+        assert partially_bound_groups(store) == []
+        gang_members = [
+            p for p in store.list("pods")
+            if (p["metadata"].get("labels") or {}).get(POD_GROUP_LABEL)
+        ]
+        assert len(gang_members) == 3
+        assert all((p.get("spec") or {}).get("nodeName") for p in gang_members)
+
+    def test_nominated_pods_drain(self):
+        store = new_store()
+        svc = new_service(store)
+
+        def feed(tick: int) -> bool:
+            if tick >= 3:
+                return False
+            for i in range(tick * 10, (tick + 1) * 10):
+                store.create("pods", mk_pod(i))
+            if tick == 1:
+                nom = mk_pod(700)
+                nom["status"] = {"nominatedNodeName": "node-1"}
+                store.create("pods", nom)
+            return True
+
+        svc.schedule_stream(feed=feed, streaming=True)
+        m = svc.metrics()
+        assert m["stream_drains_by_reason"].get("nominated pods", 0) >= 1
+        assert m["stream_waves_total"] >= 1  # resumed after the drain
+        assert (store.get("pods", "pod-700", "default").get("spec") or {}).get("nodeName")
+
+    def test_unschedulable_requeue_boundary_serializes(self):
+        """A pod parked in unschedulableQ (all-fail wave, no event to
+        reactivate it) must rejoin the stream exactly when the serial
+        cadence readmits it: wave k's bind events fire move_all, so the
+        overlap admission for wave k+1 has to wait for wave k's commit.
+        Regression: the overlapped admission used to run BEFORE the
+        commit, so the parked pod slipped one wave and composition/
+        counters diverged from the serial path."""
+        def build_and_run(streaming: bool):
+            store = ClusterStore(clock=lambda: 1700000000.0)
+            for i in range(4):
+                store.create("nodes", mk_node(i))
+            # backlog of schedulable pods with LATER creationTimestamps
+            # than the giant, so capped waves admit the giant first the
+            # moment it is ready
+            for i in range(6):
+                store.create("pods", mk_pod(100 + i))
+            svc = new_service(store)
+
+            def feed(tick: int) -> bool:
+                if tick:
+                    return False
+                store.create("pods", mk_pod(0, giant=True))
+                return True
+
+            svc.schedule_stream(feed=feed, streaming=streaming, wave_pods=1)
+            return store, svc
+
+        s1, svc1 = build_and_run(True)
+        s0, svc0 = build_and_run(False)
+        assert pod_state(s1) == pod_state(s0)
+        m = svc1.metrics()
+        # the gate engaged: at least one boundary serialized because the
+        # giant sat parked while a schedulable wave was in flight
+        assert m["stream_drains_by_reason"].get("unschedulable requeue", 0) >= 1
+        assert m["stream_waves_total"] >= 3
+        # the giant ended unbound with the sequential-shaped condition
+        giant = s1.get("pods", "pod-0", "default")
+        assert not (giant.get("spec") or {}).get("nodeName")
+
+    def test_node_change_mid_stream_drains(self):
+        s1, svc1 = run_session(True, add_node_at=2)
+        s0, _ = run_session(False, add_node_at=2)
+        assert pod_state(s1) == pod_state(s0)
+        m = svc1.metrics()
+        assert m["stream_drains_by_reason"].get("node/config change", 0) >= 1
+        # streaming resumed on the grown node set
+        assert m["stream_waves_total"] >= 3
+
+
+# ----------------------------------------------------------------- knobs
+
+class TestStreamKnobs:
+    def test_env_knob_disables_overlap(self, monkeypatch):
+        monkeypatch.setenv("KSS_STREAM_PIPELINE", "0")
+        store = new_store()
+        svc = new_service(store)
+        sess = StreamSession(svc, feed=churn_feed(store, 2))
+        assert sess.streaming is False
+        sess.run()
+        assert svc.metrics()["stream_overlap_s"] == 0.0
+        assert svc.metrics()["stream_waves_total"] >= 1
+        monkeypatch.setenv("KSS_STREAM_PIPELINE", "1")
+        assert StreamSession(svc).streaming is True
+        # explicit argument wins over the knob
+        monkeypatch.setenv("KSS_STREAM_PIPELINE", "0")
+        assert StreamSession(svc, streaming=True).streaming is True
+
+    def test_max_waves_caps_dispatches_including_in_flight(self):
+        """The overlap prefetch must count the in-flight (uncommitted)
+        wave against ``max_waves`` — a cap of 1 means ONE streamed wave,
+        not one committed plus one prefetched."""
+        store = new_store()
+        svc = new_service(store)
+        StreamSession(svc, feed=churn_feed(store, 4), max_waves=1, streaming=True).run()
+        assert svc.metrics()["stream_waves_total"] == 1
+
+    def test_max_waves_budget_is_per_session(self):
+        """``max_waves`` bounds THIS session's waves.  The service-level
+        stats counter accumulates across sessions, so a second capped
+        session on the same service must still get its full budget
+        (regression: comparing against the global counter made the
+        second session break before admitting a single pod)."""
+        store = new_store()
+        svc = new_service(store)
+
+        def feed(base):
+            def f(tick: int) -> bool:
+                if tick >= 2:
+                    return False
+                for j in range(6):
+                    store.create("pods", mk_pod(base + tick * 6 + j))
+                return True
+            return f
+
+        res1 = svc.schedule_stream(feed=feed(10000), max_waves=2, streaming=True)
+        assert len(res1) == 12 and svc.metrics()["stream_waves_total"] == 2
+        res2 = svc.schedule_stream(feed=feed(20000), max_waves=2, streaming=True)
+        assert len(res2) == 12, "second session never admitted its feed"
+        assert svc.metrics()["stream_waves_total"] == 4
+
+    def test_mesh_engine_drains_to_sequential_path(self):
+        """Multi-chip services are outside schedule_async's envelope:
+        every wave must drain to the exact sequential path (counted),
+        never hit the single-device dispatch assert."""
+        import jax
+        from jax.sharding import Mesh
+
+        store = new_store()
+        svc = SchedulerService(
+            store, tie_break="first", use_batch="force", batch_min_work=1,
+            mesh=Mesh(np.array(jax.devices("cpu")[:8]), ("nodes",)),
+        )
+        svc.start_scheduler(None)
+        svc.schedule_stream(feed=churn_feed(store, 2), streaming=True)
+        m = svc.metrics()
+        assert m["stream_waves_total"] == 0
+        assert m["stream_drains_by_reason"].get("multi-chip", 0) >= 2
+        assert all((p.get("spec") or {}).get("nodeName") for p in store.list("pods"))
+
+    def test_metrics_render_includes_stream_counters(self):
+        from kube_scheduler_simulator_tpu.server.metrics import render_metrics
+
+        store, svc = run_session(True, ticks=2)
+
+        class _DI:
+            cluster_store = store
+
+            @staticmethod
+            def scheduler_service():
+                return svc
+
+        text = render_metrics(_DI())
+        assert "simulator_stream_waves_total" in text
+        assert "simulator_stream_pods_total" in text
+        assert "simulator_stream_overlap_seconds_total" in text
+        assert "simulator_stream_stall_seconds_total" in text
+        assert "simulator_stream_drains_total" in text
+
+
+# ---------------------------------------------- EncodeCache concurrency
+
+def _tiny_cluster(n_nodes: int = 6, n_bound: int = 12):
+    nodes = [mk_node(i) for i in range(n_nodes)]
+    rv = [0]
+
+    def stamp(p):
+        rv[0] += 1
+        p["metadata"]["resourceVersion"] = str(rv[0])
+        return p
+
+    for n in nodes:
+        stamp(n)
+    bound = []
+    for i in range(n_bound):
+        p = stamp(mk_pod(i))
+        p["spec"]["nodeName"] = f"node-{i % n_nodes}"
+        bound.append(p)
+    pending = [stamp(mk_pod(500 + i)) for i in range(4)]
+    return nodes, bound, pending, stamp
+
+
+class TestEncodeCacheConcurrency:
+    def test_lock_serializes_the_bound_diff(self, monkeypatch):
+        """Mutual exclusion pin: two threads encoding through one cache
+        never interleave inside the fingerprint-table diff.  The same
+        harness run with the lock knocked out observes the interleave —
+        i.e. this test FAILS on the unlocked implementation, which is
+        exactly what it pins."""
+        nodes, bound, pending, _stamp = _tiny_cluster()
+
+        state = {"cur": 0, "max": 0}
+        orig = E.EncodeCache._apply_bound_delta
+
+        def slow_diff(self, all_pods):
+            state["cur"] += 1
+            state["max"] = max(state["max"], state["cur"])
+            time.sleep(0.05)
+            try:
+                return orig(self, all_pods)
+            finally:
+                state["cur"] -= 1
+
+        monkeypatch.setattr(E.EncodeCache, "_apply_bound_delta", slow_diff)
+
+        def hammer(cache):
+            barrier = threading.Barrier(2)
+
+            def worker():
+                barrier.wait()
+                for _ in range(3):
+                    cache.encode(nodes, bound + pending, pending, None)
+
+            ts = [threading.Thread(target=worker) for _ in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+
+        cache = E.EncodeCache()
+        cache.encode(nodes, bound + pending, pending, None)  # prime (cold)
+        state["max"] = 0
+        hammer(cache)
+        assert state["max"] == 1, "encode() interleaved despite the lock"
+
+        # knock the lock out: the interleave MUST be observable (this is
+        # what the assertion above would look like on the unlocked code)
+        unlocked = E.EncodeCache()
+        unlocked.encode(nodes, bound + pending, pending, None)
+        unlocked._lock = contextlib.nullcontext()
+        state["max"] = 0
+        hammer(unlocked)
+        assert state["max"] >= 2, "harness lost its sensitivity to the race"
+
+    def test_concurrent_churn_stress_aggregates_consistent(self):
+        """Two threads churn encode() over a shared cache while the
+        bound set evolves; afterwards the cache's maintained aggregates
+        must equal a fresh prime of the final state (the unlocked
+        version double-applies interleaved diffs and drifts)."""
+        nodes, bound, pending, stamp = _tiny_cluster(n_nodes=5, n_bound=10)
+        cache = E.EncodeCache()
+        cluster_lock = threading.Lock()
+        bound_live = list(bound)
+        stop = threading.Event()
+
+        def churner(tid: int):
+            rng = random.Random(tid)
+            for k in range(12):
+                with cluster_lock:
+                    # mutate: re-stamp one pod (rv bump) and swap one in/out
+                    if bound_live and rng.random() < 0.5:
+                        p = dict(rng.choice(bound_live))
+                        p["metadata"] = dict(p["metadata"])
+                        stamp(p)
+                        bound_live[[q["metadata"]["name"] for q in bound_live].index(p["metadata"]["name"])] = p
+                    else:
+                        p = stamp(mk_pod(800 + tid * 100 + k))
+                        p["spec"]["nodeName"] = f"node-{k % 5}"
+                        bound_live.append(p)
+                    snapshot = list(bound_live)
+                cache.encode(nodes, snapshot + pending, pending, None)
+
+        threads = [threading.Thread(target=churner, args=(t,)) for t in (1, 2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        # settle the cache on the final state, then compare aggregates
+        with cluster_lock:
+            final = list(bound_live)
+        cache.encode(nodes, final + pending, pending, None)
+        fresh = E.EncodeCache()
+        fresh.encode(nodes, final + pending, pending, None)
+        assert np.array_equal(cache.pod_count, fresh.pod_count)
+        assert np.array_equal(cache.nonzero, fresh.nonzero)
+        assert cache.bound.keys() == fresh.bound.keys()
+        assert cache.bound_affinity == fresh.bound_affinity
