@@ -1091,6 +1091,111 @@ def run_stream_report(
     }
 
 
+def run_tune_report(quick=False):
+    """cfg10-tune: the learned scoring head (tuning/) — tune the plugin
+    weights on ≥2 scenario families and report the objective improvement
+    over the profile defaults, plus the pinned ZERO-DRIFT row: the same
+    workload scheduled with default weights constant-folded (the oracle
+    executables), with the default weights TRACED (the tuner's kernel
+    path), and through the sequential cycle, byte-compared over the full
+    population — the ISSUE 8 acceptance evidence that lifting the weight
+    vector into a traced argument changed no default-path bytes."""
+    import jax
+
+    from kube_scheduler_simulator_tpu.tuning import run_tuning
+
+    sizes = (
+        dict(n_nodes=8, n_pods=48, steps=3, pop=6)
+        if quick
+        else dict(n_nodes=12, n_pods=96, steps=8, pop=16)
+    )
+    rows = []
+    for family, tuner in (("imbalance", "cem"), ("consolidate", "cem"), ("imbalance", "grad")):
+        kw = dict(sizes)
+        if tuner == "grad":
+            kw.pop("pop")
+        t0 = time.perf_counter()
+        r = run_tuning(family=family, tuner=tuner, seed=11, **kw)
+        rows.append(
+            {
+                "config": f"cfg10-tune-{family}-{tuner}",
+                "kernel_platform": r["kernelPlatform"],
+                "family": family,
+                "objective": r["objective"],
+                "tuner": tuner,
+                "nodes": r["nodes"],
+                "pods": r["pods"],
+                "score_plugins": r["scorePlugins"],
+                "default_weights": r["defaultWeights"],
+                "tuned_weights": [round(w, 4) for w in r["weights"]],
+                "default_objective": round(r["defaultObjective"], 6),
+                "tuned_objective": round(r["tunedObjective"], 6),
+                "improvement": round(r["improvement"], 6),
+                "rollouts": r["rollouts"],
+                "dispatches": r["dispatches"],
+                "grad_dispatches": r["gradDispatches"],
+                "wall_s": round(time.perf_counter() - t0, 3),
+            }
+        )
+
+    # --- the zero-drift row: default weights, three paths, byte parity
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+    from kube_scheduler_simulator_tpu.tuning.scenario import build_family
+    from kube_scheduler_simulator_tpu.utils.parity import pod_parity_state
+
+    nodes, pods, _obj = build_family(
+        "imbalance", n_nodes=6 if quick else 10, n_pods=32 if quick else 80, seed=3
+    )
+
+    def run_mode(mode: str):
+        store = ClusterStore()
+        for n in nodes:
+            store.create("nodes", n)
+        for p in pods:
+            store.create("pods", p)
+        svc = SchedulerService(
+            store,
+            tie_break="first",
+            use_batch="off" if mode == "sequential" else "force",
+            batch_min_work=0,
+        )
+        svc.start_scheduler(None)
+        if mode == "traced":
+            # override == the profile's own integer defaults: the kernel
+            # runs with the weight vector traced, the numbers unchanged
+            svc.set_plugin_weights(
+                {n: float(w) for n, w in svc.framework.score_weights.items()}
+            )
+            assert svc.plugin_weights() is not None
+        svc.schedule_pending()
+        return pod_parity_state(store)
+
+    states = {m: run_mode(m) for m in ("sequential", "folded", "traced")}
+
+    def mismatches(a: str, b: str) -> int:
+        da, db = states[a], states[b]
+        return sum(1 for k in set(da) | set(db) if da.get(k) != db.get(k))
+
+    rows.append(
+        {
+            "config": "cfg10-tune-zero-drift",
+            "kernel_platform": jax.default_backend(),
+            "nodes": len(nodes),
+            "pods": len(pods),
+            "parity_pods_compared": len(states["sequential"]),
+            "parity_mismatches_traced_vs_folded": mismatches("traced", "folded"),
+            "parity_mismatches_traced_vs_sequential": mismatches("traced", "sequential"),
+            "parity_note": (
+                "default weights via the traced-weight kernel path vs the "
+                "constant-folded executables vs the sequential oracle: "
+                "bindings+annotations byte-compared over the full population"
+            ),
+        }
+    )
+    return rows
+
+
 def _mean_annotation_bytes(store) -> int:
     total = n = 0
     for p in store.list("pods", copy_objects=False):
@@ -1417,7 +1522,20 @@ def main() -> None:
         action="store_true",
         help="run cfg9-stream (streamed vs sequential sustained churn throughput) and write BENCH_stream.json",
     )
+    ap.add_argument(
+        "--tune-report",
+        action="store_true",
+        help="run cfg10-tune (tuned vs default plugin weights on two scenario families + the zero-drift parity row) and write BENCH_tune.json",
+    )
     args = ap.parse_args()
+
+    if args.tune_report:
+        rows = run_tune_report(quick=args.quick)
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_tune.json")
+        with open(path, "w") as f:
+            json.dump(rows, f, indent=1)
+        print(json.dumps(rows, indent=1))
+        return
 
     if args.stream_report:
         rows = [run_stream_report(quick=args.quick)]

@@ -196,8 +196,12 @@ class ScaleUpEstimator:
         dp, dims = B.lower(pr, dtype=eng.dtype)
         # full coverage, no rotation: the sampling machinery compiles out
         # and visit order == index order (tie_break="first" then fills the
-        # lowest template copy first — deterministic best-fit packing)
-        cfg = eng.cfg._replace(sampling=False, trace=False)
+        # lowest template copy first — deterministic best-fit packing).
+        # traced_weights off: the fresh lower() carries only the scalar
+        # plugin_w placeholder, and estimation is a feasibility/packing
+        # surface — it keeps the profile's constant-folded weights even
+        # while a live override (tuning/) is installed on the engine.
+        cfg = eng.cfg._replace(sampling=False, trace=False, traced_weights=False)
         G = len(blocks)
         N = dims["N"]
         masks = np.zeros((G, N), dtype=bool)

@@ -65,6 +65,20 @@ class BatchConfig(NamedTuple):
     # node AND the start index is 0, where visit order == index order.
     # BatchEngine picks the variant per round; both share the jit cache.
     sampling: bool = True
+    # True lifts the per-plugin score weights out of ``scores`` into the
+    # TRACED DeviceProblem.plugin_w [S] vector: weight changes re-dispatch
+    # the same executable instead of recompiling (the tuner's rollout
+    # loop, SchedulerService weight overrides).  False (default) keeps the
+    # weights constant-folded from ``scores`` — byte-identical executables
+    # to the pre-traced build.
+    traced_weights: bool = False
+    # Softmax-relaxed decision head (tuning/relax.py): τ > 0 rewrites the
+    # commit one-hot as a straight-through estimator — forward values are
+    # EXACTLY the hard argmax decision (relaxed and hard rollouts agree
+    # bit-for-bit), but the backward pass routes d(carry)/d(weights)
+    # through softmax(totals/τ) over the sampled nodes, which is what
+    # makes whole rollouts differentiable in the plugin weights.  0 = off.
+    relax_tau: float = 0.0
 
 
 FILTER_KERNELS = (
@@ -233,6 +247,9 @@ class DeviceProblem(NamedTuple):
     pod_active: Any       # [P] bool (False = padding row, never committed)
     node_active: Any      # [N] bool (False = padding column, never feasible)
     tb_base: Any          # [] uint32: attempt counter of the round's first pod
+    # Traced per-plugin score weights [S] (cfg.traced_weights); a scalar
+    # placeholder when the weights are constant-folded from cfg.scores.
+    plugin_w: Any
     # Feasible-node sampling (upstream numFeasibleNodesToFind + rotating
     # start index, mirrored from framework_runner.schedule_one's filter
     # loop).  All three are traced scalars: value changes don't recompile.
@@ -391,6 +408,7 @@ def lower(pr: BatchProblem, dtype=None) -> "tuple[DeviceProblem, dict]":
         pod_active=b(pr.pod_active),
         node_active=b(pr.node_active),
         tb_base=np.uint32(0),
+        plugin_w=np.int32(0),
         sample_k=np.int32(pr.N_true),
         start0=np.int32(0),
         n_true=np.int32(pr.N_true),
@@ -1390,7 +1408,7 @@ def build_batch_fn(
         raws = {}
         norms = {}
         totals = jnp.zeros(N, dtype=dt)
-        for name, weight in cfg.scores:
+        for k_s, (name, weight) in enumerate(cfg.scores):
             if name == "NodeResourcesFit":
                 req_nz = nonzero + dp.pod_nonzero[i][None, :]  # [N,2]
                 a = dp.nz_alloc
@@ -1504,7 +1522,10 @@ def build_batch_fn(
             if cfg.trace:
                 raws[name] = raw
                 norms[name] = norm
-            totals = totals + norm * float(weight)
+            if cfg.traced_weights:
+                totals = totals + norm * dp.plugin_w[k_s]
+            else:
+                totals = totals + norm * float(weight)
 
         # Single-feasible-node bypass: scores are skipped (annotations omit
         # them); selection is the lone feasible node either way.  Ties are
@@ -1532,6 +1553,16 @@ def build_batch_fn(
         commit = count > 0
         onehot = (jnp.arange(N) == sel) & commit  # [N]
         oh = onehot.astype(dt)
+        if cfg.relax_tau > 0:
+            # straight-through relaxed head: forward value IS the hard
+            # one-hot (byte parity with relax off), backward routes
+            # through softmax(totals/τ) over the sampled nodes so
+            # d(committed planes)/d(plugin_w) is nonzero — the gradient
+            # tuner's whole-rollout surrogate (tuning/relax.py)
+            soft = jax.nn.softmax(
+                jnp.where(sampled, totals / float(cfg.relax_tau), NEG)
+            ) * commit.astype(dt)
+            oh = soft + lax.stop_gradient(oh - soft)
         requested = requested + oh[:, None] * pod_req[None, :]
         nonzero = nonzero + oh[:, None] * dp.pod_nonzero[i][None, :]
         pod_count = pod_count + oh
@@ -1637,6 +1668,7 @@ def build_batch_fn(
         dp = _expand_features(dp, carry0[0].dtype)
         carry, ys = lax.scan(functools.partial(step, dp), carry0, jnp.arange(Pw))
         ys["final_requested"] = carry[0]
+        ys["final_nonzero"] = carry[1]  # [N,2] committed cpu/mem (objectives)
         ys["final_pod_count"] = carry[2]
         ys["final_start"] = carry[-1]
         # One fetchable [5,P] view of the per-pod scalar outputs: each

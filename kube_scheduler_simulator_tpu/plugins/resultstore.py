@@ -104,6 +104,13 @@ class ResultStore:
         self._results: dict[str, dict[str, Any]] = {}
         self._weights = dict(score_plugin_weight or {})
 
+    def set_weights(self, score_plugin_weight: "dict[str, Any]") -> None:
+        """Swap the finalScore weighting (the service's plugin-weight
+        override path, tuning/) — floats allowed; integral products keep
+        the integer path's exact bytes (format_weighted_score)."""
+        with self._mu:
+            self._weights = dict(score_plugin_weight)
+
     @staticmethod
     def _key(namespace: str, pod_name: str) -> str:
         return f"{namespace}/{pod_name}"
@@ -144,8 +151,18 @@ class ResultStore:
     def _add_normalized_locked(
         self, namespace: str, pod_name: str, node_name: str, plugin: str, normalized_score: int
     ) -> None:
-        final = int(normalized_score) * int(self._weights.get(plugin, 0))
-        self._entry(namespace, pod_name)["finalScore"].setdefault(node_name, {})[plugin] = str(final)
+        w = self._weights.get(plugin, 0)
+        if isinstance(w, float) and not w.is_integer():
+            # tuned (float) weight override: shared renderer, byte-equal
+            # to the integer path whenever the product is integral
+            from kube_scheduler_simulator_tpu.tuning.validate import (
+                format_weighted_score,
+            )
+
+            final = format_weighted_score(int(normalized_score), w)
+        else:
+            final = str(int(normalized_score) * int(w))
+        self._entry(namespace, pod_name)["finalScore"].setdefault(node_name, {})[plugin] = final
 
     def add_pre_filter_result(
         self,

@@ -150,6 +150,31 @@ class ScenarioEngine:
 
     def _run_steps(self, scenario: Obj, status: Obj, timeline: dict) -> Obj:
         spec = scenario.get("spec") or {}
+        # spec.pluginWeights: replay the scenario under a tuned plugin-
+        # weight vector (the learned scoring head, tuning/) — applied for
+        # exactly this run, then the PREVIOUS override (or the defaults)
+        # is reinstated, so the knob is a pure function of the Scenario,
+        # replays stay deterministic, and a live operator override
+        # survives someone else's scenario run.
+        plugin_weights = spec.get("pluginWeights")
+        weights_applied = False
+        prev_weights = None
+        if plugin_weights is not None:
+            try:
+                prev_weights = getattr(self.scheduler, "_weights_requested", None)
+                self.scheduler.set_plugin_weights(plugin_weights)
+                weights_applied = True
+            except Exception as e:
+                status["phase"] = "Failed"
+                status["message"] = f"spec.pluginWeights: {e}"
+                return scenario
+        try:
+            return self._run_steps_inner(scenario, spec, status, timeline)
+        finally:
+            if weights_applied:
+                self.scheduler.set_plugin_weights(prev_weights)
+
+    def _run_steps_inner(self, scenario: Obj, spec: Obj, status: Obj, timeline: dict) -> Obj:
         # Wipe the simulated cluster but PRESERVE Scenario objects: they
         # are operator bookkeeping, not cluster resources — wiping them
         # would silently delete scenarios queued behind this run.  The

@@ -249,7 +249,7 @@ class BatchResult:
             tr = self.out["trace"]
             cfg = self._engine.cfg
 
-            def lut_inv(arr: "np.ndarray") -> tuple:
+            def lut_inv(arr: "np.ndarray", fmt=str) -> tuple:
                 """[P,WS] ints → (LUT of rendered str per DISTINCT value,
                 [P,WS] int64 inverse indices): each distinct value is
                 formatted ONCE, and the wave C path splices values from
@@ -257,17 +257,34 @@ class BatchResult:
                 (``_strs_of``) are only built for the fallback paths.
                 Score planes are narrow-range ints, so the common case is
                 a direct offset LUT (min/max + one subtract) instead of
-                np.unique's full sort of P×WS elements."""
+                np.unique's full sort of P×WS elements.  ``fmt`` renders
+                a value (the weight-override path renders norm × float
+                weight from the int norm LUT)."""
                 mn = int(arr.min()) if arr.size else 0
                 mx = int(arr.max()) if arr.size else 0
                 if mx - mn <= 4096:
-                    lut = [str(v) for v in range(mn, mx + 1)]
+                    lut = [fmt(v) for v in range(mn, mx + 1)]
                     inv = arr.astype(np.int64) - mn
                     return lut, np.ascontiguousarray(inv)
                 uniq, inv = np.unique(arr, return_inverse=True)
-                lut = [str(int(v)) for v in uniq]
+                lut = [fmt(int(v)) for v in uniq]
                 return lut, np.ascontiguousarray(
                     inv.reshape(arr.shape).astype(np.int64)
+                )
+
+            wov = self._engine.weight_override
+
+            def fin_li_of(k: int, s: str, w) -> tuple:
+                if wov is None:
+                    return lut_inv(tr["norm"][k].astype(np.int32) * int(w))
+                from kube_scheduler_simulator_tpu.tuning.validate import (
+                    format_weighted_score,
+                )
+
+                wk = float(wov[k])
+                return lut_inv(
+                    tr["norm"][k].astype(np.int32),
+                    fmt=lambda v, _w=wk: format_weighted_score(v, _w),
                 )
 
             fp = tr.get("fail_plug")
@@ -291,8 +308,7 @@ class BatchResult:
                 "norm_int": {s: tr["norm"][k] for k, (s, _w) in enumerate(cfg.scores)},
                 "raw_li": {s: lut_inv(tr["raw"][k]) for k, (s, _w) in enumerate(cfg.scores)},
                 "fin_li": {
-                    s: lut_inv(tr["norm"][k].astype(np.int32) * int(w))
-                    for k, (s, w) in enumerate(cfg.scores)
+                    s: fin_li_of(k, s, w) for k, (s, w) in enumerate(cfg.scores)
                 },
                 # lazily materialized [P][WS] interned-str lists (fallbacks)
                 "raw_s": {},
@@ -830,15 +846,19 @@ class BatchResult:
     def totals_map(self, i: int) -> dict[int, int]:
         """FEASIBLE node index → weighted score total (Σ weight ×
         normalized, recomputed from the compact trace — trace mode).
-        Infeasible nodes carry no scores (the cycle never scores them)."""
+        Infeasible nodes carry no scores (the cycle never scores them).
+        Under a weight override the totals are floats (the kernel's own
+        weighted sum), ints on the default path as before."""
         tr = self._tr()
+        wov = self._engine.weight_override
         sids = tr["sids"][i]
-        totals: dict[int, int] = {int(n): 0 for n in sids if n >= 0}
-        for (plugin, weight) in self._engine.cfg.scores:
+        totals: dict[int, Any] = {int(n): 0 for n in sids if n >= 0}
+        for k, (plugin, weight) in enumerate(self._engine.cfg.scores):
+            w = float(wov[k]) if wov is not None else int(weight)
             norm_row = tr["norm_int"][plugin][i]
             for j, n in enumerate(sids):
                 if n >= 0:
-                    totals[int(n)] += int(norm_row[j]) * int(weight)
+                    totals[int(n)] += int(norm_row[j]) * w
         return totals
 
     def feasible_idx(self, i: int) -> set[int]:
@@ -910,11 +930,24 @@ class BatchEngine:
         profile_dir: "str | None" = None,
         mesh: Any = None,
         incremental: "bool | str" = "auto",
+        weights: Any = None,
     ):
         """``mesh``: a ``jax.sharding.Mesh`` with a "nodes" axis — the
         problem's node axis shards across the mesh's devices
         (ops/batch.shard_device_problem) and cross-node reductions become
         XLA collectives over ICI.  None = single-device.
+
+        ``weights``: optional plugin-weight OVERRIDE for the score pass —
+        a vector (profile score order) or name → weight mapping,
+        validated at this boundary (finite, non-negative, correct arity;
+        tuning/validate.py raises WeightValidationError otherwise).  When
+        set, the kernel runs with the weight vector TRACED
+        (``BatchConfig.traced_weights``): weight changes re-dispatch the
+        same executables, and the annotation formatters render
+        finalScore with the override (``format_weighted_score`` — byte-
+        identical to the integer path for integral products).  None
+        (default) keeps the profile weights constant-folded — the
+        executables and annotation bytes of the pre-traced build.
 
         ``incremental``: delta re-encode across rounds — a host-side
         EncodeCache (ops/encode.py) retains per-object encoded state so
@@ -950,6 +983,18 @@ class BatchEngine:
         tune_malloc()
         self.profile_dir = profile_dir or os.environ.get("KSS_TPU_PROFILE_DIR") or None
         self.mesh = mesh
+        # Plugin-weight override (the learned scoring head, tuning/):
+        # validated HERE — the config boundary — so a bad vector is a
+        # clear WeightValidationError, never a shape error inside jit.
+        self.weight_override: "np.ndarray | None" = None
+        if weights is not None:
+            from kube_scheduler_simulator_tpu.tuning.validate import (
+                validate_plugin_weights,
+            )
+
+            self.weight_override = validate_plugin_weights(
+                weights, [s for s, _w in self.scores], defaults=dict(self.scores)
+            )
         self.cfg = B.BatchConfig(
             filters=tuple(f for f in self.filters if f in KERNEL_FILTERS),
             scores=tuple((s, w) for s, w in self.scores),
@@ -959,6 +1004,7 @@ class BatchEngine:
             trace=trace,
             tie_break=tie_break,
             seed=seed,
+            traced_weights=self.weight_override is not None,
         )
         # Incremental encode + device-resident problem (the steady-state
         # churn hot path): an EXPLICIT bool argument wins (callers like
@@ -1063,9 +1109,17 @@ class BatchEngine:
         ext = getattr(framework, "extender_service", None)
         if ext is not None and ext.extenders:
             unsupported = unsupported or "extender webhooks configured"
+        # a service-level weight override (SchedulerService(weights=) /
+        # spec.pluginWeights) rides on the framework; the engine then runs
+        # the traced-weight kernel path with it
+        override = getattr(framework, "score_weight_override", None)
+        weights = (
+            [float(override.get(s, w)) for s, w in scores] if override else None
+        )
         eng = cls(
             filters=filters,
             scores=scores,
+            weights=weights,
             fit_strategy=fit_strategy,
             fit_resources=fit_resources,
             fit_shape=fit_shape,
@@ -1109,6 +1163,11 @@ class BatchEngine:
         store listing serves both this check and the encode pass."""
         if self._unsupported_config:
             return False, self._unsupported_config
+        # A node-less cluster gives the kernel zero-size score planes
+        # (jnp reductions with no identity crash); the round's outcome is
+        # trivially "all unschedulable" — the sequential cycle's path.
+        if not nodes:
+            return False, "no nodes in cluster"
         # An unbound pod nominated by an earlier preemption reserves its
         # node for other pods' filter runs (upstream
         # RunFilterPluginsWithNominatedPods) — the kernel doesn't model
@@ -1288,6 +1347,11 @@ class BatchEngine:
             sample_k=np.int32(sample_k),
             start0=np.int32(start0),
         )
+        if self.weight_override is not None:
+            # traced weight vector [S]: changes re-dispatch, never recompile
+            dp = dp._replace(
+                plugin_w=np.asarray(self.weight_override, dtype=dp.alloc.dtype)
+            )
         # Compile out the sampling machinery when it cannot engage this
         # round (full coverage, no rotation): visit order == index order.
         cfg = self.cfg._replace(sampling=sample_k < len(nodes) or start0 != 0)

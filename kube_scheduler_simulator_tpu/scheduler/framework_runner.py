@@ -130,6 +130,14 @@ class Framework:
         self.handle = handle
         handle.framework = self
         self.score_weights = dict(score_weights or {})
+        # Optional plugin-weight OVERRIDE (the learned scoring head,
+        # tuning/): SchedulerService.set_plugin_weights installs a
+        # name → float map here; the weighted-sum below and the batch
+        # engine (from_framework) both read it, so a round keeps the
+        # same weighting whichever path it takes.  score_weights itself
+        # stays the profile's integer config — restoring the default is
+        # just clearing this.
+        self.score_weight_override: "dict[str, float] | None" = None
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
         self.seed = seed
         self.next_start_node_index = 0
@@ -530,7 +538,8 @@ class Framework:
                     score = 0
                 raw[ni.name] = score
             wp.normalize_scores(state, pod, raw)
-            weight = self.score_weights.get(wp.original.name, 1)
+            weights = self.score_weight_override or self.score_weights
+            weight = weights.get(wp.original.name, 1)
             for name, s in raw.items():
                 totals[name] += s * weight
 
@@ -570,11 +579,27 @@ class Framework:
             wp.unreserve(state, pod, node_name)
 
     def sort_pods(self, pods: list[Obj]) -> list[Obj]:
-        """Order the activeQ by the QueueSort plugin (PrioritySort default)."""
+        """Order the activeQ by the QueueSort plugin (PrioritySort default).
+
+        Ties (neither less(a,b) nor less(b,a)) MUST compare equal so the
+        stable sort preserves arrival order.  The old comparator returned
+        1 for ties ("a > b"), which is inconsistent (it also claims b > a)
+        — Timsort then emits a length-dependent permutation of the tied
+        group, so two otherwise-identical workloads whose creationTimestamps
+        straddle a wall-clock second boundary differently scheduled in
+        DIFFERENT orders (the test_mixed_everything_differential flake)."""
         qs = self.plugins["queue_sort"]
         if not qs:
             return list(pods)
         import functools
 
         less = qs[0].less
-        return sorted(pods, key=functools.cmp_to_key(lambda a, b: -1 if less(a, b) else 1))
+
+        def cmp(a: Obj, b: Obj) -> int:
+            if less(a, b):
+                return -1
+            if less(b, a):
+                return 1
+            return 0
+
+        return sorted(pods, key=functools.cmp_to_key(cmp))

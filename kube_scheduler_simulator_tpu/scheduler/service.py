@@ -55,6 +55,7 @@ class SchedulerService:
         autoscale: str = "off",
         autoscaler_opts: "dict | None" = None,
         autoscale_interval_s: float = 10.0,
+        weights: Any = None,
     ):
         """``use_batch``: "off" = sequential cycle only; "auto" = run whole
         pending rounds through the TPU batch engine when the profile ×
@@ -84,6 +85,17 @@ class SchedulerService:
         paths behave as "off").  ``autoscaler_opts`` forwards to
         :class:`~kube_scheduler_simulator_tpu.autoscaler.ClusterAutoscaler`
         (expander, scale-down threshold/rounds).
+        ``weights``: optional plugin-weight override for the score pass
+        (the learned scoring head, tuning/) — a vector in the profile's
+        score-plugin order or a name → weight mapping, validated at
+        ``start_scheduler`` (finite, non-negative, correct arity;
+        WeightValidationError → HTTP 422 at the API boundary).  Applied
+        to every profile: the sequential cycle's weighted sum, the
+        result store's finalScore rendering, and the batch engines
+        (which then run the kernel with the vector TRACED) all see the
+        same numbers.  ``set_plugin_weights`` changes it live; the
+        scenario engine drives it from ``spec.pluginWeights``.
+
         ``autoscale_interval_s`` throttles the BACKGROUND loop's
         autoscaler passes: the poll tick is ~0.25 s, and an
         unneeded-rounds timer advancing at 4 Hz would drain idle
@@ -205,7 +217,20 @@ class SchedulerService:
             "stream_overlap_s": 0.0,
             "stream_stall_s": 0.0,
             "stream_drains": {},
+            # learned scoring head (tuning/): on-device tuner activity —
+            # rollouts = hard objective evaluations, grad = straight-
+            # through value-and-grad dispatches; tuning_objective maps
+            # objective name → the last run's tuned value
+            "tuning_runs": 0,
+            "tuning_rollouts": 0,
+            "tuning_grad_dispatches": 0,
+            "tuning_objective": {},
         }
+        # plugin-weight override requested at construction (or later via
+        # set_plugin_weights); resolved/validated when frameworks exist
+        self._weights_requested = weights
+        self._weights_override: "dict[str, float] | None" = None
+        self._last_tuning_report: "Obj | None" = None
         # guards batch_fallbacks against the metrics scrape thread
         self._stats_lock = threading.Lock()
         # Capacity engine (autoscaler/): built lazily on first use so
@@ -321,6 +346,11 @@ class SchedulerService:
         self.extender_service = extender_service
         self._batch_engine = None  # rebuilt lazily for the new profiles
         self._batch_engines = {}
+        # re-apply a requested weight override onto the fresh frameworks
+        # (validation failures roll the whole (re)start back)
+        self._weights_override = None
+        if self._weights_requested is not None:
+            self.set_plugin_weights(self._weights_requested)
         self._current_cfg = cfg
         # a scheduler (re)build is a scheduling-relevant event: pods that
         # were unschedulable under the OLD config must be re-attempted
@@ -393,6 +423,87 @@ class SchedulerService:
             if fw is not src:
                 fw.next_start_node_index = src.next_start_node_index
                 fw.sched_counter = src.sched_counter
+
+    # ------------------------------------------------------- weight override
+
+    def score_plugin_names(self, profile: "str | None" = None) -> list[str]:
+        """The score plugins of a profile (default profile when None), in
+        profile order — the arity a pluginWeights vector must match."""
+        fw = self.frameworks.get(profile) if profile else self.framework
+        assert fw is not None, "scheduler not started"
+        return [wp.original.name for wp in fw.plugins["score"]]
+
+    def set_plugin_weights(self, weights: Any) -> "dict[str, float] | None":
+        """Install (or clear, with None) a plugin-weight override across
+        every profile: the sequential cycle's weighted sum, the result
+        stores' finalScore rendering and the batch engines (rebuilt
+        lazily on the TRACED-weight kernel path) all pick it up.
+        Validates at this boundary — finite, non-negative, correct arity
+        per profile — raising WeightValidationError otherwise (422 at
+        the HTTP layer).  Returns the resolved default-profile mapping."""
+        assert self.framework is not None, "scheduler not started"
+        if weights is None:
+            self._weights_requested = None
+            self._weights_override = None
+            for fw in self.frameworks.values():
+                fw.score_weight_override = None
+                fw.result_store.set_weights(fw.score_weights)
+        else:
+            # validate EVERY profile before touching any (atomic: a
+            # rejection leaves the previous override fully in place on
+            # all profiles, result stores and engines)
+            resolved: "dict[str, float] | None" = None
+            for fw, mapping in self.check_plugin_weights(weights):
+                fw.score_weight_override = mapping
+                fw.result_store.set_weights(mapping)
+                if fw is self.framework:
+                    resolved = mapping
+            self._weights_requested = weights
+            self._weights_override = resolved
+        # engines bake traced_weights into their compiled config: rebuild
+        self._batch_engine = None
+        self._batch_engines = {}
+        return self._weights_override
+
+    def check_plugin_weights(self, weights: Any) -> "list[tuple[Any, dict[str, float]]]":
+        """Validate a weight vector against EVERY profile WITHOUT applying
+        — the dry-run the API boundary uses for its 422 pre-check (a
+        vector valid for the default profile but not a secondary one must
+        be rejected up front, not as a Failed scenario status).  Returns
+        (framework, resolved name → weight mapping) per profile; raises
+        WeightValidationError naming the offending profile."""
+        from kube_scheduler_simulator_tpu.tuning.validate import (
+            validate_plugin_weights,
+        )
+
+        plans = []
+        for name, fw in self.frameworks.items():
+            names = [wp.original.name for wp in fw.plugins["score"]]
+            try:
+                vec = validate_plugin_weights(weights, names, defaults=fw.score_weights)
+            except Exception as e:
+                raise type(e)(f"profile {name}: {e}") from None
+            plans.append((fw, dict(zip(names, vec.tolist()))))
+        return plans
+
+    def plugin_weights(self) -> "dict[str, float] | None":
+        """The active default-profile weight override (None = defaults)."""
+        return self._weights_override
+
+    def note_tuning_run(self, session: Any, report: Obj) -> None:
+        """Absorb one tuning run's dispatch counts + outcome into the
+        service counters (/metrics tuning_* family)."""
+        with self._stats_lock:
+            self.stats["tuning_runs"] += 1
+            self.stats["tuning_rollouts"] += int(getattr(session, "rollouts", 0))
+            self.stats["tuning_grad_dispatches"] += int(
+                getattr(session, "grad_dispatches", 0)
+            )
+            self.stats["tuning_objective"] = {
+                **self.stats["tuning_objective"],
+                report["objective"]: float(report["tunedObjective"]),
+            }
+        self._last_tuning_report = report
 
     def _build_framework(self, cfg: Obj, profile: "Obj | None" = None, store_key: str = RESULT_STORE_KEY) -> Framework:
         if profile is None:
@@ -1259,6 +1370,13 @@ class SchedulerService:
             "stream_overlap_s": self.stats["stream_overlap_s"],
             "stream_stall_s": self.stats["stream_stall_s"],
             "stream_drains_by_reason": stream_drains,
+            # learned scoring head (tuning/): tuner activity + live
+            # weight-override state
+            "tuning_runs_total": self.stats["tuning_runs"],
+            "tuning_rollouts_total": self.stats["tuning_rollouts"],
+            "tuning_grad_dispatches_total": self.stats["tuning_grad_dispatches"],
+            "tuning_objective": dict(self.stats["tuning_objective"]),
+            "plugin_weights_overridden": int(self._weights_override is not None),
             # Permit wait machinery, live (the gauge) and cumulative
             "waiting_pods": len(self._all_waiting_keys()),
             "permit_wait_expired": self.stats["permit_wait_expired"],

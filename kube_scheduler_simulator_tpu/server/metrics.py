@@ -132,6 +132,20 @@ def render_metrics(di: Any) -> str:
             0,
             {"reason": "none"},
         )
+    # learned scoring head (tuning/): on-device tuner activity + the
+    # live weight-override state
+    counter("tuning_runs_total", "Weight-tuning runs completed (one per family per /api/v1/tuning or bench invocation).", m["tuning_runs_total"])
+    counter("tuning_rollouts_total", "On-device rollouts evaluated by the tuners (CEM counts every population member).", m["tuning_rollouts_total"])
+    counter("tuning_grad_dispatches_total", "Straight-through value-and-grad dispatches (gradient tuner).", m["tuning_grad_dispatches_total"])
+    for objective, v in sorted(m["tuning_objective"].items()):
+        counter(
+            "tuning_objective",
+            "Tuned objective value of the most recent run, by objective name (higher = better).",
+            round(float(v), 6),
+            {"name": objective},
+            typ="gauge",
+        )
+    counter("plugin_weights_overridden", "1 while a plugin-weight override (learned scoring head) is active on the live profiles.", m["plugin_weights_overridden"], typ="gauge")
     # Permit wait machinery (waiting-pod map)
     counter("waiting_pods", "Pods parked at Permit holding a reservation.", m["waiting_pods"], typ="gauge")
     counter("permit_wait_expired_total", "Permit waits rejected on deadline expiry.", m["permit_wait_expired"])

@@ -50,6 +50,7 @@ from urllib.parse import parse_qs, urlparse
 from kube_scheduler_simulator_tpu.server.di import DIContainer
 from kube_scheduler_simulator_tpu.services.resourcewatcher import PARAM_KINDS
 from kube_scheduler_simulator_tpu.state.store import KINDS, AlreadyExistsError, NotFoundError
+from kube_scheduler_simulator_tpu.tuning.validate import WeightValidationError
 
 Obj = dict[str, Any]
 
@@ -57,6 +58,43 @@ _EXTENDER_RE = re.compile(r"^/api/v1/extender/(filter|prioritize|preempt|bind)/(
 _RESOURCE_RE = re.compile(r"^/api/v1/resources/([a-z]+)(?:/([^/]+))?$")
 _NODEGROUP_RE = re.compile(r"^/api/v1/nodegroups(?:/([^/]+))?$")
 _PODGROUP_RE = re.compile(r"^/api/v1/podgroups(?:/([^/]+))?$")
+
+
+def _run_tuning_request(svc: Any, body: Obj) -> Obj:
+    """POST /api/v1/tuning: run the weight tuner on one or more scenario
+    families against the live profile's plugin set and return the
+    default-vs-tuned comparison.  Sizes/steps are capped — this runs
+    synchronously in the request thread."""
+    from kube_scheduler_simulator_tpu.tuning import run_tuning
+    from kube_scheduler_simulator_tpu.tuning.scenario import FAMILIES
+
+    families = body.get("families")
+    if families is None:
+        families = [body.get("family") or "imbalance"]
+    if not isinstance(families, list) or not families:
+        raise ValueError("families must be a non-empty list of scenario family names")
+    for f in families:
+        if f not in FAMILIES:
+            raise ValueError(f"unknown scenario family {f!r}; choose from {sorted(FAMILIES)}")
+    tuner = body.get("tuner") or "cem"
+    clamp = lambda v, lo, hi, d: max(lo, min(int(v if v is not None else d), hi))
+    kw = dict(
+        objective=body.get("objective"),
+        tuner=tuner,
+        n_nodes=clamp(body.get("nodes"), 2, 64, 12),
+        n_pods=clamp(body.get("pods"), 4, 512, 96),
+        steps=clamp(body.get("steps"), 1, 64, 4),
+        pop=clamp(body.get("pop"), 2, 64, 8),
+        seed=clamp(body.get("seed"), 0, 1 << 30, 0),
+        weights=body.get("weights"),
+        svc=svc,
+    )
+    report = {
+        "tuner": tuner,
+        "results": [run_tuning(family=f, **kw) for f in families],
+    }
+    svc._last_tuning_report = report
+    return report
 
 
 class SimulatorServer:
@@ -263,6 +301,28 @@ def _make_handler(server: SimulatorServer):
                         self._send_json(200, {"mode": "off"})
                     else:
                         self._send_json(200, {"mode": svc.autoscale, **asc.status()})
+                elif url.path == "/api/v1/tuning":
+                    # the learned scoring head's state: active override,
+                    # tunable families/objectives, and the last run's
+                    # default-vs-tuned comparison (POST /api/v1/tuning runs one)
+                    from kube_scheduler_simulator_tpu.tuning.objective import OBJECTIVES
+                    from kube_scheduler_simulator_tpu.tuning.scenario import FAMILIES
+
+                    svc = di.scheduler_service()
+                    self._send_json(
+                        200,
+                        {
+                            "pluginWeights": svc.plugin_weights(),
+                            "scorePlugins": (
+                                svc.score_plugin_names()
+                                if svc.framework is not None
+                                else []
+                            ),
+                            "families": sorted(FAMILIES),
+                            "objectives": list(OBJECTIVES),
+                            "lastReport": svc._last_tuning_report,
+                        },
+                    )
                 elif m := _NODEGROUP_RE.match(url.path):
                     name = m.group(1)
                     if name is None:
@@ -353,10 +413,26 @@ def _make_handler(server: SimulatorServer):
                 elif url.path == "/api/v1/scenarios":
                     from kube_scheduler_simulator_tpu.scenario import ScenarioEngine
 
+                    body = self._body() or {}
+                    svc = di.scheduler_service()
+                    pw = (body.get("spec") or {}).get("pluginWeights")
+                    if pw is not None and svc.framework is not None:
+                        # reject a bad weight vector HERE with a 422 —
+                        # not as a Failed scenario status deep in the
+                        # run; the dry-run checks EVERY profile, exactly
+                        # as applying will
+                        svc.check_plugin_weights(pw)
                     engine = ScenarioEngine(
-                        di.cluster_store, di.scheduler_service(), di.controller_manager()
+                        di.cluster_store, svc, di.controller_manager()
                     )
-                    self._send_json(200, engine.run(self._body() or {}))
+                    self._send_json(200, engine.run(body))
+                elif url.path == "/api/v1/tuning":
+                    # run/compare the learned scoring head: tune plugin
+                    # weights on scenario families, report default-vs-
+                    # tuned objectives (tuning/tuner.run_tuning)
+                    self._send_json(
+                        200, _run_tuning_request(di.scheduler_service(), self._body() or {})
+                    )
                 elif url.path == "/api/v1/schedulersimulations":
                     # KEP-184 one-shot runner: one Scenario × N isolated
                     # simulator instances, comparative report in status
@@ -408,6 +484,10 @@ def _make_handler(server: SimulatorServer):
                 self._send_json(409, {"message": str(e)})
             except NotFoundError as e:
                 self._send_json(404, {"message": str(e)})
+            except WeightValidationError as e:
+                # a malformed plugin-weight vector is a semantic error in
+                # an otherwise well-formed request: 422, named clearly
+                self._send_json(422, {"message": str(e)})
             except ValueError as e:
                 self._send_json(400, {"message": str(e)})
             except IndexError:

@@ -80,6 +80,8 @@ HTML = r"""<!doctype html>
     <div id="others"></div>
     <h2 style="margin-top:14px">Autoscaler</h2>
     <div id="autoscaler" class="muted">…</div>
+    <h2 style="margin-top:14px">Tuning</h2>
+    <div id="tuning" class="muted">…</div>
   </div>
 </main>
 <main id="tablesview" style="display:none; grid-template-columns:1fr;">
@@ -110,6 +112,7 @@ MODULE_ORDER = [
     "forms.js",      # create/edit YAML, scheduler config, export/import
     "metrics.js",    # Prometheus metrics panel
     "autoscaler.js", # node-group table + autoscaler action feed
+    "tuning.js",     # learned-scoring-head panel: run tuner, compare weights
     "watch.js",      # live list-watch stream + workload polling
     "main.js",       # bootstrap
 ]

@@ -41,6 +41,7 @@ async function pollWorkloads() {
       }
       render();
       await refreshAutoscaler();
+      await refreshTuning();
     } catch (e) {}
     await new Promise(r => setTimeout(r, 3000));
   }

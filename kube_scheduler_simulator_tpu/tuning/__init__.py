@@ -1,0 +1,46 @@
+"""Learned scoring head: differentiable plugin weights + on-device tuner.
+
+The batch scorer (ops/batch.py) materializes per-plugin score tensors in
+pure JAX; this package lifts the one thing the scheduling-policy papers
+tune — the plugin weight vector — into a traced kernel argument and
+builds the machinery around it:
+
+- ``validate``  — weight-vector validation at the API/config boundary
+  (finite, non-negative, profile arity) + finalScore rendering shared by
+  the batch formatter and the sequential result store.
+- ``objective`` — utilization / fragmentation / pending-age scenario
+  objectives, reduced on device from a rollout's committed planes.
+- ``relax``     — the straight-through relaxed decision head: whole
+  rollouts differentiable in the weights, forward bit-identical to hard.
+- ``tuner``     — CEM (vmapped population per dispatch) and normalized
+  gradient ascent; ``run_tuning`` is the entry every surface uses.
+- ``scenario``  — deterministic scenario families with real weight/
+  objective trade-offs.
+
+Import discipline: this module stays jax-free so the server and service
+can import the validation boundary cheaply; the heavy pieces load when a
+tuning run actually starts.
+"""
+
+from kube_scheduler_simulator_tpu.tuning.validate import (  # noqa: F401
+    WeightValidationError,
+    format_weighted_score,
+    validate_plugin_weights,
+)
+
+__all__ = [
+    "WeightValidationError",
+    "format_weighted_score",
+    "validate_plugin_weights",
+    "run_tuning",
+    "tuning_defaults",
+    "tuning_families",
+]
+
+
+def __getattr__(name: str):  # lazy: keep jax out of light importers
+    if name in ("run_tuning", "tuning_defaults", "tuning_families", "TuningSession"):
+        from kube_scheduler_simulator_tpu.tuning import tuner
+
+        return getattr(tuner, name)
+    raise AttributeError(name)

@@ -1,0 +1,87 @@
+"""Scenario objectives: scalar rollout scores computed ON DEVICE.
+
+Each objective reads the committed placement planes a full batch rollout
+leaves in its outputs (``ops/batch.build_batch_fn`` → ``ys``) plus the
+static problem planes (``DeviceProblem``), and returns one scalar in
+"higher is better" orientation — the tuners maximize, so cost-shaped
+objectives (fragmentation, pending-age) are negated here, once, instead
+of per-tuner sign juggling.
+
+All three are pure jnp expressions, so they fuse into the rollout's jit
+and the tuner loop never fetches a plane: one scalar comes back per
+rollout.  Differentiability (for the straight-through gradient tuner,
+tuning/relax.py):
+
+- ``utilization`` and ``fragmentation`` read the final resource carry,
+  which the relaxed head's soft one-hot flows into — real gradients.
+- ``pending_age`` reads the hard per-pod selection (scheduled or not),
+  which does NOT depend on the weights through any soft path (filter
+  feasibility is score-independent), so its weight-gradient is zero
+  except through multi-step resource displacement; use the CEM tuner
+  for it (docs/tuning.md, determinism caveats).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+OBJECTIVES = ("utilization", "fragmentation", "pending_age")
+
+
+def _used_frac(ys: dict, dp: Any):
+    """[N,2] committed cpu/mem fraction over active nodes (0 where the
+    node allocates none of the resource or is padding)."""
+    used = ys["final_nonzero"]
+    cap = dp.nz_alloc
+    active = dp.node_active[:, None]
+    return jnp.where((cap > 0) & active, used / jnp.where(cap == 0, 1.0, cap), 0.0)
+
+
+def utilization(ys: dict, dp: Any, age_w: Any):
+    """Concentration-weighted mean utilization: Σ f² / Σ f over the
+    per-node cpu/mem used-fractions.  Rewards consolidating load onto
+    fewer, fuller nodes (the cluster-autoscaler's bin-packing objective)
+    and is smooth in the committed planes, so the relaxed rollout
+    differentiates it.  Range (0, 1]; higher = tighter packing."""
+    f = _used_frac(ys, dp)
+    s = jnp.sum(f)
+    return jnp.sum(f * f) / jnp.where(s == 0, 1.0, s)
+
+
+def fragmentation(ys: dict, dp: Any, age_w: Any):
+    """Negated resource-shape imbalance: mean |cpu_frac − mem_frac| over
+    active nodes.  A node whose cpu is exhausted while memory idles (or
+    vice versa) strands the idle resource — classic fragmentation.
+    Higher (closer to 0) = better balanced."""
+    f = _used_frac(ys, dp)
+    active = dp.node_active
+    n = jnp.maximum(jnp.sum(active.astype(f.dtype)), 1.0)
+    return -jnp.sum(jnp.abs(f[:, 0] - f[:, 1]) * active) / n
+
+
+def pending_age(ys: dict, dp: Any, age_w: Any):
+    """Negated age-weighted pending mass: Σ age_w over pods the rollout
+    left unscheduled, normalized by total age mass.  0 when everything
+    places; −1 when nothing does.  ``age_w`` comes from
+    ``ops/encode.objective_planes`` (creationTimestamp seniority, queue
+    rank fallback)."""
+    pending = (ys["selected"] < 0) & dp.pod_active
+    total = jnp.maximum(jnp.sum(age_w), 1e-9)
+    return -jnp.sum(age_w * pending) / total
+
+
+_FNS = {
+    "utilization": utilization,
+    "fragmentation": fragmentation,
+    "pending_age": pending_age,
+}
+
+
+def objective_value(name: str, ys: dict, dp: Any, age_w: Any):
+    """The named objective's scalar (higher = better) for one rollout."""
+    fn = _FNS.get(name)
+    if fn is None:
+        raise ValueError(f"unknown objective {name!r}; choose from {OBJECTIVES}")
+    return fn(ys, dp, age_w)

@@ -1,0 +1,71 @@
+"""Differentiable rollouts: the straight-through relaxed decision head.
+
+The batch kernel's per-pod decision is an argmax over weighted plugin
+scores — piecewise constant in the weights, gradient zero everywhere.
+``BatchConfig.relax_tau > 0`` (ops/batch.py) rewrites the commit one-hot
+as a straight-through estimator:
+
+    soft = softmax(totals / τ) over the sampled nodes
+    oh   = soft + stop_gradient(hard − soft)
+
+Forward values are EXACTLY the hard rollout's (``oh == hard`` as
+numbers; the relaxed and hard rollouts agree bit-for-bit — pinned by
+tests/test_tuning.py), but the backward pass flows d(committed resource
+planes)/d(weights) through the softmax, so a whole rollout's objective
+differentiates in the plugin-weight vector.  This is the "Learning to
+Score" setting (arXiv 2603.10545): a fixed feasibility oracle with a
+learnable scoring head; the GFlowNets robust-scheduling line (arXiv
+2302.05446) motivates the temperature-relaxed decision distribution.
+
+Builders here compose the kernel's jitted scan with an on-device
+objective (tuning/objective.py) so the tuner loop exchanges ONE scalar
+(or one [S] gradient) per dispatch — rollouts never leave the device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from kube_scheduler_simulator_tpu.ops import batch as B
+from kube_scheduler_simulator_tpu.tuning.objective import objective_value
+
+
+def build_value_fn(
+    cfg: "B.BatchConfig",
+    dims: dict,
+    objective: str,
+    relax_tau: float = 0.0,
+) -> "Callable[[Any, Any, Any], Any]":
+    """``value(dp, w, age_w) -> scalar`` (higher = better): one full
+    rollout with the [S] weight vector ``w`` traced in, the objective
+    reduced on device.  ``relax_tau > 0`` builds the straight-through
+    head; forward values equal the hard build's."""
+    cfg = cfg._replace(traced_weights=True, relax_tau=float(relax_tau), trace=False)
+    fn = B.build_batch_fn(cfg, dims)
+
+    def value(dp, w, age_w):
+        ys = fn(dp._replace(plugin_w=jnp.asarray(w, dp.alloc.dtype)))
+        return objective_value(objective, ys, dp, age_w)
+
+    return value
+
+
+def build_population_fn(value_fn: Callable) -> Callable:
+    """``evaluate(dp, W[pop,S], age_w) -> [pop]`` hard objectives in ONE
+    dispatch: the rollout vmaps over the weight axis only, the problem
+    planes broadcast — a whole CEM generation is a single device call."""
+    return jax.jit(jax.vmap(value_fn, in_axes=(None, 0, None)))
+
+
+def build_grad_fn(value_fn: Callable) -> Callable:
+    """``grad(dp, w, age_w) -> (value, dvalue/dw)`` in one dispatch —
+    ``value_fn`` must come from a ``relax_tau > 0`` build for the
+    gradient to be nonzero."""
+    return jax.jit(
+        lambda dp, w, age_w: jax.value_and_grad(
+            lambda wv: value_fn(dp, wv, age_w)
+        )(w)
+    )

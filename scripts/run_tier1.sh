@@ -24,4 +24,8 @@ if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/gang_smoke.py; then 
 # sequential path over a 3-wave churn scenario, byte-compared with
 # engaged/overlapped assertions (scripts/stream_smoke.py).
 if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/stream_smoke.py; then rc=1; fi
+# Tuning smoke: a tiny 2-step CEM run on a toy scenario (objective
+# monotonicity + tuned >= default) plus the default-weight byte-parity
+# pin — folded vs traced kernel paths (scripts/tune_smoke.py).
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/tune_smoke.py; then rc=1; fi
 exit $rc

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Fast tuning smoke: a tiny 2-step CEM run on a toy scenario family plus
+the default-weight byte-parity pin — the tier-1 step that catches
+regressions in the learned scoring head (tuning/) without the slow
+markers.
+
+Asserts three things:
+
+1. CEM monotonicity: ``bestSoFar`` never decreases across generations
+   (best-so-far is monotone by construction; a violation means the
+   population evaluation and the bookkeeping disagree).
+2. The tuned objective is >= the default-weight objective (the default
+   vector is always a candidate via the elitist mean injection, so the
+   tuner can never report a regression).
+3. Default-weight byte parity: the SAME workload scheduled with the
+   profile's default weights constant-folded (the oracle executables)
+   and with the defaults TRACED through the tuner's kernel path leaves
+   byte-identical bindings + annotations.
+
+Exit 0 = all hold; nonzero = diverged.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+
+
+def main() -> int:
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+    from kube_scheduler_simulator_tpu.tuning import run_tuning
+    from kube_scheduler_simulator_tpu.tuning.scenario import build_family
+    from kube_scheduler_simulator_tpu.utils.parity import pod_parity_state
+
+    # --- 1+2: tiny CEM run, monotone best-so-far, tuned >= default
+    r = run_tuning(family="imbalance", tuner="cem", n_nodes=6, n_pods=24, steps=2, pop=4, seed=7)
+    best = [h["bestSoFar"] for h in r["history"]]
+    if any(b < a for a, b in zip(best, best[1:])):
+        print(f"FAIL: CEM bestSoFar not monotone: {best}", file=sys.stderr)
+        return 1
+    if r["tunedObjective"] < r["defaultObjective"]:
+        print(
+            f"FAIL: tuned objective {r['tunedObjective']} < default "
+            f"{r['defaultObjective']} (defaults are always a candidate)",
+            file=sys.stderr,
+        )
+        return 1
+    if r["rollouts"] <= 0 or r["dispatches"] <= 0:
+        print(f"FAIL: no rollouts recorded: {r['rollouts']}/{r['dispatches']}", file=sys.stderr)
+        return 1
+
+    # --- 3: default weights, folded vs traced, byte parity
+    nodes, pods, _obj = build_family("imbalance", n_nodes=5, n_pods=20, seed=2)
+
+    def run_mode(traced: bool):
+        store = ClusterStore(clock=lambda: 1700000000.0)
+        for n in nodes:
+            store.create("nodes", n)
+        for p in pods:
+            store.create("pods", p)
+        svc = SchedulerService(store, tie_break="first", use_batch="force", batch_min_work=0)
+        svc.start_scheduler(None)
+        if traced:
+            svc.set_plugin_weights(
+                {n: float(w) for n, w in svc.framework.score_weights.items()}
+            )
+            assert svc.plugin_weights() is not None, "override did not install"
+        svc.schedule_pending()
+        return pod_parity_state(store)
+
+    folded = run_mode(False)
+    traced = run_mode(True)
+    bad = [k for k in set(folded) | set(traced) if folded.get(k) != traced.get(k)]
+    if bad:
+        k = sorted(bad)[0]
+        print(
+            f"FAIL: {len(bad)} pods diverge between folded and traced default "
+            f"weights; first: {k}\n folded={str(folded.get(k))[:400]}\n "
+            f"traced={str(traced.get(k))[:400]}",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"tune smoke OK: cem bestSoFar {best} (default {r['defaultObjective']:.6f}), "
+        f"{r['rollouts']} rollouts/{r['dispatches']} dispatches; "
+        f"{len(folded)} pods byte-identical folded vs traced defaults"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,528 @@
+"""The learned scoring head (tuning/): traced weights, objectives, tuners.
+
+The contract has two halves.  EXACTNESS: with the profile's default
+weights — constant-folded (the oracle executables) or installed as a
+traced override — every byte the simulator writes must match the
+sequential oracle, across randomized churn; and with any validated float
+override, the batch path must agree with the sequential cycle run under
+the SAME override (the sequential runner's plain-Python weighted sum is
+the host-side oracle scorer).  OPTIMIZATION: the relaxed decision head's
+forward values are bit-identical to the hard rollout, its gradients are
+nonzero where the objective is smooth in the committed planes, and the
+CEM loop's best-so-far is monotone with tuned >= default.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any
+
+import numpy as np
+import pytest
+
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.state.store import ClusterStore
+from kube_scheduler_simulator_tpu.tuning.validate import (
+    WeightValidationError,
+    format_weighted_score,
+    validate_plugin_weights,
+)
+
+from tests.test_batch_parity import mk_node, mk_pod, profile_with
+
+Obj = dict[str, Any]
+
+PLUGINS = ["NodeResourcesFit", "NodeResourcesBalancedAllocation", "TaintToleration"]
+
+
+# --------------------------------------------------------------- validation
+
+
+def test_validate_sequence_happy_path():
+    v = validate_plugin_weights([1, 2.5, 0], ["A", "B", "C"])
+    assert v.tolist() == [1.0, 2.5, 0.0]
+
+
+def test_validate_mapping_with_defaults():
+    v = validate_plugin_weights({"B": 3}, ["A", "B"], defaults={"A": 1, "B": 1})
+    assert v.tolist() == [1.0, 3.0]
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        [1, 2],  # arity
+        [1, 2, 3, 4],  # arity
+        [1, -2, 3],  # negative
+        [1, float("nan"), 3],  # not finite
+        [1, float("inf"), 3],  # not finite
+        [1, "x", 3],  # not a number
+        [1, True, 3],  # bool is not a weight
+        {"Nope": 1},  # unknown plugin
+        "1,2,3",  # not a sequence
+        42,  # not a sequence
+    ],
+)
+def test_validate_rejects(bad):
+    with pytest.raises(WeightValidationError):
+        validate_plugin_weights(bad, ["A", "B", "C"], defaults={"A": 1, "B": 1, "C": 1})
+
+
+def test_validate_mapping_missing_without_default():
+    with pytest.raises(WeightValidationError):
+        validate_plugin_weights({"A": 1}, ["A", "B"])
+
+
+def test_format_weighted_score_integer_bytes():
+    # integral products must render the integer path's exact bytes
+    for norm in (0, 1, 37, 100):
+        for w in (0, 1, 2, 10):
+            assert format_weighted_score(norm, float(w)) == str(norm * w)
+    assert format_weighted_score(100, 1.5) == "150"  # integral float product
+    assert format_weighted_score(37, 0.5) == "18.5"
+
+
+# ------------------------------------------------- service-level validation
+
+
+def _cluster(n_nodes=12, seed=99):
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(n_nodes):
+        labels = {
+            "kubernetes.io/hostname": f"node-{i}",
+            "topology.kubernetes.io/zone": f"z{i % 3}",
+        }
+        taints = (
+            [{"key": "spot", "value": "true", "effect": "NoSchedule"}]
+            if i % 5 == 4
+            else None
+        )
+        nodes.append(
+            mk_node(
+                f"node-{i}",
+                cpu_m=rng.choice([4000, 8000, 16000]),
+                mem_mi=rng.choice([8192, 16384]),
+                labels=labels,
+                taints=taints,
+            )
+        )
+    return nodes
+
+
+def _pods(lo, hi, seed=7):
+    """Schedulable mixed pods: every pod fits SOMEWHERE, so both paths
+    record exactly one attempt per pod and the byte comparison isolates
+    the SCORING surface (unschedulable-retry cadence is queue-path
+    timing, pinned by the commit-pipeline suites)."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(lo, hi):
+        out.append(
+            mk_pod(
+                f"pod-{i:04d}",
+                cpu_m=rng.choice([100, 300, 700, 1500]),
+                mem_mi=rng.choice([128, 512, 2048]),
+                labels={"app": f"a{i % 3}"},
+            )
+        )
+    return out
+
+
+def _service(nodes, mode, weights=None, **kw):
+    store = ClusterStore(clock=lambda: 1700000000.0)
+    for n in nodes:
+        store.create("nodes", n)
+    svc = SchedulerService(
+        store,
+        tie_break="first",
+        use_batch=mode,
+        batch_min_work=0,
+        weights=weights,
+        **kw,
+    )
+    svc.start_scheduler(
+        {"profiles": [profile_with(PLUGINS)], "percentageOfNodesToScore": 100}
+    )
+    return store, svc
+
+
+def _pod_states(store):
+    out = {}
+    for p in store.list("pods"):
+        out[p["metadata"]["name"]] = (
+            (p.get("spec") or {}).get("nodeName"),
+            p["metadata"].get("annotations") or {},
+        )
+    return out
+
+
+def test_service_rejects_bad_weights_at_start():
+    nodes = _cluster(4)
+    store = ClusterStore()
+    for n in nodes:
+        store.create("nodes", n)
+    svc = SchedulerService(store, weights=[1, 2])  # wrong arity for profile
+    with pytest.raises(WeightValidationError):
+        svc.start_scheduler(
+            {"profiles": [profile_with(PLUGINS)], "percentageOfNodesToScore": 100}
+        )
+
+
+def test_set_plugin_weights_validates_and_clears():
+    nodes = _cluster(4)
+    _store, svc = _service(nodes, "off")
+    with pytest.raises(WeightValidationError):
+        svc.set_plugin_weights([1, -1, 1])
+    assert svc.plugin_weights() is None  # rejected: nothing installed
+    got = svc.set_plugin_weights([1, 2.5, 1])
+    assert got == dict(zip(svc.score_plugin_names(), [1.0, 2.5, 1.0]))
+    assert svc.framework.score_weight_override == got
+    svc.set_plugin_weights(None)
+    assert svc.plugin_weights() is None
+    assert svc.framework.score_weight_override is None
+
+
+# ------------------------------------------------------------ weight parity
+
+
+def _run_churn(svc, store, waves=3, seed=3):
+    """Randomized churn: waves of randomized pods scheduled against the
+    evolving bound state (no mid-wave deletes — delete-requeue timing is
+    queue-path-dependent and pinned by the commit-pipeline suites; this
+    harness isolates SCORING parity)."""
+    created = 0
+    for w in range(waves):
+        for p in _pods(created, created + 20, seed=seed + w):
+            store.create("pods", dict(p))
+            created += 1
+        svc.schedule_pending(max_rounds=1)
+
+
+@pytest.mark.parametrize("trial", range(4))
+def test_random_weights_batch_matches_sequential_oracle(trial):
+    """Randomized float weight vectors: the traced-weight kernel path must
+    reproduce the sequential cycle run under the SAME override — node
+    choices and annotation bytes (finalScore rendered from float weights
+    included).  The sequential runner computes its weighted sum in plain
+    Python on host — the NumPy-oracle scorer the kernel is judged
+    against."""
+    rng = np.random.default_rng(100 + trial)
+    weights = [round(float(w), 2) for w in rng.uniform(0.0, 4.0, size=len(PLUGINS))]
+    nodes = _cluster(10, seed=trial)
+    store_b, svc_b = _service(nodes, "force", weights=weights)
+    store_s, svc_s = _service(nodes, "off", weights=weights)
+    _run_churn(svc_b, store_b, seed=trial)
+    _run_churn(svc_s, store_s, seed=trial)
+    assert svc_b.stats["batch_pods"] > 0, "batch path never engaged"
+    b, s = _pod_states(store_b), _pod_states(store_s)
+    assert b.keys() == s.keys()
+    for name in sorted(b):
+        assert b[name][0] == s[name][0], f"{name}: node divergence under {weights}"
+        assert b[name][1] == s[name][1], (
+            f"{name}: annotation divergence under {weights}:\n"
+            f" batch={b[name][1]}\n seq={s[name][1]}"
+        )
+
+
+def test_default_weights_byte_identical_traced_vs_folded_vs_oracle():
+    """The zero-drift pin: the profile's own default weights run (a)
+    constant-folded — the pre-traced executables, (b) as a traced
+    override, and (c) through the sequential oracle, across randomized
+    churn — all three byte-identical."""
+    nodes = _cluster(10, seed=42)
+
+    def run(mode, weights):
+        store, svc = _service(nodes, mode, weights=weights)
+        _run_churn(svc, store, seed=5)
+        return _pod_states(store), svc
+
+    folded, svc_f = run("force", None)
+    defaults = {n: float(w) for n, w in svc_f.framework.score_weights.items()}
+    traced, svc_t = run("force", defaults)
+    oracle, _ = run("off", None)
+    assert svc_t.plugin_weights() is not None
+    assert svc_t.stats["batch_pods"] > 0
+    assert folded.keys() == traced.keys() == oracle.keys()
+    for name in sorted(folded):
+        assert folded[name] == traced[name], f"{name}: traced defaults drifted"
+        assert folded[name] == oracle[name], f"{name}: batch vs oracle drifted"
+
+
+# -------------------------------------------------- relaxed head + tuners
+
+
+def _session(family="imbalance", objective=None, n_nodes=6, n_pods=24, seed=1):
+    from kube_scheduler_simulator_tpu.tuning.scenario import build_family
+    from kube_scheduler_simulator_tpu.tuning.tuner import TuningSession, profile_scores
+
+    nodes, pods, fam_obj = build_family(family, n_nodes=n_nodes, n_pods=n_pods, seed=seed)
+    scores, filters = profile_scores()
+    return TuningSession(
+        nodes, pods, scores, filters=filters, objective=objective or fam_obj
+    )
+
+
+def test_relaxed_forward_bit_identical_to_hard():
+    """τ > 0 must not change a single forward bit: the straight-through
+    head's value IS the hard rollout's, only the backward pass differs."""
+    s = _session()
+    w = np.asarray([1.0, 2.0, 1.0][: len(s.scores)], dtype=np.float64)
+    if len(w) < len(s.scores):
+        w = np.ones(len(s.scores))
+    hard = s.evaluate(w)
+    for tau in (1.0, 50.0, 1000.0):
+        v, _g = s.value_and_grad(w, tau)
+        assert v == hard, f"relaxed forward diverged at tau={tau}: {v} != {hard}"
+
+
+def test_grad_nonzero_on_smooth_objective():
+    s = _session(family="imbalance", objective="fragmentation", n_pods=32)
+    w = np.ones(len(s.scores), dtype=np.float64)
+    _v, g = s.value_and_grad(w, tau=50.0)
+    assert np.all(np.isfinite(g))
+    assert float(np.linalg.norm(g)) > 0.0, "relaxed rollout gradient is identically zero"
+    assert s.grad_dispatches == 1
+
+
+def test_population_matches_single_rollouts():
+    """One vmapped population dispatch must agree with per-vector rollouts."""
+    s = _session()
+    rng = np.random.default_rng(3)
+    W = rng.uniform(0.2, 3.0, size=(4, len(s.scores)))
+    pop = s.evaluate_population(W)
+    single = np.asarray([s.evaluate(w) for w in W])
+    np.testing.assert_allclose(pop, single, rtol=1e-6)
+
+
+def test_cem_monotone_and_never_worse_than_default():
+    from kube_scheduler_simulator_tpu.tuning import run_tuning
+
+    r = run_tuning(family="imbalance", tuner="cem", n_nodes=6, n_pods=24, steps=3, pop=6, seed=2)
+    best = [h["bestSoFar"] for h in r["history"]]
+    assert all(b >= a for a, b in zip(best, best[1:])), best
+    assert r["tunedObjective"] >= r["defaultObjective"]
+    assert r["rollouts"] == 1 + 3 * 6  # default eval + pop per generation
+    assert r["dispatches"] == 1 + 3  # one eval + one vmapped dispatch per gen
+
+
+def test_objective_values_sane():
+    s_u = _session(family="consolidate", objective="utilization")
+    v = s_u.evaluate(np.ones(len(s_u.scores)))
+    assert 0.0 < v <= 1.0
+    s_p = _session(family="tail", objective="pending_age", n_pods=30)
+    v = s_p.evaluate(np.ones(len(s_p.scores)))
+    assert -1.0 <= v <= 0.0
+
+
+# ------------------------------------------------ scenario knob + metrics
+
+
+def test_scenario_plugin_weights_knob_applies_and_restores():
+    from kube_scheduler_simulator_tpu.scenario import ScenarioEngine
+
+    nodes = _cluster(6)
+    store, svc = _service(nodes, "auto")
+    engine = ScenarioEngine(store, svc, None)
+    ops = [
+        {
+            "id": "1",
+            "step": {"major": 1},
+            "createOperation": {
+                "typeMeta": {"kind": "Pod"},
+                "object": mk_pod("sc-pod-0", cpu_m=100, mem_mi=128),
+            },
+        },
+        {"id": "2", "step": {"major": 2}, "doneOperation": {}},
+    ]
+    out = engine.run(
+        {
+            "metadata": {"name": "tuned-run", "namespace": "default"},
+            "spec": {"operations": ops, "pluginWeights": [1, 3.5, 1]},
+        }
+    )
+    assert out["status"]["phase"] == "Succeeded", out["status"]
+    # the knob is scoped to the run: override restored afterwards
+    assert svc.plugin_weights() is None
+
+    bad = engine.run(
+        {
+            "metadata": {"name": "bad-run", "namespace": "default"},
+            "spec": {"operations": ops, "pluginWeights": [1, -1]},
+        }
+    )
+    assert bad["status"]["phase"] == "Failed"
+    assert "pluginWeights" in bad["status"]["message"]
+
+
+def test_autoscaler_estimation_with_override_active():
+    """The scale-up estimator lowers a FRESH problem whose plugin_w is the
+    scalar placeholder — it must run with constant-folded weights even
+    while a live override has the engine on the traced path (regression:
+    traced cfg + placeholder plugin_w crashed every estimate into the
+    resource-only fallback)."""
+    from kube_scheduler_simulator_tpu.autoscaler import ClusterAutoscaler
+
+    store = ClusterStore()
+    store.create(
+        "nodegroups",
+        {
+            "metadata": {"name": "g1"},
+            "spec": {
+                "minSize": 0,
+                "maxSize": 8,
+                "priority": 0,
+                "template": {
+                    "metadata": {"labels": {}},
+                    "spec": {},
+                    "status": {"allocatable": {"cpu": "4000m", "memory": "8Gi", "pods": "20"}},
+                },
+            },
+        },
+    )
+    svc = SchedulerService(store, tie_break="first", use_batch="off")
+    svc.start_scheduler(None)
+    svc.set_plugin_weights({"NodeResourcesFit": 2.5})
+    for i in range(4):
+        store.create("pods", mk_pod(f"asc-{i}", cpu_m=1500, mem_mi=1024))
+    svc.schedule_pending(max_rounds=1)
+    asc = ClusterAutoscaler(store, svc)
+    action = asc.scale_up(svc.pending_pods())
+    assert action["method"] == "xla-batch", action
+    est = asc._estimator
+    assert est is not None and est.dispatches >= 1 and est.kernel_errors == 0
+
+
+def test_scenario_restores_preexisting_override():
+    """A scenario's pluginWeights is scoped to the run: a live operator
+    override installed BEFORE the run must be reinstated after, not
+    cleared to defaults."""
+    from kube_scheduler_simulator_tpu.scenario import ScenarioEngine
+
+    nodes = _cluster(4)
+    store, svc = _service(nodes, "off")
+    live = svc.set_plugin_weights({"NodeResourcesFit": 2.5})
+    engine = ScenarioEngine(store, svc, None)
+    ops = [{"id": "1", "step": {"major": 1}, "doneOperation": {}}]
+    out = engine.run(
+        {
+            "metadata": {"name": "scoped", "namespace": "default"},
+            "spec": {"operations": ops, "pluginWeights": [1, 1, 1]},
+        }
+    )
+    assert out["status"]["phase"] == "Succeeded", out["status"]
+    assert svc.plugin_weights() == live, "pre-existing override must survive the run"
+    svc.set_plugin_weights(None)
+
+
+def test_set_plugin_weights_atomic_across_profiles():
+    """A vector valid for one profile but not another must reject WITHOUT
+    touching any profile: the previous override stays fully in place."""
+    nodes = _cluster(4)
+    store = ClusterStore()
+    for n in nodes:
+        store.create("nodes", n)
+    svc = SchedulerService(store, use_batch="off")
+    p1 = profile_with(PLUGINS)
+    p2 = dict(profile_with(["NodeResourcesFit"]), schedulerName="second")
+    svc.start_scheduler({"profiles": [p1, p2], "percentageOfNodesToScore": 100})
+    live = svc.set_plugin_weights({"NodeResourcesFit": 2.0})  # valid everywhere
+    with pytest.raises(WeightValidationError, match="profile"):
+        svc.set_plugin_weights([1, 1, 1])  # arity 3: valid for p1 only
+    assert svc.plugin_weights() == live
+    for fw in svc.frameworks.values():
+        assert fw.score_weight_override is not None, "profile lost the live override"
+
+
+def test_tuning_http_routes():
+    """/api/v1/tuning GET (state) + POST (run) + the 422 mapping for a
+    malformed weight vector — over a real socket."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from kube_scheduler_simulator_tpu.server import DIContainer, SimulatorServer
+
+    di = DIContainer(use_batch="off")
+    srv = SimulatorServer(di, port=0)
+    srv.start(background=True)
+    try:
+        def req(method, path, body=None):
+            url = f"http://127.0.0.1:{srv.port}{path}"
+            data = json.dumps(body).encode() if body is not None else None
+            r = urllib.request.Request(
+                url, data=data, method=method, headers={"Content-Type": "application/json"}
+            )
+            try:
+                with urllib.request.urlopen(r, timeout=30) as resp:
+                    raw = resp.read()
+                    return resp.status, (json.loads(raw) if raw else None)
+            except urllib.error.HTTPError as e:
+                raw = e.read()
+                return e.code, (json.loads(raw) if raw else None)
+
+        code, state = req("GET", "/api/v1/tuning")
+        assert code == 200
+        assert state["pluginWeights"] is None
+        assert "imbalance" in state["families"]
+        assert state["lastReport"] is None
+
+        code, rep = req(
+            "POST",
+            "/api/v1/tuning",
+            {"families": ["imbalance"], "tuner": "cem", "nodes": 5, "pods": 16, "steps": 2, "pop": 4},
+        )
+        assert code == 200, rep
+        (res,) = rep["results"]
+        assert res["tunedObjective"] >= res["defaultObjective"]
+        assert res["rollouts"] > 0
+
+        code, state = req("GET", "/api/v1/tuning")
+        assert code == 200 and state["lastReport"] is not None
+
+        # malformed starting weights → 422 with the named problem
+        code, err = req("POST", "/api/v1/tuning", {"families": ["imbalance"], "weights": [1, -2]})
+        assert code == 422, (code, err)
+        assert "non-negative" in err["message"] or "expected" in err["message"]
+
+        # scenario spec.pluginWeights validated at POST time → 422 too
+        code, err = req(
+            "POST",
+            "/api/v1/scenarios",
+            {"metadata": {"name": "bad"}, "spec": {"operations": [], "pluginWeights": [1]}},
+        )
+        assert code == 422, (code, err)
+
+        # unknown family → 400
+        code, err = req("POST", "/api/v1/tuning", {"families": ["nope"]})
+        assert code == 400, (code, err)
+    finally:
+        srv.shutdown()
+
+
+def test_metrics_expose_tuning_counters():
+    from kube_scheduler_simulator_tpu.tuning import run_tuning
+
+    nodes = _cluster(4)
+    _store, svc = _service(nodes, "off")
+    r = run_tuning(
+        family="imbalance", tuner="cem", n_nodes=5, n_pods=16, steps=2, pop=4, svc=svc
+    )
+    m = svc.metrics()
+    assert m["tuning_runs_total"] == 1
+    assert m["tuning_rollouts_total"] == r["rollouts"]
+    assert m["tuning_objective"]["fragmentation"] == pytest.approx(r["tunedObjective"])
+
+    class _DI:
+        cluster_store = _store
+
+        def scheduler_service(self):
+            return svc
+
+    from kube_scheduler_simulator_tpu.server.metrics import render_metrics
+
+    text = render_metrics(_DI())
+    assert "simulator_tuning_rollouts_total" in text
+    assert 'simulator_tuning_objective{name="fragmentation"}' in text
+    assert "simulator_tuning_grad_dispatches_total" in text
+    assert "simulator_plugin_weights_overridden 0" in text
