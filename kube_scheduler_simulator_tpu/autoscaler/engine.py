@@ -145,6 +145,10 @@ class ClusterAutoscaler:
             "estimate_kernel_errors": est.kernel_errors if est else 0,
             "estimate_last_s": est.last_estimate_s if est else 0.0,
             "estimate_cum_s": est.cum_estimate_s if est else 0.0,
+            "estimate_sharded_dispatches": est.sharded_dispatches if est else 0,
+            "estimate_shard_plane_bytes_per_device": (
+                est.shard_plane_bytes_per_device if est else 0
+            ),
             "groups": {
                 gs["name"]: {"current": gs["currentSize"], "min": gs["minSize"], "max": gs["maxSize"]}
                 for gs in self.group_status()
@@ -189,7 +193,11 @@ class ClusterAutoscaler:
 
     def _estimator_for(self, fw: Any) -> ScaleUpEstimator:
         if self._estimator is None or self._estimator_fw is not fw:
-            self._estimator = ScaleUpEstimator.from_framework(fw, store=self.store)
+            # the estimator shards its node axis over the same mesh the
+            # scheduler's batch engines shard over
+            self._estimator = ScaleUpEstimator.from_framework(
+                fw, store=self.store, mesh=getattr(self.scheduler, "mesh", None)
+            )
             self._estimator_fw = fw
         return self._estimator
 

@@ -67,12 +67,17 @@ class ScaleUpEstimator:
         added_affinity: "Obj | None" = None,
         store: Any = None,
         seed: int = 0,
+        mesh: Any = None,
     ):
+        from kube_scheduler_simulator_tpu.ops.mesh import resolve_mesh
         from kube_scheduler_simulator_tpu.scheduler.batch_engine import BatchEngine
 
         # Feasibility = the profile's filters; packing = MostAllocated
         # best-fit (see module docstring).  trace off: estimation needs
-        # decisions, not annotations.
+        # decisions, not annotations.  ``mesh``: the estimator manages
+        # its own sharding (the vmapped dispatch places the [G,N] lane
+        # mask itself), so the inner engine stays mesh-less.
+        self.mesh = resolve_mesh(mesh)
         self.engine = BatchEngine(
             filters=filters,
             scores=[("NodeResourcesFit", 1)],
@@ -83,6 +88,7 @@ class ScaleUpEstimator:
             trace=False,
             tie_break="first",
             seed=seed,
+            mesh=None,
         )
         self.engine._store = store
         self._fn_cache: dict = {}
@@ -91,13 +97,17 @@ class ScaleUpEstimator:
         self.compiles = 0
         self.last_estimate_s = 0.0
         self.cum_estimate_s = 0.0
+        self.sharded_dispatches = 0
+        self.shard_plane_bytes_per_device = 0
         # kernel-path crashes that degraded to the resource fallback — a
         # nonzero count means a BUG (supported() said the workload was
         # coverable), not a legitimately unsupported workload
         self.kernel_errors = 0
 
     @classmethod
-    def from_framework(cls, framework: Any, store: Any = None) -> "ScaleUpEstimator":
+    def from_framework(
+        cls, framework: Any, store: Any = None, mesh: Any = None
+    ) -> "ScaleUpEstimator":
         filters = [wp.original.name for wp in framework.plugins["filter"]]
         hard_w = 1
         added = None
@@ -113,6 +123,7 @@ class ScaleUpEstimator:
             added_affinity=added,
             store=store,
             seed=framework.seed,
+            mesh=mesh,
         )
 
     # ------------------------------------------------------------- estimate
@@ -192,7 +203,11 @@ class ScaleUpEstimator:
             added_affinity=eng.added_affinity,
             volumes=volumes or {},
         )
-        pr = E.pad_problem(pr)
+        # a mesh needs the node axis divisible by its device count
+        from kube_scheduler_simulator_tpu.ops.mesh import mesh_devices
+
+        nm = mesh_devices(self.mesh) or 1
+        pr = E.pad_problem(pr, node_multiple=nm)
         dp, dims = B.lower(pr, dtype=eng.dtype)
         # full coverage, no rotation: the sampling machinery compiles out
         # and visit order == index order (tie_break="first" then fills the
@@ -208,7 +223,10 @@ class ScaleUpEstimator:
         for g, (_grp, lo, hi) in enumerate(blocks):
             masks[g, lo:hi] = True
 
-        key = (tuple(sorted(dims.items())), cfg, G)
+        key = (
+            tuple(sorted(dims.items())), cfg, G,
+            id(self.mesh) if self.mesh is not None else None,
+        )
         fn = self._fn_cache.get(key)
         if fn is None:
             base = B.build_batch_fn(cfg, dims)
@@ -219,8 +237,29 @@ class ScaleUpEstimator:
             self._fn_cache[key] = fn
             self.compiles += 1
 
-        dp = jax.device_put(dp._replace(node_active=masks))
-        out = fn(dp)  # ONE dispatch: G lanes x (P pods x N template rows)
+        if self.mesh is not None:
+            # shard the node axis over the mesh — every lane's template
+            # rows split across devices and the per-lane reductions
+            # (feasible counts, argmax select) become collectives; the
+            # [G,N] lane mask shards its NODE axis (lanes replicate)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self.sharded_dispatches += 1
+            # account the [G,N] lane mask that is actually placed, not
+            # lower()'s [N] node_active placeholder it replaces
+            self.shard_plane_bytes_per_device += B.tree_shard_bytes_per_device(
+                dp._replace(node_active=masks), nm
+            )
+            dp = B.shard_device_problem(dp, self.mesh)
+            mask_dev = jax.device_put(
+                masks, NamedSharding(self.mesh, P(None, "nodes"))
+            )
+            dp = dp._replace(node_active=mask_dev)
+            with self.mesh:
+                out = fn(dp)  # ONE dispatch: G lanes x (P pods x N template rows)
+        else:
+            dp = jax.device_put(dp._replace(node_active=masks))
+            out = fn(dp)  # ONE dispatch: G lanes x (P pods x N template rows)
         self.dispatches += 1
         packed = np.asarray(out["packed_pod"])          # [G, 5, P]
         pod_count = np.asarray(out["final_pod_count"])  # [G, N]

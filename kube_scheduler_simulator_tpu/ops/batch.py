@@ -519,6 +519,7 @@ NODE_AXIS_SPECS = {
     "node_label_idx": (0,),
     "node_img_idx": (0,),
     "node_unsched": (0,),
+    "node_active": (0,),
     # [KT/SG/G, N]: shard the node axis
     "node_domain": (1,),
     "spread_counts0": (1,),
@@ -592,6 +593,21 @@ def tree_nbytes(dp: "DeviceProblem") -> int:
         for leaf in (val if isinstance(val, tuple) else (val,)):
             if isinstance(leaf, np.ndarray) and leaf.ndim:
                 total += leaf.nbytes
+    return total
+
+
+def tree_shard_bytes_per_device(dp: "DeviceProblem", n_devices: int) -> int:
+    """Per-device bytes of a full sharded placement of ``dp``: node-axis
+    planes (NODE_AXIS_SPECS) split across the mesh, everything else
+    replicated in full on every device — the memory-scaling claim of the
+    sharded path, surfaced as ``plane_shard_bytes_per_device``."""
+    n = max(int(n_devices), 1)
+    total = 0
+    for name, val in dp._asdict().items():
+        sharded = name in NODE_AXIS_SPECS
+        for leaf in (val if isinstance(val, tuple) else (val,)):
+            if isinstance(leaf, np.ndarray) and leaf.ndim:
+                total += leaf.nbytes // n if sharded else leaf.nbytes
     return total
 
 

@@ -1,0 +1,156 @@
+"""Node-axis mesh plumbing: env-knob resolution, boundary validation,
+per-device byte accounting, and cross-platform lowering dryruns.
+
+The node axis is this workload's tensor-parallel axis (every per-step
+filter/score is elementwise over nodes; cross-node reductions — feasible
+counts, normalize max/min, argmax select — become XLA collectives), and
+three kernels scan it: the main batch scan (ops/batch.py), the preemption
+victim search (preemption/kernel.py) and the autoscaler estimation
+dispatch (autoscaler/estimator.py).  All three shard it over the SAME
+``jax.sharding.Mesh`` with a "nodes" axis, resolved here.
+
+Resolution order: an explicit ``jax.sharding.Mesh`` wins; the ``"auto"``
+sentinel (the SchedulerService / BatchEngine default) consults the
+``KSS_MESH_DEVICES`` env knob; ``None`` / unset / ``1`` means
+single-device.  Validation happens HERE, at the boundary — a bad device
+count is a :class:`MeshConfigError` naming the rule it broke, never a
+jit shape error three layers down.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+AXIS_NAME = "nodes"
+
+
+class MeshConfigError(ValueError):
+    """A mesh/device-count configuration the boundary rejects."""
+
+
+def _available_devices() -> list:
+    import jax
+
+    return list(jax.local_devices())
+
+
+def mesh_from_env(axis_name: str = AXIS_NAME) -> "Any | None":
+    """Build the node-axis mesh the ``KSS_MESH_DEVICES`` knob asks for,
+    or None when the knob is unset/empty/``1`` (single-device).
+
+    Rejected with a clear :class:`MeshConfigError` (never a downstream
+    jit shape error):
+
+    - non-integer or non-positive values;
+    - counts exceeding the locally visible device count;
+    - non-power-of-two counts.  The engines DO pad the node axis to any
+      device multiple, so every count would run — but the encoder's
+      bucket series {2^k, 1.25·2^k, 1.5·2^k, 1.75·2^k}
+      (ops/encode._bucket) is divisible by a power-of-two count for
+      every bucket ≥ 4× the count (executables stay on the bucketed
+      shapes the jit cache reuses), while a non-power-of-two count
+      divides almost none of it — off-bucket node padding and a fresh
+      executable family on every bucket transition.  Real accelerator
+      meshes come in power-of-two sizes; a count like 3 or 6 is near
+      certainly a typo, and the boundary rejects it loudly rather than
+      silently running a shape-churning mesh.
+    """
+    raw = os.environ.get("KSS_MESH_DEVICES")
+    if raw is None or not raw.strip():
+        return None
+    try:
+        n = int(raw)
+    except ValueError:
+        raise MeshConfigError(
+            f"KSS_MESH_DEVICES must be a positive integer, got {raw!r}"
+        ) from None
+    if n <= 0:
+        raise MeshConfigError(f"KSS_MESH_DEVICES must be >= 1, got {n}")
+    if n == 1:
+        return None
+    if n & (n - 1):
+        raise MeshConfigError(
+            f"KSS_MESH_DEVICES={n} is not a power of two: a power-of-two "
+            f"count divides every padded node bucket ≥ 4× its size (the "
+            f"jit cache keeps reusing the bucketed executables), while "
+            f"{n} divides almost none — every bucket transition would pad "
+            f"off-series and compile a fresh executable family; accelerator "
+            f"meshes come in power-of-two sizes, so this is rejected as a "
+            f"misconfiguration"
+        )
+    devices = _available_devices()
+    if n > len(devices):
+        raise MeshConfigError(
+            f"KSS_MESH_DEVICES={n} exceeds the {len(devices)} visible "
+            f"device(s) — set XLA_FLAGS=--xla_force_host_platform_device_count"
+            f"=N for a virtual CPU mesh, or lower the knob"
+        )
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devices[:n]), (axis_name,))
+
+
+def resolve_mesh(mesh: Any, axis_name: str = AXIS_NAME) -> "Any | None":
+    """Normalize a mesh argument: ``"auto"`` → :func:`mesh_from_env`,
+    ``None`` → None, an explicit Mesh → itself (validated to carry the
+    ``"nodes"`` axis every sharded kernel shards over)."""
+    if mesh is None:
+        return None
+    if isinstance(mesh, str):
+        if mesh == "auto":
+            return mesh_from_env(axis_name)
+        raise MeshConfigError(f"mesh must be a jax Mesh, None or 'auto', got {mesh!r}")
+    if axis_name not in getattr(mesh, "shape", {}):
+        raise MeshConfigError(
+            f"mesh {mesh} has no {axis_name!r} axis — the node-axis kernels "
+            f"shard over Mesh(devices, ({axis_name!r},))"
+        )
+    return mesh
+
+
+def mesh_devices(mesh: Any) -> int:
+    """Device count of a node-axis mesh (0 = single-device/no mesh)."""
+    return int(mesh.shape[AXIS_NAME]) if mesh is not None else 0
+
+
+def mesh_on_accelerator(mesh: Any) -> bool:
+    """True when the mesh's devices are a real accelerator (donation of
+    sharded carries engages there; the virtual CPU mesh skips it — CPU
+    jit has no donation support and would warn per compile)."""
+    if mesh is None:
+        return False
+    dev = next(iter(mesh.devices.flat))
+    return dev.platform != "cpu"
+
+
+# ------------------------------------------------------ lowering dryruns
+
+def tpu_lowering_dryrun(fn, args: tuple, platform: str = "tpu") -> "tuple[bool, str]":
+    """Lower a jitted computation for ``platform`` without the hardware —
+    the cross-platform ``jax.export`` path traces the function and runs
+    the platform's lowering rules, so "does this executable even lower
+    for TPU" is answerable from a CPU-only host.  Sharded variants pass
+    mesh-placed (or sharding-carrying ShapeDtypeStruct) args; the
+    shardings are recorded symbolically in the exported module.
+
+    Returns ``(True, summary)`` on success, ``(False, reason)`` when the
+    export API is unavailable or the lowering fails — callers surface
+    the reason loudly (a test skip message, a bench row note) instead of
+    silently passing.  This checks LOWERING (StableHLO for the platform,
+    sharding annotations included), not the platform compiler's codegen —
+    that needs the device."""
+    try:
+        import jax.export as jexp
+    except Exception as e:  # pragma: no cover - ancient jax
+        return False, f"jax.export unavailable: {type(e).__name__}: {e}"
+    try:
+        exp = jexp.export(fn, platforms=[platform])(*args)
+        return True, (
+            f"{platform} lowering OK: {len(exp.mlir_module_serialized)} bytes "
+            f"of StableHLO, {exp.nr_devices} device(s)"
+        )
+    except Exception as e:
+        msg = str(e).split("\n")[0][:300]
+        return False, f"{platform} lowering failed: {type(e).__name__}: {msg}"

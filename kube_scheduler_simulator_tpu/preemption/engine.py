@@ -124,7 +124,7 @@ class PreemptionRound:
 
     def __init__(self, pr: "PE.PreemptionProblem", tail: list[Obj], fit_k: int,
                  ureq_all: np.ndarray, uprio_all: np.ndarray,
-                 pod_reasons: "list[str | None]", n_true: int):
+                 pod_reasons: "list[str | None]", n_true: int, mesh: Any = None):
         self.pr = pr
         self.tail = tail
         self.fit_k = fit_k  # NodeResourcesFit's index in cfg.filters, -1 if absent
@@ -132,11 +132,15 @@ class PreemptionRound:
         self.uprio_all = uprio_all  # [T]
         self.pod_reasons = pod_reasons  # per tail pod: unsupported reason or None
         self.n_true = n_true
+        # the engine's node-axis mesh: the victim search shards its [N,...]
+        # planes over the same devices the main scan shards over
+        self.mesh = mesh
         # usage committed by earlier windows of this kernel run (scaled)
         self._extra_req = np.zeros_like(pr.base_req)
         self._extra_cnt = np.zeros_like(pr.base_cnt)
         self.kernel_s = 0.0
         self.dispatches = 0
+        self.sharded_dispatches = 0
 
     def note_success(self, tail_idx: int, node_id: int) -> None:
         """Record a committed bind from an already-replayed window, so
@@ -205,11 +209,15 @@ class PreemptionRound:
         pr.base_cnt = base_cnt + self._extra_cnt
         t0 = time.perf_counter()
         try:
-            masks = PK.run_search(pr, ucand, ureq, uprio, smask, sreq, snode)
+            masks = PK.run_search(
+                pr, ucand, ureq, uprio, smask, sreq, snode, mesh=self.mesh
+            )
         finally:
             pr.base_req, pr.base_cnt = base_req, base_cnt
         self.kernel_s += time.perf_counter() - t0
         self.dispatches += 1
+        if self.mesh is not None:
+            self.sharded_dispatches += 1
 
         cand, victims, viol = masks["cand"], masks["victims"], masks["viol"]
         vp = pr.vprio[None, :, :]
@@ -309,4 +317,10 @@ def prepare_round(
         )
     cfg_filters = eng.cfg.filters
     fit_k = cfg_filters.index("NodeResourcesFit") if "NodeResourcesFit" in cfg_filters else -1
-    return PreemptionRound(pr, tail, fit_k, ureq_all, uprio_all, reasons, len(nis)), None
+    return (
+        PreemptionRound(
+            pr, tail, fit_k, ureq_all, uprio_all, reasons, len(nis),
+            mesh=getattr(eng, "mesh", None),
+        ),
+        None,
+    )

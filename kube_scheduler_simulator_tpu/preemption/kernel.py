@@ -140,19 +140,33 @@ def _f(x: np.ndarray) -> np.ndarray:
     return np.asarray(x, dtype=dt)
 
 
-def run_search(pr, ucand, ureq, uprio, smask, sreq, snode):
+def run_search(pr, ucand, ureq, uprio, smask, sreq, snode, mesh=None):
     """Dispatch the search: pads U/V/S to buckets (the jit cache sees
     O(log) shapes as rounds churn) and returns numpy masks trimmed back
     to the true dims.  ``pr`` is the encoded PreemptionProblem (columns
-    already GCD-scaled by the engine)."""
+    already GCD-scaled by the engine).
+
+    ``mesh``: a node-axis ``jax.sharding.Mesh`` — the per-node state
+    (alloc/usage/victim slots, axis 0 of the [N,...] planes and axis 1
+    of ``ucand``) shards across its devices and the vmap(N) lane set
+    splits over the mesh; per-pod vectors and the same-window commit
+    tables replicate.  The node axis is padded to a device multiple
+    (padding nodes carry no candidates, no victims, zero capacity — they
+    can never produce a decision), and the returned masks are trimmed
+    back, so sharded == unsharded bit-for-bit."""
     from kube_scheduler_simulator_tpu.ops.encode import _bucket
 
     U_true, N = ucand.shape
+    N_true = N
     V_true, R, PDB = pr.V, len(pr.resource_names), pr.PDB
     S_true = len(snode)
     U = max(_bucket(U_true), 1)
     V = max(_bucket(V_true), 1)
     S = _bucket(S_true)
+    from kube_scheduler_simulator_tpu.ops.mesh import mesh_devices
+
+    nm = mesh_devices(mesh) or 1
+    N = ((N + nm - 1) // nm) * nm  # mesh needs the node axis divisible
 
     def pad(a, dim, size):
         if a.shape[dim] == size:
@@ -161,28 +175,58 @@ def run_search(pr, ucand, ureq, uprio, smask, sreq, snode):
         w[dim] = (0, size - a.shape[dim])
         return np.pad(a, w)
 
-    ucand_p = pad(np.asarray(ucand, dtype=bool), 0, U)
+    ucand_p = pad(pad(np.asarray(ucand, dtype=bool), 1, N), 0, U)
     ureq_p = _f(pad(np.asarray(ureq), 0, U))
     uprio_p = pad(np.asarray(uprio, dtype=np.int64), 0, U)
     smask_p = pad(pad(np.asarray(smask, dtype=bool).reshape(U_true, S_true), 1, S), 0, U) if S else np.zeros((U, 0), dtype=bool)
     sreq_p = _f(pad(np.asarray(sreq).reshape(S_true, R), 0, S)) if S else np.zeros((0, R))
     snode_p = pad(np.asarray(snode, dtype=np.int32), 0, S) if S else np.zeros((0,), dtype=np.int32)
 
-    vreq_p = _f(pad(pr.vreq, 1, V))
-    vprio_p = pad(pr.vprio, 1, V)
-    vvalid_p = pad(pr.vvalid, 1, V)
-    vmatch_p = pad(pr.vmatch, 1, V)
+    vreq_p = _f(pad(pad(pr.vreq, 1, V), 0, N))
+    vprio_p = pad(pad(pr.vprio, 1, V), 0, N)
+    vvalid_p = pad(pad(pr.vvalid, 1, V), 0, N)
+    vmatch_p = pad(pad(pr.vmatch, 1, V), 0, N)
 
-    fn = build_preempt_fn(U, N, V, R, PDB, S)
-    out = fn(
+    args = (
         ucand_p, ureq_p, uprio_p, smask_p,
-        _f(pr.alloc), _f(pr.base_req), _f(pr.base_cnt), _f(pr.max_pods),
+        _f(pad(pr.alloc, 0, N)), _f(pad(pr.base_req, 0, N)),
+        _f(pad(pr.base_cnt, 0, N)), _f(pad(pr.max_pods, 0, N)),
         vreq_p, vprio_p, vvalid_p, vmatch_p,
         np.asarray(pr.allowed, dtype=np.int32),
         sreq_p, snode_p,
     )
+    fn = build_preempt_fn(U, N, V, R, PDB, S)
+    if mesh is not None:
+        args = shard_search_args(args, mesh)
+        with mesh:
+            out = fn(*args)
+    else:
+        out = fn(*args)
     return {
-        "cand": np.asarray(out["cand"])[:U_true],
-        "victims": np.asarray(out["victims"])[:U_true, :, :V_true],
-        "viol": np.asarray(out["viol"])[:U_true, :, :V_true],
+        "cand": np.asarray(out["cand"])[:U_true, :N_true],
+        "victims": np.asarray(out["victims"])[:U_true, :N_true, :V_true],
+        "viol": np.asarray(out["viol"])[:U_true, :N_true, :V_true],
     }
+
+
+# argument positions of run_search's jitted fn whose axis 0 is the node
+# axis (alloc/base_req/base_cnt/max_pods/vreq/vprio/vvalid/vmatch);
+# ucand (position 0) shards the node axis at axis 1
+_SEARCH_NODE_AXIS0 = (4, 5, 6, 7, 8, 9, 10, 11)
+
+
+def shard_search_args(args: tuple, mesh) -> tuple:
+    """Place the victim-search arguments on the mesh: node-axis planes
+    sharded, everything else replicated — one device_put for the tuple."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def spec(i, a):
+        nd = np.asarray(a).ndim
+        if i in _SEARCH_NODE_AXIS0:
+            return NamedSharding(mesh, P("nodes", *([None] * (nd - 1))))
+        if i == 0:  # ucand [U, N]
+            return NamedSharding(mesh, P(None, "nodes"))
+        return NamedSharding(mesh, P())
+
+    shardings = tuple(spec(i, a) for i, a in enumerate(args))
+    return tuple(jax.device_put(list(args), list(shardings)))

@@ -982,7 +982,11 @@ class BatchEngine:
         enable_persistent_compilation_cache()
         tune_malloc()
         self.profile_dir = profile_dir or os.environ.get("KSS_TPU_PROFILE_DIR") or None
-        self.mesh = mesh
+        # "auto" consults the KSS_MESH_DEVICES env knob; a bad count is a
+        # MeshConfigError at THIS boundary, never a jit shape error
+        from kube_scheduler_simulator_tpu.ops.mesh import resolve_mesh
+
+        self.mesh = resolve_mesh(mesh)
         # Plugin-weight override (the learned scoring head, tuning/):
         # validated HERE — the config boundary — so a bad vector is a
         # clear WeightValidationError, never a shape error inside jit.
@@ -1026,6 +1030,12 @@ class BatchEngine:
         # its own counter); encode_full counter for cache-off engines
         self._direct_bytes_uploaded = 0
         self._encode_full_nocache = 0
+        # node-axis sharding observability: rounds dispatched with the
+        # node axis sharded over the mesh, and the cumulative per-device
+        # bytes of their problem placements (sharded planes divided
+        # across the mesh, replicated planes in full)
+        self.sharded_dispatches = 0
+        self.shard_plane_bytes_per_device = 0
         self._fn_cache: dict = {}
         # trace-compaction executables, keyed by (scan key, visited-width
         # bucket) — kept apart so _fn_cache counts scan executables only
@@ -1333,7 +1343,9 @@ class BatchEngine:
             )
         # mesh sharding needs the node axis divisible by the mesh's "nodes"
         # axis — pad it even with bucketing off
-        node_multiple = int(self.mesh.shape["nodes"]) if self.mesh is not None else 1
+        from kube_scheduler_simulator_tpu.ops.mesh import mesh_devices
+
+        node_multiple = mesh_devices(self.mesh) or 1
         if self.bucket or node_multiple > 1:
             pr = E.pad_problem(pr, node_multiple=node_multiple)
         t1 = time.perf_counter()
@@ -1371,6 +1383,14 @@ class BatchEngine:
             ws0,
             id(self.mesh) if self.mesh is not None else None,
         )
+        if self.mesh is not None:
+            # every dispatch of this round's problem runs node-sharded;
+            # the per-device accounting reads the HOST tree (before
+            # placement), so placer and direct paths report identically
+            self.sharded_dispatches += 1
+            self.shard_plane_bytes_per_device += B.tree_shard_bytes_per_device(
+                dp, node_multiple
+            )
         if self._placer is not None:
             # device-resident problem: unchanged planes stay on device,
             # small row deltas go up as jitted scatter-updates (sharded
@@ -1381,8 +1401,8 @@ class BatchEngine:
         elif self.mesh is not None:
             # multi-chip: shard the node axis over the mesh; the jitted
             # computation picks the shardings up from the placed arrays
-            # (donation is skipped — sharded carries would need matching
-            # output shardings to alias)
+            # (accelerator meshes still donate the carry — see
+            # _finish_prepped; only the virtual CPU mesh skips donation)
             self._direct_bytes_uploaded += B.tree_nbytes(dp)
             dp = B.shard_device_problem(dp, self.mesh)
         else:
@@ -1479,6 +1499,8 @@ class BatchEngine:
             s["device_bytes_uploaded_total"] = self._direct_bytes_uploaded
             s["device_plane_reuses_total"] = 0
             s["device_scatter_updates_total"] = 0
+        s["sharded_dispatches_total"] = self.sharded_dispatches
+        s["plane_shard_bytes_per_device"] = self.shard_plane_bytes_per_device
         return s
 
     def _note_round(self, timings: dict) -> None:
@@ -1629,7 +1651,16 @@ class BatchEngine:
         fn = self._fn_cache.get(key)
         t2 = time.perf_counter()
         if fn is None:
-            fn = B.build_batch_fn(cfg, dims, donate=self.mesh is None, ws0=ws0)
+            # Donation is preserved on accelerator meshes: the sharded
+            # initial carry aliases into the scan carry (GSPMD keeps the
+            # elementwise carry updates on the input shardings, so XLA
+            # can alias shard-for-shard).  Only the virtual CPU mesh
+            # skips it — CPU jit has no donation support and would warn
+            # per compile.
+            from kube_scheduler_simulator_tpu.ops.mesh import mesh_on_accelerator
+
+            donate = self.mesh is None or mesh_on_accelerator(self.mesh)
+            fn = B.build_batch_fn(cfg, dims, donate=donate, ws0=ws0)
             self._fn_cache[key] = fn
             self.compiles += 1
         out_dev = fn(dp)

@@ -49,7 +49,7 @@ class SchedulerService:
         batch_min_work: int = 2048,
         batch_max_restarts: int = 8,
         clock: "Callable[[], float] | None" = None,
-        mesh: Any = None,
+        mesh: Any = "auto",
         commit_wave: int = 256,
         pipeline: "bool | str" = "auto",
         autoscale: str = "off",
@@ -112,8 +112,14 @@ class SchedulerService:
         self._clock = clock
         self.use_batch = use_batch
         # jax.sharding.Mesh for multi-chip rounds: every profile engine
-        # shards its node axis over it (SURVEY §2.5 scaling axis)
-        self.mesh = mesh
+        # (and the preemption victim search + autoscaler estimator riding
+        # on it) shards its node axis over it (SURVEY §2.5 scaling axis).
+        # "auto" consults the KSS_MESH_DEVICES env knob, validated at
+        # this boundary (ops/mesh.py: a bad device count raises a
+        # MeshConfigError here, never a jit shape error mid-round).
+        from kube_scheduler_simulator_tpu.ops.mesh import resolve_mesh
+
+        self.mesh = resolve_mesh(mesh)
         self.batch_min_work = batch_min_work
         self.commit_wave = max(int(commit_wave), 1)
         self.pipeline = pipeline
@@ -190,6 +196,7 @@ class SchedulerService:
             "preempt_nominations": 0,
             "preempt_victims": 0,
             "preempt_dispatches": 0,
+            "preempt_sharded_dispatches": 0,
             "preempt_kernel_s": 0.0,
             "preempt_fallbacks": {},
             # gang engine (gang/): all-or-nothing PodGroup placement on
@@ -1023,6 +1030,7 @@ class SchedulerService:
             if pctx is not None:
                 with self._stats_lock:
                     self.stats["preempt_dispatches"] += pctx.dispatches
+                    self.stats["preempt_sharded_dispatches"] += pctx.sharded_dispatches
                     self.stats["preempt_kernel_s"] += pctx.kernel_s
             if restart_at is None:
                 break
@@ -1324,6 +1332,8 @@ class SchedulerService:
             "device_bytes_uploaded_total": 0,
             "device_plane_reuses_total": 0,
             "device_scatter_updates_total": 0,
+            "sharded_dispatches_total": 0,
+            "plane_shard_bytes_per_device": 0,
         }
         for e in list(self._batch_engines.values()) or ([eng] if eng else []):
             es = e.encode_stats()
@@ -1333,8 +1343,21 @@ class SchedulerService:
                         enc[k][reason] = enc[k].get(reason, 0) + n
                 else:
                     enc[k] += es.get(k, 0)
+        # node-axis sharding: the victim search and the autoscaler's
+        # estimation dispatch shard over the same mesh as the main scan —
+        # their sharded work aggregates into the same pair of counters
+        enc["sharded_dispatches_total"] += self.stats["preempt_sharded_dispatches"]
+        asc_m = self._autoscaler.metrics() if self._autoscaler is not None else None
+        if asc_m is not None:
+            enc["sharded_dispatches_total"] += asc_m["estimate_sharded_dispatches"]
+            enc["plane_shard_bytes_per_device"] += asc_m[
+                "estimate_shard_plane_bytes_per_device"
+            ]
+        from kube_scheduler_simulator_tpu.ops.mesh import mesh_devices
+
         return {
             **enc,
+            "shard_devices": mesh_devices(self.mesh),
             "batch_commits": self.stats["batch_commits"],
             "batch_pods": self.stats["batch_pods"],
             "batch_restarts": self.stats["batch_restarts"],
@@ -1389,7 +1412,7 @@ class SchedulerService:
             "engine_last_timings": last_t,
             "engine_cum_timings": dict(eng.cum_timings) if eng else {},
             # capacity engine (None when off or never engaged)
-            "autoscaler": self._autoscaler.metrics() if self._autoscaler is not None else None,
+            "autoscaler": asc_m,
         }
 
     def _commit_batch_wave(

@@ -171,6 +171,10 @@ def render_metrics(di: Any) -> str:
     counter("device_bytes_uploaded_total", "Host-to-device bytes actually shipped for problem placement (reused resident planes upload nothing).", m["device_bytes_uploaded_total"])
     counter("device_plane_reuses_total", "Device-resident planes reused unchanged across rounds.", m["device_plane_reuses_total"])
     counter("device_scatter_updates_total", "Resident planes updated in place via jitted row scatter-updates.", m["device_scatter_updates_total"])
+    # node-axis mesh sharding (ops/mesh.py): the scale axis across chips
+    counter("shard_devices", "Devices in the node-axis sharding mesh (0 = single-device).", m["shard_devices"], typ="gauge")
+    counter("sharded_dispatches_total", "Kernel dispatches executed with the node axis sharded over the mesh (main scan + victim search + estimator).", m["sharded_dispatches_total"])
+    counter("plane_shard_bytes_per_device", "Cumulative per-device bytes of sharded problem placements (node-sharded planes split across the mesh, replicated planes counted in full).", m["plane_shard_bytes_per_device"])
     counter("batch_compiles_total", "XLA compilations of the batch kernel (jit cache misses).", m["engine_compiles"])
     counter("batch_executable_cache_entries", "Compiled batch executables held in the jit cache.", m["engine_cache_entries"], typ="gauge")
     for phase, secs in sorted(m["engine_cum_timings"].items()):
@@ -200,6 +204,7 @@ def render_metrics(di: Any) -> str:
         counter("autoscaler_estimation_dispatches_total", "Vmapped estimation-kernel dispatches (one per scale-up estimate, all groups).", asc["estimate_dispatches"])
         counter("autoscaler_estimation_compiles_total", "XLA compilations of the estimation kernel.", asc["estimate_compiles"])
         counter("autoscaler_estimation_kernel_errors_total", "Kernel-path crashes degraded to the resource-only fallback (nonzero = bug).", asc["estimate_kernel_errors"])
+        counter("autoscaler_estimation_sharded_dispatches_total", "Estimation dispatches executed with the template-row axis sharded over the mesh.", asc["estimate_sharded_dispatches"])
         counter("autoscaler_estimation_seconds_total", "Cumulative scale-up estimation wall.", round(asc["estimate_cum_s"], 6))
         counter("autoscaler_estimation_seconds_last", "Last scale-up estimation wall.", round(asc["estimate_last_s"], 6), typ="gauge")
         for gname, gs in sorted(asc["groups"].items()):

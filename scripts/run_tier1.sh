@@ -28,4 +28,8 @@ if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/stream_smoke.py; the
 # monotonicity + tuned >= default) plus the default-weight byte-parity
 # pin — folded vs traced kernel paths (scripts/tune_smoke.py).
 if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/tune_smoke.py; then rc=1; fi
+# Shard smoke: KSS_MESH_DEVICES=4 churn on a virtual CPU mesh
+# byte-compared against single-device (sharded dispatches asserted), plus
+# the f32-vs-x64 oracle spot check (scripts/shard_smoke.py).
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/shard_smoke.py; then rc=1; fi
 exit $rc

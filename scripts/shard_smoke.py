@@ -1,0 +1,221 @@
+"""Shard smoke (tier-1): mesh parity + the f32-vs-x64 spot check, fast.
+
+Two independent gates, both cheap enough for every tier-1 run:
+
+1. **Sharded == unsharded bytes**: the same churn workload scheduled
+   through a ``KSS_MESH_DEVICES=4`` virtual CPU mesh (the env-knob
+   plumbing, end to end: service default mesh="auto" → ops/mesh.py
+   resolution → node-axis ``NamedSharding`` dispatch) and through a
+   single-device service, final stores byte-compared — with
+   ``sharded_dispatches_total`` asserted >0 so a silently-unsharded run
+   can't fake the parity.
+2. **f32 spot check**: the batch kernel with x64 DISABLED (float32
+   math — the TPU dtype regime) against the x64 sequential oracle,
+   annotation trail byte-compared over the full population.  The full
+   cfg4-scale differential lives in tests/test_shard.py; this is the
+   smoke-sized canary.
+
+Exit nonzero on any divergence.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+try:  # the axon plugin dials the TPU tunnel even when CPU-pinned
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import random  # noqa: E402
+
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService  # noqa: E402
+from kube_scheduler_simulator_tpu.state.store import ClusterStore  # noqa: E402
+from kube_scheduler_simulator_tpu.utils.parity import pod_parity_state  # noqa: E402
+
+
+def mk_node(i: int) -> dict:
+    return {
+        "metadata": {
+            "name": f"n-{i:03d}",
+            "labels": {
+                "topology.kubernetes.io/zone": f"z{i % 3}",
+                "kubernetes.io/hostname": f"n-{i:03d}",
+            },
+        },
+        "status": {"allocatable": {"cpu": "8000m", "memory": "16Gi", "pods": "64"}},
+    }
+
+
+def mk_pod(i: int, rng: random.Random) -> dict:
+    spec: dict = {
+        "containers": [
+            {
+                "name": "c",
+                "resources": {
+                    "requests": {
+                        "cpu": f"{rng.choice([100, 250, 500])}m",
+                        "memory": f"{rng.choice([128, 256])}Mi",
+                    }
+                },
+            }
+        ]
+    }
+    if i % 3 == 0:
+        spec["topologySpreadConstraints"] = [
+            {
+                "maxSkew": 2,
+                "topologyKey": "topology.kubernetes.io/zone",
+                "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": {"matchLabels": {"app": f"a{i % 2}"}},
+            }
+        ]
+    return {
+        "metadata": {
+            "name": f"p-{i:04d}",
+            "namespace": "default",
+            "labels": {"app": f"a{i % 2}"},
+            "creationTimestamp": f"2024-01-01T00:{i // 60:02d}:{i % 60:02d}Z",
+        },
+        "spec": spec,
+    }
+
+
+def run_churn(env_devices: "str | None") -> "tuple[dict, dict]":
+    """Two churn waves through a service; mesh from the env knob."""
+    if env_devices is None:
+        os.environ.pop("KSS_MESH_DEVICES", None)
+    else:
+        os.environ["KSS_MESH_DEVICES"] = env_devices
+    try:
+        store = ClusterStore()
+        # 42 nodes: not divisible by 4 — the engine pads the node axis
+        for i in range(42):
+            store.create("nodes", mk_node(i))
+        svc = SchedulerService(
+            store, tie_break="first", use_batch="force", batch_min_work=0
+        )
+        svc.start_scheduler(None)
+        rng = random.Random(7)
+        created = 0
+        for _wave in range(2):
+            for _ in range(60):
+                store.create("pods", mk_pod(created, rng))
+                created += 1
+            svc.schedule_pending(max_rounds=2)
+            # delete every 7th bound pod (both runs see the same set)
+            bound = sorted(
+                p["metadata"]["name"]
+                for p in store.list("pods")
+                if (p.get("spec") or {}).get("nodeName")
+            )
+            for nm in bound[::7]:
+                store.delete("pods", nm, "default")
+        return pod_parity_state(store), svc.metrics()
+    finally:
+        os.environ.pop("KSS_MESH_DEVICES", None)
+
+
+def f32_spot_check() -> "tuple[int, int]":
+    """f32 (x64 disabled) kernel vs the x64 sequential oracle, full
+    population, annotation trail byte-compared."""
+    from kube_scheduler_simulator_tpu.scheduler.batch_engine import BatchEngine
+
+    rng = random.Random(13)
+    svc = SchedulerService(ClusterStore(), tie_break="first", mesh=None)
+    for i in range(48):
+        svc.cluster_store.create("nodes", mk_node(i))
+    for i in range(64):
+        svc.cluster_store.create("pods", mk_pod(i, rng))
+    svc.start_scheduler(None)
+    fw = svc.framework
+    pending = fw.sort_pods(svc.pending_pods())
+    jax.config.update("jax_enable_x64", False)
+    try:
+        eng = BatchEngine.from_framework(fw, trace=True, incremental=False)
+        res = eng.schedule(
+            svc.cluster_store.list("nodes"),
+            svc.cluster_store.list("pods"),
+            pending,
+            svc.cluster_store.list("namespaces"),
+        )
+        docs = [
+            (
+                res.selected_nodes[i],
+                res.filter_annotation_json(i),
+                *res.score_annotations_json(i),
+            )
+            for i in range(len(pending))
+        ]
+    finally:
+        jax.config.update("jax_enable_x64", True)
+    svc.schedule_pending(max_rounds=1)
+    mism = compared = 0
+    for i, key in enumerate(res.pod_keys):
+        ns_, name_ = key.split("/", 1)
+        pod = svc.cluster_store.get("pods", name_, ns_)
+        annos = pod["metadata"].get("annotations") or {}
+        sel, filt, sco, fin = docs[i]
+        if sel != (pod.get("spec") or {}).get("nodeName"):
+            mism += 1
+        for kind, got in (
+            ("filter-result", filt),
+            ("score-result", sco),
+            ("finalscore-result", fin),
+        ):
+            want = annos.get(f"scheduler-simulator/{kind}")
+            if want is not None or got != "{}":
+                compared += 1
+                mism += want != got
+    return mism, compared
+
+
+def main() -> int:
+    base_state, base_m = run_churn(None)
+    mesh_state, mesh_m = run_churn("4")
+    if base_m["shard_devices"] != 0 or base_m["sharded_dispatches_total"] != 0:
+        print("shard-smoke FAIL: unsharded run reports mesh activity")
+        return 1
+    if mesh_m["shard_devices"] != 4 or mesh_m["sharded_dispatches_total"] < 1:
+        print(
+            f"shard-smoke FAIL: KSS_MESH_DEVICES=4 run never sharded "
+            f"(devices={mesh_m['shard_devices']}, "
+            f"dispatches={mesh_m['sharded_dispatches_total']})"
+        )
+        return 1
+    keys = set(base_state) | set(mesh_state)
+    bad = [k for k in keys if base_state.get(k) != mesh_state.get(k)]
+    if bad:
+        print(f"shard-smoke FAIL: {len(bad)}/{len(keys)} pods diverge under sharding: {sorted(bad)[:5]}")
+        return 1
+    f32_mism, f32_compared = f32_spot_check()
+    if f32_mism or f32_compared < 64:
+        print(
+            f"shard-smoke FAIL: f32-vs-x64 spot check: {f32_mism} mismatches "
+            f"over {f32_compared} documents"
+        )
+        return 1
+    print(
+        f"shard-smoke OK: {len(keys)} pods byte-identical on a 4-device mesh "
+        f"(sharded_dispatches={mesh_m['sharded_dispatches_total']}, "
+        f"per-device plane bytes={mesh_m['plane_shard_bytes_per_device']}); "
+        f"f32-vs-x64 spot check 0/{f32_compared} mismatches"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

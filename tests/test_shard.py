@@ -1,0 +1,459 @@
+"""Mesh-sharded scheduling: the node axis as the scale axis (ISSUE 9).
+
+Pins the three promises of the sharded path:
+
+- **Parity**: sharding the node axis over a mesh changes NO bytes —
+  main scan (pre-existing suites), preemption victim search and
+  autoscaler estimator (new here, randomized churn), including node
+  counts that don't divide the device count (the engines pad).
+- **The f32 story**: the batch kernel run with x64 DISABLED (the TPU
+  dtype regime: float32 math, int32 planes) is byte-identical to the
+  x64 sequential oracle at cfg4 scale — the GCD-scaled integer encoding
+  is what makes low-precision device math exact.
+- **TPU lowering**: the main scan (trace on/off), the victim search and
+  the estimation dispatch all LOWER for the TPU platform, sharded and
+  unsharded, via the cross-platform ``jax.export`` path — checkable
+  from a CPU-only host; failures skip loudly with the reason.
+
+Plus the ``KSS_MESH_DEVICES`` boundary validation (a bad device count
+is a MeshConfigError naming the rule, never a jit shape error) and the
+``shard_devices`` / ``sharded_dispatches_total`` /
+``plane_shard_bytes_per_device`` observability contract.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any
+
+import numpy as np
+import pytest
+
+from kube_scheduler_simulator_tpu.ops import batch as B
+from kube_scheduler_simulator_tpu.ops import encode as E
+from kube_scheduler_simulator_tpu.ops import mesh as M
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.state.store import ClusterStore
+from kube_scheduler_simulator_tpu.utils.parity import pod_parity_state
+
+from tests.test_batch_parity import mk_node, mk_pod, profile_with
+
+Obj = dict[str, Any]
+
+
+def cpu_mesh(n: int):
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= n, "conftest forces an 8-device virtual CPU mesh"
+    return Mesh(np.array(devices[:n]), ("nodes",))
+
+
+def _stamp(p: Obj, i: int) -> Obj:
+    p["metadata"]["creationTimestamp"] = f"2024-01-01T00:{i // 60:02d}:{i % 60:02d}Z"
+    return p
+
+
+# ------------------------------------------------- env-knob boundary
+
+
+def test_mesh_env_knob_validation(monkeypatch):
+    """KSS_MESH_DEVICES is validated at the boundary: every bad value is
+    a MeshConfigError naming the broken rule — never a downstream jit
+    shape error."""
+    for bad in ("0", "-2", "abc", "1.5", ""):
+        monkeypatch.setenv("KSS_MESH_DEVICES", bad)
+        if bad.strip() == "":
+            assert M.mesh_from_env() is None  # empty = unset
+            continue
+        with pytest.raises(M.MeshConfigError):
+            M.mesh_from_env()
+    # non-divisor counts (not a power of two: can't divide every node
+    # bucket) are rejected with the padding rule in the message
+    monkeypatch.setenv("KSS_MESH_DEVICES", "3")
+    with pytest.raises(M.MeshConfigError, match="power of two"):
+        M.mesh_from_env()
+    # more devices than the host exposes
+    monkeypatch.setenv("KSS_MESH_DEVICES", "1024")
+    with pytest.raises(M.MeshConfigError, match="device"):
+        M.mesh_from_env()
+    # happy paths
+    monkeypatch.setenv("KSS_MESH_DEVICES", "1")
+    assert M.mesh_from_env() is None  # 1 = single-device, no mesh
+    monkeypatch.setenv("KSS_MESH_DEVICES", "4")
+    mesh = M.mesh_from_env()
+    assert int(mesh.shape["nodes"]) == 4
+    # resolve_mesh: "auto" consults the env; explicit Mesh passes through;
+    # a mesh without the "nodes" axis is rejected
+    assert int(M.resolve_mesh("auto").shape["nodes"]) == 4
+    assert M.resolve_mesh(mesh) is mesh
+    assert M.resolve_mesh(None) is None
+    import jax
+    from jax.sharding import Mesh
+
+    with pytest.raises(M.MeshConfigError, match="nodes"):
+        M.resolve_mesh(Mesh(np.array(jax.devices("cpu")[:2]), ("batch",)))
+
+
+def test_service_mesh_env_plumbing(monkeypatch):
+    """SchedulerService's default mesh="auto" picks the env knob up, the
+    round runs sharded (byte-identical to single-device), and the
+    shard_devices / sharded_dispatches_total /
+    plane_shard_bytes_per_device observability lands in service.metrics()
+    and the Prometheus rendering."""
+
+    def build(env_devices: "str | None"):
+        if env_devices is None:
+            monkeypatch.delenv("KSS_MESH_DEVICES", raising=False)
+        else:
+            monkeypatch.setenv("KSS_MESH_DEVICES", env_devices)
+        store = ClusterStore()
+        # 13 nodes: deliberately NOT divisible by the 4-device mesh —
+        # the engine pads the node axis to a device multiple
+        for i in range(13):
+            store.create("nodes", mk_node(f"n-{i}", cpu_m=4000, mem_mi=8192))
+        rng = random.Random(5)
+        for i in range(30):
+            p = mk_pod(f"p-{i}", cpu_m=rng.choice([100, 200, 400]), mem_mi=128)
+            store.create("pods", _stamp(p, i))
+        svc = SchedulerService(store, tie_break="first", use_batch="force", batch_min_work=0)
+        svc.start_scheduler(None)
+        svc.schedule_pending(max_rounds=1)
+        return store, svc
+
+    s1, v1 = build(None)
+    s2, v2 = build("4")
+    assert v1.mesh is None and int(v2.mesh.shape["nodes"]) == 4
+    d1, d2 = pod_parity_state(s1), pod_parity_state(s2)
+    assert d1 == d2, "sharded round diverged from single-device bytes"
+    m1, m2 = v1.metrics(), v2.metrics()
+    assert m1["shard_devices"] == 0 and m1["sharded_dispatches_total"] == 0
+    assert m2["shard_devices"] == 4
+    assert m2["sharded_dispatches_total"] >= 1
+    assert m2["plane_shard_bytes_per_device"] > 0
+    # and the per-device bytes are genuinely smaller than the full tree
+    assert m2["plane_shard_bytes_per_device"] < m2["device_bytes_uploaded_total"]
+
+    class _DI:
+        def __init__(self, svc):
+            self._svc = svc
+            self.cluster_store = svc.cluster_store
+
+        def scheduler_service(self):
+            return self._svc
+
+    from kube_scheduler_simulator_tpu.server.metrics import render_metrics
+
+    text = render_metrics(_DI(v2))
+    assert "simulator_shard_devices 4" in text
+    assert "simulator_sharded_dispatches_total" in text
+    assert "simulator_plane_shard_bytes_per_device" in text
+
+
+def test_field_sharding_non_divisible_is_clear_error():
+    """Direct shard_device_problem users (no engine padding) get a clear
+    ValueError naming the field and the fix, not a jit shape error."""
+    mesh = cpu_mesh(8)
+    with pytest.raises(ValueError, match="not divisible"):
+        B.field_sharding(mesh, "alloc", np.zeros((13, 2)))
+
+
+# ------------------------------------- preemption victim search, sharded
+
+
+def _preempt_cluster(seed: int, n_nodes: int) -> ClusterStore:
+    """A preemption-shaped cluster: full nodes, mixed-priority victims
+    with PDB coverage, and higher-priority preemptors arriving last."""
+    rng = random.Random(seed)
+    store = ClusterStore()
+    for i in range(n_nodes):
+        store.create("nodes", mk_node(f"node-{i}", cpu_m=1000, mem_mi=2048))
+    k = 0
+    for i in range(n_nodes):
+        for j in range(rng.choice([1, 2])):
+            v = mk_pod(f"victim-{i}-{j}", cpu_m=rng.choice([400, 500]), mem_mi=128,
+                       labels={"app": f"a{i % 3}"})
+            v["spec"]["nodeName"] = f"node-{i}"
+            v["spec"]["priority"] = rng.choice([0, 10])
+            v.setdefault("status", {})["startTime"] = f"2024-01-01T01:00:{k % 60:02d}Z"
+            store.create("pods", _stamp(v, k))
+            k += 1
+    store.create(
+        "poddisruptionbudgets",
+        {
+            "metadata": {"name": "pdb-a0", "namespace": "default"},
+            "spec": {"maxUnavailable": 1, "selector": {"matchLabels": {"app": "a0"}}},
+        },
+    )
+    for i in range(3):
+        vip = mk_pod(f"vip-{i}", cpu_m=rng.choice([600, 700]), mem_mi=64)
+        vip["spec"]["priority"] = 1000
+        store.create("pods", _stamp(vip, 100 + i))
+    return store
+
+
+def test_preemption_sharded_parity_randomized_churn():
+    """The batched victim search sharded over a mesh is byte-identical
+    to the unsharded batched path across randomized churn rounds —
+    including a node count (7) the 4-device mesh must pad."""
+    mesh = cpu_mesh(4)
+    for seed, n_nodes in ((11, 7), (12, 8)):
+
+        def run(m):
+            store = _preempt_cluster(seed, n_nodes)
+            svc = SchedulerService(
+                store, tie_break="first", use_batch="auto", batch_min_work=0, mesh=m
+            )
+            svc.start_scheduler({"percentageOfNodesToScore": 100})
+            svc.schedule_pending(max_rounds=1)
+            # churn: evict one settled victim, add a fresh preemptor,
+            # re-run — the second round's search sees mutated state
+            for nm in sorted(
+                p["metadata"]["name"]
+                for p in store.list("pods")
+                if p["metadata"]["name"].startswith("victim") and p["spec"].get("nodeName")
+            )[:2]:
+                store.delete("pods", nm, "default")
+            extra = mk_pod("vip-late", cpu_m=500, mem_mi=64)
+            extra["spec"]["priority"] = 2000
+            store.create("pods", _stamp(extra, 200))
+            svc.schedule_pending(max_rounds=1)
+            return store, svc
+
+        s1, v1 = run(None)
+        s2, v2 = run(mesh)
+        assert v2.stats["preempt_sharded_dispatches"] >= 1, "mesh search never engaged"
+        assert v1.stats["preempt_sharded_dispatches"] == 0
+        assert v1.stats["preempt_nominations"] == v2.stats["preempt_nominations"]
+        d1, d2 = pod_parity_state(s1), pod_parity_state(s2)
+        assert d1 == d2, (
+            f"seed {seed}: sharded preemption diverged on "
+            f"{sum(1 for kk in set(d1) | set(d2) if d1.get(kk) != d2.get(kk))} pods"
+        )
+
+
+# --------------------------------------- autoscaler estimator, sharded
+
+
+def test_estimator_sharded_parity_randomized_churn():
+    """Scale-up estimation sharded over the mesh returns the exact
+    estimates of the unsharded dispatch across randomized churn (groups
+    × pending pods mutate between estimates)."""
+    from tests.test_autoscaler import mk_group, mk_pod as as_pod, mk_service
+    from kube_scheduler_simulator_tpu.autoscaler.engine import ClusterAutoscaler
+
+    mesh = cpu_mesh(4)
+    for seed in (3, 4):
+
+        def run(m):
+            rng = random.Random(seed)
+            store = ClusterStore()
+            store.create("nodegroups", mk_group("small", mx=6, cpu="2000m", mem="4Gi"))
+            store.create("nodegroups", mk_group("big", mx=5, cpu="8000m", mem="16Gi"))
+            svc = mk_service(store)
+            svc.mesh = m
+            for i in range(rng.choice([5, 7])):
+                store.create("pods", as_pod(f"p{i}", cpu=f"{rng.choice([500, 1500])}m"))
+            svc.schedule_pending(max_rounds=1)
+            asc = ClusterAutoscaler(store, svc)
+            est1 = asc._estimator_for(svc.framework).estimate(
+                sorted(asc.node_groups(), key=lambda g: g["metadata"]["name"]),
+                {"small": 6, "big": 5},
+                svc.framework.sort_pods(svc.pending_pods()),
+            )
+            # churn: more pending arrives, one group shrinks its headroom
+            for i in range(3):
+                store.create("pods", as_pod(f"q{i}", cpu="1200m"))
+            est2 = asc._estimator_for(svc.framework).estimate(
+                sorted(asc.node_groups(), key=lambda g: g["metadata"]["name"]),
+                {"small": 2, "big": 5},
+                svc.framework.sort_pods(svc.pending_pods()),
+            )
+            return [e.__dict__ for e in est1 + est2], asc._estimator
+
+        r1, e1 = run(None)
+        r2, e2 = run(mesh)
+        assert e2.sharded_dispatches == 2 and e1.sharded_dispatches == 0
+        assert e1.kernel_errors == 0 and e2.kernel_errors == 0
+        assert all(e["method"] == "xla-batch" for e in r1)
+        assert r1 == r2, f"seed {seed}: sharded estimation diverged"
+
+
+# ------------------------------------------------- the f32 / TPU story
+
+
+def test_f32_kernel_vs_x64_oracle_cfg4_scale():
+    """VERDICT's standing wound: every parity suite forces x64, so the
+    float32 numbers were unattested.  Run the batch kernel with x64
+    DISABLED (float32 math, int32 planes — the TPU dtype regime) at
+    cfg4 scale (5000 nodes, the cfg4 plugin mix) against the x64
+    sequential oracle and pin ZERO byte mismatches on the annotation
+    trail.  The oracle leg subsamples the pod queue (the bench's
+    established method: with tie_break="first" the first K commits
+    evolve identically), so its host wall stays test-sized while the
+    kernel still scans the full cfg4 node axis."""
+    import jax
+
+    from bench import mk_node as b_node, mk_pod as b_pod
+    from kube_scheduler_simulator_tpu.scheduler.batch_engine import BatchEngine
+
+    N, P = 5000, 24
+    rng = random.Random(42)
+    nodes = [b_node(i) for i in range(N)]
+    pods = [b_pod(i, rng, interpod=True) for i in range(P)]
+    cfg = {
+        "percentageOfNodesToScore": 100,
+        "profiles": [profile_with(["NodeResourcesFit", "InterPodAffinity"])],
+    }
+    svc = SchedulerService(ClusterStore(), tie_break="first")
+    for n in nodes:
+        svc.cluster_store.create("nodes", n)
+    for p in pods:
+        svc.cluster_store.create("pods", p)
+    svc.start_scheduler(cfg)
+    fw = svc.framework
+    pending = fw.sort_pods(svc.pending_pods())
+
+    # f32 engine pass over the same pre-commit snapshot, x64 OFF.
+    # (Explicit flag toggle, not jax.experimental.disable_x64(): the
+    # context manager does not restore an env-var-derived True on exit.)
+    jax.config.update("jax_enable_x64", False)
+    try:
+        assert jax.config.jax_enable_x64 is False
+        # lower() picks the problem dtype from the live flag — attest f32
+        tiny = E.encode(nodes[:2], [], pods[:1])
+        assert B.lower(tiny)[0].alloc.dtype == np.float32
+        eng = BatchEngine.from_framework(fw, trace=True, incremental=False)
+        res = eng.schedule(
+            svc.cluster_store.list("nodes"),
+            svc.cluster_store.list("pods"),
+            pending,
+            svc.cluster_store.list("namespaces"),
+        )
+        filt = [res.filter_annotation_json(i) for i in range(P)]
+        sco = [res.score_annotations_json(i) for i in range(P)]
+    finally:
+        jax.config.update("jax_enable_x64", True)
+
+    # x64 sequential oracle commits the same queue
+    assert jax.config.jax_enable_x64 is True
+    svc.schedule_pending(max_rounds=1)
+
+    mismatches = []
+    compared = 0
+    for i, key in enumerate(res.pod_keys):
+        ns_, name_ = key.split("/", 1)
+        pod = svc.cluster_store.get("pods", name_, ns_)
+        annos = pod["metadata"].get("annotations") or {}
+        if res.selected_nodes[i] != (pod.get("spec") or {}).get("nodeName"):
+            mismatches.append((i, "binding"))
+        for kind, got in (
+            ("filter-result", filt[i]),
+            ("score-result", sco[i][0]),
+            ("finalscore-result", sco[i][1]),
+        ):
+            want = annos.get(f"scheduler-simulator/{kind}")
+            if want is not None or got != "{}":
+                compared += 1
+                if want != got:
+                    mismatches.append((i, kind))
+    assert compared >= 2 * P, "annotation trail unexpectedly empty"
+    assert not mismatches, (
+        f"f32 kernel diverged from the x64 oracle on {len(mismatches)} "
+        f"documents: {mismatches[:5]}"
+    )
+
+
+# ------------------------------------------------ TPU lowering dryruns
+
+
+def _tiny_problem(node_multiple: int = 8):
+    import __graft_entry__ as GE
+
+    nodes, pods = GE._build_objects(P=8, N=32)
+    pr = E.encode(nodes, pods, pods)
+    pr = E.pad_problem(pr, node_multiple=node_multiple)
+    return B.lower(pr)
+
+
+def _require(ok: bool, info: str):
+    """Pass, or skip LOUDLY with the lowering failure as the reason —
+    the dryrun's contract (a silent pass would fake TPU coverage)."""
+    if not ok:
+        pytest.skip(f"TPU lowering dryrun unavailable: {info}")
+
+
+@pytest.mark.parametrize("trace", [False, True])
+def test_tpu_lowering_main_kernel(trace):
+    """The main batch scan lowers for TPU — trace on and off, sharded
+    (8-device mesh recorded in the export) and unsharded."""
+    dp, dims = _tiny_problem()
+    cfg = B.BatchConfig(
+        filters=("NodeResourcesFit", "TaintToleration"),
+        scores=(("NodeResourcesFit", 1), ("TaintToleration", 3)),
+        trace=trace,
+        sampling=False,
+    )
+    fn = B.build_batch_fn(cfg, dims)
+    ok, info = M.tpu_lowering_dryrun(fn, (dp,))
+    _require(ok, info)
+    mesh = cpu_mesh(8)
+    sdp = B.shard_device_problem(dp, mesh)
+    ok, info = M.tpu_lowering_dryrun(fn, (sdp,))
+    _require(ok, info)
+    assert "8 device(s)" in info, info
+
+
+def test_tpu_lowering_preemption_kernel():
+    from kube_scheduler_simulator_tpu.preemption import kernel as PK
+
+    U, N, V, R, PDB, S = 8, 32, 8, 2, 2, 8
+    fn = PK.build_preempt_fn(U, N, V, R, PDB, S)
+    args = (
+        np.ones((U, N), bool), np.ones((U, R)), np.zeros(U, np.int64),
+        np.zeros((U, S), bool),
+        np.ones((N, R)), np.zeros((N, R)), np.zeros(N), np.full(N, 64.0),
+        np.zeros((N, V, R)), np.zeros((N, V), np.int64), np.ones((N, V), bool),
+        np.zeros((N, V, PDB), bool),
+        np.zeros(PDB, np.int32), np.zeros((S, R)), np.zeros(S, np.int32),
+    )
+    ok, info = M.tpu_lowering_dryrun(fn, args)
+    _require(ok, info)
+    sargs = PK.shard_search_args(args, cpu_mesh(8))
+    ok, info = M.tpu_lowering_dryrun(fn, sargs)
+    _require(ok, info)
+    assert "8 device(s)" in info, info
+
+
+def test_tpu_lowering_estimator_kernel():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dp, dims = _tiny_problem()
+    cfg = B.BatchConfig(
+        filters=("NodeResourcesFit",),
+        scores=(("NodeResourcesFit", 1),),
+        fit_strategy="MostAllocated",
+        trace=False,
+        sampling=False,
+    )
+    base = B.build_batch_fn(cfg, dims)
+    axes = B.DeviceProblem(
+        **{f: (0 if f == "node_active" else None) for f in B.DeviceProblem._fields}
+    )
+    vfn = jax.jit(jax.vmap(base, in_axes=(axes,)))
+    G, N = 2, dims["N"]
+    masks = np.zeros((G, N), bool)
+    masks[0, : N // 2] = True
+    masks[1, N // 2 :] = True
+    ok, info = M.tpu_lowering_dryrun(vfn, (dp._replace(node_active=masks),))
+    _require(ok, info)
+    mesh = cpu_mesh(8)
+    sdp = B.shard_device_problem(dp, mesh)
+    sdp = sdp._replace(
+        node_active=jax.device_put(masks, NamedSharding(mesh, P(None, "nodes")))
+    )
+    ok, info = M.tpu_lowering_dryrun(vfn, (sdp,))
+    _require(ok, info)
+    assert "8 device(s)" in info, info
