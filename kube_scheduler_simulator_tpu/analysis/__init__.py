@@ -1,0 +1,25 @@
+"""Kernel-contract analysis: AST rules + runtime trace discipline.
+
+The static side (``run_analysis``) mechanizes the repo's hand-enforced
+XLA invariants as five rules over the live tree — KSS-DTYPE,
+KSS-HOST-SYNC, KSS-DONATE, KSS-ENV, KSS-LOCK — each born from a shipped
+bug (see docs/static-analysis.md).  The runtime side
+(:class:`RecompileGuard`) asserts the zero-steady-state-recompiles
+contract the AST can't see.  ``scripts/check_contracts.py`` is the CLI;
+tier-1 runs it with the baseline applied.
+"""
+
+from kube_scheduler_simulator_tpu.analysis.framework import (  # noqa: F401
+    BaselineError,
+    Finding,
+    apply_baseline,
+    default_rules,
+    load_baseline,
+    render_report,
+    run_analysis,
+)
+from kube_scheduler_simulator_tpu.analysis.runtime import (  # noqa: F401
+    RecompileError,
+    RecompileGuard,
+    compile_count,
+)

@@ -1,0 +1,22 @@
+"""KSS-DONATE bad fixture 1: reading a module-donated buffer after dispatch."""
+
+import jax
+
+
+def _scatter(buf, idx, rows):
+    return buf.at[idx].set(rows)
+
+
+scatter_donate = jax.jit(_scatter, donate_argnums=(0,))
+
+
+def update_plane(plane, idx, rows):
+    out = scatter_donate(plane, idx, rows)
+    stale = plane.sum()  # expect-finding
+    return out, stale
+
+
+def double_dispatch(plane, idx, rows):
+    first = scatter_donate(plane, idx, rows)
+    second = scatter_donate(plane, idx, rows)  # expect-finding
+    return first, second

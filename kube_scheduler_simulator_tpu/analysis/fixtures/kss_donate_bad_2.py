@@ -1,0 +1,30 @@
+"""KSS-DONATE bad fixture 2: local donating bindings + maybe-donating alias."""
+
+import jax
+
+
+def _consume(carry, xs):
+    return carry + xs
+
+
+def run_round(carry0, xs, on_cpu):
+    jitted = jax.jit(_consume, donate_argnums=(0,))
+    plain = jax.jit(_consume)
+    fn = plain if on_cpu else jitted  # maybe-donating: flagged all the same
+    out = fn(carry0, xs)
+    retry = carry0 + 1.0  # expect-finding
+    return out, retry
+
+
+def later_rebind(carry0, xs):
+    jitted = jax.jit(_consume, donate_argnums=(0,))
+    out = jitted(carry0, xs)
+    carry0 = carry0 + 1.0  # expect-finding
+    return out, carry0
+
+
+def named_donation(weights, grads):
+    step = jax.jit(_consume, donate_argnames=("carry",))
+    out = step(carry=weights, xs=grads)
+    norm = weights.sum()  # expect-finding
+    return out, norm

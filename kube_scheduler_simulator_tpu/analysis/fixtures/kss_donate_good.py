@@ -1,0 +1,23 @@
+"""KSS-DONATE good fixture: the self-replace idiom and pre-call reads."""
+
+import jax
+
+
+def _scatter(buf, idx, rows):
+    return buf.at[idx].set(rows)
+
+
+scatter_donate = jax.jit(_scatter, donate_argnums=(0,))
+scatter_copy = jax.jit(_scatter)
+
+
+def update_in_place(plane, idx, rows):
+    sharding = plane.sharding  # read BEFORE the donation: fine
+    plane = scatter_donate(plane, idx, rows)  # canonical self-replace
+    total = plane.sum()  # the result, not the stale buffer
+    return plane, total, sharding
+
+
+def copy_path(plane, idx, rows):
+    out = scatter_copy(plane, idx, rows)  # no donation: stale reads fine
+    return out, plane.sum()

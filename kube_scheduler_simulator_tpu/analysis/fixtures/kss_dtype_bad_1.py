@@ -1,0 +1,22 @@
+"""KSS-DTYPE bad fixture 1: integer reductions without a pinned dtype.
+
+Never imported — AST-only material for the rule self-test.  Lines
+carrying the expect marker comment must be flagged, and no others.
+"""
+
+import jax.numpy as jnp
+
+
+def victim_counts(mask, slots, feasible):
+    pos = jnp.cumsum(mask.astype(jnp.int32), axis=1)  # expect-finding
+    total = jnp.sum(feasible.astype(jnp.int32))  # expect-finding
+    ranked = jnp.cumsum(slots > 0)  # expect-finding
+    bools = jnp.sum(mask & feasible)  # expect-finding
+    return pos, total, ranked, bools
+
+
+def pinned_for_contrast(mask):
+    # the same shapes with the dtype pinned: silent
+    pos = jnp.cumsum(mask.astype(jnp.int32), axis=1, dtype=jnp.int32)
+    total = jnp.sum(mask.astype(jnp.int32), dtype=jnp.int32)
+    return pos, total

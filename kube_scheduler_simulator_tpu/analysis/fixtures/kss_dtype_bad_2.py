@@ -1,0 +1,14 @@
+"""KSS-DTYPE bad fixture 2: array creation with x64-dependent defaults."""
+
+import jax.numpy as jnp
+
+N = 16
+
+
+def build_planes(n_nodes, sel):
+    idx = jnp.arange(n_nodes)  # expect-finding
+    acc = jnp.zeros((n_nodes, 2))  # expect-finding
+    fail = jnp.full(n_nodes, -1)  # expect-finding
+    ident = jnp.eye(4)  # expect-finding
+    onehot = (jnp.arange(N) == sel)  # expect-finding
+    return idx, acc, fail, ident, onehot

@@ -1,0 +1,17 @@
+"""KSS-DTYPE good fixture: pinned dtypes and float reductions — silent."""
+
+import jax.numpy as jnp
+
+
+def kernel_planes(n_nodes, mask, scores, weights):
+    idx = jnp.arange(n_nodes, dtype=jnp.int32)
+    acc = jnp.zeros((n_nodes, 2), dtype=jnp.float32)
+    fail = jnp.full(n_nodes, -1, dtype=jnp.int8)
+    flags = jnp.zeros((n_nodes,), bool)  # positional dtype idiom
+    like = jnp.zeros_like(scores)  # inherits dtype
+    pos = jnp.cumsum(mask.astype(jnp.int32), dtype=jnp.int32)
+    # float reductions never promote: unpinned is fine
+    total = jnp.sum(scores * weights)
+    frac = jnp.sum(jnp.where(mask, scores, 0.0))
+    cast_f = jnp.sum(mask.astype(scores.dtype))
+    return idx, acc, fail, flags, like, pos, total, frac, cast_f

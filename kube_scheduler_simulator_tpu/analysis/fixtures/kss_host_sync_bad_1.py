@@ -1,0 +1,21 @@
+"""KSS-HOST-SYNC bad fixture 1: host sync inside a @jax.jit function."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def kernel(scores, threshold):
+    best = jnp.max(scores)
+    if best > threshold:  # expect-finding
+        scores = scores * 2.0
+    host = np.asarray(scores)  # expect-finding
+    peak = float(best)  # expect-finding
+    return scores, host, peak
+
+
+def dispatch(scores):
+    # host-side caller: reading the DISPATCH RESULT is fine
+    out, host, peak = kernel(scores, 0.5)
+    return float(peak)

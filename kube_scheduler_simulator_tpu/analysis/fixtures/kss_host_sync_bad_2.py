@@ -1,0 +1,30 @@
+"""KSS-HOST-SYNC bad fixture 2: sync in scan/vmap bodies + helpers."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def scan_step(carry, x):
+    total = carry + x
+    while total > 0:  # expect-finding
+        total = total - 1.0
+    flag = total.item()  # expect-finding
+    return total, flag
+
+
+def helper(feasible):
+    # reachable through the vmapped lane below: tainted via jnp result
+    count = jnp.sum(feasible, dtype=jnp.int32)
+    n = int(count)  # expect-finding
+    return n
+
+
+def lane(row):
+    return helper(row > 0)
+
+
+def run(rows, c0, xs):
+    out = jax.vmap(lane)(rows)
+    carry, ys = lax.scan(scan_step, c0, xs)
+    return out, carry, ys

@@ -1,0 +1,51 @@
+"""KSS-HOST-SYNC good fixture: static-config branching, is-None checks,
+comprehension shadowing, static_argnames — all silent."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+RESOURCES = (("cpu", 1.0), ("memory", 2.0))
+
+
+def build_kernel(cfg):
+    def step(carry, x):
+        total = carry + x
+        if cfg.trace:  # closure config: static at trace time
+            total = total * 2.0
+        # comprehension w shadows any traced outer w
+        wsum = float(sum(w for _, w in RESOURCES)) or 1.0
+        scaled = sum(total * float(w) for _, w in RESOURCES) / wsum
+        extra = carry.get("extra") if isinstance(carry, dict) else None
+        if extra is None:  # trace-time identity check: legal
+            scaled = scaled + 0.0
+        return scaled, total
+
+    return jax.jit(step)  # roots `step` for the analysis, lexically
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def kernel(scores, mode):
+    if mode == "double":  # static_argnames param: concrete at trace time
+        scores = scores * 2.0
+    return jnp.sum(scores)
+
+
+@jax.jit
+def shape_metadata(x):
+    # .shape/.ndim/.dtype on a tracer are concrete at trace time: the
+    # legal idiom, not host sync
+    n = int(x.shape[0])
+    if x.ndim > 1:
+        x = x.reshape(n, -1)
+    width = float(x.shape[-1])
+    return x * width
+
+
+def run(cfg, c0, xs):
+    step = build_kernel(cfg)
+    carry, ys = lax.scan(step, c0, xs)
+    n = int(len(xs))  # host code: int() outside any kernel
+    return carry, ys, n

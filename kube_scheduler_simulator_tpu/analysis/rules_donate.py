@@ -1,0 +1,181 @@
+"""KSS-DONATE: a donated buffer is dead after the dispatch.
+
+``jax.jit(..., donate_argnums=)`` hands the argument's device buffer to
+XLA for in-place reuse — after the call the old array is INVALID, and
+reading it raises a deleted-buffer error on real accelerators while the
+CPU backend (no donation support) silently keeps it alive, so the bug
+ships green on CPU and explodes on a TPU.  The repo's contract (the
+DevicePlacer bank rule): after dispatching through a donating callable,
+the donated binding is never read again in that function — the result
+replaces it (``buf = donate_fn(buf, ...)``) or the function returns.
+
+Statically: collect name bindings to donating callables —
+``X = jax.jit(f, donate_argnums=(0,))`` at module or function level,
+including conditional aliases (``fn = copy_variant if on_cpu else
+donate_variant`` makes ``fn`` a *maybe*-donating callable, flagged all
+the same: the read is broken exactly on the hardware where donation is
+real).  At every call ``X(a, b, ...)`` inside a function, the
+positional args named by ``donate_argnums`` (or keyword args named by
+``donate_argnames``) that are plain names are checked for loads after
+the call line; a rebind of the name (including the canonical
+``a = X(a, ...)`` self-replace) ends the liveness of the stale buffer.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kube_scheduler_simulator_tpu.analysis.framework import Finding, Project, Rule, SourceFile
+
+
+def _donation_spec(call: ast.Call) -> "tuple[tuple[int, ...], tuple[str, ...]] | None":
+    """``jax.jit(f, donate_argnums=..., donate_argnames=...)`` → the
+    literal donated positions/names, or None when not a donating jit."""
+    f = call.func
+    is_jit = (isinstance(f, ast.Attribute) and f.attr == "jit") or (
+        isinstance(f, ast.Name) and f.id == "jit"
+    )
+    if not is_jit:
+        return None
+    nums: list[int] = []
+    names: list[str] = []
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            vals = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    nums.append(v.value)
+        elif kw.arg == "donate_argnames":
+            vals = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    names.append(v.value)
+    if not nums and not names:
+        return None
+    return tuple(nums), tuple(names)
+
+
+class DonateRule(Rule):
+    name = "KSS-DONATE"
+    paths = None
+
+    def check_file(self, src: SourceFile, ctx: Project) -> "list[Finding]":
+        # name → (argnums, argnames); conditional aliases join in
+        donating: "dict[str, tuple[tuple[int, ...], tuple[str, ...]]]" = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+                node.targets[0], ast.Name
+            ):
+                tgt = node.targets[0].id
+                for rhs in (
+                    [node.value.body, node.value.orelse]
+                    if isinstance(node.value, ast.IfExp)
+                    else [node.value]
+                ):
+                    spec = _donation_spec(rhs) if isinstance(rhs, ast.Call) else None
+                    if spec is None and isinstance(rhs, ast.Name) and rhs.id in donating:
+                        spec = donating[rhs.id]  # alias of a donating name
+                    if spec is not None:
+                        donating[tgt] = spec
+        out: list[Finding] = []
+        for fn in ast.walk(src.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._check_fn(src, fn, donating))
+        return out
+
+    # ----------------------------------------------------------- per-func
+
+    def _check_fn(
+        self,
+        src: SourceFile,
+        fn: ast.FunctionDef,
+        module_donating: "dict[str, tuple[tuple[int, ...], tuple[str, ...]]]",
+    ) -> "list[Finding]":
+        donating = dict(module_donating)
+        # local bindings/aliases shadow module ones
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+                node.targets[0], ast.Name
+            ):
+                tgt = node.targets[0].id
+                for rhs in (
+                    [node.value.body, node.value.orelse]
+                    if isinstance(node.value, ast.IfExp)
+                    else [node.value]
+                ):
+                    spec = _donation_spec(rhs) if isinstance(rhs, ast.Call) else None
+                    if spec is None and isinstance(rhs, ast.Name) and rhs.id in donating:
+                        spec = donating[rhs.id]
+                    if spec is not None:
+                        donating[tgt] = spec
+
+        out: list[Finding] = []
+        # every donating call site: (call node, donated plain-name args)
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            spec = None
+            if isinstance(call.func, ast.Name) and call.func.id in donating:
+                spec = donating[call.func.id]
+            elif (d := _donation_spec(call.func) if isinstance(call.func, ast.Call) else None):
+                spec = d  # direct jax.jit(f, donate_argnums=...)(args)
+            if spec is None:
+                continue
+            nums, names = spec
+            donated_names: list[str] = []
+            for i in nums:
+                if i < len(call.args) and isinstance(call.args[i], ast.Name):
+                    donated_names.append(call.args[i].id)
+            for kw in call.keywords:
+                if kw.arg in names and isinstance(kw.value, ast.Name):
+                    donated_names.append(kw.value.id)
+            if not donated_names:
+                continue
+            out.extend(self._reads_after(src, fn, call, donated_names))
+        return out
+
+    def _reads_after(
+        self, src: SourceFile, fn: ast.FunctionDef, call: ast.Call, donated: "list[str]"
+    ) -> "list[Finding]":
+        out: list[Finding] = []
+        call_line = call.end_lineno or call.lineno
+        for name in donated:
+            rebind_line = None
+            # the canonical self-replace: name = donating(name, ...) on the
+            # call's own statement rebinds at the call line
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                    for t in targets:
+                        if isinstance(t, ast.Name) and t.id == name:
+                            ln = node.lineno
+                            if ln >= call.lineno and (rebind_line is None or ln < rebind_line):
+                                rebind_line = ln
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Name)
+                    and node.id == name
+                    and isinstance(node.ctx, ast.Load)
+                    and node.lineno > call_line
+                    and (rebind_line is None or node.lineno <= rebind_line)
+                ):
+                    # the canonical self-replace (name = donating(name,…))
+                    # needs no special case: its rebind line IS the call
+                    # line, so the (call_line, rebind_line] window is
+                    # empty — any load that lands here, including the RHS
+                    # of a LATER rebind (buf = buf + 1), reads the stale
+                    # buffer and is flagged
+                    out.append(
+                        src.finding(
+                            self.name,
+                            node,
+                            f"read of '{name}' after it was donated to the "
+                            f"dispatch on line {call.lineno}: the buffer is "
+                            "deleted on accelerators with donation support "
+                            "(CPU silently keeps it alive, so tests stay "
+                            "green and TPUs crash). Use the dispatch result, "
+                            "or rebind the name before reading it.",
+                        )
+                    )
+                    break  # one finding per donated name per call
+        return out

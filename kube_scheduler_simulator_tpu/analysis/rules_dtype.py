@@ -1,0 +1,188 @@
+"""KSS-DTYPE: integer jnp ops in kernel modules must pin their dtype.
+
+The motivating bug (PR 3): under ``jax_enable_x64``, ``jnp.sum`` over an
+int32 operand promotes the result to int64 — numpy's reduction-promotion
+rule — and a kernel carry built from that sum crashed every >=100-node
+round with a dtype mismatch.  The same instability hides in every
+``jnp.cumsum(mask.astype(jnp.int32))`` (int64 under x64, int32 without)
+and every ``jnp.arange(N)`` / ``jnp.zeros(shape)`` whose default dtype
+IS the x64 flag.  In kernel modules the contract is: integer-typed
+reductions and every array-creation call carry an explicit ``dtype=``,
+so the lowered program is the same program under either x64 setting.
+
+Two checks, scoped to the kernel modules (``ops/``,
+``preemption/kernel|encode``, ``gang/kernel|encode``,
+``tuning/relax|objective``):
+
+- **creation family** (``jnp.arange/zeros/ones/full/empty/eye``): flag
+  when neither a ``dtype=`` kwarg nor a positional dtype argument (the
+  ``jnp.zeros((G,), jnp.int32)`` idiom) is present.  ``*_like`` variants
+  inherit their dtype and are exempt.
+- **reduction family** (``jnp.sum/prod/cumsum/cumprod``): flag when
+  ``dtype=`` is absent AND the operand shows *integer evidence* —
+  a comparison/boolean expression, an ``.astype()`` to an integer/bool
+  dtype, an integer-literal ``jnp.where`` arm, or an integer-hinting
+  name (``*mask``/``*count``/``*idx``...).  Float evidence anywhere
+  (float literals, ``.astype`` to a float dtype) wins and clears the
+  flag: float reductions don't promote.
+
+The evidence walk is a deliberate under-approximation: an operand whose
+dtype the AST can't see stays unflagged (soundness of the *fix* list
+over completeness), and anything it misses is one baseline entry away.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from kube_scheduler_simulator_tpu.analysis.framework import Finding, Project, Rule, SourceFile
+
+CREATION = {"arange", "zeros", "ones", "full", "empty", "eye"}
+REDUCTION = {"sum", "prod", "cumsum", "cumprod"}
+
+_INT_DTYPE = re.compile(r"^(u?int(8|16|32|64)|bool_?)$")
+_FLOAT_DTYPE = re.compile(r"^(float(16|32|64)|bfloat16|complex(64|128))$")
+_INT_NAME_HINT = re.compile(r"(^|_)(mask|count|cnt|idx|index|ids|rank|slots)$")
+
+
+def _is_jnp(func: ast.AST) -> "str | None":
+    """``jnp.<name>`` / ``jax.numpy.<name>`` → name, else None."""
+    if not isinstance(func, ast.Attribute):
+        return None
+    v = func.value
+    if isinstance(v, ast.Name) and v.id == "jnp":
+        return func.attr
+    if (
+        isinstance(v, ast.Attribute)
+        and v.attr == "numpy"
+        and isinstance(v.value, ast.Name)
+        and v.value.id == "jax"
+    ):
+        return func.attr
+    return None
+
+
+def _dtype_expr_class(node: ast.AST) -> "str | None":
+    """Classify an expression used AS a dtype (astype arg, positional
+    dtype): 'int' / 'float' / None (unknown)."""
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+        name = node.value
+    else:
+        return None
+    if name in ("bool", "int"):
+        return "int"
+    if name == "float":
+        return "float"
+    if _INT_DTYPE.match(name):
+        return "int"
+    if _FLOAT_DTYPE.match(name):
+        return "float"
+    return None
+
+
+def _looks_like_dtype(node: ast.AST) -> bool:
+    return _dtype_expr_class(node) is not None or (
+        isinstance(node, ast.Attribute) and node.attr == "dtype"  # x.dtype
+    )
+
+
+def _evidence(node: ast.AST) -> "str | None":
+    """Integer/float evidence for a reduction operand ('int'/'float'/None).
+    Float evidence dominates: a float-typed operand cannot promote."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, bool) or isinstance(node.value, int):
+            return "int"
+        if isinstance(node.value, float):
+            return "float"
+        return None
+    if isinstance(node, (ast.Compare, ast.BoolOp)):
+        return "int"  # bool operands promote through int32/int64
+    if isinstance(node, ast.UnaryOp):
+        if isinstance(node.op, ast.Not):
+            return "int"
+        return _evidence(node.operand)
+    if isinstance(node, ast.Call):
+        # x.astype(D): the cast REPLACES the operand's dtype — classify D
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "astype" and node.args:
+            return _dtype_expr_class(node.args[0])
+        jnp_name = _is_jnp(node.func)
+        if jnp_name == "where" and len(node.args) >= 3:
+            return _combine(_evidence(node.args[1]), _evidence(node.args[2]))
+        if jnp_name in ("int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64"):
+            return "int"
+        if jnp_name in ("float16", "float32", "float64", "bfloat16"):
+            return "float"
+        return None
+    if isinstance(node, ast.BinOp):
+        return _combine(_evidence(node.left), _evidence(node.right))
+    if isinstance(node, ast.Subscript):
+        return _evidence(node.value)
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        name = node.id if isinstance(node, ast.Name) else node.attr
+        if _INT_NAME_HINT.search(name):
+            return "int"
+        return None
+    return None
+
+
+def _combine(a: "str | None", b: "str | None") -> "str | None":
+    if a == "float" or b == "float":
+        return "float"
+    if a == "int" or b == "int":
+        return "int"
+    return None
+
+
+class DtypeRule(Rule):
+    name = "KSS-DTYPE"
+    paths = (
+        "kube_scheduler_simulator_tpu/ops/*.py",
+        "kube_scheduler_simulator_tpu/preemption/kernel.py",
+        "kube_scheduler_simulator_tpu/preemption/encode.py",
+        "kube_scheduler_simulator_tpu/gang/kernel.py",
+        "kube_scheduler_simulator_tpu/gang/encode.py",
+        "kube_scheduler_simulator_tpu/tuning/relax.py",
+        "kube_scheduler_simulator_tpu/tuning/objective.py",
+    )
+
+    def check_file(self, src: SourceFile, ctx: Project) -> "list[Finding]":
+        out: list[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _is_jnp(node.func)
+            if fname is None:
+                continue
+            has_dtype_kw = any(kw.arg == "dtype" for kw in node.keywords)
+            if fname in CREATION:
+                if has_dtype_kw or any(_looks_like_dtype(a) for a in node.args):
+                    continue
+                out.append(
+                    src.finding(
+                        self.name,
+                        node,
+                        f"jnp.{fname} without an explicit dtype: the default dtype "
+                        "follows the jax_enable_x64 flag, so the lowered kernel "
+                        "differs between x64 and f32 runs (the PR 3 crash class). "
+                        f"Pin it: jnp.{fname}(..., dtype=jnp.int32) or pass the "
+                        "operand dtype.",
+                    )
+                )
+            elif fname in REDUCTION and not has_dtype_kw and node.args:
+                if _evidence(node.args[0]) == "int":
+                    out.append(
+                        src.finding(
+                            self.name,
+                            node,
+                            f"jnp.{fname} over an integer operand without dtype=: "
+                            "numpy reduction promotion widens int32 to int64 under "
+                            "jax_enable_x64 (the PR 3 crash class). Pin it: "
+                            f"jnp.{fname}(..., dtype=jnp.int32).",
+                        )
+                    )
+        return out

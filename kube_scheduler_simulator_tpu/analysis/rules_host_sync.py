@@ -1,0 +1,416 @@
+"""KSS-HOST-SYNC: no host synchronization inside kernel-reachable code.
+
+Inside a ``jax.jit`` / ``vmap`` / ``lax.scan``-traced function, values
+are tracers: ``.item()``, ``float()/int()/bool()``, ``np.asarray`` and
+python ``if``/``while`` on a traced value either crash at trace time
+(ConcretizationTypeError) or — worse — silently bake one concrete value
+into the compiled program and force a recompile per distinct input (the
+PR 7 estimator pathology, where a traced-weights config reaching a
+fresh ``lower()`` recompiled and then crashed every estimate).  The
+contract: kernel-reachable code stays in jnp/lax; branching on data uses
+``jnp.where``/``lax.cond``; host reads happen outside the dispatch.
+
+Mechanized as a two-phase AST pass per module:
+
+1. **Reachability** — kernel ROOTS are functions decorated with
+   ``@jax.jit`` (or ``@partial(jax.jit, ...)``), passed to
+   ``jax.jit/vmap/pmap/grad/value_and_grad/checkpoint`` or to
+   ``lax.scan/fori_loop/while_loop/cond/switch/map`` (unwrapping
+   ``functools.partial``).  Reachability closes over same-module calls
+   by name, resolved lexically (nested helpers included).
+2. **Taint** — tracer-typed names: the parameters of vmapped/scanned
+   bodies (all of them), jit parameters minus ``static_argnums`` /
+   ``static_argnames``, results of ``jnp.*``/``lax.*`` calls, and
+   anything assigned from a tainted expression (one forward pass run to
+   fixpoint).  Closure variables stay untainted — ``if cfg.trace:``
+   style static-config branching inside a kernel builder is exactly the
+   repo's idiom and must not flag.
+
+Flagged inside kernel-reachable functions: ``.item()`` on anything;
+``float()/int()/bool()`` and ``np.asarray/np.array`` over a tainted
+expression; ``if``/``while`` whose test mentions a tainted name.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kube_scheduler_simulator_tpu.analysis.framework import Finding, Project, Rule, SourceFile
+
+_TRANSFORMS = {"jit", "vmap", "pmap", "grad", "value_and_grad", "checkpoint", "remat"}
+# lax control-flow: argument positions holding traced-callable bodies
+_LAX_BODY_ARGS = {
+    "scan": (0,),
+    "fori_loop": (2,),
+    "while_loop": (0, 1),
+    "cond": (1, 2),
+    "switch": (1,),
+    "map": (0,),
+    "associative_scan": (0,),
+}
+
+
+def _call_root(func: ast.AST) -> "str | None":
+    """'jit' for jax.jit / jit; 'scan' for lax.scan / jax.lax.scan; etc."""
+    if isinstance(func, ast.Name):
+        name = func.id
+    elif isinstance(func, ast.Attribute):
+        name = func.attr
+    else:
+        return None
+    if name in _TRANSFORMS or name in _LAX_BODY_ARGS:
+        return name
+    return None
+
+
+def _unwrap_partial(node: ast.AST) -> ast.AST:
+    """functools.partial(f, ...) → f (one level is all the repo uses)."""
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, (ast.Name, ast.Attribute))
+        and (
+            (isinstance(node.func, ast.Name) and node.func.id == "partial")
+            or (isinstance(node.func, ast.Attribute) and node.func.attr == "partial")
+        )
+        and node.args
+    ):
+        return node.args[0]
+    return node
+
+
+class _Scope:
+    """Lexical function-def index: qualified defs + name resolution."""
+
+    def __init__(self, tree: ast.Module):
+        #: id(FunctionDef) → node
+        self.defs: "dict[str, list[ast.FunctionDef]]" = {}
+        self.parents: "dict[ast.AST, ast.AST]" = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, []).append(node)
+
+    def resolve(self, name: str, at: ast.AST) -> "ast.FunctionDef | None":
+        """The def for ``name`` visible from ``at``: innermost lexical
+        candidate whose parent chain contains ``at``'s chain."""
+        cands = self.defs.get(name)
+        if not cands:
+            return None
+        chain = set()
+        n: "ast.AST | None" = at
+        while n is not None:
+            chain.add(n)
+            n = self.parents.get(n)
+        best, depth = None, -1
+        for c in cands:
+            p = self.parents.get(c)
+            if p in chain or p is None:
+                d = 0
+                q = p
+                while q is not None:
+                    d += 1
+                    q = self.parents.get(q)
+                if d > depth:
+                    best, depth = c, d
+        return best
+
+
+def _static_params(call: "ast.Call | None", fn: ast.FunctionDef) -> "set[str]":
+    """Parameter names a jit call marks static (literal argnums/argnames)."""
+    out: set[str] = set()
+    if call is None:
+        return out
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            vals = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int) and v.value < len(params):
+                    out.add(params[v.value])
+        elif kw.arg == "static_argnames":
+            vals = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    out.add(v.value)
+    return out
+
+
+def _names_in(node: ast.AST) -> "set[str]":
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+_STATIC_ATTRS = ("shape", "dtype", "ndim", "size", "weak_type")
+
+
+def _free_names(node: ast.AST) -> "set[str]":
+    """Names an expression reads MINUS names bound by comprehensions
+    inside it (``float(w) for _, w in cfg.static`` reads the
+    comprehension's ``w``, not an outer traced one — comprehension
+    scopes are real scopes), and MINUS names reached only through
+    static-metadata attributes: ``x.shape``/``x.dtype``/``x.ndim`` on a
+    tracer are concrete at trace time, so ``int(x.shape[0])`` and
+    ``if x.ndim > 1:`` are the legal idiom, not host sync."""
+    bound: set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.comprehension):
+            bound |= _target_bases(n.target)
+
+    names: set[str] = set()
+
+    def collect(n: ast.AST):
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return  # everything under x.shape/... is trace-time static
+        if isinstance(n, ast.Name):
+            names.add(n.id)
+        for child in ast.iter_child_nodes(n):
+            collect(child)
+
+    collect(node)
+    return names - bound
+
+
+def _is_none_check(test: ast.AST) -> bool:
+    """``x is None`` / ``x is not None``: a trace-time identity check —
+    legal python on a tracer (constantly False) and the repo's idiom for
+    optional host-dict entries."""
+    return (
+        isinstance(test, ast.Compare)
+        and len(test.ops) == 1
+        and isinstance(test.ops[0], (ast.Is, ast.IsNot))
+        and isinstance(test.comparators[0], ast.Constant)
+        and test.comparators[0].value is None
+    )
+
+
+def _target_bases(t: ast.AST) -> "set[str]":
+    """The names an assignment target REBINDS (or mutates through):
+    ``raws[name] = v`` rebinds through ``raws`` — the subscript ``name``
+    is a read, not a taint target."""
+    if isinstance(t, ast.Name):
+        return {t.id}
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out: set[str] = set()
+        for el in t.elts:
+            out |= _target_bases(el)
+        return out
+    if isinstance(t, (ast.Subscript, ast.Attribute)):
+        return _target_bases(t.value)
+    if isinstance(t, ast.Starred):
+        return _target_bases(t.value)
+    return set()
+
+
+def _has_jnp_call(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            v = n.func.value
+            if isinstance(v, ast.Name) and v.id in ("jnp", "lax"):
+                return True
+    return False
+
+
+class HostSyncRule(Rule):
+    name = "KSS-HOST-SYNC"
+    paths = None  # reachability, not path scoping, bounds the noise
+
+    # ------------------------------------------------------------ phase 1
+
+    def _kernel_roots(
+        self, tree: ast.Module, scope: _Scope
+    ) -> "dict[ast.FunctionDef, set[str]]":
+        """roots → static param names (jit static_argnums/argnames)."""
+        roots: "dict[ast.FunctionDef, set[str]]" = {}
+
+        def add_root(fnode: ast.AST, at: ast.AST, jit_call: "ast.Call | None"):
+            fnode = _unwrap_partial(fnode)
+            target: "ast.FunctionDef | None" = None
+            if isinstance(fnode, ast.Lambda):
+                return  # lambdas get taint via the enclosing walk (rare here)
+            if isinstance(fnode, ast.Name):
+                target = scope.resolve(fnode.id, at)
+            elif isinstance(fnode, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                target = fnode
+            if target is not None:
+                statics = _static_params(jit_call, target)
+                prev = roots.get(target)
+                roots[target] = statics if prev is None else (prev & statics)
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    d = dec
+                    jit_call = None
+                    if isinstance(d, ast.Call):
+                        root = _call_root(d.func)
+                        if root == "jit":
+                            jit_call = d
+                            roots.setdefault(node, set()).update(_static_params(d, node))
+                            continue
+                        # @partial(jax.jit, static_argnames=...)
+                        inner = d.args[0] if (
+                            isinstance(d.func, (ast.Name, ast.Attribute))
+                            and (getattr(d.func, "id", None) == "partial"
+                                 or getattr(d.func, "attr", None) == "partial")
+                            and d.args
+                        ) else None
+                        if inner is not None and _call_root(inner) == "jit":
+                            roots.setdefault(node, set()).update(_static_params(d, node))
+                            continue
+                    if _call_root(d) == "jit":
+                        roots.setdefault(node, set())
+            if isinstance(node, ast.Call):
+                root = _call_root(node.func)
+                if root in _TRANSFORMS and node.args:
+                    add_root(node.args[0], node, node if root == "jit" else None)
+                elif root in _LAX_BODY_ARGS:
+                    for pos in _LAX_BODY_ARGS[root]:
+                        if pos < len(node.args):
+                            arg = node.args[pos]
+                            if isinstance(arg, (ast.Tuple, ast.List)):  # switch branches
+                                for el in arg.elts:
+                                    add_root(el, node, None)
+                            else:
+                                add_root(arg, node, None)
+        return roots
+
+    def _reachable(
+        self, roots: "dict[ast.FunctionDef, set[str]]", scope: _Scope
+    ) -> "dict[ast.FunctionDef, set[str]]":
+        """Close roots over same-module calls by name.  Called functions
+        get NO param taint from the closure (their args may be static) —
+        they still flag .item() and tainted-derived sync inside."""
+        out = dict(roots)
+        work = list(roots)
+        while work:
+            fn = work.pop()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                    target = scope.resolve(node.func.id, node)
+                    if target is not None and target not in out:
+                        out[target] = set(
+                            a.arg for a in target.args.posonlyargs + target.args.args
+                        )  # all params static-by-default: taint only flows via jnp results
+                        work.append(target)
+        return out
+
+    # ------------------------------------------------------------ phase 2
+
+    def _check_fn(
+        self, src: SourceFile, fn: ast.FunctionDef, static_params: "set[str]"
+    ) -> "list[Finding]":
+        params = {a.arg for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs}
+        tainted: set[str] = params - static_params - {"self", "cls"}
+        # nested defs are visited through their own reachability entry;
+        # don't double-scan their bodies here
+        nested = {
+            n
+            for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n is not fn
+        }
+
+        def in_nested(node: ast.AST) -> bool:
+            line = getattr(node, "lineno", None)
+            if line is None:
+                return True  # lineno-less helper nodes carry no accesses
+            return any(n.lineno <= line <= (n.end_lineno or n.lineno) for n in nested)
+
+        # forward taint propagation to fixpoint
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                if in_nested(node):
+                    continue
+                if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    value = node.value
+                    if value is None:
+                        continue
+                    dirty = bool(_names_in(value) & tainted) or _has_jnp_call(value)
+                    if not dirty:
+                        continue
+                    targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                    for t in targets:
+                        for base in _target_bases(t):
+                            if base not in tainted:
+                                tainted.add(base)
+                                changed = True
+
+        out: list[Finding] = []
+
+        def flag(node: ast.AST, what: str):
+            out.append(
+                src.finding(
+                    self.name,
+                    node,
+                    f"{what} inside the jit/vmap/scan-reachable function "
+                    f"'{fn.name}': host synchronization on a traced value "
+                    "either crashes at trace time or bakes one concrete value "
+                    "in and recompiles per input (the PR 7 estimator "
+                    "pathology). Stay in jnp/lax (jnp.where, lax.cond) or "
+                    "hoist the host read outside the dispatch.",
+                )
+            )
+
+        def expr_tainted(e: ast.AST, shadowed: "frozenset[str]") -> bool:
+            return bool((_free_names(e) - shadowed) & tainted) or _has_jnp_call(e)
+
+        def visit(node: ast.AST, shadowed: "frozenset[str]"):
+            if node is not fn and isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return  # nested defs get their own reachability entry
+            # comprehension scopes shadow outer (possibly tainted) names
+            if isinstance(
+                node, (ast.GeneratorExp, ast.ListComp, ast.SetComp, ast.DictComp)
+            ):
+                bound: set[str] = set()
+                for gen in node.generators:
+                    bound |= _target_bases(gen.target)
+                shadowed = shadowed | frozenset(bound)
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "item" and not node.args:
+                    flag(node, ".item()")
+                elif isinstance(f, ast.Name) and f.id in ("float", "int", "bool") and node.args:
+                    if expr_tainted(node.args[0], shadowed):
+                        flag(node, f"{f.id}() on a traced value")
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in ("asarray", "array", "asanyarray")
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in ("np", "numpy")
+                    and node.args
+                    and expr_tainted(node.args[0], shadowed)
+                ):
+                    flag(node, f"np.{f.attr}() on a traced value")
+            elif isinstance(node, (ast.If, ast.While)):
+                if not _is_none_check(node.test) and (
+                    (_free_names(node.test) - shadowed) & tainted
+                ):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    flag(node, f"python '{kind}' on a traced value")
+            for child in ast.iter_child_nodes(node):
+                visit(child, shadowed)
+
+        visit(fn, frozenset())
+        return out
+
+    # -------------------------------------------------------------- entry
+
+    def check_file(self, src: SourceFile, ctx: Project) -> "list[Finding]":
+        scope = _Scope(src.tree)
+        roots = self._kernel_roots(src.tree, scope)
+        if not roots:
+            return []
+        reachable = self._reachable(roots, scope)
+        out: list[Finding] = []
+        for fn, statics in reachable.items():
+            if fn in roots:
+                out.extend(self._check_fn(src, fn, statics))
+            else:
+                # call-closure functions: every param conservatively static
+                out.extend(
+                    self._check_fn(
+                        src, fn, {a.arg for a in fn.args.posonlyargs + fn.args.args}
+                    )
+                )
+        return out

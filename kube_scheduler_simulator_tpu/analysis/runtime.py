@@ -1,0 +1,107 @@
+"""Runtime trace discipline: compile counting and the RecompileGuard.
+
+The static rules catch contract violations the AST can see; the one it
+can't is the PR 7 pathology — code that is perfectly legal python but
+RECOMPILES on every call because something non-hashable-stable (a fresh
+``lower()``, a traced-weights config reaching a placeholder executable,
+a shape that misses its bucket) lands in the jit cache key.  On a
+steady-state workload the contract is: after the warmup wave, zero new
+backend compiles.
+
+JAX already emits exactly the right signal:
+``/jax/core/compile/backend_compile_duration`` fires once per backend
+compile and never on a cached dispatch.  ``jax.monitoring`` listeners
+cannot be unregistered individually, so this module installs ONE
+process-global listener (idempotently) that feeds a monotone counter;
+:class:`RecompileGuard` snapshots the counter on entry and asserts the
+delta on exit.
+
+Usage::
+
+    warmup()                        # compiles are expected here
+    with RecompileGuard("steady-state waves"):
+        for _ in range(n):          # re-dispatch only
+            step()
+
+Wired into tier-1 via scripts/stream_smoke.py and scripts/tune_smoke.py
+(steady-state second pass over a warmed service), and pinned against
+the PR 7 estimator contract in tests/test_contracts.py (a live weight
+override must not recompile the second estimate).
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_installed = False
+_compiles = 0
+
+_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _listener(event: str, duration: float, **kwargs) -> None:
+    global _compiles
+    if event == _EVENT:
+        # += is a read-modify-write, and compiles can fire from more than
+        # one thread (stream session vs commit thread) — take the lock so
+        # a concurrent pair never loses an increment; compiles are rare
+        # and multi-second, so the lock costs nothing
+        with _lock:
+            _compiles += 1
+
+
+def _ensure_installed() -> None:
+    global _installed
+    with _lock:
+        if not _installed:
+            import jax.monitoring
+
+            jax.monitoring.register_event_duration_secs_listener(_listener)
+            _installed = True
+
+
+def compile_count() -> int:
+    """Monotone count of JAX backend compiles since the listener was
+    installed (installs it on first call — counts start at the first
+    guard/count usage, not process start)."""
+    _ensure_installed()
+    return _compiles
+
+
+class RecompileError(AssertionError):
+    """A guarded region compiled when its contract said it must not."""
+
+
+class RecompileGuard:
+    """Assert at most ``max_compiles`` backend compiles inside the block.
+
+    ``name`` labels the violated contract in the error message.  The
+    guard is reentrant-safe (each instance snapshots independently) and
+    usable as a plain counter: ``guard.compiles`` after exit holds the
+    delta whether or not it raised... it only raises when the delta
+    exceeds ``max_compiles``.
+    """
+
+    def __init__(self, name: str = "steady state", max_compiles: int = 0):
+        self.name = name
+        self.max_compiles = int(max_compiles)
+        self.compiles = 0
+        self._t0 = 0
+
+    def __enter__(self) -> "RecompileGuard":
+        _ensure_installed()
+        self._t0 = compile_count()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.compiles = compile_count() - self._t0
+        if exc_type is None and self.compiles > self.max_compiles:
+            raise RecompileError(
+                f"RecompileGuard({self.name!r}): {self.compiles} backend "
+                f"compile(s) inside a region whose contract allows "
+                f"{self.max_compiles} — something in the guarded dispatch "
+                "path is rebuilding executables per call (fresh lower(), "
+                "unstable cache key, or an unbucketed shape)."
+            )
+        return False

@@ -88,6 +88,11 @@ class ControllerManager:
             )
 
     def _on_event(self, ev: Any) -> None:
+        # lock-free: the store's event bus is SYNCHRONOUS and dispatches
+        # while the store's reentrant lock is held (state/store.py _emit
+        # runs inside the mutating call) — this callback is lock-held by
+        # construction, through a subscription the static analysis can't
+        # see; taking store.lock here would merely re-enter it
         # Pod churn concerns the replicaset controller when owned pods
         # appear (user-created pod adopted by / surplus to an existing RS)
         # or disappear — but NOT for the scheduler's bind updates

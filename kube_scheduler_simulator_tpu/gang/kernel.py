@@ -123,7 +123,7 @@ def build_feasibility_fn(G: int, M: int, N: int, R: int, D: int):
             pick = jnp.argmax(rank)
             can = fits.any() | ~valid
             place = valid & fits.any()
-            one = (jnp.arange(N) == pick) & place
+            one = (jnp.arange(N, dtype=jnp.int32) == pick) & place
             free = free - jnp.where(one[:, None], req[None, :], 0)
             cnt_free = cnt_free - one.astype(cnt_free.dtype)
             used_dom = used_dom.at[dom_n[pick]].max(place)

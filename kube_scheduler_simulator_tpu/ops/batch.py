@@ -921,7 +921,7 @@ def build_compact_fn(
             cumsum + scatter stable partition: the ids are already
             sorted, so a comparison sort per row is pure overhead (the
             two argsorts here were the dominant trace cost on CPU)."""
-            pos = jnp.cumsum(mask.astype(jnp.int32), axis=1) - 1
+            pos = jnp.cumsum(mask.astype(jnp.int32), axis=1, dtype=jnp.int32) - 1
             dest = jnp.where(mask & (pos < Wd), pos, Wd)
             ids = jnp.zeros((P, Wd), dtype=jnp.int32).at[
                 rows, dest
@@ -1391,7 +1391,7 @@ def build_batch_fn(
             def rot_cumsum(mask):
                 """c[n] = number of True entries with visit rank <= r[n] (a
                 cumsum in rotation order), plus the total count."""
-                pref = jnp.cumsum(mask.astype(jnp.int32))
+                pref = jnp.cumsum(mask.astype(jnp.int32), dtype=jnp.int32)
                 tot = pref[N - 1]
                 ps = jnp.where(start == 0, 0, jnp.take(pref, jnp.maximum(start - 1, 0)))
                 return jnp.where(idx >= start, pref - ps, pref + (tot - ps)), tot
@@ -1412,11 +1412,11 @@ def build_batch_fn(
             r = idx
 
             def rot_cumsum(mask):
-                pref = jnp.cumsum(mask.astype(jnp.int32))
+                pref = jnp.cumsum(mask.astype(jnp.int32), dtype=jnp.int32)
                 return pref, pref[N - 1]
 
             sampled = feasible
-            total = jnp.sum(feasible.astype(jnp.int32))
+            total = jnp.sum(feasible.astype(jnp.int32), dtype=jnp.int32)
             processed = nt
             count = total * dp.pod_active[i]
 
@@ -1567,7 +1567,7 @@ def build_batch_fn(
 
         # ----------------------------------------------------------- commit
         commit = count > 0
-        onehot = (jnp.arange(N) == sel) & commit  # [N]
+        onehot = (jnp.arange(N, dtype=jnp.int32) == sel) & commit  # [N]
         oh = onehot.astype(dt)
         if cfg.relax_tau > 0:
             # straight-through relaxed head: forward value IS the hard
@@ -1603,7 +1603,7 @@ def build_batch_fn(
             sel_safe = jnp.clip(sel, 0)
             d_g = dp.gdom[:, sel_safe]  # [G]
             d_g = jnp.where((d_g >= 0) & commit, d_g, D)
-            ip_sel = ip_sel.at[jnp.arange(ip_sel.shape[0]), d_g].add(dp.term_match[:, i] * commit)
+            ip_sel = ip_sel.at[jnp.arange(ip_sel.shape[0], dtype=jnp.int32), d_g].add(dp.term_match[:, i] * commit)
             for k in range(KO):
                 g = dp.ip_own_g[i, k]
                 active = (g >= 0) & commit
@@ -1641,7 +1641,7 @@ def build_batch_fn(
                 # feasible nodes' values to [ws0], ascending node id —
                 # byte-identical to the post-pass take_along_axis(sorder)
                 # (same order, same values), emitted at a tenth the size
-                pos_id = jnp.cumsum(sampled.astype(jnp.int32)) - 1
+                pos_id = jnp.cumsum(sampled.astype(jnp.int32), dtype=jnp.int32) - 1
                 dest = jnp.where(sampled & (pos_id < ws0), pos_id, ws0)
 
                 def compact1(v):
@@ -1682,7 +1682,7 @@ def build_batch_fn(
         if window is not None:
             dp = slice_pod_window(dp, offset, window)
         dp = _expand_features(dp, carry0[0].dtype)
-        carry, ys = lax.scan(functools.partial(step, dp), carry0, jnp.arange(Pw))
+        carry, ys = lax.scan(functools.partial(step, dp), carry0, jnp.arange(Pw, dtype=jnp.int32))
         ys["final_requested"] = carry[0]
         ys["final_nonzero"] = carry[1]  # [N,2] committed cpu/mem (objectives)
         ys["final_pod_count"] = carry[2]

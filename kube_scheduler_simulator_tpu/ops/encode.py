@@ -1557,6 +1557,10 @@ class EncodeCache:
         counters may be one in-flight encode apart from each other —
         fine for a scrape, which only needs each counter individually
         intact."""
+        # lock-free: copy-on-write read — _encode_locked never mutates the
+        # published fallback dict in place (it rebinds a fresh merged dict)
+        # and the int values are replaced atomically under the GIL, so a
+        # scrape never queues behind a multi-second cold encode
         return {
             k: (dict(v) if isinstance(v, dict) else v) for k, v in self.stats.items()
         }

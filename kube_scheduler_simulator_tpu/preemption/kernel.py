@@ -53,7 +53,7 @@ def build_preempt_fn(U: int, N: int, V: int, R: int, PDB: int, S: int):
             # matching lower pod violates once the running count exceeds
             # disruptionsAllowed (utils/pdb.violates_pdb's decrement)
             m = vmatch_n & lower[:, None]
-            cum = jnp.cumsum(m.astype(jnp.int32), axis=0)
+            cum = jnp.cumsum(m.astype(jnp.int32), axis=0, dtype=jnp.int32)
             viol = jnp.any(vmatch_n & (cum > allowed[None, :]), axis=1) & lower
         else:
             viol = jnp.zeros(V, dtype=bool)

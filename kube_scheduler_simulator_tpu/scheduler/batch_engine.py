@@ -1150,6 +1150,32 @@ class BatchEngine:
         eng._store = getattr(framework.handle, "cluster_store", None)
         return eng
 
+    def set_weight_override(self, override: "dict[str, float]") -> None:
+        """Swap the traced weight vector WITHOUT rebuilding the engine.
+
+        Only legal on the traced path (``cfg.traced_weights``): there the
+        weights are a kernel ARGUMENT — the next dispatch simply carries
+        the new ``plugin_w`` vector and every executable and device-
+        resident plane survives.  This is the PR 7 contract ("weight
+        changes re-dispatch, never recompile") extended to the service
+        boundary: without it, every live ``set_plugin_weights`` call
+        tore the engines down and recompiled the world per retune
+        (caught by the RecompileGuard step in scripts/tune_smoke.py).
+        Validated exactly like the constructor path."""
+        if not self.cfg.traced_weights:
+            raise ValueError(
+                "set_weight_override requires the traced-weights path; "
+                "a folded engine must be rebuilt to install an override"
+            )
+        from kube_scheduler_simulator_tpu.tuning.validate import (
+            validate_plugin_weights,
+        )
+
+        weights = [float(override.get(s, w)) for s, w in self.scores]
+        self.weight_override = validate_plugin_weights(
+            weights, [s for s, _w in self.scores], defaults=dict(self.scores)
+        )
+
     def _volumes(self) -> "dict[str, list[Obj]]":
         """The volume resource kinds for encode() (empty without a store)."""
         store = getattr(self, "_store", None)

@@ -237,10 +237,10 @@ class SchedulingQueue:
             counts = {ACTIVE: 0, BACKOFF: 0, UNSCHEDULABLE: 0}
             for st in self._pods.values():
                 counts[st.state] += 1
-        return {
-            "queue_active": counts[ACTIVE],
-            "queue_backoff": counts[BACKOFF],
-            "queue_unschedulable": counts[UNSCHEDULABLE],
-            "queue_moves": self.moves,
-            "queue_flushes": self.flushes,
-        }
+            return {
+                "queue_active": counts[ACTIVE],
+                "queue_backoff": counts[BACKOFF],
+                "queue_unschedulable": counts[UNSCHEDULABLE],
+                "queue_moves": self.moves,
+                "queue_flushes": self.flushes,
+            }

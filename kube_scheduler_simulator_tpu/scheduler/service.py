@@ -467,9 +467,24 @@ class SchedulerService:
                     resolved = mapping
             self._weights_requested = weights
             self._weights_override = resolved
-        # engines bake traced_weights into their compiled config: rebuild
-        self._batch_engine = None
-        self._batch_engines = {}
+        # Engines bake traced_weights into their compiled config, so a
+        # folded<->traced MODE change rebuilds them — but a VALUE-only
+        # change on an already-traced engine swaps the vector in place:
+        # the weights are a traced kernel argument there, and tearing the
+        # engines down would recompile every executable per retune (the
+        # PR 7 "re-dispatch, never recompile" contract at the service
+        # boundary; runtime-enforced by scripts/tune_smoke.py's
+        # RecompileGuard and analysis/runtime.py).
+        if weights is None or any(
+            not eng.cfg.traced_weights for eng in self._batch_engines.values()
+        ):
+            self._batch_engine = None
+            self._batch_engines = {}
+        else:
+            for name, fw in self.frameworks.items():
+                eng = self._batch_engines.get(name)
+                if eng is not None:
+                    eng.set_weight_override(fw.score_weight_override)
         return self._weights_override
 
     def check_plugin_weights(self, weights: Any) -> "list[tuple[Any, dict[str, float]]]":
@@ -941,6 +956,9 @@ class SchedulerService:
                 tc = time.perf_counter()
                 for pod in pending:
                     results[_pod_key(pod)] = self.schedule_one(pod, snapshot)
+                # lock-free: single-writer scalar bumps on the scheduling
+                # thread (GIL-atomic += on fixed stats keys); _stats_lock is
+                # for multi-key dict publishes (fallback/drain maps)
                 self.stats["commit_s"] += time.perf_counter() - tc
             else:
                 if gang_ctx is not None and gang_ctx.engaged:
@@ -1038,6 +1056,8 @@ class SchedulerService:
             restarts += 1
             if i >= len(pending):
                 break
+            # lock-free: single-writer scalar bump on the scheduling thread
+            # (GIL-atomic += on a fixed stats key)
             self.stats["batch_restarts"] += 1
             if pctx is None and restarts >= self.batch_max_restarts:
                 # Preemption-heavy round whose PostFilter work runs on the
@@ -1095,6 +1115,9 @@ class SchedulerService:
         outside the engine's envelope.  Returns the absolute
         pending-index to restart the kernel from after a successful
         preemption, else None."""
+        # lock-free: all stats accesses in this method are single-writer
+        # scalar bumps on the scheduling thread (GIL-atomic += on fixed
+        # keys); _stats_lock is for multi-key read-modify-write publishes
         window = result.pending
         sample_start = result.out["sample_start"]
         if gang_ctx is not None:
@@ -1311,6 +1334,9 @@ class SchedulerService:
         pkg/debuggablescheduler/debuggable_scheduler.go:13-15; here the
         simulator's own counters are first-class)."""
         eng = self._batch_engine
+        # lock-free: the scalar stats reads below are GIL-atomic snapshots
+        # of single-writer counters (one-bump skew is fine for a scrape);
+        # only the multi-key dicts are copied under the lock here
         with self._stats_lock:
             fallbacks = dict(self.stats["batch_fallbacks"])
             preempt_fallbacks = dict(self.stats["preempt_fallbacks"])
@@ -1623,6 +1649,8 @@ class SchedulerService:
         attempt_move_seq = self.queue.move_seq
         result = fw.schedule_one(pod, snapshot)
         self._sync_rotation(fw)
+        # lock-free: single-writer scalar bump on the scheduling thread
+        # (GIL-atomic += on a fixed stats key)
         self.stats["sequential_pods"] += 1
         # gang cascades inside the cycle (Coscheduling permit releases /
         # post-filter rejections) resolve OTHER waiting pods — record

@@ -137,6 +137,11 @@ class StreamSession:
             d[reason] = d.get(reason, 0) + 1
 
     def _note_wave(self, cnt: int) -> None:
+        # lock-free: single-writer scalar bumps (only the session thread
+        # commits waves); each += is GIL-atomic under fixed dict keys, and
+        # the metrics scrape tolerates one-wave skew between counters —
+        # _stats_lock is reserved for multi-key read-modify-write publishes
+        # like the stream_drains dict (_count_drain)
         self._session_waves += 1
         self.svc.stats["stream_waves"] += 1
         self.svc.stats["stream_pods"] += cnt
@@ -296,6 +301,9 @@ class StreamSession:
         # fetch) are a stall, not hidden work — keep them out of the
         # overlap bucket so overlap_efficiency stays honest
         dev_wait = pb._dev_wait - dev0
+        # lock-free: single-writer scalar bumps on the session thread
+        # (fixed keys, GIL-atomic +=); _stats_lock guards only multi-key
+        # dict publishes — see _count_drain
         svc.stats["stream_stall_s"] += dev_wait
         cnt = len(pb.pending)
         point_names = {
@@ -405,6 +413,8 @@ class StreamSession:
             pb = flight["pb"]
             t0 = time.perf_counter()
             pb.decisions()
+            # lock-free: single-writer scalar bumps on the session thread
+            # (GIL-atomic += on fixed keys; the lock is for dict publishes)
             svc.stats["stream_stall_s"] += time.perf_counter() - t0
             n_fail = int((pb.selected[: len(pb.pending)] < 0).sum())
             if n_fail and self._seq_failures():

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""The kernel-contract checker CLI: AST rules + baseline, CI-enforced.
+
+Modes:
+
+- default            — run every rule over the live tree (package +
+                       scripts + bench.py) with analysis/baseline.toml
+                       applied; exit 0 iff no unbaselined findings and
+                       no unparseable files.
+- --selftest         — run the rules over analysis/fixtures/ and check
+                       the fixture matrix: every ``# expect-finding``
+                       line in a ``*_bad_*`` fixture must be flagged by
+                       exactly its rule, good fixtures must be clean,
+                       and every rule must fire at least twice.  Exit 0
+                       iff the matrix holds — this is the checker
+                       checking itself, run by tier-1 BEFORE the live
+                       tree so a broken rule can't silently pass it.
+- --json             — machine-readable report (findings, suppressed
+                       with justifications, unused suppressions) for
+                       dashboarding.
+- --rules A,B        — restrict to a comma-separated rule subset.
+- --no-baseline      — show everything the rules see (triage mode).
+
+Exit codes: 0 clean, 1 findings/matrix failures, 2 internal error
+(malformed baseline, unparseable checker input).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _selftest(as_json: bool) -> int:
+    import json
+    import re
+
+    from kube_scheduler_simulator_tpu.analysis import run_analysis
+    from kube_scheduler_simulator_tpu.analysis.framework import PACKAGE, repo_root
+
+    report = run_analysis(fixtures=True, baseline_path=None)
+    found: dict[tuple[str, int], str] = {}
+    for f in report["findings"]:
+        found.setdefault((f.file, f.line), f.rule)
+
+    fdir = os.path.join(repo_root(), PACKAGE, "analysis", "fixtures")
+    failures: list[str] = []
+    fired: dict[str, int] = {}
+    expect_re = re.compile(r"#\s*expect-finding\b")
+    for fn in sorted(os.listdir(fdir)):
+        if not fn.endswith(".py"):
+            continue
+        rel = f"{PACKAGE}/analysis/fixtures/{fn}"
+        with open(os.path.join(fdir, fn), "r", encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+        expected = {i for i, ln in enumerate(lines, 1) if expect_re.search(ln)}
+        got = {line for (file, line) in found if file == rel}
+        if "_bad_" in fn:
+            if not expected:
+                failures.append(f"{fn}: bad fixture carries no # expect-finding markers")
+            missing = expected - got
+            extra = got - expected
+            if missing:
+                failures.append(f"{fn}: lines {sorted(missing)} expected a finding, got none")
+            if extra:
+                failures.append(f"{fn}: unexpected findings on lines {sorted(extra)}")
+            for line in expected & got:
+                fired[found[(rel, line)]] = fired.get(found[(rel, line)], 0) + 1
+        else:  # good fixtures must be silent
+            if got:
+                failures.append(f"{fn}: good fixture flagged on lines {sorted(got)}")
+    for rule in ("KSS-DTYPE", "KSS-HOST-SYNC", "KSS-DONATE", "KSS-ENV", "KSS-LOCK"):
+        if fired.get(rule, 0) < 2:
+            failures.append(
+                f"{rule}: fixture matrix demonstrates only {fired.get(rule, 0)} "
+                "finding(s); the contract needs >=2 bad cases"
+            )
+    if as_json:
+        print(json.dumps({"ok": not failures, "failures": failures, "fired": fired}, indent=2))
+    elif failures:
+        for msg in failures:
+            print(f"selftest FAIL: {msg}", file=sys.stderr)
+    else:
+        print(
+            "contract selftest OK: "
+            + ", ".join(f"{r}={n}" for r, n in sorted(fired.items()))
+        )
+    return 1 if failures else 0
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", action="store_true", help="machine-readable report")
+    ap.add_argument("--selftest", action="store_true", help="run the fixture matrix")
+    ap.add_argument("--no-baseline", action="store_true", help="ignore the baseline")
+    ap.add_argument("--rules", default=None, help="comma-separated rule subset")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return _selftest(args.json)
+
+    from kube_scheduler_simulator_tpu.analysis import (
+        BaselineError,
+        default_rules,
+        render_report,
+        run_analysis,
+    )
+
+    rules = default_rules()
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - {r.name for r in rules}
+        if unknown:
+            print(f"unknown rule(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.name in wanted]
+    try:
+        report = run_analysis(
+            rules=rules, baseline_path=None if args.no_baseline else ""
+        )
+    except BaselineError as e:
+        print(f"baseline error: {e}", file=sys.stderr)
+        return 2
+    print(render_report(report, as_json=args.json))
+    return 1 if (report["findings"] or report["errors"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

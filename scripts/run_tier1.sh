@@ -32,4 +32,11 @@ if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/tune_smoke.py; then 
 # byte-compared against single-device (sharded dispatches asserted), plus
 # the f32-vs-x64 oracle spot check (scripts/shard_smoke.py).
 if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/shard_smoke.py; then rc=1; fi
+# Kernel-contract checker (docs/static-analysis.md): FIRST the fixture
+# self-test (every rule must fire on its known-bad fixtures and stay
+# silent on the good ones — a broken rule must not silently pass the
+# tree), THEN the live tree with analysis/baseline.toml applied; any
+# unbaselined KSS-DTYPE/HOST-SYNC/DONATE/ENV/LOCK finding fails tier-1.
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/check_contracts.py --selftest; then rc=1; fi
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/check_contracts.py; then rc=1; fi
 exit $rc

@@ -117,12 +117,38 @@ def run(streaming: bool):
 
     svc, store = build()
     svc.schedule_stream(feed=feed_factory(store), streaming=streaming)
-    return pod_parity_state(store), svc.metrics()
+    return pod_parity_state(store), svc.metrics(), svc, store
+
+
+def steady_state_guard(svc, store) -> int:
+    """One more streamed churn pass over the WARMED service: every
+    executable this wave shape needs was compiled during the parity run,
+    so the steady-state contract is zero new backend compiles — the
+    RecompileGuard turns a silent recompile-per-wave regression (the PR 7
+    pathology class) into a loud tier-1 failure."""
+    from kube_scheduler_simulator_tpu.analysis import RecompileGuard
+    from kube_scheduler_simulator_tpu.analysis.runtime import RecompileError
+
+    def feed(tick: int) -> bool:
+        if tick >= 1:
+            return False
+        for i in range(1000, 1000 + PER_TICK):
+            store.create("pods", mk_pod(i))
+        return True
+
+    try:
+        with RecompileGuard("stream steady-state waves") as g:
+            svc.schedule_stream(feed=feed, streaming=True)
+    except RecompileError as e:
+        print(f"FAIL: {e}", file=sys.stderr)
+        return 1
+    print(f"stream-smoke steady state: {g.compiles} recompiles across the warmed pass")
+    return 0
 
 
 def main() -> int:
-    d1, m1 = run(True)
-    d0, m0 = run(False)
+    d1, m1, svc1, store1 = run(True)
+    d0, m0, _svc0, _store0 = run(False)
     if d1.keys() != d0.keys():
         print(f"stream-smoke: pod sets diverged ({len(d1)} vs {len(d0)})", file=sys.stderr)
         return 1
@@ -143,6 +169,9 @@ def main() -> int:
     if m0["stream_overlap_s"] != 0.0:
         print("stream-smoke: the serial baseline reported overlap", file=sys.stderr)
         return 1
+    rc = steady_state_guard(svc1, store1)
+    if rc:
+        return rc
     print(
         f"stream-smoke OK: {len(d1)} pods byte-identical; "
         f"waves={m1['stream_waves_total']} pods={m1['stream_pods_total']} "

@@ -71,10 +71,10 @@ def main() -> int:
             )
             assert svc.plugin_weights() is not None, "override did not install"
         svc.schedule_pending()
-        return pod_parity_state(store)
+        return pod_parity_state(store), svc, store
 
-    folded = run_mode(False)
-    traced = run_mode(True)
+    folded, _svc_f, _store_f = run_mode(False)
+    traced, svc_t, store_t = run_mode(True)
     bad = [k for k in set(folded) | set(traced) if folded.get(k) != traced.get(k)]
     if bad:
         k = sorted(bad)[0]
@@ -85,10 +85,37 @@ def main() -> int:
             file=sys.stderr,
         )
         return 1
+    # --- 4: the traced-weights contract, runtime-enforced: a weight
+    # CHANGE re-dispatches the warmed executable, never recompiles (the
+    # PR 7 estimator bug class — a recompile per weight vector would turn
+    # every tuner generation into a compile storm)
+    from kube_scheduler_simulator_tpu.analysis import RecompileGuard
+    from kube_scheduler_simulator_tpu.analysis.runtime import RecompileError
+
+    svc_t.set_plugin_weights(
+        {n: 2.0 * float(w) for n, w in svc_t.framework.score_weights.items()}
+    )
+    # churn the bound pods out and replay the SAME workload: the steady
+    # state must be shape-identical to the warmed wave (a fuller cluster
+    # would legitimately hit a new retry-bucket shape and compile)
+    for p in pods:
+        store_t.delete("pods", p["metadata"]["name"], p["metadata"].get("namespace", "default"))
+    for i, p in enumerate(pods):
+        clone = {**p, "metadata": {**p["metadata"], "name": f"steady-{i}"}}
+        clone.pop("status", None)
+        store_t.create("pods", clone)
+    try:
+        with RecompileGuard("tuning steady-state weight re-dispatch") as g:
+            svc_t.schedule_pending()
+    except RecompileError as e:
+        print(f"FAIL: {e}", file=sys.stderr)
+        return 1
+
     print(
         f"tune smoke OK: cem bestSoFar {best} (default {r['defaultObjective']:.6f}), "
         f"{r['rollouts']} rollouts/{r['dispatches']} dispatches; "
-        f"{len(folded)} pods byte-identical folded vs traced defaults"
+        f"{len(folded)} pods byte-identical folded vs traced defaults; "
+        f"{g.compiles} recompiles after a weight change on the warmed service"
     )
     return 0
 

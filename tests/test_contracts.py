@@ -1,0 +1,307 @@
+"""The kernel-contract analyzer (analysis/): rules, baseline, runtime.
+
+Three layers of pinning:
+
+1. **Fixture matrix** — every rule fires on its known-bad fixtures at
+   exactly the marked lines and stays silent on the good fixtures
+   (the same matrix scripts/check_contracts.py --selftest enforces in
+   tier-1; here each rule is additionally exercised through the API).
+2. **Live tree** — the repository itself, with analysis/baseline.toml
+   applied, has zero findings: the contracts hold on the code that
+   ships, and any new violation fails this test before it ships.
+3. **Runtime** — RecompileGuard counts real backend compiles: a warmed
+   dispatch is silent, a fresh shape raises, and the PR 7 estimator
+   contract (live weight override ⇒ zero new compiles on the second
+   estimate) plus the service-boundary weight-swap contract (value-only
+   set_plugin_weights ⇒ zero recompiles) hold on a real service.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+from typing import Any
+
+import pytest
+
+from kube_scheduler_simulator_tpu.analysis import (
+    BaselineError,
+    RecompileGuard,
+    apply_baseline,
+    compile_count,
+    load_baseline,
+    run_analysis,
+)
+from kube_scheduler_simulator_tpu.analysis.framework import PACKAGE, repo_root
+from kube_scheduler_simulator_tpu.analysis.runtime import RecompileError
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+from tests.test_batch_parity import mk_node, mk_pod
+
+Obj = dict[str, Any]
+
+ROOT = repo_root()
+FIXDIR = os.path.join(ROOT, PACKAGE, "analysis", "fixtures")
+RULES = ("KSS-DTYPE", "KSS-HOST-SYNC", "KSS-DONATE", "KSS-ENV", "KSS-LOCK")
+
+
+# ---------------------------------------------------------- fixture matrix
+
+
+def _fixture_report():
+    return run_analysis(fixtures=True, baseline_path=None)
+
+
+def _expected_lines(fname: str) -> set[int]:
+    marker = re.compile(r"#\s*expect-finding\b")
+    with open(os.path.join(FIXDIR, fname), "r", encoding="utf-8") as f:
+        return {i for i, ln in enumerate(f.read().splitlines(), 1) if marker.search(ln)}
+
+
+@pytest.mark.parametrize(
+    "fname",
+    sorted(f for f in os.listdir(FIXDIR) if f.endswith(".py")),
+)
+def test_fixture_matrix(fname):
+    """Bad fixtures are flagged at exactly their marked lines (by the
+    rule the fixture belongs to); good fixtures are silent."""
+    report = _fixture_report()
+    rel = f"{PACKAGE}/analysis/fixtures/{fname}"
+    got = {f.line: f.rule for f in report["findings"] if f.file == rel}
+    expected = _expected_lines(fname)
+    if "_bad_" in fname:
+        assert expected, f"{fname}: a bad fixture must carry expect markers"
+        assert set(got) == expected, (
+            f"{fname}: flagged lines {sorted(got)} != expected {sorted(expected)}"
+        )
+        slug = fname.split("_bad_")[0].replace("kss_", "kss-").replace("_", "-").upper()
+        assert all(r == slug for r in got.values()), got
+    else:
+        assert not got, f"{fname}: good fixture flagged: {got}"
+        assert not expected, f"{fname}: good fixture carries expect markers"
+
+
+def test_every_rule_demonstrated_twice():
+    report = _fixture_report()
+    by_rule: dict[str, int] = {}
+    for f in report["findings"]:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    for rule in RULES:
+        assert by_rule.get(rule, 0) >= 2, (rule, by_rule)
+
+
+# --------------------------------------------------------------- live tree
+
+
+def test_live_tree_clean_with_baseline():
+    """The shipping tree holds every contract (baseline applied); the
+    baseline itself is fully used — a stale suppression is a failure
+    here so the allowlist shrinks as code heals."""
+    report = run_analysis()
+    assert not report["errors"], report["errors"]
+    assert not report["findings"], "\n".join(f.render() for f in report["findings"])
+    assert not report["unused_suppressions"], [
+        (s.rule, s.file, s.symbol) for s in report["unused_suppressions"]
+    ]
+
+
+def test_live_tree_has_baselined_findings():
+    """The suppressions are real: running WITHOUT the baseline surfaces
+    the justified findings (the baseline documents them, it doesn't
+    imagine them)."""
+    report = run_analysis(baseline_path=None)
+    assert report["suppressed"] == []
+    assert report["findings"], "baseline entries exist, so raw findings must too"
+
+
+# ----------------------------------------------------------------- baseline
+
+
+def test_baseline_requires_justification(tmp_path):
+    p = tmp_path / "baseline.toml"
+    p.write_text('[[suppress]]\nrule = "KSS-DTYPE"\n')
+    with pytest.raises(BaselineError, match="justification"):
+        load_baseline(str(p))
+    p.write_text('[[suppress]]\nrule = "KSS-DTYPE"\njustification = "  "\n')
+    with pytest.raises(BaselineError, match="justification"):
+        load_baseline(str(p))
+    p.write_text(
+        '[[suppress]]\nrule = "KSS-DTYPE"\nbogus_key = 1\njustification = "x"\n'
+    )
+    with pytest.raises(BaselineError, match="unknown keys"):
+        load_baseline(str(p))
+
+
+def test_baseline_matching_and_unused(tmp_path):
+    p = tmp_path / "baseline.toml"
+    p.write_text(
+        "\n".join(
+            [
+                "[[suppress]]",
+                'rule = "KSS-DTYPE"',
+                'file = "*/fixtures/kss_dtype_bad_1.py"',
+                'justification = "test"',
+                "[[suppress]]",
+                'rule = "KSS-LOCK"',
+                'symbol = "NoSuchClass.*"',
+                'justification = "stale"',
+            ]
+        )
+    )
+    sups = load_baseline(str(p))
+    findings = _fixture_report()["findings"]
+    kept, suppressed = apply_baseline(findings, sups)
+    assert suppressed and all(
+        f.file.endswith("kss_dtype_bad_1.py") for f, _s in suppressed
+    )
+    assert all(not f.file.endswith("kss_dtype_bad_1.py") for f in kept)
+    assert [s.rule for s in sups if not s.used] == ["KSS-LOCK"]
+
+
+# ---------------------------------------------------------------- CLI gate
+
+
+def test_cli_selftest_and_live_exit_codes():
+    """The tier-1 wiring end to end: --selftest exit 0 (fixtures fire),
+    live run exit 0 (tree clean), and an injected violation — a bad
+    fixture dropped into the scanned tree — flips the live run nonzero."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    cli = os.path.join(ROOT, "scripts", "check_contracts.py")
+    r = subprocess.run(
+        [sys.executable, cli, "--selftest"], capture_output=True, text=True, env=env
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run([sys.executable, cli], capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # inject: a kernel-module file with an unpinned integer reduction
+    bad = os.path.join(ROOT, PACKAGE, "ops", "_contracts_injected_violation.py")
+    with open(bad, "w", encoding="utf-8") as f:
+        f.write(
+            "import jax.numpy as jnp\n\n\n"
+            "def injected(mask):\n"
+            "    return jnp.cumsum(mask.astype(jnp.int32))\n"
+        )
+    try:
+        r = subprocess.run(
+            [sys.executable, cli, "--json"], capture_output=True, text=True, env=env
+        )
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "_contracts_injected_violation" in r.stdout
+        assert '"ok": false' in r.stdout
+    finally:
+        os.unlink(bad)
+
+
+# ------------------------------------------------------------------ runtime
+
+
+def test_recompile_guard_counts_and_raises():
+    import jax
+    import jax.numpy as jnp
+
+    import numpy as np
+
+    fn = jax.jit(lambda x: x * 2.0 + 1.0)
+    # inputs prepared OUTSIDE the guards: even a jnp.ones() literal
+    # compiles its own broadcast kernel, which is exactly what the guard
+    # is built to catch
+    x3a, x3b, x5, x7 = (np.ones((n,), np.float32) for n in (3, 3, 5, 7))
+    fn(x3a)  # warm outside the guard
+    with RecompileGuard("warmed dispatch") as g:
+        fn(x3b)
+    assert g.compiles == 0
+    before = compile_count()
+    with pytest.raises(RecompileError, match="warm shapes"):
+        with RecompileGuard("warm shapes"):
+            fn(x5)  # fresh shape: must be counted and raised
+    assert compile_count() > before
+    # max_compiles budgets an expected warmup
+    with RecompileGuard("bounded warmup", max_compiles=1) as g:
+        fn(x7)
+    assert g.compiles == 1
+
+
+def _estimator_cluster() -> "tuple[ClusterStore, SchedulerService]":
+    store = ClusterStore()
+    store.create(
+        "nodegroups",
+        {
+            "metadata": {"name": "g1"},
+            "spec": {
+                "minSize": 0,
+                "maxSize": 8,
+                "priority": 0,
+                "template": {
+                    "metadata": {"labels": {}},
+                    "spec": {},
+                    "status": {
+                        "allocatable": {"cpu": "4000m", "memory": "8Gi", "pods": "20"}
+                    },
+                },
+            },
+        },
+    )
+    svc = SchedulerService(store, tie_break="first", use_batch="off")
+    svc.start_scheduler(None)
+    return store, svc
+
+
+def test_estimator_weight_override_zero_recompiles_on_second_estimate():
+    """The PR 7 estimator contract, pinned at the runtime layer: with a
+    live traced-weights override installed, the FIRST estimate may
+    compile (cold executables), the SECOND may not — the estimator's
+    fn-cache plus its constant-folded weight pin must hold under the
+    override, or every autoscaler pass becomes a compile storm."""
+    from kube_scheduler_simulator_tpu.autoscaler import ClusterAutoscaler
+
+    store, svc = _estimator_cluster()
+    svc.set_plugin_weights({"NodeResourcesFit": 2.5})
+    for i in range(4):
+        store.create("pods", mk_pod(f"rg-{i}", cpu_m=1500, mem_mi=1024))
+    svc.schedule_pending(max_rounds=1)
+    asc = ClusterAutoscaler(store, svc)
+    action = asc.scale_up(svc.pending_pods())
+    assert action["method"] == "xla-batch", action
+    est = asc._estimator
+    assert est is not None and est.kernel_errors == 0
+    with RecompileGuard("estimator second estimate under weight override"):
+        action2 = asc.scale_up(svc.pending_pods())
+    assert action2["method"] == "xla-batch", action2
+    assert est.kernel_errors == 0
+
+
+def test_set_plugin_weights_value_change_keeps_engines(monkeypatch):
+    """The service-boundary half of the same contract: a VALUE-only
+    weight change on an already-traced engine swaps the vector in place
+    (zero recompiles, engines preserved); clearing the override is a
+    mode change and legitimately rebuilds.  The incremental placer is
+    pinned OFF so its lazily-engaged scatter kernels (whose row-bucket
+    shapes vary with churn, a legitimate warmup) don't alias the
+    contract under test."""
+    monkeypatch.setenv("KSS_ENCODE_INCREMENTAL", "0")
+    store = ClusterStore(clock=lambda: 1700000000.0)
+    for i in range(4):
+        store.create("nodes", mk_node(f"n-{i}", cpu_m=8000, mem_mi=16384))
+    svc = SchedulerService(store, tie_break="first", use_batch="force", batch_min_work=0)
+    svc.start_scheduler(None)
+    svc.set_plugin_weights({"NodeResourcesFit": 2.0})
+    for i in range(6):
+        store.create("pods", mk_pod(f"w-{i}", cpu_m=200, mem_mi=256))
+    svc.schedule_pending()  # warm the traced executables (cold uploads)
+    for i in range(6):
+        store.create("pods", mk_pod(f"w2-{i}", cpu_m=200, mem_mi=256))
+    svc.schedule_pending()  # warm the placer's scatter-update kernels too
+    eng_before = svc._batch_engine
+    assert eng_before is not None and eng_before.cfg.traced_weights
+    svc.set_plugin_weights({"NodeResourcesFit": 3.5})
+    assert svc._batch_engine is eng_before, "value-only change must keep the engine"
+    for i in range(6):
+        store.create("pods", mk_pod(f"w3-{i}", cpu_m=200, mem_mi=256))
+    with RecompileGuard("weight value change on warmed engines"):
+        svc.schedule_pending()
+    # clearing the override IS a mode change: engines rebuild
+    svc.set_plugin_weights(None)
+    assert svc._batch_engine is None
