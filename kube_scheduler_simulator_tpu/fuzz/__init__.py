@@ -1,0 +1,70 @@
+"""Differential scenario fuzzer + chaos engine.
+
+Every subsystem since PR 1 ships with a sequential oracle and a
+byte-parity bar — a free differential-testing oracle.  This package is
+the engine that drives it (docs/fuzzing.md):
+
+- :mod:`fuzz.generator` + :mod:`fuzz.coverage` — seeded composite
+  scenarios (gang x preemption x autoscale x churn x retune), sampled
+  for structural diversity over coverage buckets;
+- :mod:`fuzz.runner` + :mod:`fuzz.verdict` — each scenario executed
+  through independent paths (batch vs sequential oracle, streamed vs
+  serial, sharded vs single-device) with the full annotation trail
+  diffed byte-for-byte; counted exactness-gate drains are explained
+  routing, any byte mismatch is a divergence;
+- :mod:`fuzz.shrink` — deterministic minimization of diverging
+  scenarios down to committed ``fuzz/fixtures/`` with exact expected
+  bytes;
+- :mod:`fuzz.chaos` — mid-run kernel-failure injection; the engines
+  must degrade to the sequential path without committing a partial or
+  divergent wave.
+
+Tier-1 runs a bounded seeded sweep (scripts/fuzz_smoke.py); the
+``KSS_FUZZ_*`` knobs (docs/environment-variables.md) select seed,
+scenario budget, shrink budget and the long-haul mode.
+"""
+
+from kube_scheduler_simulator_tpu.fuzz.coverage import FEATURES, MIN_COMPOSE, CoverageMap
+from kube_scheduler_simulator_tpu.fuzz.generator import generate_scenario
+from kube_scheduler_simulator_tpu.fuzz.runner import (
+    DEFAULT_COMPARISONS,
+    FuzzHarness,
+    FuzzHarnessError,
+    encode_state,
+    fuzz_knobs,
+    run_differential,
+)
+from kube_scheduler_simulator_tpu.fuzz.shrink import (
+    FIXTURE_DIR,
+    canonical_json,
+    iter_fixture_paths,
+    load_fixture,
+    make_fixture,
+    replay_fixture,
+    shrink,
+    write_fixture,
+)
+from kube_scheduler_simulator_tpu.fuzz.chaos import ChaosError, KernelChaos
+
+__all__ = [
+    "FEATURES",
+    "MIN_COMPOSE",
+    "CoverageMap",
+    "generate_scenario",
+    "DEFAULT_COMPARISONS",
+    "FuzzHarness",
+    "FuzzHarnessError",
+    "encode_state",
+    "fuzz_knobs",
+    "run_differential",
+    "FIXTURE_DIR",
+    "canonical_json",
+    "iter_fixture_paths",
+    "load_fixture",
+    "make_fixture",
+    "replay_fixture",
+    "shrink",
+    "write_fixture",
+    "ChaosError",
+    "KernelChaos",
+]

@@ -1,0 +1,118 @@
+"""The chaos layer: inject mid-run device faults the system must survive.
+
+The scheduling engines promise that a kernel failure is never fatal and
+never partial: a crashed dispatch, window fetch, or streamed
+decision/result fetch degrades the round (or wave) to the sequential
+path, byte-identical to a run where the crash never happened, with the
+event counted (``batch_fallbacks`` / ``stream_drains_by_reason`` under
+``kernel error: *``).  This module is the adversary that earns that
+promise: a :class:`KernelChaos` context deterministically fails chosen
+*device events* — every engine interaction gets a global sequence
+number — and the differential runner then byte-compares the chaotic run
+against a clean oracle.
+
+Device events, in occurrence order across the whole context:
+
+- ``schedule`` / ``schedule_async`` / ``schedule_waves`` — one event per
+  engine call, ticked BEFORE dispatch (a failing event aborts with
+  nothing committed);
+- ``window`` — one per window fetched from a ``schedule_waves``
+  iterator (failing event k leaves windows < k committed: the mid-round
+  wave-restart shape);
+- ``decisions`` / ``result`` — one per streamed fetch (failing before
+  any of that wave committed).
+
+Injection is via the service's ``_engine_for`` seam, so every profile
+engine — and the stream session riding on it — sees the same chaos.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+Obj = dict[str, Any]
+
+
+class ChaosError(RuntimeError):
+    """The injected device fault (looks like any other kernel crash to
+    the engines — they must not special-case it)."""
+
+
+class _ChaosPendingBatch:
+    """Wraps a PendingBatch so the streamed fetch points tick too."""
+
+    def __init__(self, pb: Any, chaos: "KernelChaos"):
+        object.__setattr__(self, "_pb", pb)
+        object.__setattr__(self, "_chaos", chaos)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(object.__getattribute__(self, "_pb"), name)
+
+    def decisions(self) -> Any:
+        self._chaos._tick("decisions")
+        return self._pb.decisions()
+
+    def result(self) -> Any:
+        self._chaos._tick("result")
+        return self._pb.result()
+
+
+class _ChaosEngineProxy:
+    """Forwards everything to the real engine; the dispatch surface
+    (schedule / schedule_async / schedule_waves / window fetches) ticks
+    the chaos counter first."""
+
+    def __init__(self, eng: Any, chaos: "KernelChaos"):
+        object.__setattr__(self, "_eng", eng)
+        object.__setattr__(self, "_chaos", chaos)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(object.__getattribute__(self, "_eng"), name)
+
+    def schedule(self, *a: Any, **kw: Any) -> Any:
+        self._chaos._tick("schedule")
+        return self._eng.schedule(*a, **kw)
+
+    def schedule_async(self, *a: Any, **kw: Any) -> Any:
+        self._chaos._tick("schedule_async")
+        return _ChaosPendingBatch(self._eng.schedule_async(*a, **kw), self._chaos)
+
+    def schedule_waves(self, *a: Any, **kw: Any) -> Iterator:
+        self._chaos._tick("schedule_waves")
+        return self._chaos._wrap_windows(self._eng.schedule_waves(*a, **kw))
+
+
+class KernelChaos:
+    """Context manager failing the device events whose global sequence
+    numbers are in ``fail_events``.  ``events`` counts all events seen,
+    ``trips`` the injected failures — a test asserting chaos actually
+    fired checks ``trips > 0``."""
+
+    def __init__(self, svc: Any, fail_events: "frozenset[int] | set[int]" = frozenset({0})):
+        self.svc = svc
+        self.fail_events = frozenset(int(e) for e in fail_events)
+        self.events = 0
+        self.trips = 0
+        self._orig: Any = None
+
+    def _tick(self, what: str) -> None:
+        e = self.events
+        self.events += 1
+        if e in self.fail_events:
+            self.trips += 1
+            raise ChaosError(f"injected kernel failure at device event #{e} ({what})")
+
+    def _wrap_windows(self, gen: Iterator) -> Iterator:
+        for item in gen:
+            self._tick("window")
+            yield item
+
+    def __enter__(self) -> "KernelChaos":
+        self._orig = self.svc._engine_for  # the bound method
+        self.svc._engine_for = lambda fw: _ChaosEngineProxy(self._orig(fw), self)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        # remove the instance attribute shadowing the class method
+        self.svc.__dict__.pop("_engine_for", None)
+        self._orig = None

@@ -51,8 +51,13 @@ class ScenarioError(Exception):
     pass
 
 
-class ScenarioClock:
-    """Deterministic timeline clock for scenario replay.
+from kube_scheduler_simulator_tpu.utils.simclock import SimClock
+
+
+class ScenarioClock(SimClock):
+    """Deterministic timeline clock for scenario replay — the historical
+    name for :class:`~kube_scheduler_simulator_tpu.utils.simclock.SimClock`
+    in its service-clock role.
 
     Construct a SchedulerService with ``clock=ScenarioClock()`` and the
     scheduling queue's backoff AND every framework's Permit deadlines run
@@ -61,16 +66,6 @@ class ScenarioClock:
     gang ``scheduleTimeoutSeconds`` expiry replays byte-deterministically
     — the same Scenario always expires the same waits at the same steps
     (KEP-140 determinism rules, README.md:600-610)."""
-
-    def __init__(self, start: float = 0.0):
-        self.now = float(start)
-
-    def __call__(self) -> float:
-        return self.now
-
-    def advance(self, dt: float) -> float:
-        self.now += float(dt)
-        return self.now
 
 
 def _major_of(step: Any) -> int:

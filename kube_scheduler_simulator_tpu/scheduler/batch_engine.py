@@ -208,12 +208,29 @@ class BatchResult:
         pr: "E.BatchProblem | _WindowProblem",
         nodes: list[Obj],
         fr_shared: "dict | None" = None,
+        weight_override: Any = "_at_construction",
     ):
         self._engine = engine
         self.pending = pending
         self.out = out
         self.problem = pr
         self.nodes = nodes
+        # The weight vector THIS round was dispatched with.  Annotation
+        # rendering is lazy (a streamed wave materializes at commit, after
+        # the next wave is already in flight), so reading the engine's
+        # LIVE weight_override there is wrong the moment a mid-stream
+        # set_plugin_weights lands between this round's dispatch and its
+        # commit: the serial cadence commits wave k before the retune, so
+        # the streamed commit must render with the dispatch-time weights
+        # (found by the differential fuzzer — fuzz/fixtures/ pins it).
+        # schedule_async snapshots at dispatch and passes it through; the
+        # synchronous paths construct the result at dispatch time, where
+        # the live value IS the dispatch-time value.
+        self.weight_override = (
+            engine.weight_override
+            if isinstance(weight_override, str) and weight_override == "_at_construction"
+            else weight_override
+        )
         self.selected = np.asarray(out["selected"])  # node index or -1, per pod
         self.feasible_count = np.asarray(out["feasible_count"])
         self.node_names = pr.node_names
@@ -272,7 +289,7 @@ class BatchResult:
                     inv.reshape(arr.shape).astype(np.int64)
                 )
 
-            wov = self._engine.weight_override
+            wov = self.weight_override  # dispatch-time snapshot, not live
 
             def fin_li_of(k: int, s: str, w) -> tuple:
                 if wov is None:
@@ -850,7 +867,7 @@ class BatchResult:
         Under a weight override the totals are floats (the kernel's own
         weighted sum), ints on the default path as before."""
         tr = self._tr()
-        wov = self._engine.weight_override
+        wov = self.weight_override  # dispatch-time snapshot, not live
         sids = tr["sids"][i]
         totals: dict[int, Any] = {int(n): 0 for n in sids if n >= 0}
         for k, (plugin, weight) in enumerate(self._engine.cfg.scores):
@@ -1818,6 +1835,10 @@ class PendingBatch:
         self._blob = None
         self._result: "BatchResult | None" = None
         self.pending: list[Obj] = ctx["pending"]
+        # snapshot NOW: a live retune between this dispatch and result()
+        # must not change how this wave's finalScores render (the kernel
+        # already ran with this vector — see BatchResult.weight_override)
+        self._weight_override = engine.weight_override
 
     def decisions(self) -> dict:
         """Packed per-pod outputs (selected/feasible_count/sample_*/
@@ -1874,7 +1895,10 @@ class PendingBatch:
                     "total_s": t3 - ctx["t0"],
                 }
             )
-            self._result = BatchResult(eng, ctx["pending"], out, ctx["pr"], ctx["nodes"])
+            self._result = BatchResult(
+                eng, ctx["pending"], out, ctx["pr"], ctx["nodes"],
+                weight_override=self._weight_override,
+            )
             self._out_dev = None  # release the round's device references
             self._blob = None
         return self._result

@@ -232,6 +232,12 @@ class SchedulerService:
             "tuning_rollouts": 0,
             "tuning_grad_dispatches": 0,
             "tuning_objective": {},
+            # differential fuzzer (fuzz/): scenarios judged through this
+            # service, unexplained byte divergences by comparison kind
+            # (nonzero = bug), and accepted shrinker reductions
+            "fuzz_scenarios": 0,
+            "fuzz_divergences": {},
+            "fuzz_shrink_steps": 0,
         }
         # plugin-weight override requested at construction (or later via
         # set_plugin_weights); resolved/validated when frameworks exist
@@ -526,6 +532,19 @@ class SchedulerService:
                 report["objective"]: float(report["tunedObjective"]),
             }
         self._last_tuning_report = report
+
+    def note_fuzz_report(self, report: Obj) -> None:
+        """Absorb one fuzz session's outcome into the service counters
+        (/metrics ``fuzz_*`` family): ``{"scenarios": n, "divergences":
+        {kind: n}, "shrink_steps": n}`` — the shape
+        scripts/fuzz_smoke.py reports after its sweep."""
+        with self._stats_lock:
+            self.stats["fuzz_scenarios"] += int(report.get("scenarios", 0))
+            fd = dict(self.stats["fuzz_divergences"])
+            for kind, n in (report.get("divergences") or {}).items():
+                fd[kind] = fd.get(kind, 0) + int(n)
+            self.stats["fuzz_divergences"] = fd
+            self.stats["fuzz_shrink_steps"] += int(report.get("shrink_steps", 0))
 
     def _build_framework(self, cfg: Obj, profile: "Obj | None" = None, store_key: str = RESULT_STORE_KEY) -> Framework:
         if profile is None:
@@ -1011,15 +1030,19 @@ class SchedulerService:
                 volumes=volumes,
                 nominated=noms or None,
             )
-            if self._pipeline_on() and self.mesh is None and len(tail) > self.commit_wave:
-                # pipelined round: window k+1's device execution overlaps
-                # window k's host commit (engine double-buffers the scan)
-                windows = eng.schedule_waves(
-                    *args, **kw, wave_pods=max(self.commit_wave, 256)
-                )
-            else:
-                result = eng.schedule(*args, **kw)
-                windows = iter([(result, 0, len(tail))])
+            try:
+                if self._pipeline_on() and self.mesh is None and len(tail) > self.commit_wave:
+                    # pipelined round: window k+1's device execution overlaps
+                    # window k's host commit (engine double-buffers the scan)
+                    windows = iter(
+                        eng.schedule_waves(*args, **kw, wave_pods=max(self.commit_wave, 256))
+                    )
+                else:
+                    result = eng.schedule(*args, **kw)
+                    windows = iter([(result, 0, len(tail))])
+            except Exception as e:  # kernel/dispatch crash: nothing committed
+                self._degrade_segment(fw, tail, results, noms, e)
+                return
             snapshot = None
             restart_at = None
             # batched-PostFilter context, built lazily at the run's first
@@ -1032,7 +1055,20 @@ class SchedulerService:
                         fw, eng, snapshot, nodes, tail, noms
                     )
                 }
-            for result, off, cnt in windows:
+            while True:
+                try:
+                    window = next(windows)
+                except StopIteration:
+                    break
+                except Exception as e:
+                    # mid-round device failure (a later window's fetch):
+                    # every committed wave is byte-identical to the
+                    # sequential prefix, so the remaining pods finish on
+                    # the sequential cycle — never a partial wave
+                    self._flush_pctx_stats(pholder)
+                    self._degrade_segment(fw, tail, results, noms, e)
+                    return
+                result, off, cnt = window
                 if snapshot is None:
                     # after the round's encode captured the cluster state
                     snapshot = self.build_snapshot()
@@ -1044,12 +1080,8 @@ class SchedulerService:
                 if restart_at is not None:
                     break  # abandon the remaining windows (state changed)
                 fw.next_start_node_index = result.final_start
+            self._flush_pctx_stats(pholder)
             pctx = (pholder or {}).get("ctx")
-            if pctx is not None:
-                with self._stats_lock:
-                    self.stats["preempt_dispatches"] += pctx.dispatches
-                    self.stats["preempt_sharded_dispatches"] += pctx.sharded_dispatches
-                    self.stats["preempt_kernel_s"] += pctx.kernel_s
             if restart_at is None:
                 break
             i = restart_at
@@ -1070,6 +1102,43 @@ class SchedulerService:
                 for pod in pending[i:]:
                     results[_pod_key(pod)] = self.schedule_one(pod, snapshot)
                 break
+
+    def _flush_pctx_stats(self, pholder: "dict | None") -> None:
+        pctx = (pholder or {}).get("ctx")
+        if pctx is None:
+            return
+        with self._stats_lock:
+            self.stats["preempt_dispatches"] += pctx.dispatches
+            self.stats["preempt_sharded_dispatches"] += pctx.sharded_dispatches
+            self.stats["preempt_kernel_s"] += pctx.kernel_s
+
+    def _degrade_segment(
+        self,
+        fw: Framework,
+        pods: list[Obj],
+        results: dict,
+        noms: "list[tuple[Obj, str]]",
+        err: Exception,
+    ) -> None:
+        """A kernel/dispatch crash mid-round (a real device failure, or
+        injected chaos — fuzz/chaos.py): the failing window committed
+        NOTHING, and every wave committed before it is byte-identical to
+        the sequential path's prefix, so the round finishes on the
+        (equally exact) sequential cycle instead of dying — never a
+        partial or divergent wave.  Counted in ``batch_fallbacks`` as
+        ``kernel error: <type>``; nonzero without injected chaos is a
+        bug (the fuzz smoke asserts the distinction)."""
+        self._count_fallback(f"kernel error: {type(err).__name__}")
+        snapshot = self.build_snapshot()
+        self._prune_mid_round_nominations(snapshot, noms)
+        tc = time.perf_counter()
+        for pod in pods:
+            if _pod_key(pod) in results:
+                continue  # committed (or parked at Permit) before the crash
+            results[_pod_key(pod)] = self.schedule_one(pod, snapshot)
+        # lock-free: single-writer scalar bump on the scheduling thread
+        # (GIL-atomic += on a fixed stats key)
+        self.stats["commit_s"] += time.perf_counter() - tc
 
     def _pipeline_on(self) -> bool:
         """Resolve the ``pipeline`` setting once: "auto" turns the
@@ -1342,6 +1411,7 @@ class SchedulerService:
             preempt_fallbacks = dict(self.stats["preempt_fallbacks"])
             gang_fallbacks = dict(self.stats["gang_fallbacks"])
             stream_drains = dict(self.stats["stream_drains"])
+            fuzz_divergences = dict(self.stats["fuzz_divergences"])
         last_t = dict(eng.last_timings) if eng else {}
         # the fraction of the last pipelined round's device time hidden
         # under host commits (0 for un-pipelined rounds) — the bench's
@@ -1426,6 +1496,11 @@ class SchedulerService:
             "tuning_grad_dispatches_total": self.stats["tuning_grad_dispatches"],
             "tuning_objective": dict(self.stats["tuning_objective"]),
             "plugin_weights_overridden": int(self._weights_override is not None),
+            # differential fuzzer (fuzz/): scenario sweeps reported into
+            # this service via note_fuzz_report
+            "fuzz_scenarios_total": self.stats["fuzz_scenarios"],
+            "fuzz_divergences_by_kind": fuzz_divergences,
+            "fuzz_shrink_steps_total": self.stats["fuzz_shrink_steps"],
             # Permit wait machinery, live (the gauge) and cumulative
             "waiting_pods": len(self._all_waiting_keys()),
             "permit_wait_expired": self.stats["permit_wait_expired"],

@@ -47,6 +47,13 @@ before the commit could miss the reactivation the serial cadence would
 see).  All three still count a drain event — the counter tracks
 pipeline serialization points, not sequential-path rounds.
 
+A device CRASH (dispatch, decision fetch, or result blob fetch raising
+— real, or injected by fuzz/chaos.py) is survivable at every point: the
+dying wave has committed nothing, so its pods simply re-drain through
+the sequential path (or the next admission), counted as ``kernel
+error: <type>`` in ``stream_drains_by_reason`` — never a partial or
+divergent wave.
+
 ``KSS_STREAM_PIPELINE=0`` (or ``streaming=False``) keeps the admission
 loop but runs every wave strictly serially — the A/B baseline the bench
 compares against (``bench.py --stream-report``).
@@ -285,6 +292,29 @@ class StreamSession:
         fw = self.svc.framework
         return bool(fw.plugins["post_filter"]) and self.svc.use_batch != "force"
 
+    def _fetch_result(self, flight: dict) -> bool:
+        """Block on the wave's compaction blob — the LAST device
+        interaction of a wave, guarded so a crash (real, or injected by
+        fuzz/chaos.py) drains cleanly while NOTHING is committed yet.
+        Only this fetch is guarded: a failure inside ``_commit`` proper
+        is a host-commit bug after pods may have bound, and must crash
+        loudly (the batch path guards only its window fetches for the
+        same reason).  The blocked wait lands in ``stream_stall_s``
+        here; the fetch is cached, so ``_commit``'s own accounting sees
+        zero further device wait."""
+        pb = flight["pb"]
+        dev0 = pb._dev_wait
+        try:
+            pb.result()
+        except Exception as e:
+            self._count_drain(f"kernel error: {type(e).__name__}")
+            return False
+        finally:
+            # lock-free: single-writer scalar bump on the session thread
+            # (GIL-atomic += on a fixed stats key)
+            self.svc.stats["stream_stall_s"] += pb._dev_wait - dev0
+        return True
+
     def _commit(self, flight: dict, overlapped: bool) -> None:
         """Commit one streamed wave in strict order: trace fetch,
         annotation materialization, bulk result-store fill, bind +
@@ -403,16 +433,30 @@ class StreamSession:
                     self._drain_round(gate)
                     continue
                 fw = svc.framework
-                flight = self._dispatch(
-                    pending, nodes, fw.sched_counter,
-                    fw.next_start_node_index, bank, volumes,
-                )
+                try:
+                    flight = self._dispatch(
+                        pending, nodes, fw.sched_counter,
+                        fw.next_start_node_index, bank, volumes,
+                    )
+                except Exception as e:  # device crash: nothing committed
+                    # the same pods re-drain through the sequential path
+                    # (fuzz/chaos.py injects exactly this; a real crash
+                    # degrades the same way — never a partial wave)
+                    self._drain_round(f"kernel error: {type(e).__name__}")
                 continue
 
             # a wave is in flight: learn its decisions (tiny fetch)
             pb = flight["pb"]
             t0 = time.perf_counter()
-            pb.decisions()
+            try:
+                pb.decisions()
+            except Exception as e:
+                # the in-flight wave died before ANY commit: abandon its
+                # device work, hand the same pods to the exact sequential
+                # round, and stream on at the next wave
+                flight = None
+                self._drain_round(f"kernel error: {type(e).__name__}")
+                continue
             # lock-free: single-writer scalar bumps on the session thread
             # (GIL-atomic += on fixed keys; the lock is for dict publishes)
             svc.stats["stream_stall_s"] += time.perf_counter() - t0
@@ -433,7 +477,10 @@ class StreamSession:
                 # the serial path would — overlapping it would retry the
                 # pod one wave late.  Commit first, admit after.
                 self._count_drain("kernel failures")
-                self._commit(flight, overlapped=False)
+                if self._fetch_result(flight):
+                    self._commit(flight, overlapped=False)
+                # on a failed fetch the pods stay pending and re-drain
+                # at the next admission
                 flight = None
                 self._maybe_gc()
                 continue
@@ -479,11 +526,21 @@ class StreamSession:
                         fw = flight["fw"]
                         t0 = time.perf_counter()
                         bank ^= 1
-                        next_flight = self._dispatch(
-                            pending2, nodes,
-                            fw.sched_counter + len(pb.pending),
-                            pb.final_start, bank, volumes, binds=binds,
-                        )
+                        try:
+                            next_flight = self._dispatch(
+                                pending2, nodes,
+                                fw.sched_counter + len(pb.pending),
+                                pb.final_start, bank, volumes, binds=binds,
+                            )
+                        except Exception as e:
+                            # overlap dispatch crashed: wave k commits
+                            # normally below, and the gated pods re-drain
+                            # at the next pipeline-empty pass (their feed
+                            # tick already fired — hold it) on the serial
+                            # cadence the commit establishes
+                            next_flight = None
+                            self._count_drain(f"kernel error: {type(e).__name__}")
+                            self._feed_hold = True
                         svc.stats["stream_overlap_s"] += time.perf_counter() - t0
                     else:
                         # gated waves are NOT admitted into the overlap;
@@ -496,6 +553,17 @@ class StreamSession:
             # commit wave k — overlapping wave k+1's in-flight kernel
             # when one was dispatched (serial mode never prefetches, so
             # the same commit machinery runs un-overlapped)
-            self._commit(flight, overlapped=next_flight is not None)
+            if self._fetch_result(flight):
+                self._commit(flight, overlapped=next_flight is not None)
+            else:
+                # wave k's blob fetch died before ANY host commit.  Wave
+                # k+1 (if prefetched) was encoded against placements that
+                # now never landed — abandon it too; both waves' pods are
+                # still pending and re-drain in one admission (creation
+                # order preserved, so bytes match the serial cadence),
+                # without consuming the feed tick k+1 already pulled.
+                if next_flight is not None:
+                    self._feed_hold = True
+                next_flight = None
             flight = next_flight
             self._maybe_gc()

@@ -146,6 +146,24 @@ def render_metrics(di: Any) -> str:
             typ="gauge",
         )
     counter("plugin_weights_overridden", "1 while a plugin-weight override (learned scoring head) is active on the live profiles.", m["plugin_weights_overridden"], typ="gauge")
+    # differential fuzzer (fuzz/): sweep outcomes reported through
+    # service.note_fuzz_report (scripts/fuzz_smoke.py, nightlong-haul runs)
+    counter("fuzz_scenarios_total", "Composite fuzz scenarios judged through the differential runner.", m["fuzz_scenarios_total"])
+    for kind, n in sorted(m["fuzz_divergences_by_kind"].items()):
+        counter(
+            "fuzz_divergences_total",
+            "Unexplained byte divergences between differential paths, by comparison kind (nonzero = bug).",
+            n,
+            {"kind": kind},
+        )
+    if not m["fuzz_divergences_by_kind"]:
+        counter(
+            "fuzz_divergences_total",
+            "Unexplained byte divergences between differential paths, by comparison kind (nonzero = bug).",
+            0,
+            {"kind": "none"},
+        )
+    counter("fuzz_shrink_steps_total", "Accepted shrinker reductions while minimizing diverging scenarios.", m["fuzz_shrink_steps_total"])
     # Permit wait machinery (waiting-pod map)
     counter("waiting_pods", "Pods parked at Permit holding a reservation.", m["waiting_pods"], typ="gauge")
     counter("permit_wait_expired_total", "Permit waits rejected on deadline expiry.", m["permit_wait_expired"])

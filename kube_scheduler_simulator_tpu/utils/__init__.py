@@ -1,6 +1,7 @@
 from kube_scheduler_simulator_tpu.utils.gojson import go_marshal
 from kube_scheduler_simulator_tpu.utils.quantity import parse_quantity, milli_value, value
 from kube_scheduler_simulator_tpu.utils.retry import retry_on_conflict
+from kube_scheduler_simulator_tpu.utils.simclock import SimClock
 
 __all__ = [
     "go_marshal",
@@ -8,4 +9,5 @@ __all__ = [
     "milli_value",
     "value",
     "retry_on_conflict",
+    "SimClock",
 ]

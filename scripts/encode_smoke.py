@@ -21,12 +21,16 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
 
 
+from kube_scheduler_simulator_tpu.utils import SimClock
+
+
 def build(inc: bool):
     os.environ["KSS_ENCODE_INCREMENTAL"] = "1" if inc else "0"
+
     from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
     from kube_scheduler_simulator_tpu.state.store import ClusterStore
 
-    store = ClusterStore(clock=lambda: 1700000000.0)
+    store = ClusterStore(clock=SimClock(1_700_000_000.0))
     for i in range(12):
         store.create(
             "nodes",

@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Differential fuzz smoke (tier-1): a bounded seeded sweep of composite
+scenarios through the byte-parity differential runner, plus the chaos
+and mesh legs — the adversary every future PR inherits (docs/fuzzing.md).
+
+Bounded mode (default): a FIXED seed list drives ``KSS_FUZZ_SCENARIOS``
+(default 25) generated scenarios, each composing >= 3 subsystems
+(gang / preemption / autoscale / churn / retune), through
+batch-vs-oracle and streamed-vs-serial byte diffs; then one scenario
+re-runs with injected kernel failures (parity must hold and the degrade
+must be counted) and one through a ``KSS_MESH_DEVICES=2`` sharded pair.
+Any unexplained byte divergence exits 1 — after confirming it standalone,
+shrinking it (``KSS_FUZZ_SHRINK_STEPS`` checks), and dumping the
+minimized repro + verdict to /tmp for triage and fixture promotion.
+
+Long-haul mode (nightlies): ``KSS_FUZZ_BUDGET=<seconds>`` keeps
+generating fresh scenario indices until the wall-clock budget runs out.
+
+Exit 0 = every scenario at parity; nonzero = divergence (or a harness
+invariant broke).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:  # the axon plugin dials the TPU tunnel even when CPU-pinned
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+from kube_scheduler_simulator_tpu.fuzz import (  # noqa: E402
+    CoverageMap,
+    FuzzHarness,
+    KernelChaos,
+    fuzz_knobs,
+    generate_scenario,
+    run_differential,
+    shrink,
+)
+from kube_scheduler_simulator_tpu.fuzz import chaos as chaos_mod  # noqa: E402
+
+
+def triage_divergence(scn, kinds, shrink_budget: int) -> dict:
+    """Confirm a divergence standalone (fresh services), shrink it, dump
+    the minimized repro to /tmp — the triage trail docs/fuzzing.md walks."""
+    comparisons = tuple(kinds)
+    # ONE standalone harness for the confirmation AND every shrink check:
+    # a fresh harness per check would recompile 2-4 service pairs up to
+    # KSS_FUZZ_SHRINK_STEPS times and blow the tier-1 step budget before
+    # the repro dump lands; reset() keeps each candidate internally
+    # aligned (both pair members replay the same candidate sequence)
+    standalone = FuzzHarness()
+
+    def still_fails(s):
+        v, _ = run_differential(s, standalone, comparisons=comparisons)
+        return bool(v["divergences"])
+
+    out: dict = {"scenario": scn["name"], "kinds": list(kinds)}
+    if not still_fails(scn):
+        out["standalone"] = "did NOT reproduce standalone (cross-scenario context?)"
+        return out
+    mini, stats = shrink(scn, still_fails, max_checks=shrink_budget)
+    out["standalone"] = "reproduced"
+    out["shrink_steps"] = stats["steps"]
+    path = f"/tmp/kss_fuzz_{scn['name']}.json"
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"kinds": list(kinds), "scenario": mini}, f, sort_keys=True, indent=2)
+    out["repro"] = path
+    return out
+
+
+def main() -> int:
+    knobs = fuzz_knobs()
+    t0 = time.monotonic()
+    harness = FuzzHarness()
+    cov = CoverageMap()
+    report = {"scenarios": 0, "divergences": {}, "shrink_steps": 0}
+    failures: list[dict] = []
+    scenarios: list[dict] = []
+
+    def judge(scn) -> None:
+        if len(scn["features"]) < 3:
+            raise AssertionError(f"{scn['name']} composes only {scn['features']}")
+        v, _states = run_differential(scn, harness)
+        scenarios.append(scn)
+        report["scenarios"] += 1
+        for kind in v["divergences"]:
+            report["divergences"][kind] = report["divergences"].get(kind, 0) + 1
+        if v["divergences"]:
+            print(f"fuzz-smoke DIVERGENCE {scn['name']} {v['divergences']}", file=sys.stderr)
+            print(json.dumps(v["comparisons"], indent=1)[:4000], file=sys.stderr)
+            tri = triage_divergence(scn, v["divergences"], knobs["shrink_steps"])
+            report["shrink_steps"] += tri.get("shrink_steps", 0)
+            failures.append(tri)
+            print(f"fuzz-smoke triage: {json.dumps(tri)}", file=sys.stderr)
+
+    if knobs["budget_s"] > 0:
+        # long-haul: fresh indices until the budget is spent
+        i = 0
+        while time.monotonic() - t0 < knobs["budget_s"]:
+            judge(generate_scenario(knobs["seed"], i, coverage=cov))
+            i += 1
+    else:
+        # bounded tier-1 mode: a fixed seed list (seed, seed+1)
+        seeds = (knobs["seed"], knobs["seed"] + 1)
+        per_seed = (knobs["scenarios"] + len(seeds) - 1) // len(seeds)
+        for seed in seeds:
+            for i in range(per_seed):
+                judge(generate_scenario(seed, i, coverage=cov))
+
+    # ---- chaos leg: injected kernel failures must degrade, not diverge
+    chaos_scn = generate_scenario(
+        knobs["seed"] + 7, 0, features=frozenset({"preemption", "churn", "retune"})
+    )
+    trips = {"n": 0}
+    _orig_exit = chaos_mod.KernelChaos.__exit__
+
+    def _spy_exit(self, *exc):
+        trips["n"] += self.trips
+        return _orig_exit(self, *exc)
+
+    chaos_mod.KernelChaos.__exit__ = _spy_exit
+    try:
+        v, _ = run_differential(
+            chaos_scn, harness,
+            chaos={"roles": ["batch", "stream-on"], "fail_events": [0, 3]},
+        )
+    finally:
+        chaos_mod.KernelChaos.__exit__ = _orig_exit
+    if v["divergences"]:
+        print(f"fuzz-smoke: CHAOS run diverged: {v['divergences']}", file=sys.stderr)
+        return 1
+    if trips["n"] < 2:
+        print(f"fuzz-smoke: chaos never tripped (trips={trips['n']})", file=sys.stderr)
+        return 1
+    explained = {k: n for c in v["comparisons"] for k, n in c["explained"].items()}
+    if not any("kernel error" in r for m in explained.values() for r in m):
+        print(f"fuzz-smoke: chaos degrade not counted: {explained}", file=sys.stderr)
+        return 1
+    report["scenarios"] += 1
+
+    # ---- mesh leg: one scenario sharded over a 2-device virtual mesh
+    shard_scn = generate_scenario(
+        knobs["seed"] + 8, 0, features=frozenset({"preemption", "churn", "retune"})
+    )
+    v, _ = run_differential(shard_scn, harness, comparisons=("shard-vs-single",))
+    if v["divergences"]:
+        print("fuzz-smoke: shard-vs-single diverged", file=sys.stderr)
+        print(json.dumps(v["comparisons"], indent=1)[:4000], file=sys.stderr)
+        report["divergences"]["shard-vs-single"] = (
+            report["divergences"].get("shard-vs-single", 0) + 1
+        )
+        failures.append({"scenario": shard_scn["name"], "kinds": ["shard-vs-single"]})
+    report["scenarios"] += 1
+    _store, shard_svc = harness.service("default", "shard")
+    if shard_svc.metrics()["sharded_dispatches_total"] <= 0:
+        print("fuzz-smoke: the shard leg never sharded a dispatch", file=sys.stderr)
+        return 1
+
+    # ---- metrics wiring: the sweep reports into a live service
+    _store_m, svc_m = harness.service("default", "batch")
+    svc_m.note_fuzz_report(report)
+    from kube_scheduler_simulator_tpu.server.metrics import render_metrics
+
+    class _DI:
+        cluster_store = _store_m
+
+        def scheduler_service(self):
+            return svc_m
+
+    text = render_metrics(_DI())
+    for needle in (
+        "simulator_fuzz_scenarios_total",
+        "simulator_fuzz_divergences_total",
+        "simulator_fuzz_shrink_steps_total",
+    ):
+        if needle not in text:
+            print(f"fuzz-smoke: /metrics missing {needle}", file=sys.stderr)
+            return 1
+
+    wall = time.monotonic() - t0
+    if failures:
+        print(
+            f"fuzz-smoke FAIL: {len(failures)} diverging scenario(s) of "
+            f"{report['scenarios']} in {wall:.0f}s — minimized repros in /tmp "
+            f"(promote to fuzz/fixtures/ after the fix per docs/fuzzing.md)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"fuzz-smoke OK: {report['scenarios']} scenarios, 0 unexplained divergences, "
+        f"chaos degrade counted ({trips['n']} trips), shard leg sharded, "
+        f"{wall:.0f}s; coverage: {json.dumps(cov.summary())}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,7 @@ from kube_scheduler_simulator_tpu.gang import gang_scheduler_config, partially_b
 from kube_scheduler_simulator_tpu.gang.scenario import make_member, make_node
 from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
 from kube_scheduler_simulator_tpu.state.store import ClusterStore
+from kube_scheduler_simulator_tpu.utils import SimClock
 
 
 def mk_solo(name):
@@ -64,7 +65,7 @@ def churn(store, svc, seed):
 
 
 def build(use_batch):
-    store = ClusterStore(clock=lambda: 0.0)
+    store = ClusterStore(clock=SimClock(0.0))
     store.create("namespaces", {"metadata": {"name": "default"}})
     for i in range(8):
         store.create("nodes", make_node(f"node-{i}", 8, f"zone-{i % 3}"))

@@ -32,6 +32,13 @@ if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/tune_smoke.py; then 
 # byte-compared against single-device (sharded dispatches asserted), plus
 # the f32-vs-x64 oracle spot check (scripts/shard_smoke.py).
 if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/shard_smoke.py; then rc=1; fi
+# Differential fuzz smoke (docs/fuzzing.md): a bounded seeded sweep of
+# >= 25 composite scenarios (gang x preemption x autoscale x churn x
+# retune) through batch-vs-oracle and streamed-vs-serial byte diffs,
+# plus the chaos-degrade and 2-device mesh legs; any unexplained byte
+# divergence is shrunk, dumped to /tmp for triage, and fails tier-1.
+# Long-haul nightlies rerun it with KSS_FUZZ_BUDGET=<seconds>.
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu python scripts/fuzz_smoke.py; then rc=1; fi
 # Kernel-contract checker (docs/static-analysis.md): FIRST the fixture
 # self-test (every rule must fire on its known-bad fixtures and stay
 # silent on the good ones — a broken rule must not silently pass the

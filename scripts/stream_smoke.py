@@ -24,6 +24,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
 
+from kube_scheduler_simulator_tpu.utils import SimClock
+
 PER_TICK = 40
 TICKS = 3
 
@@ -67,7 +69,7 @@ def build():
     from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
     from kube_scheduler_simulator_tpu.state.store import ClusterStore
 
-    store = ClusterStore(clock=lambda: 1700000000.0)
+    store = ClusterStore(clock=SimClock(1_700_000_000.0))
     for i in range(16):
         store.create(
             "nodes",

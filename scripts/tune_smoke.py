@@ -29,6 +29,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
 
+from kube_scheduler_simulator_tpu.utils import SimClock
+
 
 def main() -> int:
     from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
@@ -58,7 +60,7 @@ def main() -> int:
     nodes, pods, _obj = build_family("imbalance", n_nodes=5, n_pods=20, seed=2)
 
     def run_mode(traced: bool):
-        store = ClusterStore(clock=lambda: 1700000000.0)
+        store = ClusterStore(clock=SimClock(1_700_000_000.0))
         for n in nodes:
             store.create("nodes", n)
         for p in pods:

@@ -19,6 +19,7 @@ from kube_scheduler_simulator_tpu.gang import (
 )
 from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
 from kube_scheduler_simulator_tpu.state import ClusterStore
+from kube_scheduler_simulator_tpu.utils import SimClock
 
 
 def mk_node(name, cpu="8", zone="zone-a"):
@@ -52,7 +53,7 @@ def mk_group(name, min_member, timeout=120, **spec_extra):
 
 
 def new_store():
-    s = ClusterStore(clock=lambda: 0.0)
+    s = ClusterStore(clock=SimClock(0.0))
     s.create("namespaces", {"metadata": {"name": "default"}})
     return s
 
@@ -475,7 +476,7 @@ class TestScenarioReplay:
         from kube_scheduler_simulator_tpu.gang.scenario import make_training_scenario
         from kube_scheduler_simulator_tpu.scenario.engine import ScenarioClock, ScenarioEngine
 
-        store = ClusterStore(clock=lambda: 0.0)
+        store = ClusterStore(clock=SimClock(0.0))
         svc = SchedulerService(
             store, tie_break="first", use_batch=use_batch, batch_min_work=0,
             clock=ScenarioClock(),
@@ -515,7 +516,7 @@ class TestScenarioReplay:
     def test_scenario_clock_expires_gang_timeouts(self):
         from kube_scheduler_simulator_tpu.scenario.engine import ScenarioClock, ScenarioEngine
 
-        store = ClusterStore(clock=lambda: 0.0)
+        store = ClusterStore(clock=SimClock(0.0))
         clock = ScenarioClock()
         svc = SchedulerService(store, tie_break="first", use_batch="off", clock=clock)
         svc.start_scheduler(gang_scheduler_config())

@@ -27,6 +27,7 @@ from kube_scheduler_simulator_tpu.ops import encode as E
 from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
 from kube_scheduler_simulator_tpu.scheduler.stream import StreamSession
 from kube_scheduler_simulator_tpu.state.store import ClusterStore
+from kube_scheduler_simulator_tpu.utils import SimClock
 
 Obj = dict[str, Any]
 
@@ -90,7 +91,7 @@ def mk_pod(i: int, giant: bool = False) -> Obj:
 
 
 def new_store(n_nodes: int = 24) -> ClusterStore:
-    store = ClusterStore(clock=lambda: 1700000000.0)
+    store = ClusterStore(clock=SimClock(1_700_000_000.0))
     for i in range(n_nodes):
         store.create("nodes", mk_node(i))
     return store
@@ -239,7 +240,7 @@ class TestStreamDrains:
             partially_bound_groups,
         )
 
-        store = ClusterStore(clock=lambda: 0.0)
+        store = ClusterStore(clock=SimClock(0.0))
         store.create("namespaces", {"metadata": {"name": "default"}})
         for i in range(12):
             store.create("nodes", mk_node(i))
@@ -318,7 +319,7 @@ class TestStreamDrains:
         commit, so the parked pod slipped one wave and composition/
         counters diverged from the serial path."""
         def build_and_run(streaming: bool):
-            store = ClusterStore(clock=lambda: 1700000000.0)
+            store = ClusterStore(clock=SimClock(1_700_000_000.0))
             for i in range(4):
                 store.create("nodes", mk_node(i))
             # backlog of schedulable pods with LATER creationTimestamps

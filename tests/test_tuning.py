@@ -29,6 +29,7 @@ from kube_scheduler_simulator_tpu.tuning.validate import (
 )
 
 from tests.test_batch_parity import mk_node, mk_pod, profile_with
+from kube_scheduler_simulator_tpu.utils import SimClock
 
 Obj = dict[str, Any]
 
@@ -130,7 +131,7 @@ def _pods(lo, hi, seed=7):
 
 
 def _service(nodes, mode, weights=None, **kw):
-    store = ClusterStore(clock=lambda: 1700000000.0)
+    store = ClusterStore(clock=SimClock(1_700_000_000.0))
     for n in nodes:
         store.create("nodes", n)
     svc = SchedulerService(

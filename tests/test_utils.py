@@ -183,3 +183,41 @@ class TestRetry:
 
         with pytest.raises(ConflictError):
             retry_on_conflict(fn, sleep=lambda _: None)
+
+
+class TestSimClock:
+    """The promoted deterministic clock (utils/simclock.py): one helper
+    serving both the ClusterStore (creationTimestamps) and the
+    SchedulerService (queue backoff + Permit deadlines) roles, never
+    advancing on read."""
+
+    def test_callable_and_advance(self):
+        from kube_scheduler_simulator_tpu.utils import SimClock
+
+        clk = SimClock(10.0)
+        assert clk() == 10.0
+        assert clk() == 10.0  # reads NEVER advance (read counts differ
+        # between the batch and sequential paths)
+        assert clk.advance(2.5) == 12.5
+        assert clk() == 12.5
+
+    def test_never_backwards(self):
+        from kube_scheduler_simulator_tpu.utils import SimClock
+
+        with pytest.raises(ValueError):
+            SimClock().advance(-1.0)
+
+    def test_scenario_clock_is_simclock(self):
+        from kube_scheduler_simulator_tpu.scenario.engine import ScenarioClock
+        from kube_scheduler_simulator_tpu.utils import SimClock
+
+        assert issubclass(ScenarioClock, SimClock)
+
+    def test_pins_store_creation_timestamps(self):
+        from kube_scheduler_simulator_tpu.state.store import ClusterStore
+        from kube_scheduler_simulator_tpu.utils import SimClock
+
+        store = ClusterStore(clock=SimClock(0.0))
+        store.create("pods", {"metadata": {"name": "p", "namespace": "default"}})
+        ts = store.get("pods", "p")["metadata"]["creationTimestamp"]
+        assert ts == "1970-01-01T00:00:00Z"
