@@ -1333,6 +1333,189 @@ def run_shard_report(N=50000, P=256, devices=8, runs=2, quick=False):
     return row
 
 
+def run_shard_stream_report(
+    N=50000,
+    per_tick=48,
+    seed_bound=1000,
+    devices=2,
+    min_stream_s=66.0,
+    max_ticks=24,
+    quick=False,
+):
+    """cfg12-shard-stream: the stream × mesh FUSION at the 100k-node
+    class — a ≥50k-node cluster under a sustained (≥60 s) churn stream,
+    scheduled sharded + streamed SIMULTANEOUSLY (node axis split over a
+    ``devices``-wide mesh, waves overlapped through the sharded
+    double-buffered DevicePlacer banks), byte-compared against the
+    serial single-device path over the identical deterministic feed —
+    the ISSUE 13 acceptance row (ROADMAP "fuse stream × mesh").
+
+    The fused leg runs first and stops feeding at the first tick
+    boundary past ``min_stream_s`` (bounded by ``max_ticks``); the
+    serial leg then replays exactly that many ticks, so both legs see
+    the same create/delete sequence (every tick's ops are a pure
+    function of the tick index).  Deletions only touch pods settled ≥2
+    ticks — committed under both cadences.  One timed run per mode (a
+    50k-node leg is minutes on a CPU host; the parity claim needs no
+    min-of-N, and the wall columns carry the platform caveat)."""
+    import collections
+
+    import jax
+
+    from kube_scheduler_simulator_tpu.ops.mesh import resolve_mesh
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+    from kube_scheduler_simulator_tpu.utils.parity import pod_parity_state
+
+    if quick:
+        N, per_tick, seed_bound, min_stream_s, max_ticks = 2000, 24, 200, 5.0, 4
+    devices = min(devices, len(jax.local_devices()))
+    if devices < 2:
+        raise RuntimeError(
+            f"--shard-stream-report needs >=2 devices, found "
+            f"{len(jax.local_devices())} ({jax.default_backend()}); on CPU set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=N"
+        )
+    import numpy as np
+    from jax.sharding import Mesh
+
+    def stamp(p, i):
+        p["metadata"]["creationTimestamp"] = (
+            f"2024-03-01T{i // 3600 % 24:02d}:{i // 60 % 60:02d}:{i % 60:02d}Z"
+        )
+        return p
+
+    def tick_ops(tick: int):
+        """The tick's (creates, delete_names) — a pure function of the
+        tick index, so an adaptively-capped fused leg and the serial
+        replay see byte-identical op streams."""
+        rng = random.Random(4200 + tick)
+        creates = []
+        for j in range(per_tick):
+            i = tick * per_tick + j
+            creates.append(stamp(mk_pod(i, rng, spread=i % 3 == 0), seed_bound + i))
+        deletes = []
+        if tick >= 2:
+            # pods created at tick-2: settled under BOTH cadences (the
+            # streamed feed runs one commit earlier than the serial one)
+            prev = [f"pod-{i}" for i in range((tick - 2) * per_tick, (tick - 1) * per_tick)]
+            deletes = random.Random(9000 + tick).sample(prev, min(8, len(prev)))
+        return creates, deletes
+
+    def build(mesh):
+        rng = random.Random(7)
+        store = ClusterStore(clock=lambda: 1700000000.0)
+        for i in range(N):
+            store.create("nodes", mk_node(i))
+        settled = collections.deque()
+        for i in range(seed_bound):
+            p = stamp(mk_pod(1_000_000 + i, rng, spread=i % 3 == 0), i)
+            p["metadata"]["name"] = f"seed-{i}"
+            p["spec"]["nodeName"] = f"node-{i % N}"
+            store.create("pods", p)
+        svc = SchedulerService(store, tie_break="first", use_batch="force", mesh=mesh)
+        svc.start_scheduler(None)
+        return svc, store
+
+    def run_mode(mesh, streaming: bool, n_ticks: "int | None"):
+        """Returns (wall_s, actual_ticks, metrics, store).  ``n_ticks``
+        None = adaptive (stop past min_stream_s); the wall excludes the
+        prime tick (compile + cold 50k-node encode, identical fixed
+        costs in both modes)."""
+        svc, store = build(mesh)
+        # prime tick: tick 0 through the mode's own path
+        creates, deletes = tick_ops(0)
+        for p in creates:
+            store.create("pods", p)
+        svc.schedule_stream(feed=lambda t: False, streaming=streaming)
+        pods0 = svc.metrics()["stream_pods_total"]  # prime session's spend
+        t0 = time.perf_counter()
+        state = {"ticks": 1}
+
+        def feed(tick: int) -> bool:
+            t = tick + 1  # tick 0 was the prime
+            if n_ticks is not None:
+                if t >= n_ticks:
+                    return False
+            elif t >= max_ticks or (
+                t >= 3 and time.perf_counter() - t0 >= min_stream_s
+            ):
+                return False
+            creates, deletes = tick_ops(t)
+            for p in creates:
+                store.create("pods", p)
+            for nm in deletes:
+                try:
+                    store.delete("pods", nm, "default")
+                except KeyError:
+                    pass
+            state["ticks"] = t + 1
+            return True
+
+        svc.schedule_stream(feed=feed, streaming=streaming)
+        wall = time.perf_counter() - t0
+        m = svc.metrics()
+        m["timed_stream_pods"] = m["stream_pods_total"] - pods0
+        return wall, state["ticks"], m, store
+
+    mesh = resolve_mesh(Mesh(np.array(jax.local_devices()[:devices]), ("nodes",)))
+    wall_fused, ticks_run, m_fused, store_fused = run_mode(mesh, True, None)
+    wall_serial, _ticks2, m_serial, store_serial = run_mode(None, False, ticks_run)
+
+    d_fused = pod_parity_state(store_fused)
+    d_serial = pod_parity_state(store_serial)
+    keys = set(d_fused) | set(d_serial)
+    mismatches = sum(1 for k in keys if d_fused.get(k) != d_serial.get(k))
+    scheduled = m_fused["timed_stream_pods"]  # prime session excluded
+
+    row = {
+        "config": "cfg12-shard-stream",
+        "kernel_platform": jax.default_backend(),
+        "dtype": "float64" if jax.config.jax_enable_x64 else "float32",
+        "nodes": N,
+        "seed_bound": seed_bound,
+        "per_tick": per_tick,
+        "ticks": ticks_run,
+        "shard_devices": devices,
+        "runs_per_mode": 1,
+        "scheduled_streamed_pods": scheduled,
+        "wall_s_fused": round(wall_fused, 2),
+        "wall_s_serial_single": round(wall_serial, 2),
+        # the acceptance bar: the fused leg sustained >= 60 s of churn
+        "sustained_stream_s": round(wall_fused, 2),
+        "pods_per_s_fused": round(scheduled / wall_fused, 2) if wall_fused else 0.0,
+        "fused_speedup_vs_serial_single": (
+            round(wall_serial / wall_fused, 2) if wall_fused else 0.0
+        ),
+        "stream_waves_total": m_fused["stream_waves_total"],
+        "sharded_dispatches": m_fused["sharded_dispatches_total"],
+        "placer_bank_rotations": m_fused["placer_bank_rotations_total"],
+        "stream_drains_by_reason": m_fused["stream_drains_by_reason"],
+        "encode_delta_total": m_fused["encode_delta_total"],
+        "plane_shard_bytes_per_device": m_fused["plane_shard_bytes_per_device"],
+        "parity_pods_compared": len(keys),
+        "parity_mismatches_fused_vs_serial_single": mismatches,
+        "parity_note": (
+            "bindings+annotations+conditions byte-compared, sharded+streamed "
+            "vs serial single-device, identical deterministic tick feed"
+        ),
+    }
+    if jax.default_backend() == "cpu":
+        row["platform_note"] = (
+            "virtual CPU mesh + streamed overlap on a shared-memory host: the "
+            "fused leg pays collective overhead AND double-buffer overhead "
+            "with no extra cores and no device shadow to win back (cfg9 and "
+            "cfg11 carry the same caveat individually), so the speedup column "
+            "understates a real TPU mesh badly — this row's load-bearing "
+            "claims are the byte parity at 50k nodes under sustained churn, "
+            "that the fused executables build/dispatch/rotate banks at this "
+            "scale, and the per-device plane split; the committed AOT "
+            "artifacts (ops/aot_artifacts/, tests/test_aot.py) attest the "
+            "same lowered modules load-and-run elsewhere"
+        )
+    return row
+
+
 def _mean_annotation_bytes(store) -> int:
     total = n = 0
     for p in store.list("pods", copy_objects=False):
@@ -1669,7 +1852,29 @@ def main() -> None:
         action="store_true",
         help="run cfg11-shard (50k-node traced round, node axis sharded vs single-device, byte parity + per-device bytes) and write BENCH_shard.json",
     )
+    ap.add_argument(
+        "--shard-stream-report",
+        action="store_true",
+        help="run cfg12-shard-stream (50k-node sustained churn stream, sharded + streamed vs serial single-device byte parity) and write BENCH_shard_stream.json",
+    )
     args = ap.parse_args()
+
+    if args.shard_stream_report:
+        # the virtual mesh needs multiple CPU devices; must be set before
+        # jax initializes a backend (the bench parent never imports jax)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        rows = [run_shard_stream_report(quick=args.quick)]
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_shard_stream.json"
+        )
+        with open(path, "w") as f:
+            json.dump(rows, f, indent=1)
+        print(json.dumps(rows, indent=1))
+        return
 
     if args.shard_report:
         # the virtual mesh needs multiple CPU devices; must be set before

@@ -24,7 +24,12 @@ Tier-1 runs a bounded seeded sweep (scripts/fuzz_smoke.py); the
 scenario budget, shrink budget and the long-haul mode.
 """
 
-from kube_scheduler_simulator_tpu.fuzz.coverage import FEATURES, MIN_COMPOSE, CoverageMap
+from kube_scheduler_simulator_tpu.fuzz.coverage import (
+    FEATURES,
+    MESH_STREAM,
+    MIN_COMPOSE,
+    CoverageMap,
+)
 from kube_scheduler_simulator_tpu.fuzz.generator import generate_scenario
 from kube_scheduler_simulator_tpu.fuzz.runner import (
     DEFAULT_COMPARISONS,
@@ -48,6 +53,7 @@ from kube_scheduler_simulator_tpu.fuzz.chaos import ChaosError, KernelChaos
 
 __all__ = [
     "FEATURES",
+    "MESH_STREAM",
     "MIN_COMPOSE",
     "CoverageMap",
     "generate_scenario",

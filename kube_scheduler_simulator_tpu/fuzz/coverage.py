@@ -15,6 +15,16 @@ Buckets are over the five counted subsystems (the ISSUE's composition
 bar): ``gang``, ``preemption``, ``autoscale``, ``churn``, ``retune``.
 Sub-flavors (taints, PDB flips, topology spread) ride inside those and
 vary with the scenario seed, not the bucket key.
+
+The lattice also carries an EXECUTION-MODE extension: the
+``mesh-stream`` tag marks a scenario driven through the fused
+sharded-streaming path (``KSS_MESH_DEVICES=2`` + a streamed feed — the
+``shard-stream-vs-serial`` runner comparison), so the coverage summary
+distinguishes "this composition ran" from "this composition ran through
+the stream × mesh fusion".  Execution tags are noted via
+:meth:`CoverageMap.note_exec`; they never enter the generator's feature
+sampling (they describe how a scenario was DRIVEN, not what it
+composes).
 """
 
 from __future__ import annotations
@@ -25,6 +35,10 @@ import random
 # the composable subsystems — every generated scenario picks >= MIN_COMPOSE
 FEATURES: tuple[str, ...] = ("gang", "preemption", "autoscale", "churn", "retune")
 MIN_COMPOSE = 3
+
+# execution-mode bucket tags (never sampled as scenario features): the
+# stream × mesh fusion leg marks its scenarios' buckets with this
+MESH_STREAM = "mesh-stream"
 
 
 def all_buckets(min_size: int = MIN_COMPOSE) -> list[frozenset[str]]:
@@ -51,6 +65,14 @@ class CoverageMap:
     def note(self, features: "frozenset[str] | set[str] | list[str]") -> None:
         key = frozenset(features)
         self.counts[key] = self.counts.get(key, 0) + 1
+
+    def note_exec(self, features: "frozenset[str] | set[str] | list[str]", mode: str = MESH_STREAM) -> None:
+        """Record an execution-mode bucket: the scenario's feature set
+        tagged with how it was driven (e.g. ``mesh-stream`` for the
+        sharded + streamed differential leg).  Kept apart from
+        :meth:`note` so the generator's least-covered sampling over the
+        plain feature lattice is unaffected."""
+        self.note(frozenset(features) | {mode})
 
     def choose_features(self, rng: random.Random, candidates: int = 6) -> frozenset[str]:
         """Draw ``candidates`` random feature subsets (size >= MIN_COMPOSE)

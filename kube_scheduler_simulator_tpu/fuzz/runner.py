@@ -12,6 +12,10 @@ on the full annotation trail (:mod:`fuzz.verdict`):
   admission feed, streamed (overlapped pipeline) vs strictly serial.
 - ``shard-vs-single`` (opt-in): ``KSS_MESH_DEVICES=2`` node-axis
   sharding against the single-device engine, ``use_batch="force"``.
+- ``shard-stream-vs-serial`` (opt-in): the stream × mesh FUSION — the
+  timeline as a streamed feed on a ``KSS_MESH_DEVICES=2`` sharded
+  engine (sharded double-buffered placer banks, overlapped waves)
+  against the strictly serial single-device projection.
 
 **Service reuse.**  XLA compiles dominate a fresh service's first round,
 so a :class:`FuzzHarness` keeps one long-lived (store, service) pair per
@@ -95,6 +99,11 @@ _ROLE_KW: dict[str, dict] = {
     "stream-off": {"use_batch": "auto", "batch_min_work": 0},
     "shard": {"use_batch": "force", "batch_min_work": 0, "_mesh_devices": "2"},
     "shard-base": {"use_batch": "force", "batch_min_work": 0},
+    # the stream × mesh fusion: sharded engines on a STREAMED feed,
+    # byte-diffed against the serial single-device projection of the
+    # same timeline (the cfg12 fusion's differential adversary)
+    "shard-stream": {"use_batch": "force", "batch_min_work": 0, "_mesh_devices": "2"},
+    "shard-stream-off": {"use_batch": "force", "batch_min_work": 0},
 }
 
 
@@ -260,14 +269,18 @@ _COMPARISON_ROLES: dict[str, tuple[str, str]] = {
     "batch-vs-oracle": ("batch", "oracle"),
     "stream-vs-serial": ("stream-on", "stream-off"),
     "shard-vs-single": ("shard", "shard-base"),
+    # sharded + streamed simultaneously vs serial single-device: the
+    # fused fast path's parity bar (ISSUE 13 / ROADMAP "fuse stream ×
+    # mesh"), driven from day one by the fuzzer's composite scenarios
+    "shard-stream-vs-serial": ("shard-stream", "shard-stream-off"),
 }
 
 
 def _run_role(scenario: Obj, store: Any, svc: Any, role: str, chaos: "Obj | None") -> Obj:
     def drive() -> Obj:
-        if role == "stream-on":
+        if role in ("stream-on", "shard-stream"):
             return run_stream(scenario, store, svc, streaming=True)
-        if role == "stream-off":
+        if role in ("stream-off", "shard-stream-off"):
             return run_stream(scenario, store, svc, streaming=False)
         return run_ticks(scenario, store, svc)
 

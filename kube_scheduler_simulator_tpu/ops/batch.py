@@ -701,6 +701,13 @@ class DevicePlacer:
         self.plane_reuses = 0
         self.scatter_updates = 0
         self.full_uploads = 0
+        # per-bank observability (the streaming double buffer): how often
+        # the pipeline rotated banks, and scatter traffic per bank —
+        # surfaced as /metrics gauges so a stuck rotation (one bank
+        # starving while the other churns) is visible from a scrape
+        self.bank_rotations = 0
+        self.scatter_updates_by_bank: dict[int, int] = {}
+        self._last_bank: "dict[Any, int]" = {}  # shape key → last bank placed
         # key → {(field, sub): (host ndarray, device array)}
         self._cache: "dict[Any, dict]" = {}
         self._order: list = []
@@ -717,7 +724,9 @@ class DevicePlacer:
             banks = self._cache[key] = {}
             self._order.append(key)
             while len(self._order) > self.max_keys:
-                self._cache.pop(self._order.pop(0), None)
+                evicted = self._order.pop(0)
+                self._cache.pop(evicted, None)
+                self._last_bank.pop(evicted, None)
         else:
             self._order.remove(key)
             self._order.append(key)
@@ -751,10 +760,39 @@ class DevicePlacer:
         self.scatter_updates += 1
         return out
 
+    def bank_stats(self, n_devices: int = 0) -> "dict[int, dict]":
+        """Per-bank resident-state snapshot for /metrics: scatter-update
+        count plus the PER-DEVICE bytes of each bank's resident planes
+        (node-sharded planes split across ``n_devices``, everything else
+        counted in full — the same accounting as
+        :func:`tree_shard_bytes_per_device`; ``n_devices``<=1 means
+        single-device, full bytes)."""
+        n = max(int(n_devices), 1)
+        out: dict[int, dict] = {}
+        for banks in self._cache.values():
+            for bank, entry in banks.items():
+                b = out.setdefault(bank, {"resident_plane_bytes_per_device": 0, "planes": 0})
+                for (name, _sub), (host, _dev) in entry.items():
+                    sharded = name in NODE_AXIS_SPECS
+                    b["resident_plane_bytes_per_device"] += (
+                        host.nbytes // n if sharded else host.nbytes
+                    )
+                    b["planes"] += 1
+        for bank in self.scatter_updates_by_bank:
+            out.setdefault(bank, {"resident_plane_bytes_per_device": 0, "planes": 0})
+        for bank in out:
+            out[bank]["scatter_updates"] = self.scatter_updates_by_bank.get(bank, 0)
+        return out
+
     def place(self, dp: "DeviceProblem", key, bank: int = 0) -> "DeviceProblem":
         """Place ``dp`` on device, reusing/delta-updating resident planes.
         ``bank`` selects the resident plane set (double-buffer lane) —
         diffs and scatter-donations only ever touch that bank's buffers."""
+        bank = int(bank)
+        prev = self._last_bank.get(key)
+        if prev is not None and prev != bank:
+            self.bank_rotations += 1
+        self._last_bank[key] = bank
         entry = self._entry(key, int(bank))
         out: dict[str, Any] = {}
         uploads: dict = {}      # (field, sub) → host value (one device_put)
@@ -816,6 +854,10 @@ class DevicePlacer:
             out_leaves.update(placed)
         for path, dev_old, idx, rows in scatters:
             out_leaves[path] = self._scatter(dev_old, idx, rows)
+        if scatters:
+            self.scatter_updates_by_bank[bank] = (
+                self.scatter_updates_by_bank.get(bank, 0) + len(scatters)
+            )
 
         # refresh the resident cache (lower() allocates fresh host arrays
         # every round, so holding the references is safe)
@@ -1752,8 +1794,15 @@ def build_batch_fn(
         _carry, ys = _scan(carry0, dp)
         return ys
 
+    # the returned callable exposes its exportable jit target + calling
+    # convention so the AOT artifact cache (ops/aot.py) can serialize the
+    # lowered module and a warm engine can rebuild the same fn(dp) shape
+    # around a deserialized one
     if not donate:
-        return jax.jit(run)
+        jitted = jax.jit(run)
+        jitted.jit_target = jitted
+        jitted.split_carry = False
+        return jitted
 
     # Donate ONLY the initial carry (as its own jit argument) and return
     # the final carry so every donated buffer has an output to alias into
@@ -1772,4 +1821,6 @@ def build_batch_fn(
         slim = dp._replace(**{f: jnp.int32(0) for f in CARRY0_FIELDS})
         return jitted(carry0, slim)
 
+    fn.jit_target = jitted
+    fn.split_carry = True
     return fn

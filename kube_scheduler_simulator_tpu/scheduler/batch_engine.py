@@ -1043,6 +1043,15 @@ class BatchEngine:
         self._placer = (
             B.DevicePlacer(mesh=self.mesh) if inc_on else None
         )
+        # AOT artifact cache (ops/aot.py): jax.export round-trips of the
+        # lowered scan, keyed on disk — a warm start (or a TPU host
+        # replaying a committed artifact) skips tracing entirely.  None
+        # when KSS_AOT_CACHE_DIR is unset; every load failure is a
+        # counted fallback to a fresh trace, never a crash.
+        from kube_scheduler_simulator_tpu.ops.aot import AotScanCache
+
+        self._aot = AotScanCache.from_env()
+        self._aot_pending: "tuple | None" = None  # export deferred past dispatch
         # H2D traffic on the non-cached placement path (the placer keeps
         # its own counter); encode_full counter for cache-off engines
         self._direct_bytes_uploaded = 0
@@ -1534,16 +1543,31 @@ class BatchEngine:
                 "encode_rows_reencoded_total": 0,
                 "encode_fallbacks_by_reason": {},
             }
+        from kube_scheduler_simulator_tpu.ops.mesh import mesh_devices
+
         if self._placer is not None:
             s["device_bytes_uploaded_total"] = self._placer.bytes_uploaded
             s["device_plane_reuses_total"] = self._placer.plane_reuses
             s["device_scatter_updates_total"] = self._placer.scatter_updates
+            s["placer_bank_rotations_total"] = self._placer.bank_rotations
+            s["placer_banks"] = self._placer.bank_stats(mesh_devices(self.mesh))
         else:
             s["device_bytes_uploaded_total"] = self._direct_bytes_uploaded
             s["device_plane_reuses_total"] = 0
             s["device_scatter_updates_total"] = 0
+            s["placer_bank_rotations_total"] = 0
+            s["placer_banks"] = {}
         s["sharded_dispatches_total"] = self.sharded_dispatches
         s["plane_shard_bytes_per_device"] = self.shard_plane_bytes_per_device
+        if self._aot is not None:
+            s.update(self._aot.stats())
+        else:
+            s.update(
+                aot_cache_hits_total=0,
+                aot_cache_misses_total=0,
+                aot_cache_saves_total=0,
+                aot_cache_fallbacks_by_reason={},
+            )
         return s
 
     def _note_round(self, timings: dict) -> None:
@@ -1686,6 +1710,65 @@ class BatchEngine:
                 }
             )
 
+    def _scan_fn(self, ctx: dict):
+        """The one-dispatch scan executable for a prepped round, shared
+        by ``_finish_prepped`` and ``schedule_async`` (the streamed
+        producer) so both paths hit the same jit cache AND the same AOT
+        artifact cache.  On a jit-cache miss, the AOT cache (when
+        enabled) is consulted first — a valid on-disk artifact
+        deserializes into a callable with zero tracing; otherwise the
+        executable is built fresh and (cache enabled) exported to disk
+        for the next process.
+
+        Donation is preserved on accelerator meshes: the sharded initial
+        carry aliases into the scan carry (GSPMD keeps the elementwise
+        carry updates on the input shardings, so XLA can alias
+        shard-for-shard).  Only the virtual CPU mesh skips it — CPU jit
+        has no donation support and would warn per compile."""
+        key = ctx["key"]
+        fn = self._fn_cache.get(key)
+        if fn is not None:
+            return fn
+        from kube_scheduler_simulator_tpu.ops.mesh import mesh_on_accelerator
+
+        donate = self.mesh is None or mesh_on_accelerator(self.mesh)
+        meta = None
+        if self._aot is not None:
+            meta = self._aot.scan_meta(
+                ctx["dims"], ctx["cfg"], ctx["ws0"], self.mesh, split_carry=donate
+            )
+            fn = self._aot.load_scan(meta, donate=donate)
+        if fn is None:
+            fn = B.build_batch_fn(ctx["cfg"], ctx["dims"], donate=donate, ws0=ctx["ws0"])
+            self.compiles += 1
+            if self._aot is not None:
+                # stash the export for AFTER the round's dispatch: the
+                # export re-traces the scan (its one-time cost per new
+                # artifact), and running it while the freshly-dispatched
+                # kernel executes keeps it off the critical path.  Args
+                # are ShapeDtypeStruct twins built NOW, pre-donation
+                # (metadata only — no buffers read, none held alive).
+                from kube_scheduler_simulator_tpu.ops.aot import _export_args
+
+                self._aot_pending = (
+                    meta,
+                    getattr(fn, "jit_target", None),
+                    _export_args(ctx["dp"], split_carry=donate),
+                )
+        self._fn_cache[key] = fn
+        return fn
+
+    def _aot_flush(self) -> None:
+        """Write the pending AOT export, if any — called right after a
+        round's kernel dispatch so the export's re-trace overlaps the
+        in-flight device work instead of delaying it."""
+        pending = getattr(self, "_aot_pending", None)
+        if pending is None or self._aot is None:
+            return
+        self._aot_pending = None
+        meta, jit_target, args = pending
+        self._aot.save_scan(meta, jit_target, args)
+
     def _finish_prepped(self, ctx: dict) -> BatchResult:
         """Run a prepped round through the one-dispatch path (used by
         schedule_waves when the pod axis is too small to split)."""
@@ -1694,19 +1777,9 @@ class BatchEngine:
         fn = self._fn_cache.get(key)
         t2 = time.perf_counter()
         if fn is None:
-            # Donation is preserved on accelerator meshes: the sharded
-            # initial carry aliases into the scan carry (GSPMD keeps the
-            # elementwise carry updates on the input shardings, so XLA
-            # can alias shard-for-shard).  Only the virtual CPU mesh
-            # skips it — CPU jit has no donation support and would warn
-            # per compile.
-            from kube_scheduler_simulator_tpu.ops.mesh import mesh_on_accelerator
-
-            donate = self.mesh is None or mesh_on_accelerator(self.mesh)
-            fn = B.build_batch_fn(cfg, dims, donate=donate, ws0=ws0)
-            self._fn_cache[key] = fn
-            self.compiles += 1
+            fn = self._scan_fn(ctx)
         out_dev = fn(dp)
+        self._aot_flush()  # pending export overlaps the in-flight kernel
         packed = np.asarray(out_dev["packed_pod"])
         out = self._packed_out(packed)
         if self.trace:
@@ -1750,22 +1823,25 @@ class BatchEngine:
         executable cache with plain ``schedule()`` rounds.  The returned
         :class:`PendingBatch` is consumed in two blocking steps:
         ``decisions()`` (tiny packed fetch, compaction dispatched), then
-        ``result()`` (trace blob fetch + reconstruction)."""
-        assert self.trace and self.mesh is None, (
-            "streamed rounds are single-device trace rounds"
-        )
+        ``result()`` (trace blob fetch + reconstruction).
+
+        Mesh-sharded engines stream too (the PR 13 fusion): the wave's
+        problem uploads into the bank's SHARDED resident planes
+        (DevicePlacer preserves each plane's NamedSharding across bank
+        rotation), the scan runs with the node axis sharded over the
+        mesh, and on accelerator meshes the sharded initial carry is
+        donated shard-for-shard exactly as on the synchronous path —
+        the virtual CPU mesh skips donation (no CPU support), decided
+        in ``_scan_fn``."""
+        assert self.trace, "streamed rounds are trace rounds"
         ctx = self._prep(
             nodes, all_pods, pending, namespaces, base_counter, start_index,
             volumes, nominated, bank=bank,
         )
         t2 = time.perf_counter()
-        key = ctx["key"]
-        fn = self._fn_cache.get(key)
-        if fn is None:
-            fn = B.build_batch_fn(ctx["cfg"], ctx["dims"], donate=True, ws0=ctx["ws0"])
-            self._fn_cache[key] = fn
-            self.compiles += 1
+        fn = self._scan_fn(ctx)
         out_dev = fn(ctx.pop("dp"))
+        self._aot_flush()  # pending export overlaps the in-flight kernel
         return PendingBatch(self, ctx, out_dev, t2)
 
     # ----------------------------------------------------- trace helpers

@@ -1430,13 +1430,29 @@ class SchedulerService:
             "device_scatter_updates_total": 0,
             "sharded_dispatches_total": 0,
             "plane_shard_bytes_per_device": 0,
+            "placer_bank_rotations_total": 0,
+            # bank → {"scatter_updates", "resident_plane_bytes_per_device",
+            # "planes"}, summed across profile engines (the streaming
+            # double buffer's per-bank gauges)
+            "placer_banks": {},
+            # AOT artifact cache (ops/aot.py): jax.export round-trips of
+            # the lowered scan, aggregated across profile engines
+            "aot_cache_hits_total": 0,
+            "aot_cache_misses_total": 0,
+            "aot_cache_saves_total": 0,
+            "aot_cache_fallbacks_by_reason": {},
         }
         for e in list(self._batch_engines.values()) or ([eng] if eng else []):
             es = e.encode_stats()
             for k in enc:
-                if k == "encode_fallbacks_by_reason":
+                if k in ("encode_fallbacks_by_reason", "aot_cache_fallbacks_by_reason"):
                     for reason, n in es.get(k, {}).items():
                         enc[k][reason] = enc[k].get(reason, 0) + n
+                elif k == "placer_banks":
+                    for bank, bs in es.get(k, {}).items():
+                        agg = enc[k].setdefault(bank, {})
+                        for f, v in bs.items():
+                            agg[f] = agg.get(f, 0) + v
                 else:
                     enc[k] += es.get(k, 0)
         # node-axis sharding: the victim search and the autoscaler's

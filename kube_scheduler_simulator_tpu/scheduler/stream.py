@@ -27,13 +27,23 @@ dissolves the round boundary:
   annotation bytes are byte-identical to the serial path's
   (tests/test_stream.py, scripts/stream_smoke.py).
 
+Mesh-sharded engines stream too (the stream × mesh fusion): a wave's
+delta encode scatters into the *other* bank's SHARDED resident planes
+(DevicePlacer preserves each plane's NamedSharding across bank
+rotation), the scan dispatches with the node axis sharded over the
+mesh, and on accelerator meshes the sharded initial carry is donated
+shard-for-shard — so a 50k-node-class sharded kernel for wave k can be
+in flight while wave k+1 encodes into the opposite bank
+(scripts/shard_stream_smoke.py, bench cfg12).
+
 Anything outside that envelope **drains the pipeline**, counted per
 reason in ``stream_drains_by_reason``.  Most reasons route the wave to
 the sequential path — gang profiles / parked waiting pods ("gang" — a
 GangRound's atomic commit must never interleave with a streamed wave),
 pending preemption nominations, multi-profile rounds, unsupported
-workloads, and kernel failures on profiles whose PostFilter could
-preempt (a successful preemption rewrites cluster state mid-round);
+workloads, trace-less engines, and kernel failures on profiles whose
+PostFilter could preempt (a successful preemption rewrites cluster
+state mid-round);
 those waves run through ``SchedulerService.schedule_pending`` — the
 pre-existing exact machinery — and streaming resumes at the next wave.
 Three gates only SERIALIZE the streamed boundary: a mid-stream
@@ -209,11 +219,13 @@ class StreamSession:
         if svc._pending_nominations():
             return "nominated pods", None
         eng = svc._engine_for(fw)
-        if eng.mesh is not None or not eng.trace:
-            # schedule_async only speaks single-device trace rounds —
-            # multi-chip (and trace-less estimation engines) take the
-            # pre-existing exact path
-            return "multi-chip", None
+        if not eng.trace:
+            # a trace-less engine cannot commit a wave from its result
+            # (no annotation trail) — the pre-existing exact path.
+            # Mesh-sharded engines STREAM (the stream × mesh fusion):
+            # schedule_async uploads into sharded double-buffered placer
+            # banks and dispatches the node-sharded scan.
+            return "trace disabled", None
         if (
             svc.use_batch == "auto"
             and len(pending) * max(len(nodes), 1) < svc.batch_min_work

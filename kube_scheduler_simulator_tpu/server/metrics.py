@@ -189,6 +189,51 @@ def render_metrics(di: Any) -> str:
     counter("device_bytes_uploaded_total", "Host-to-device bytes actually shipped for problem placement (reused resident planes upload nothing).", m["device_bytes_uploaded_total"])
     counter("device_plane_reuses_total", "Device-resident planes reused unchanged across rounds.", m["device_plane_reuses_total"])
     counter("device_scatter_updates_total", "Resident planes updated in place via jitted row scatter-updates.", m["device_scatter_updates_total"])
+    # the streaming double buffer's per-bank view (DevicePlacer banks):
+    # rotations plus scatter traffic / resident bytes per bank, so a
+    # stuck rotation (one bank starving while the other churns) shows up
+    # in a scrape
+    counter("placer_bank_rotations_total", "DevicePlacer bank alternations (streamed waves flip banks so scatter-donations never touch an in-flight kernel's buffers).", m["placer_bank_rotations_total"])
+    banks = m["placer_banks"] or {0: {"scatter_updates": 0, "resident_plane_bytes_per_device": 0, "planes": 0}}
+    for bank, bs in sorted(banks.items()):
+        counter(
+            "placer_bank_scatter_updates_total",
+            "Scatter-updates applied to resident planes, by DevicePlacer bank.",
+            bs.get("scatter_updates", 0),
+            {"bank": bank},
+        )
+        counter(
+            "placer_bank_plane_bytes_per_device",
+            "Per-device bytes of a bank's resident problem planes (node-sharded planes split across the mesh, replicated planes in full).",
+            bs.get("resident_plane_bytes_per_device", 0),
+            {"bank": bank},
+            typ="gauge",
+        )
+        counter(
+            "placer_bank_resident_planes",
+            "Resident device planes held, by DevicePlacer bank.",
+            bs.get("planes", 0),
+            {"bank": bank},
+            typ="gauge",
+        )
+    # AOT executable artifact cache (ops/aot.py — jax.export round-trips)
+    counter("aot_cache_hits_total", "Scan executables loaded from on-disk jax.export artifacts (tracing skipped).", m["aot_cache_hits_total"])
+    counter("aot_cache_misses_total", "Scan builds with no artifact on disk (fresh trace; saved when the cache is enabled).", m["aot_cache_misses_total"])
+    counter("aot_cache_saves_total", "Scan executables exported + serialized to the artifact cache.", m["aot_cache_saves_total"])
+    for reason, n in sorted(m["aot_cache_fallbacks_by_reason"].items()):
+        counter(
+            "aot_cache_fallbacks_total",
+            "Artifacts present but rejected, by reason (jax-version / mesh-spec / dtype-regime / kernel-digest / corrupt ...) — a counted fresh trace, never a crash.",
+            n,
+            {"reason": reason},
+        )
+    if not m["aot_cache_fallbacks_by_reason"]:
+        counter(
+            "aot_cache_fallbacks_total",
+            "Artifacts present but rejected, by reason (jax-version / mesh-spec / dtype-regime / kernel-digest / corrupt ...) — a counted fresh trace, never a crash.",
+            0,
+            {"reason": "none"},
+        )
     # node-axis mesh sharding (ops/mesh.py): the scale axis across chips
     counter("shard_devices", "Devices in the node-axis sharding mesh (0 = single-device).", m["shard_devices"], typ="gauge")
     counter("sharded_dispatches_total", "Kernel dispatches executed with the node axis sharded over the mesh (main scan + victim search + estimator).", m["sharded_dispatches_total"])

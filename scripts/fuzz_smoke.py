@@ -47,6 +47,7 @@ except Exception:
     pass
 
 from kube_scheduler_simulator_tpu.fuzz import (  # noqa: E402
+    MESH_STREAM,
     CoverageMap,
     FuzzHarness,
     KernelChaos,
@@ -175,6 +176,31 @@ def main() -> int:
         print("fuzz-smoke: the shard leg never sharded a dispatch", file=sys.stderr)
         return 1
 
+    # ---- mesh × stream leg: the fused fast path (sharded engines on a
+    # STREAMED feed vs serial single-device) — drives the PR 13 fusion
+    # from day one, coverage-tagged as an execution-mode bucket
+    fuse_scn = generate_scenario(
+        knobs["seed"] + 9, 0, features=frozenset({"preemption", "churn", "retune"})
+    )
+    v, _ = run_differential(fuse_scn, harness, comparisons=("shard-stream-vs-serial",))
+    cov.note_exec(fuse_scn["features"], MESH_STREAM)
+    if v["divergences"]:
+        print("fuzz-smoke: shard-stream-vs-serial diverged", file=sys.stderr)
+        print(json.dumps(v["comparisons"], indent=1)[:4000], file=sys.stderr)
+        report["divergences"]["shard-stream-vs-serial"] = (
+            report["divergences"].get("shard-stream-vs-serial", 0) + 1
+        )
+        failures.append({"scenario": fuse_scn["name"], "kinds": ["shard-stream-vs-serial"]})
+    report["scenarios"] += 1
+    _store_f, fuse_svc = harness.service("default", "shard-stream")
+    fuse_m = fuse_svc.metrics()
+    if fuse_m["sharded_dispatches_total"] <= 0:
+        print("fuzz-smoke: the mesh-stream leg never sharded a dispatch", file=sys.stderr)
+        return 1
+    if fuse_m["stream_waves_total"] <= 0:
+        print("fuzz-smoke: the mesh-stream leg never streamed a wave", file=sys.stderr)
+        return 1
+
     # ---- metrics wiring: the sweep reports into a live service
     _store_m, svc_m = harness.service("default", "batch")
     svc_m.note_fuzz_report(report)
@@ -208,6 +234,7 @@ def main() -> int:
     print(
         f"fuzz-smoke OK: {report['scenarios']} scenarios, 0 unexplained divergences, "
         f"chaos degrade counted ({trips['n']} trips), shard leg sharded, "
+        f"mesh-stream leg streamed {fuse_m['stream_waves_total']} sharded waves, "
         f"{wall:.0f}s; coverage: {json.dumps(cov.summary())}"
     )
     return 0

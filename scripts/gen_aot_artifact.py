@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Regenerate the COMMITTED AOT reference artifacts
+(kube_scheduler_simulator_tpu/ops/aot_artifacts/).
+
+The artifacts are ``jax.export`` serializations of the batch scan over
+the canonical ``ops/aot.reference_scan_workload()`` — four variants:
+
+    {single-device, 2-device node-axis mesh} × {x64, f32}
+
+each exported with ``platforms=("cpu", "tpu")`` so a TPU host replays
+the very module a CPU host exported (and vice versa).  tests/test_aot.py
+loads them back through the engine and pins byte parity against a fresh
+trace plus zero steady-state recompiles on the warm engine.
+
+Run this whenever the committed-artifact test fails with a
+``kernel-digest`` mismatch — i.e. after ANY edit to ops/batch.py:
+
+    JAX_PLATFORMS=cpu python scripts/gen_aot_artifact.py
+
+The output is deterministic in CONTENT semantics (same computation,
+same key) though not necessarily byte-stable across jax versions; the
+sidecar records the jax version, and a version-skewed host falls back
+to a fresh trace instead of loading a foreign artifact.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+try:  # the axon plugin dials the TPU tunnel even when CPU-pinned
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+from kube_scheduler_simulator_tpu.ops.aot import (  # noqa: E402
+    COMMITTED_ARTIFACT_DIR,
+    AotScanCache,
+    reference_engine,
+    reference_scan_workload,
+)
+
+
+def main() -> int:
+    shutil.rmtree(COMMITTED_ARTIFACT_DIR, ignore_errors=True)
+    os.makedirs(COMMITTED_ARTIFACT_DIR, exist_ok=True)
+    nodes, pods = reference_scan_workload()
+    mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("nodes",))
+    for x64 in (True, False):
+        jax.config.update("jax_enable_x64", x64)
+        for m in (None, mesh):
+            eng = reference_engine(mesh=m, cache_dir=COMMITTED_ARTIFACT_DIR)
+            eng._aot.platforms = ("cpu", "tpu")
+            eng.schedule(nodes, pods, pods, [])
+            s = eng._aot.stats()
+            label = f"{'mesh2' if m is not None else 'single'}/{'x64' if x64 else 'f32'}"
+            if s["aot_cache_saves_total"] != 1:
+                print(f"gen-aot FAIL: {label} saved nothing: {s}", file=sys.stderr)
+                return 1
+            print(f"gen-aot: {label} exported ({s})")
+    names = sorted(os.listdir(COMMITTED_ARTIFACT_DIR))
+    print(f"gen-aot OK: {len(names)} files in {COMMITTED_ARTIFACT_DIR}")
+    for n in names:
+        print(f"  {n} ({os.path.getsize(os.path.join(COMMITTED_ARTIFACT_DIR, n))} bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,12 @@ if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/tune_smoke.py; then 
 # byte-compared against single-device (sharded dispatches asserted), plus
 # the f32-vs-x64 oracle spot check (scripts/shard_smoke.py).
 if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/shard_smoke.py; then rc=1; fi
+# Sharded-streaming smoke: the stream x mesh FUSION — KSS_MESH_DEVICES=2
+# streamed churn (sharded double-buffered placer banks, overlapped
+# waves) byte-compared against the serial single-device path, with
+# sharded_dispatches, stream_waves and bank rotations all asserted >0
+# (scripts/shard_stream_smoke.py; bench cfg12 is the at-scale row).
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/shard_stream_smoke.py; then rc=1; fi
 # Differential fuzz smoke (docs/fuzzing.md): a bounded seeded sweep of
 # >= 25 composite scenarios (gang x preemption x autoscale x churn x
 # retune) through batch-vs-oracle and streamed-vs-serial byte diffs,

@@ -1,0 +1,343 @@
+"""AOT executable artifact cache (ops/aot.py): jax.export round-trips.
+
+The contract: serialize → reload → dispatch is BYTE-identical to a
+fresh trace (bindings + the full annotation trail), a warm-loaded
+engine holds zero steady-state recompiles, and every invalidation
+(shape key, mesh spec, dtype regime, jax version, kernel digest,
+corruption) is a COUNTED fallback to a fresh trace — never a crash.
+Plus the committed reference artifacts under ``ops/aot_artifacts/``:
+the repo carries module blobs a TPU host can load-and-run (exported
+with platforms=["cpu","tpu"]), pinned here against the live kernel.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from kube_scheduler_simulator_tpu.analysis.runtime import RecompileGuard
+from kube_scheduler_simulator_tpu.ops.aot import (
+    COMMITTED_ARTIFACT_DIR,
+    AotScanCache,
+    reference_engine,
+    reference_scan_workload,
+)
+
+
+def _mesh(n: int = 2):
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices("cpu")[:n]), ("nodes",))
+
+
+def _docs(res, n_pods: int) -> list:
+    """The byte surface under comparison: binding + filter/score/
+    finalScore annotation JSON per pod."""
+    return [
+        (
+            res.selected_nodes[i],
+            res.filter_annotation_json(i),
+            *res.score_annotations_json(i),
+        )
+        for i in range(n_pods)
+    ]
+
+
+@pytest.fixture()
+def workload():
+    return reference_scan_workload()
+
+
+@pytest.fixture()
+def cache_dir(tmp_path):
+    return str(tmp_path / "aot")
+
+
+class TestRoundTrip:
+    def test_serialize_reload_dispatch_byte_identical(self, workload, cache_dir):
+        nodes, pods = workload
+        cold = reference_engine(cache_dir=cache_dir)
+        d_cold = _docs(cold.schedule(nodes, pods, pods, []), len(pods))
+        s = cold._aot.stats()
+        assert s["aot_cache_misses_total"] == 1
+        assert s["aot_cache_saves_total"] == 1
+        assert s["aot_cache_fallbacks_by_reason"] == {}
+        names = sorted(os.listdir(cache_dir))
+        assert any(n.endswith(".bin") for n in names) and any(
+            n.endswith(".json") for n in names
+        )
+
+        warm = reference_engine(cache_dir=cache_dir)
+        d_warm = _docs(warm.schedule(nodes, pods, pods, []), len(pods))
+        s = warm._aot.stats()
+        assert s["aot_cache_hits_total"] == 1
+        assert s["aot_cache_misses_total"] == 0
+        # the warm engine never traced the scan (the compact fn still
+        # builds fresh — it is not part of the artifact)
+        assert d_warm == d_cold
+        # steady state on the warm-loaded executable: zero recompiles
+        with RecompileGuard("aot warm steady state") as g:
+            d_again = _docs(warm.schedule(nodes, pods, pods, []), len(pods))
+        assert g.compiles == 0
+        assert d_again == d_cold
+
+    def test_mesh_sharded_artifact_round_trip(self, workload, cache_dir):
+        nodes, pods = workload
+        single = reference_engine(cache_dir=cache_dir)
+        d_single = _docs(single.schedule(nodes, pods, pods, []), len(pods))
+
+        mesh_cold = reference_engine(mesh=_mesh(), cache_dir=cache_dir)
+        d_mesh = _docs(mesh_cold.schedule(nodes, pods, pods, []), len(pods))
+        s = mesh_cold._aot.stats()
+        # the single-device artifact shares the shape digest but not the
+        # configuration identity: classified, counted, then saved fresh
+        assert s["aot_cache_fallbacks_by_reason"] == {"mesh-spec": 1}
+        assert s["aot_cache_saves_total"] == 1
+        assert d_mesh == d_single
+
+        mesh_warm = reference_engine(mesh=_mesh(), cache_dir=cache_dir)
+        d_warm = _docs(mesh_warm.schedule(nodes, pods, pods, []), len(pods))
+        assert mesh_warm._aot.stats()["aot_cache_hits_total"] == 1
+        assert d_warm == d_single
+        with RecompileGuard("sharded aot warm steady state") as g:
+            mesh_warm.schedule(nodes, pods, pods, [])
+        assert g.compiles == 0
+
+
+class TestInvalidation:
+    def _seed(self, workload, cache_dir):
+        nodes, pods = workload
+        eng = reference_engine(cache_dir=cache_dir)
+        docs = _docs(eng.schedule(nodes, pods, pods, []), len(pods))
+        assert eng._aot.saves == 1
+        return docs
+
+    def test_jax_version_mismatch_counted_fresh_trace(self, workload, cache_dir):
+        nodes, pods = workload
+        d0 = self._seed(workload, cache_dir)
+        side = next(
+            os.path.join(cache_dir, n)
+            for n in sorted(os.listdir(cache_dir))
+            if n.endswith(".json")
+        )
+        with open(side) as f:
+            j = json.load(f)
+        j["jax-version"] = "0.0.1-foreign"
+        with open(side, "w") as f:
+            json.dump(j, f)
+        eng = reference_engine(cache_dir=cache_dir)
+        d1 = _docs(eng.schedule(nodes, pods, pods, []), len(pods))
+        s = eng._aot.stats()
+        assert s["aot_cache_fallbacks_by_reason"] == {"jax-version": 1}
+        assert s["aot_cache_hits_total"] == 0
+        assert d1 == d0  # the fresh trace, byte-identical
+
+    def test_stale_artifact_is_refreshed_not_permanent(self, workload, cache_dir):
+        """A rejected artifact must be OVERWRITTEN by the fresh build's
+        save — a jax upgrade or kernel edit degrades the cache for one
+        process, not forever (the save path self-heals)."""
+        nodes, pods = workload
+        self._seed(workload, cache_dir)
+        side = next(
+            os.path.join(cache_dir, n)
+            for n in sorted(os.listdir(cache_dir))
+            if n.endswith(".json")
+        )
+        with open(side) as f:
+            j = json.load(f)
+        j["jax-version"] = "0.0.1-foreign"
+        with open(side, "w") as f:
+            json.dump(j, f)
+        healer = reference_engine(cache_dir=cache_dir)
+        healer.schedule(nodes, pods, pods, [])
+        s = healer._aot.stats()
+        assert s["aot_cache_fallbacks_by_reason"] == {"jax-version": 1}
+        assert s["aot_cache_saves_total"] == 1  # the stale file was replaced
+        warm = reference_engine(cache_dir=cache_dir)
+        warm.schedule(nodes, pods, pods, [])
+        assert warm._aot.stats()["aot_cache_hits_total"] == 1
+
+    def test_kernel_digest_mismatch_counted_fresh_trace(self, workload, cache_dir):
+        nodes, pods = workload
+        d0 = self._seed(workload, cache_dir)
+        side = next(
+            os.path.join(cache_dir, n)
+            for n in sorted(os.listdir(cache_dir))
+            if n.endswith(".json")
+        )
+        with open(side) as f:
+            j = json.load(f)
+        j["kernel-digest"] = "0" * 16
+        with open(side, "w") as f:
+            json.dump(j, f)
+        eng = reference_engine(cache_dir=cache_dir)
+        d1 = _docs(eng.schedule(nodes, pods, pods, []), len(pods))
+        assert eng._aot.stats()["aot_cache_fallbacks_by_reason"] == {"kernel-digest": 1}
+        assert d1 == d0
+
+    def test_mesh_spec_mismatch_classified_not_missed(self, workload, cache_dir):
+        """A mesh engine meeting a single-device-only cache must report
+        WHY it fell back (mesh-spec), not a bare miss."""
+        nodes, pods = workload
+        self._seed(workload, cache_dir)
+        eng = reference_engine(mesh=_mesh(), cache_dir=cache_dir)
+        eng.schedule(nodes, pods, pods, [])
+        s = eng._aot.stats()
+        assert s["aot_cache_fallbacks_by_reason"] == {"mesh-spec": 1}
+        assert s["aot_cache_misses_total"] == 0
+
+    def test_shape_key_mismatch_is_a_miss(self, workload, cache_dir):
+        nodes, pods = workload
+        self._seed(workload, cache_dir)
+        more_nodes, _ = reference_scan_workload(n_nodes=48)
+        eng = reference_engine(cache_dir=cache_dir)
+        eng.schedule(more_nodes, pods, pods, [])
+        s = eng._aot.stats()
+        assert s["aot_cache_misses_total"] == 1
+        assert s["aot_cache_hits_total"] == 0
+
+    def test_corrupt_artifact_counted_fresh_trace(self, workload, cache_dir):
+        nodes, pods = workload
+        d0 = self._seed(workload, cache_dir)
+        bad = next(
+            os.path.join(cache_dir, n)
+            for n in sorted(os.listdir(cache_dir))
+            if n.endswith(".bin")
+        )
+        with open(bad, "wb") as f:
+            f.write(b"not a serialized module")
+        eng = reference_engine(cache_dir=cache_dir)
+        d1 = _docs(eng.schedule(nodes, pods, pods, []), len(pods))
+        assert eng._aot.stats()["aot_cache_fallbacks_by_reason"] == {"corrupt": 1}
+        assert d1 == d0
+
+    def test_unwritable_cache_dir_never_fails_a_round(self, workload, tmp_path):
+        nodes, pods = workload
+        blocker = tmp_path / "blocked"
+        blocker.write_text("a file where the cache dir should be")
+        eng = reference_engine(cache_dir=str(blocker / "sub"))
+        d1 = _docs(eng.schedule(nodes, pods, pods, []), len(pods))
+        assert len(d1) == len(pods)
+        s = eng._aot.stats()
+        assert s["aot_cache_saves_total"] == 0
+        assert s["aot_cache_fallbacks_by_reason"].get("export-error", 0) == 1
+
+
+class TestCommittedArtifacts:
+    """The checked-in reference artifacts: the repo ships modules a TPU
+    host can load-and-run; CI pins them against the live kernel."""
+
+    REGEN = (
+        "committed AOT artifact does not load against the live tree — "
+        "ops/batch.py changed since it was exported.  Regenerate with: "
+        "JAX_PLATFORMS=cpu python scripts/gen_aot_artifact.py"
+    )
+
+    def _check(self, mesh):
+        import jax
+
+        nodes, pods = reference_scan_workload()
+        warm = reference_engine(mesh=mesh, cache_dir=COMMITTED_ARTIFACT_DIR)
+        before = sorted(os.listdir(COMMITTED_ARTIFACT_DIR))
+        d_warm = _docs(warm.schedule(nodes, pods, pods, []), len(pods))
+        s = warm._aot.stats()
+        if s["aot_cache_fallbacks_by_reason"].get("jax-version"):
+            pytest.skip(
+                f"committed artifacts were exported under a different jax "
+                f"({jax.__version__} here) — version fallback engaged as designed"
+            )
+        assert s["aot_cache_hits_total"] == 1, f"{self.REGEN} (stats: {s})"
+        # the committed dir is read-only in spirit: a hit writes nothing
+        assert sorted(os.listdir(COMMITTED_ARTIFACT_DIR)) == before
+        fresh = reference_engine(mesh=mesh)
+        assert fresh._aot is None
+        d_fresh = _docs(fresh.schedule(nodes, pods, pods, []), len(pods))
+        assert d_warm == d_fresh, "committed artifact dispatched different bytes"
+        with RecompileGuard("committed artifact steady state") as g:
+            warm.schedule(nodes, pods, pods, [])
+        assert g.compiles == 0
+
+    def test_single_device_artifact(self):
+        self._check(mesh=None)
+
+    def test_mesh_sharded_artifact(self):
+        self._check(mesh=_mesh())
+
+    def test_artifacts_declare_tpu_platform(self):
+        """Every committed sidecar was exported for BOTH cpu and tpu —
+        the load-and-run-on-a-TPU-host claim is in the artifact, not
+        just the docs."""
+        sides = [
+            n for n in sorted(os.listdir(COMMITTED_ARTIFACT_DIR)) if n.endswith(".json")
+        ]
+        assert sides, "no committed artifacts — run scripts/gen_aot_artifact.py"
+        for n in sides:
+            with open(os.path.join(COMMITTED_ARTIFACT_DIR, n)) as f:
+                side = json.load(f)
+            assert set(side["platforms"]) >= {"cpu", "tpu"}, (n, side)
+
+
+class TestServiceWiring:
+    def test_service_metrics_and_render(self, monkeypatch, tmp_path):
+        """KSS_AOT_CACHE_DIR reaches the service's engines through the
+        normal env path, aggregates into service.metrics(), and renders
+        on /metrics alongside the per-bank placer gauges."""
+        monkeypatch.setenv("KSS_AOT_CACHE_DIR", str(tmp_path / "aot"))
+        from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+        from kube_scheduler_simulator_tpu.server.metrics import render_metrics
+        from kube_scheduler_simulator_tpu.state.store import ClusterStore
+        from kube_scheduler_simulator_tpu.utils import SimClock
+
+        store = ClusterStore(clock=SimClock(1_700_000_000.0))
+        for i in range(6):
+            store.create(
+                "nodes",
+                {
+                    "metadata": {"name": f"n-{i}", "labels": {"kubernetes.io/hostname": f"n-{i}"}},
+                    "status": {"allocatable": {"cpu": "8000m", "memory": "16Gi", "pods": "64"}},
+                },
+            )
+        for i in range(4):
+            store.create(
+                "pods",
+                {
+                    "metadata": {"name": f"p-{i}", "namespace": "default"},
+                    "spec": {
+                        "containers": [
+                            {"name": "c", "resources": {"requests": {"cpu": "100m"}}}
+                        ]
+                    },
+                },
+            )
+        svc = SchedulerService(store, tie_break="first", use_batch="force", batch_min_work=1)
+        svc.start_scheduler(None)
+        svc.schedule_pending()
+        m = svc.metrics()
+        assert m["aot_cache_misses_total"] >= 1
+        assert m["aot_cache_saves_total"] >= 1
+        assert "placer_bank_rotations_total" in m
+        assert isinstance(m["placer_banks"], dict)
+
+        class _DI:
+            cluster_store = store
+
+            @staticmethod
+            def scheduler_service():
+                return svc
+
+        text = render_metrics(_DI())
+        for needle in (
+            "simulator_aot_cache_hits_total",
+            "simulator_aot_cache_misses_total",
+            "simulator_aot_cache_saves_total",
+            "simulator_aot_cache_fallbacks_total",
+            "simulator_placer_bank_rotations_total",
+            "simulator_placer_bank_scatter_updates_total",
+            "simulator_placer_bank_plane_bytes_per_device",
+        ):
+            assert needle in text, needle

@@ -69,6 +69,26 @@ class TestCoverage:
         b = CoverageMap().choose_features(random.Random(7))
         assert a == b
 
+    def test_exec_mode_bucket_extends_lattice_without_biasing_sampling(self):
+        """The mesh×stream execution tag lands in the coverage summary
+        as its own bucket but never leaks into the generator's
+        least-covered feature sampling."""
+        import random
+
+        from kube_scheduler_simulator_tpu.fuzz.coverage import MESH_STREAM
+
+        cov = CoverageMap()
+        feats = frozenset({"churn", "retune", "preemption"})
+        cov.note(feats)
+        cov.note_exec(feats, MESH_STREAM)
+        summary = cov.summary()
+        assert summary["churn+preemption+retune"] == 1
+        assert summary[f"churn+{MESH_STREAM}+preemption+retune"] == 1
+        # sampling still draws from the plain FEATURES lattice only
+        for _ in range(20):
+            chosen = cov.choose_features(random.Random(3))
+            assert MESH_STREAM not in chosen
+
 
 class TestGenerator:
     def test_byte_deterministic(self):
@@ -189,6 +209,21 @@ class TestDifferentialParity:
         scn = generate_scenario(11, 1, features=frozenset({"gang", "churn", "retune"}))
         v, _states = run_differential(scn, harness, comparisons=("batch-vs-oracle",))
         assert v["divergences"] == []
+
+    def test_shard_stream_fusion_parity(self, harness):
+        """The stream × mesh fusion as a first-class comparison: the
+        timeline streamed on a 2-device sharded engine, byte-identical
+        to the serial single-device projection, with the sharded
+        streamed dispatches demonstrably engaged."""
+        scn = generate_scenario(11, 2, features=frozenset({"preemption", "churn", "retune"}))
+        v, states = run_differential(scn, harness, comparisons=("shard-stream-vs-serial",))
+        assert v["divergences"] == []
+        assert {c["kind"] for c in v["comparisons"]} == {"shard-stream-vs-serial"}
+        assert states["shard-stream"].keys() == states["shard-stream-off"].keys()
+        _store, svc = harness.service("default", "shard-stream")
+        m = svc.metrics()
+        assert m["sharded_dispatches_total"] > 0
+        assert m["stream_waves_total"] > 0
 
     def test_diff_states_reports_first_mismatch(self):
         a = {"default/p": ("n1", (("k", "v"),), "c")}

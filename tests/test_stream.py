@@ -411,24 +411,42 @@ class TestStreamKnobs:
         assert len(res2) == 12, "second session never admitted its feed"
         assert svc.metrics()["stream_waves_total"] == 4
 
-    def test_mesh_engine_drains_to_sequential_path(self):
-        """Multi-chip services are outside schedule_async's envelope:
-        every wave must drain to the exact sequential path (counted),
-        never hit the single-device dispatch assert."""
+    def test_mesh_engine_streams_with_parity(self):
+        """The stream × mesh fusion (PR 13): a mesh-sharded service
+        STREAMS — sharded double-buffered placer banks, node-sharded
+        scans in flight while the next wave encodes — byte-identical to
+        the serial single-device path, with the sharded dispatches and
+        the bank rotation both demonstrably engaged.  (Before the
+        fusion, mesh engines drained every wave to the sequential path
+        as "multi-chip".)"""
         import jax
         from jax.sharding import Mesh
 
-        store = new_store()
+        # 19 nodes: NOT a multiple of the 2-device mesh, so the wave
+        # problems exercise the pad-to-device-multiple path too
+        store = new_store(19)
         svc = SchedulerService(
             store, tie_break="first", use_batch="force", batch_min_work=1,
-            mesh=Mesh(np.array(jax.devices("cpu")[:8]), ("nodes",)),
+            mesh=Mesh(np.array(jax.devices("cpu")[:2]), ("nodes",)),
         )
         svc.start_scheduler(None)
-        svc.schedule_stream(feed=churn_feed(store, 2), streaming=True)
+        svc.schedule_stream(feed=churn_feed(store, 4), streaming=True)
         m = svc.metrics()
-        assert m["stream_waves_total"] == 0
-        assert m["stream_drains_by_reason"].get("multi-chip", 0) >= 2
-        assert all((p.get("spec") or {}).get("nodeName") for p in store.list("pods"))
+        assert m["stream_waves_total"] >= 3
+        assert m["sharded_dispatches_total"] >= 3
+        assert "multi-chip" not in m["stream_drains_by_reason"]
+        # the double buffer alternated banks with the sharded planes
+        placer = svc._engine_for(svc.framework)._placer
+        assert placer.bank_rotations >= 1
+        assert set(placer.bank_stats(2)) == {0, 1}
+        # byte parity vs the serial single-device path
+        s0 = new_store(19)
+        svc0 = new_service(s0)
+        svc0.schedule_stream(feed=churn_feed(s0, 4), streaming=False)
+        d1, d0 = pod_state(store), pod_state(s0)
+        assert d1.keys() == d0.keys()
+        bad = [k for k in d1 if d1[k] != d0[k]]
+        assert not bad, f"{len(bad)} pods diverged sharded-streamed vs serial, first {bad[:1]}"
 
     def test_metrics_render_includes_stream_counters(self):
         from kube_scheduler_simulator_tpu.server.metrics import render_metrics
