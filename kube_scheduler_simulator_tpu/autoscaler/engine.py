@@ -155,6 +155,21 @@ class ClusterAutoscaler:
             },
         }
 
+    def durability_state(self) -> Obj:
+        """The crash-restorable process state (state/recovery.py): the
+        per-node unneeded streaks.  Losing them to a crash delays
+        scale-downs by up to ``scale_down_unneeded_rounds`` passes,
+        which shifts node-drain events — and with them the re-activation
+        cadence of parked pods — off the uninterrupted timeline (a real
+        byte divergence the crash harness caught)."""
+        return {"unneeded": dict(self._unneeded)}
+
+    def restore_durability_state(self, state: "Obj | None") -> None:
+        if state:
+            self._unneeded = {
+                str(k): int(v) for k, v in (state.get("unneeded") or {}).items()
+            }
+
     def drain_events(self) -> list[Obj]:
         """Actions recorded since the last drain (scenario timeline feed)."""
         with self._lock:

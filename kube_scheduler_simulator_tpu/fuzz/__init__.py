@@ -49,7 +49,12 @@ from kube_scheduler_simulator_tpu.fuzz.shrink import (
     shrink,
     write_fixture,
 )
-from kube_scheduler_simulator_tpu.fuzz.chaos import ChaosError, KernelChaos
+from kube_scheduler_simulator_tpu.fuzz.chaos import (
+    ChaosError,
+    KernelChaos,
+    ProcessChaos,
+    ProcessChaosError,
+)
 
 __all__ = [
     "FEATURES",
@@ -73,4 +78,6 @@ __all__ = [
     "write_fixture",
     "ChaosError",
     "KernelChaos",
+    "ProcessChaos",
+    "ProcessChaosError",
 ]

@@ -1,4 +1,4 @@
-"""The chaos layer: inject mid-run device faults the system must survive.
+"""The chaos layer: inject mid-run faults the system must survive.
 
 The scheduling engines promise that a kernel failure is never fatal and
 never partial: a crashed dispatch, window fetch, or streamed
@@ -24,13 +24,30 @@ Device events, in occurrence order across the whole context:
 
 Injection is via the service's ``_engine_for`` seam, so every profile
 engine — and the stream session riding on it — sees the same chaos.
+
+:class:`ProcessChaos` is the second adversary, pointed at *process*
+crashes instead of kernel crashes: it runs a scenario in a journaled
+subprocess (state/journal.py), SIGKILLs it at a seeded journal-record
+index, recovers in a fresh process (state/recovery.py), finishes the
+scenario, and byte-diffs the full annotation trail against an
+uninterrupted run — the same parity bar, extended across a crash
+boundary.  Any divergence shrinks through the existing ddmin machinery
+(fuzz/shrink.py) exactly like a kernel-chaos divergence.
 """
 
 from __future__ import annotations
 
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
 from typing import Any, Iterator
 
 Obj = dict[str, Any]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 class ChaosError(RuntimeError):
@@ -116,3 +133,182 @@ class KernelChaos:
         # remove the instance attribute shadowing the class method
         self.svc.__dict__.pop("_engine_for", None)
         self._orig = None
+
+
+# --------------------------------------------------------------- processes
+
+
+class ProcessChaosError(RuntimeError):
+    """The harness itself broke (a child failed to launch, recover, or
+    report) — NOT a parity divergence."""
+
+
+class ProcessChaos:
+    """Kill-and-recover differential over one scenario.
+
+    For each seeded kill record index, three subprocess legs run
+    (:mod:`fuzz.crash_child`): the uninterrupted baseline (once), the
+    journaled run SIGKILLed at the index, and the recovery that resumes
+    and finishes the scenario.  The verdict's ``divergences`` lists the
+    kill points whose recovered annotation trail differed from the
+    baseline's — byte parity is the whole judgment, exactly as in the
+    kernel-chaos and differential legs.
+
+    ``kill_records`` are SEEDS, normalized into ``[1, records-1]``
+    against the baseline's actual record count, so a caller can pin
+    "early / middle / late" without knowing the run length.  ``role``
+    overrides the child service configuration
+    (:data:`fuzz.crash_child.DEFAULT_ROLE` — e.g. ``use_batch="auto"``
+    to exercise the wave-atomic batch commit path, ``checkpoint_every``
+    to exercise compaction mid-run).
+    """
+
+    def __init__(
+        self,
+        scenario: Obj,
+        kill_records: "tuple[int, ...] | list[int]" = (1,),
+        role: "Obj | None" = None,
+        child_timeout_s: float = 300.0,
+    ):
+        self.scenario = scenario
+        self.kill_records = tuple(int(k) for k in kill_records)
+        self.role = dict(role or {})
+        self.child_timeout_s = child_timeout_s
+
+    # ------------------------------------------------------------- children
+
+    def _child(
+        self, mode: str, journal_dir: str, plan_path: str, out_path: str
+    ) -> subprocess.CompletedProcess:
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.setdefault("JAX_PLATFORM_NAME", "cpu")
+        env["PYTHONPATH"] = _REPO_ROOT + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        try:
+            return self._exec(mode, journal_dir, plan_path, out_path, env)
+        except subprocess.TimeoutExpired as e:
+            raise ProcessChaosError(
+                f"{mode} child hung past {self.child_timeout_s:.0f}s"
+            ) from e
+
+    def _exec(
+        self, mode: str, journal_dir: str, plan_path: str, out_path: str, env: dict
+    ) -> subprocess.CompletedProcess:
+        return subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "kube_scheduler_simulator_tpu.fuzz.crash_child",
+                "--mode",
+                mode,
+                "--journal-dir",
+                journal_dir,
+                "--plan",
+                plan_path,
+                "--out",
+                out_path,
+            ],
+            cwd=_REPO_ROOT,
+            env=env,
+            capture_output=True,
+            timeout=self.child_timeout_s,
+        )
+
+    @staticmethod
+    def _read_out(out_path: str, leg: str, proc: subprocess.CompletedProcess) -> Obj:
+        try:
+            with open(out_path, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            raise ProcessChaosError(
+                f"{leg} child produced no report (rc={proc.returncode}): "
+                f"{proc.stderr.decode(errors='replace')[-2000:]}"
+            ) from None
+
+    # ------------------------------------------------------------------ run
+
+    def run(self) -> Obj:
+        """Execute the kill/recover differential; returns the verdict:
+        ``{"scenario", "records", "kill_points", "divergences",
+        "truncated_records", "partial_gangs", "first_mismatch"}``."""
+        verdict: Obj = {
+            "scenario": self.scenario.get("name", "scenario"),
+            "kill_points": [],
+            "divergences": [],
+            "truncated_records": 0,
+            "partial_gangs": 0,
+            "replayed_records": 0,
+            "first_mismatch": None,
+        }
+        with tempfile.TemporaryDirectory(prefix="kss-crash-") as td:
+            plan_path = os.path.join(td, "plan.json")
+            with open(plan_path, "w", encoding="utf-8") as f:
+                json.dump({"scenario": self.scenario, "role": self.role}, f, sort_keys=True)
+            base_out = os.path.join(td, "baseline.json")
+            proc = self._child("run", os.path.join(td, "jr-base"), plan_path, base_out)
+            if proc.returncode != 0:
+                raise ProcessChaosError(
+                    f"baseline child rc={proc.returncode}: "
+                    f"{proc.stderr.decode(errors='replace')[-2000:]}"
+                )
+            baseline = self._read_out(base_out, "baseline", proc)
+            records = int(baseline["records"])
+            verdict["records"] = records
+            verdict["journal"] = dict(baseline.get("journal") or {})
+
+            for seed_k in self.kill_records:
+                # normalize the seed into a real mid-run record index
+                k = 1 + (seed_k - 1) % max(records - 1, 1)
+                verdict["kill_points"].append(k)
+                jdir = os.path.join(td, f"jr-kill-{k}")
+                kill_plan = os.path.join(td, f"plan-kill-{k}.json")
+                with open(kill_plan, "w", encoding="utf-8") as f:
+                    json.dump(
+                        {"scenario": self.scenario, "role": self.role, "kill_at": k},
+                        f,
+                        sort_keys=True,
+                    )
+                crash_out = os.path.join(td, f"crash-{k}.json")
+                proc = self._child("crash", jdir, kill_plan, crash_out)
+                if proc.returncode != -signal.SIGKILL:
+                    raise ProcessChaosError(
+                        f"crash child at record {k} exited rc={proc.returncode} "
+                        f"instead of dying by SIGKILL: "
+                        f"{proc.stderr.decode(errors='replace')[-2000:]}"
+                    )
+                rec_out = os.path.join(td, f"recover-{k}.json")
+                proc = self._child("recover", jdir, kill_plan, rec_out)
+                if proc.returncode != 0:
+                    raise ProcessChaosError(
+                        f"recovery child at record {k} rc={proc.returncode}: "
+                        f"{proc.stderr.decode(errors='replace')[-2000:]}"
+                    )
+                recovered = self._read_out(rec_out, f"recover@{k}", proc)
+                stats = recovered.get("recovery") or {}
+                verdict["truncated_records"] += int(stats.get("truncated_records", 0))
+                verdict["partial_gangs"] += int(stats.get("partial_gangs", 0))
+                verdict["replayed_records"] += int(stats.get("replayed_records", 0))
+                if recovered["state"] != baseline["state"]:
+                    verdict["divergences"].append(k)
+                    if verdict["first_mismatch"] is None:
+                        verdict["first_mismatch"] = _first_state_mismatch(
+                            baseline["state"], recovered["state"], k
+                        )
+        return verdict
+
+
+def _first_state_mismatch(a: list, b: list, kill_point: int) -> Obj:
+    """The first differing parity row between two encoded states
+    (fuzz.runner.encode_state lists) — triage context for a divergence."""
+    da, db = dict((k, v) for k, v in a), dict((k, v) for k, v in b)
+    for key in sorted(set(da) | set(db)):
+        if da.get(key) != db.get(key):
+            return {
+                "kill_point": kill_point,
+                "pod": key,
+                "baseline": da.get(key),
+                "recovered": db.get(key),
+            }
+    return {"kill_point": kill_point, "pod": None}

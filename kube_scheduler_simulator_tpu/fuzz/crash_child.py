@@ -1,0 +1,242 @@
+"""The ProcessChaos child: one journaled scenario run per process.
+
+``fuzz.chaos.ProcessChaos`` drives three invocations of this module as
+subprocesses (``python -m kube_scheduler_simulator_tpu.fuzz.crash_child``):
+
+- ``--mode run``: the uninterrupted baseline — build a fresh store +
+  scheduler (the fuzz harness's deterministic configuration: SimClocks,
+  ``tie_break="first"``, explicit default weights), attach a journal,
+  replay the scenario tick by tick with a ``mark`` record after every
+  tick, settle, and print the final parity state + the total record
+  count (the crash run's kill index is seeded against it).
+- ``--mode crash``: the same run with ``kill_at=N`` — the journal
+  SIGKILLs the process the instant record #N is durable.  The parent
+  observes the signal death; nothing is printed.
+- ``--mode recover``: a FRESH process over the same journal directory —
+  ``RecoveryManager`` rebuilds the store (checkpoint + replay +
+  torn-tail truncation), the scheduler restarts through the recovered
+  configuration, process state (rotation counters, unschedulableQ,
+  clocks, weights, event sequence) restores from the last mark, a new
+  journal epoch opens, and the scenario RESUMES at the tick after the
+  last completed mark (re-running any partially-applied tick — scenario
+  ops are idempotent by the fuzz runner's forgiveness rules).  Prints
+  the final parity state + the recovery stats.
+
+The crash-parity pin: ``run`` state == ``recover`` state, byte for
+byte, with ``truncated_records == 0`` (a SIGKILL at a record boundary
+never tears) and ``partial_gangs == 0`` (wave/gang records are atomic).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# env pinning BEFORE any jax-importing module (same as scripts/)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import argparse  # noqa: E402
+import json  # noqa: E402
+from typing import Any  # noqa: E402
+
+Obj = dict[str, Any]
+
+DEFAULT_ROLE: Obj = {
+    # sequential-path children are import-cheap (no XLA compile); the
+    # crash smoke opts into the batch path to exercise wave atomicity
+    "use_batch": "off",
+    "batch_min_work": 0,
+    "commit_wave": 256,
+    "autoscale": "on",
+    "fsync": False,
+    "checkpoint_every": 0,
+}
+
+
+def _depin_axon() -> None:
+    try:  # the axon plugin dials the TPU tunnel even when CPU-pinned
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def _build_service(plan: Obj, store: Any):
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.utils.simclock import SimClock
+
+    role = {**DEFAULT_ROLE, **(plan.get("role") or {})}
+    svc = SchedulerService(
+        store,
+        tie_break="first",
+        clock=SimClock(0.0),
+        use_batch=role["use_batch"],
+        batch_min_work=role["batch_min_work"],
+        commit_wave=role["commit_wave"],
+        autoscale=role["autoscale"],
+        weights={},
+    )
+    cfg = None
+    if (plan["scenario"].get("profile") or "default") == "gang":
+        from kube_scheduler_simulator_tpu.gang import gang_scheduler_config
+
+        cfg = gang_scheduler_config()
+    return svc, cfg, role
+
+
+def _drive(scenario: Obj, store: Any, svc: Any, start_tick: int = 0) -> None:
+    """The fuzz runner's tick projection, with a recovery mark after
+    every completed tick (state/recovery.write_mark)."""
+    from kube_scheduler_simulator_tpu.fuzz.runner import _settle, apply_op
+    from kube_scheduler_simulator_tpu.state.recovery import write_mark
+
+    clk = svc._clock
+    step = float(scenario.get("stepSeconds") or 1.0)
+    autoscaled = "autoscale" in scenario["features"]
+    ticks = scenario["ticks"]
+    for t in range(start_tick, len(ticks)):
+        for op in ticks[t]:
+            apply_op(store, svc, op)
+        if autoscaled:
+            svc.schedule_pending_autoscaled(max_rounds=2, max_passes=4)
+        else:
+            svc.schedule_pending(max_rounds=2)
+        clk.advance(step)
+        write_mark(svc, t)
+    _settle(store, svc, autoscaled)
+    write_mark(svc, len(ticks), label="end")
+
+
+def _emit(out_path: str, doc: Obj) -> None:
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, sort_keys=True)
+
+
+def _attach(plan: Obj, role: Obj, store: Any, svc: Any, kill_at: "int | None") -> Any:
+    from kube_scheduler_simulator_tpu.services.snapshot import SnapshotService
+    from kube_scheduler_simulator_tpu.state.journal import Journal
+    from kube_scheduler_simulator_tpu.state.recovery import (
+        build_checkpoint,
+        scheduler_meta_provider,
+    )
+
+    journal = Journal(
+        plan["journal_dir"],
+        fsync=bool(role["fsync"]),
+        checkpoint_every=int(role["checkpoint_every"]),
+        kill_at=kill_at,
+    )
+    store.attach_journal(journal)
+    journal.add_meta_provider(scheduler_meta_provider(svc))
+    snap = SnapshotService(store, svc)
+    journal.checkpoint_provider = lambda: build_checkpoint(store, snap)
+    return journal
+
+
+def mode_run(plan: Obj, out_path: str, kill_at: "int | None") -> None:
+    from kube_scheduler_simulator_tpu.fuzz.runner import encode_state
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+    from kube_scheduler_simulator_tpu.utils.parity import pod_parity_state
+    from kube_scheduler_simulator_tpu.utils.simclock import SimClock
+
+    store = ClusterStore(clock=SimClock(1_700_000_000.0))
+    svc, cfg, role = _build_service(plan, store)
+    journal = _attach(plan, role, store, svc, kill_at)
+    # everything from here on is journaled: the bootstrap namespace, the
+    # scheduler-config record, every scenario mutation and commit wave
+    store.create("namespaces", {"metadata": {"name": "default"}})
+    svc.start_scheduler(cfg)
+    _drive(plan["scenario"], store, svc)
+    _emit(
+        out_path,
+        {
+            "state": encode_state(pod_parity_state(store)),
+            "records": journal.stats["records"],
+            "journal": dict(journal.stats),
+        },
+    )
+
+
+def mode_recover(plan: Obj, out_path: str) -> None:
+    from kube_scheduler_simulator_tpu.fuzz.runner import _settle, encode_state
+    from kube_scheduler_simulator_tpu.state.recovery import (
+        RecoveryManager,
+        restore_scheduler_state,
+        write_mark,
+    )
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+    from kube_scheduler_simulator_tpu.utils.parity import pod_parity_state
+    from kube_scheduler_simulator_tpu.utils.simclock import SimClock
+
+    store = ClusterStore(clock=SimClock(1_700_000_000.0))
+    mgr = RecoveryManager(plan["journal_dir"])
+    report = mgr.recover(store)
+    mgr.scan_partial_gangs(store, report)
+    svc, cfg, role = _build_service(plan, store)
+    svc.start_scheduler(report.scheduler_config or cfg)
+    restore_scheduler_state(svc, report)
+    journal = _attach(plan, role, store, svc, kill_at=None)
+    # the new epoch inherits the recovered resume point: a compaction
+    # firing before the resumed run's first mark must embed it
+    journal.last_mark = report.last_mark
+
+    mark = report.last_mark or {}
+    scenario = plan["scenario"]
+    resumed_from = -1
+    if mark.get("label") == "end":
+        # crash landed after the run finished: nothing to resume
+        resumed_from = len(scenario["ticks"]) + 1
+        write_mark(svc, resumed_from, label="end")
+    else:
+        resumed_from = int(mark.get("tick", -1)) + 1 if mark else 0
+        if resumed_from >= len(scenario["ticks"]):
+            # crash mid-settle: every tick completed; re-run the settle
+            _settle(store, svc, "autoscale" in scenario["features"])
+            write_mark(svc, len(scenario["ticks"]), label="end")
+        else:
+            _drive(scenario, store, svc, start_tick=resumed_from)
+    _emit(
+        out_path,
+        {
+            "state": encode_state(pod_parity_state(store)),
+            "recovery": report.stats(),
+            "resumed_from": resumed_from,
+        },
+    )
+
+
+def main() -> int:
+    _depin_axon()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=("run", "crash", "recover"), required=True)
+    ap.add_argument("--journal-dir", required=True)
+    ap.add_argument("--plan", required=True, help="JSON plan: scenario + role (+ kill_at)")
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+    with open(args.plan, encoding="utf-8") as f:
+        plan = json.load(f)
+    plan["journal_dir"] = args.journal_dir
+    if args.mode == "run":
+        mode_run(plan, args.out, kill_at=None)
+    elif args.mode == "crash":
+        kill_at = int(plan.get("kill_at") or 1)
+        mode_run(plan, args.out, kill_at=kill_at)
+        # reaching here means the kill point never fired (index past the
+        # end of the run) — the parent treats this exit code as a miss
+        return 3
+    else:
+        mode_recover(plan, args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

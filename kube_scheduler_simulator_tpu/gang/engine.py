@@ -339,6 +339,33 @@ class GangRound:
         if bindc:
             self_cats["bind"] = bindc
         entries.append((ns, name, self_cats))
+
+        with svc.cluster_store.journal_txn("gang-release"):
+            return self._commit_release_txn(
+                entries, wps, sib_keys, pod, ns, name, node_name, snapshot, k
+            )
+
+    def _commit_release_txn(
+        self,
+        entries: list,
+        wps: list,
+        sib_keys: list,
+        pod: Obj,
+        ns: str,
+        name: str,
+        node_name: str,
+        snapshot: Any,
+        k: "tuple[str, str]",
+    ) -> Any:
+        """The release's mutating tail, grouped into ONE atomic journal
+        record (state/journal.py): the result-store wave, the bulk bind
+        transaction, the reflector wave flush and the Scheduled event
+        recover together or not at all — a crash can never leave a
+        partially-bound gang."""
+        from kube_scheduler_simulator_tpu.scheduler.framework_runner import ScheduleResult
+
+        svc = self.service
+        fw = self.fw
         fw.result_store.add_wave_results(entries)
 
         def bind_to(node: str):

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable
+from typing import Any, Callable, Iterable
 
 from kube_scheduler_simulator_tpu.utils.keys import pod_key as _pod_key
 
@@ -91,6 +91,12 @@ class SchedulingQueue:
         # observability (metrics endpoint)
         self.moves = 0
         self.flushes = 0
+        # bumped on EVERY per-pod state change (tracking, transitions,
+        # activations): state_snapshot caches on it, so the journal's
+        # per-record meta pays the O(pods) snapshot walk only when the
+        # queue actually changed
+        self.mutation_seq = 0
+        self._snap_cache: "tuple[int, dict[str, list[str]]] | None" = None
 
     # ------------------------------------------------------------ tracking
 
@@ -98,12 +104,15 @@ class SchedulingQueue:
         with self._lock:
             if key not in self._pods:
                 self._pods[key] = _PodState()
+                self.mutation_seq += 1
 
     def forget(self, key: str) -> None:
         with self._lock:
             st = self._pods.pop(key, None)
-            if st is not None and st.state == UNSCHEDULABLE:
-                self._unschedulable -= 1
+            if st is not None:
+                self.mutation_seq += 1
+                if st.state == UNSCHEDULABLE:
+                    self._unschedulable -= 1
 
     def backoff_for(self, attempts: int) -> float:
         """Exponential per-pod backoff: initial * 2^(attempts-1), capped.
@@ -126,6 +135,7 @@ class SchedulingQueue:
                 # cycle ran) — do not resurrect a ghost entry
                 return
             was_unsched = st.state == UNSCHEDULABLE
+            self.mutation_seq += 1
             st.attempts += 1
             st.backoff_until = now + self.backoff_for(st.attempts)
             st.unschedulable_since = now
@@ -177,6 +187,7 @@ class SchedulingQueue:
                 if st.state == UNSCHEDULABLE:
                     st.state = BACKOFF if now < st.backoff_until else ACTIVE
                     self.moves += 1
+                    self.mutation_seq += 1
             self._unschedulable = 0
 
     def flush_stuck(self) -> None:
@@ -193,6 +204,7 @@ class SchedulingQueue:
                 ):
                     st.state = BACKOFF if now < st.backoff_until else ACTIVE
                     self.flushes += 1
+                    self.mutation_seq += 1
                     self._unschedulable -= 1
 
     # ---------------------------------------------------------------- pops
@@ -209,8 +221,60 @@ class SchedulingQueue:
                     out.add(key)
                 elif st.state == BACKOFF and (ignore_backoff or now >= st.backoff_until):
                     st.state = ACTIVE
+                    self.mutation_seq += 1
                     out.add(key)
         return out
+
+    def unschedulable_keys(self) -> "list[str]":
+        """The pods currently parked in unschedulableQ (sorted) — part
+        of the queue state every crash-recovery journal record carries
+        (state/recovery.scheduler_meta_provider)."""
+        with self._lock:
+            return sorted(k for k, st in self._pods.items() if st.state == UNSCHEDULABLE)
+
+    def state_snapshot(self) -> dict[str, list[str]]:
+        """The per-pod queue states, sorted — rides on every journal
+        record's meta so a recovered scheduler resumes with EXACTLY the
+        crash-point queue: a fresh queue would re-attempt pods the
+        uninterrupted run leaves parked, while a stale one would starve
+        pods whose re-activating events are already durable (both were
+        real byte divergences the crash harness caught)."""
+        with self._lock:
+            cached = self._snap_cache
+            if cached is not None and cached[0] == self.mutation_seq:
+                return cached[1]
+            out: dict[str, list[str]] = {ACTIVE: [], BACKOFF: [], UNSCHEDULABLE: []}
+            for k, st in self._pods.items():
+                out[st.state].append(k)
+            for lst in out.values():
+                lst.sort()
+            # cached + shared: consumers (the journal meta provider)
+            # serialize it immediately and must not mutate it
+            self._snap_cache = (self.mutation_seq, out)
+            return out
+
+    def restore_states(self, snapshot: "dict[str, Iterable[str]] | None") -> None:
+        """Recovery: re-arm the journaled queue states.  Attempt counts
+        and backoff deadlines are not restored (they only shape backoff
+        durations, and the deterministic drains ignore backoff); the
+        unschedulable timer restarts at recovery time, like any process
+        restart."""
+        if not snapshot:
+            return
+        now = self._clock()
+        with self._lock:
+            self.mutation_seq += 1
+            for state in (ACTIVE, BACKOFF, UNSCHEDULABLE):
+                for key in snapshot.get(state) or []:
+                    st = self._pods.get(key)
+                    if st is None:
+                        st = self._pods[key] = _PodState()
+                    elif st.state == UNSCHEDULABLE:
+                        self._unschedulable -= 1
+                    st.state = state
+                    if state == UNSCHEDULABLE:
+                        st.unschedulable_since = now
+                        self._unschedulable += 1
 
     def has_unschedulable(self) -> bool:
         """Any pod parked in unschedulableQ right now?  O(1) — the

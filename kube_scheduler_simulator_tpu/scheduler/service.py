@@ -246,6 +246,14 @@ class SchedulerService:
         self._last_tuning_report: "Obj | None" = None
         # guards batch_fallbacks against the metrics scrape thread
         self._stats_lock = threading.Lock()
+        # stream quiesce machinery (pause_streams): an exclusive store
+        # operation — snapshot load, boot recovery — drains every active
+        # StreamSession to a wave boundary (counted per reason) and holds
+        # it parked until the operation finishes
+        self._stream_cv = threading.Condition()
+        self._stream_busy = 0
+        self._stream_pause_reason: "str | None" = None
+        self._pause_mu = threading.Lock()
         # Capacity engine (autoscaler/): built lazily on first use so
         # autoscale="off" services never import the package.
         if autoscale not in ("off", "on", "scenario"):
@@ -365,6 +373,11 @@ class SchedulerService:
         if self._weights_requested is not None:
             self.set_plugin_weights(self._weights_requested)
         self._current_cfg = cfg
+        if getattr(self.cluster_store, "journal", None) is not None:
+            # the active scheduler configuration is process state the
+            # journal must carry: recovery rebuilds through the existing
+            # restart_scheduler path with the LAST journaled config
+            self.cluster_store.journal_append("config", {"config": cfg})
         # a scheduler (re)build is a scheduling-relevant event: pods that
         # were unschedulable under the OLD config must be re-attempted
         # under the new one
@@ -801,27 +814,75 @@ class SchedulerService:
             idle_sleep_s=idle_sleep_s,
         ).run()
 
+    def pause_streams(self, reason: str):
+        """Context manager: quiesce every active :class:`StreamSession`
+        before an exclusive store operation (a snapshot ``load()``'s
+        wholesale reset must never interleave with an in-flight wave
+        commit).  Each parked session counts ONE drain under ``reason``
+        in ``stream_drains_by_reason`` — the same counted-gate
+        discipline as every other exactness gate; with no session
+        active this is free.  Reentrant pausers queue on ``_pause_mu``.
+
+        The quiesce wait is BOUNDED (a session stuck inside a feed
+        callback can never park; deadlocking the API would be worse
+        than proceeding), but a fallthrough is never silent: it logs
+        and counts ``stream_drains_by_reason["pause timeout"]`` so a
+        violated exclusivity window is visible in every scrape."""
+        import contextlib
+        import logging
+
+        @contextlib.contextmanager
+        def _pause():
+            with self._pause_mu:
+                with self._stream_cv:
+                    self._stream_pause_reason = reason
+                    quiesced = self._stream_cv.wait_for(
+                        lambda: self._stream_busy == 0, timeout=60.0
+                    )
+                if not quiesced:
+                    logging.getLogger(__name__).warning(
+                        "pause_streams(%r): %d stream session(s) failed to park "
+                        "within 60s; proceeding WITHOUT exclusivity",
+                        reason,
+                        self._stream_busy,
+                    )
+                    with self._stats_lock:
+                        d = self.stats["stream_drains"]
+                        d["pause timeout"] = d.get("pause timeout", 0) + 1
+                try:
+                    yield
+                finally:
+                    with self._stream_cv:
+                        self._stream_pause_reason = None
+                        self._stream_cv.notify_all()
+
+        return _pause()
+
     def allow_waiting_pod(self, namespace: str, name: str, plugin: str) -> "ScheduleResult | None":
         """Approve a waiting pod on ``plugin``'s behalf; when that was the
         last pending permit plugin, the bind cycle runs and the full
         result set (including the recorded Wait) flushes to annotations."""
         assert self.framework is not None, "scheduler not started"
         for fw in self.frameworks.values():
-            res = fw.allow_waiting_pod(namespace, name, plugin)
-            if res is not None:
-                self._drain_resolved_waiting()
-                self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
-                return res
+            # one permit resolution = one atomic journal record (the
+            # released binds + cascade failures + annotation flush)
+            with self.cluster_store.journal_txn("attempt"):
+                res = fw.allow_waiting_pod(namespace, name, plugin)
+                if res is not None:
+                    self._drain_resolved_waiting()
+                    self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
+                    return res
         return None
 
     def reject_waiting_pod(self, namespace: str, name: str, message: str = "rejected") -> "ScheduleResult | None":
         assert self.framework is not None, "scheduler not started"
         for fw in self.frameworks.values():
-            res = fw.reject_waiting_pod(namespace, name, message)
-            if res is not None:
-                self._drain_resolved_waiting()
-                self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
-                return res
+            with self.cluster_store.journal_txn("attempt"):
+                res = fw.reject_waiting_pod(namespace, name, message)
+                if res is not None:
+                    self._drain_resolved_waiting()
+                    self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
+                    return res
         return None
 
     def process_waiting_pods(self, now: "float | None" = None) -> dict[str, ScheduleResult]:
@@ -832,14 +893,17 @@ class SchedulerService:
         a gang member's timeout rejecting its whole group — resolve more
         pods than the expiry set; the drain records them all."""
         expired: dict[str, ScheduleResult] = {}
-        for fw in self.frameworks.values():
-            if fw.waiting_pods:
-                expired.update(fw.expire_waiting_pods(now))
-        if expired:
-            with self._stats_lock:
-                self.stats["permit_wait_expired"] += len(expired)
-        if self._drain_resolved_waiting():
-            self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
+        # expiry cascades (a gang member's timeout rejecting its whole
+        # group) journal as one atomic record with their annotation flush
+        with self.cluster_store.journal_txn("attempt"):
+            for fw in self.frameworks.values():
+                if fw.waiting_pods:
+                    expired.update(fw.expire_waiting_pods(now))
+            if expired:
+                with self._stats_lock:
+                    self.stats["permit_wait_expired"] += len(expired)
+            if self._drain_resolved_waiting():
+                self.reflector.flush_all(self.cluster_store, skip_keys=self._all_waiting_keys())
         return expired
 
     def _drain_resolved_waiting(self) -> int:
@@ -1211,13 +1275,29 @@ class SchedulerService:
             if not wave_js:
                 return
             tc = time.perf_counter()
-            self._commit_batch_wave(result, wave_js, window, snapshot, point_names, fw, results)
+            # ONE atomic journal record for the whole commit wave
+            # (add_wave_results + the bind transaction + flush_wave) —
+            # crash recovery must never see a partially-committed wave.
+            # The counter bump and the rotation advance ride inside the
+            # transaction so the record's meta carries the state a
+            # resumed run must restore: the attempt counter past this
+            # wave, and the rotation the sequential path would hold at
+            # the first pod NOT yet durable (the kernel's per-pod
+            # sample_start trace; final_start once the window is done).
+            with self.cluster_store.journal_txn("wave"):
+                self._commit_batch_wave(
+                    result, wave_js, window, snapshot, point_names, fw, results
+                )
+                fw.sched_counter += len(wave_js)
+                nj = wave_js[-1] + 1
+                fw.next_start_node_index = (
+                    int(sample_start[nj]) if nj < cnt else result.final_start
+                )
             dt = time.perf_counter() - tc
             self.stats["commit_s"] += dt
             self.stats["commit_waves"] += 1
             self.stats["last_wave_commit_s"] = dt
             self.stats["last_wave_pods"] = len(wave_js)
-            fw.sched_counter += len(wave_js)
             self.stats["batch_pods"] += len(wave_js)
             wave_js.clear()
 
@@ -1467,8 +1547,23 @@ class SchedulerService:
             ]
         from kube_scheduler_simulator_tpu.ops.mesh import mesh_devices
 
+        # durability layer (state/journal.py + state/recovery.py): the
+        # write-ahead journal's write-side counters and the last boot's
+        # recovery outcome — all zero when journaling is off (the default)
+        journal = getattr(self.cluster_store, "journal", None)
+        jstats = dict(journal.stats) if journal is not None else {}
+        rstats = dict(getattr(self.cluster_store, "recovery_stats", None) or {})
+
         return {
             **enc,
+            "journal_enabled": int(journal is not None),
+            "journal_records_total": jstats.get("records", 0),
+            "journal_bytes_written_total": jstats.get("bytes", 0),
+            "journal_fsyncs_total": jstats.get("fsyncs", 0),
+            "checkpoint_compactions_total": jstats.get("compactions", 0),
+            "recovery_replayed_records_total": rstats.get("replayed_records", 0),
+            "recovery_truncated_records_total": rstats.get("truncated_records", 0),
+            "recovery_partial_gangs_total": rstats.get("partial_gangs", 0),
             "shard_devices": mesh_devices(self.mesh),
             "batch_commits": self.stats["batch_commits"],
             "batch_pods": self.stats["batch_pods"],
@@ -1636,7 +1731,24 @@ class SchedulerService:
         categories the wrapped plugins record, models/wrapped.py) and bind
         it; with ``snapshot``, assume the bind so later sequential cycles
         in the same round see it (exactly as the shared round snapshot
-        does in the all-sequential path)."""
+        does in the all-sequential path).  Like a sequential attempt,
+        the whole per-pod commit — victim deletes, bind/status, flush —
+        journals as one atomic record."""
+        with self.cluster_store.journal_txn("attempt"):
+            return self._commit_batch_pod_txn(
+                result, i, pod, snapshot, point_names, fw, preempt
+            )
+
+    def _commit_batch_pod_txn(
+        self,
+        result: Any,
+        i: int,
+        pod: Obj,
+        snapshot: "Snapshot | None" = None,
+        point_names: "dict[str, list[str]] | None" = None,
+        fw: "Framework | None" = None,
+        preempt: Any = None,
+    ) -> ScheduleResult:
         from kube_scheduler_simulator_tpu.plugins.resultstore import SUCCESS_MESSAGE
 
         if fw is None:
@@ -1738,6 +1850,15 @@ class SchedulerService:
             snapshot = self.build_snapshot()
         fw = self.framework_for(pod)
         attempt_move_seq = self.queue.move_seq
+        # one sequential attempt = one atomic journal record: the bind
+        # (or failure status + nomination + victim deletes) and the
+        # annotation flush must recover together or not at all
+        with self.cluster_store.journal_txn("attempt"):
+            return self._schedule_one_txn(pod, snapshot, fw, attempt_move_seq)
+
+    def _schedule_one_txn(
+        self, pod: Obj, snapshot: "Snapshot", fw: Framework, attempt_move_seq: int
+    ) -> ScheduleResult:
         result = fw.schedule_one(pod, snapshot)
         self._sync_rotation(fw)
         # lock-free: single-writer scalar bump on the scheduling thread

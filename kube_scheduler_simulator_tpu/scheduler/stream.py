@@ -396,6 +396,11 @@ class StreamSession:
         assert svc.framework is not None, "scheduler not started"
         import gc
 
+        # register with the service's quiesce machinery: an exclusive
+        # store operation (snapshot load) waits until every busy session
+        # has parked at a wave boundary (svc.pause_streams)
+        with svc._stream_cv:
+            svc._stream_busy += 1
         self._t0 = time.perf_counter()
         gc_was_enabled = gc.isenabled()
         if gc_was_enabled:
@@ -403,12 +408,42 @@ class StreamSession:
         try:
             self._loop()
         finally:
-            if gc_was_enabled:
-                gc.enable()
-            svc.reflector.flush_all(
-                svc.cluster_store, skip_keys=svc._all_waiting_keys()
-            )
+            # the busy slot MUST come back even if the final flush
+            # raises — a leaked count would make every future
+            # pause_streams stall its full timeout and proceed without
+            # the exclusivity it exists to provide
+            try:
+                if gc_was_enabled:
+                    gc.enable()
+                svc.reflector.flush_all(
+                    svc.cluster_store, skip_keys=svc._all_waiting_keys()
+                )
+            finally:
+                with svc._stream_cv:
+                    svc._stream_busy -= 1
+                    svc._stream_cv.notify_all()
         return self.results
+
+    def _park_for_pause(self) -> None:
+        """An exclusive store operation requested the pipeline idle:
+        count ONE drain under its reason, hand back the busy slot, and
+        block until the pause lifts.  Runs only at a wave boundary — the
+        pipeline is empty here, so the operation never interleaves with
+        an in-flight wave commit."""
+        svc = self.svc
+        with svc._stream_cv:
+            reason = svc._stream_pause_reason
+            if reason is None:
+                return
+            self._count_drain(reason)
+            svc._stream_busy -= 1
+            svc._stream_cv.notify_all()
+            # no timeout: the pauser's own wait is the bounded one — a
+            # parked session resuming early would re-enter dispatch
+            # inside the exclusive window, which is exactly the
+            # interleaving the gate exists to prevent
+            svc._stream_cv.wait_for(lambda: svc._stream_pause_reason is None)
+            svc._stream_busy += 1
 
     def _waves_left(self, in_flight: int = 0) -> bool:
         """May another streamed wave be DISPATCHED?  ``in_flight`` counts
@@ -425,6 +460,12 @@ class StreamSession:
         bank = 0
         while True:
             if flight is None:
+                # an exclusive store operation (snapshot load) may be
+                # waiting on the pipeline: park here, at the empty-
+                # pipeline boundary, until it finishes (counted drain)
+                if svc._stream_pause_reason is not None:
+                    self._park_for_pause()
+                    continue
                 # pipeline empty: admit and dispatch without overlap.
                 # The wave budget is checked BEFORE the admission tick —
                 # _admit() pulls the feed (side effects in the store), and
@@ -498,7 +539,12 @@ class StreamSession:
                 continue
 
             next_flight: "dict | None" = None
-            if (
+            if svc._stream_pause_reason is not None:
+                # an exclusive store operation is waiting: skip the
+                # overlap prefetch, commit wave k below, and park at the
+                # loop top (the drain is counted there)
+                pass
+            elif (
                 self.streaming
                 and self._waves_left(in_flight=1)
                 and svc.queue.has_unschedulable()

@@ -31,6 +31,39 @@ class DIContainer:
         autoscaler_opts: "dict | None" = None,
     ):
         self.cluster_store = cluster_store or ClusterStore()
+        # Durability boot (opt-in via KSS_JOURNAL_DIR, state/journal.py):
+        # recover any prior crash state into the store BEFORE any
+        # component subscribes (replay must not fire watch callbacks),
+        # then attach a fresh journal epoch so everything from the
+        # controllers onward is WAL-covered.  With the env unset this
+        # whole block is inert and the store behaves exactly as before.
+        from kube_scheduler_simulator_tpu.state.journal import Journal, journal_knobs
+
+        self._journal = None
+        _recovery_report = None
+        _jknobs = journal_knobs()
+        if _jknobs is not None:
+            from kube_scheduler_simulator_tpu.state.recovery import boot_recover
+
+            _recovery_report = boot_recover(_jknobs["directory"], self.cluster_store)
+            if (
+                _recovery_report is not None
+                and _recovery_report.scheduler_config is not None
+                and initial_scheduler_cfg is None
+            ):
+                # rebuild through the existing restart path with the
+                # last journaled configuration
+                initial_scheduler_cfg = _recovery_report.scheduler_config
+            self._journal = Journal(
+                _jknobs["directory"],
+                fsync=_jknobs["fsync"],
+                checkpoint_every=_jknobs["checkpoint_every"],
+            )
+            if _recovery_report is not None:
+                # the new epoch inherits the recovered resume point — a
+                # compaction before the next mark must not prune it
+                self._journal.last_mark = _recovery_report.last_mark
+            self.cluster_store.attach_journal(self._journal)
         # Controllers start before the scheduler (reference boot order,
         # simulator.go:32-106: apiserver → controllers → … → scheduler).
         from kube_scheduler_simulator_tpu.controllers import ControllerManager
@@ -44,7 +77,27 @@ class DIContainer:
             autoscale=autoscale,
             autoscaler_opts=autoscaler_opts,
         )
+        if self._journal is not None:
+            from kube_scheduler_simulator_tpu.state.recovery import (
+                scheduler_meta_provider,
+            )
+
+            self._journal.add_meta_provider(
+                scheduler_meta_provider(self._scheduler_service)
+            )
         self._scheduler_service.start_scheduler(initial_scheduler_cfg)
+        if self._journal is not None and _recovery_report is not None:
+            from kube_scheduler_simulator_tpu.state.recovery import (
+                restore_scheduler_state,
+            )
+
+            restore_scheduler_state(self._scheduler_service, _recovery_report)
+            # The 'config' record start_scheduler just journaled carries
+            # PRE-restore meta (zeroed counters, empty queue).  Stamp a
+            # boot record now so the journal's last meta reflects the
+            # restored state — a crash before the next mutation must not
+            # recover with reset rotation/queue state.
+            self.cluster_store.journal_append("boot", {"recovered": True})
         # KEP-140 operator: reconciles Scenario OBJECTS (created via the
         # kube-API group or resource routes) into finished runs; the
         # synchronous POST /api/v1/scenarios path works without it.
@@ -67,6 +120,14 @@ class DIContainer:
             self._simulator_operator = SimulatorOperator(self.cluster_store)
             self._simulator_operator.start()
         self._snapshot_service = SnapshotService(self.cluster_store, self._scheduler_service)
+        if self._journal is not None:
+            # periodic compaction reuses the snapshot service's
+            # ResourcesForSnap export as the checkpoint's resources field
+            from kube_scheduler_simulator_tpu.state.recovery import build_checkpoint
+
+            self._journal.checkpoint_provider = lambda: build_checkpoint(
+                self.cluster_store, self._snapshot_service
+            )
         # Reset captures the post-boot state (reference NewDIContainer order:
         # reset service is built at boot, capturing the initial keyspace).
         self._reset_service = ResetService(self.cluster_store, self._scheduler_service)
@@ -92,6 +153,8 @@ class DIContainer:
         self._scenario_operator.stop()
         self._controller_manager.stop()
         self._scheduler_service.stop_background()
+        if self._journal is not None:
+            self._journal.close()
 
     def scheduler_service(self) -> SchedulerService:
         return self._scheduler_service

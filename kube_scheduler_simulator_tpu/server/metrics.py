@@ -234,6 +234,17 @@ def render_metrics(di: Any) -> str:
             0,
             {"reason": "none"},
         )
+    # durability layer (state/journal.py + state/recovery.py): the
+    # write-ahead journal's write side and the last boot's recovery —
+    # all zeros when KSS_JOURNAL_DIR is unset (the default)
+    counter("journal_enabled", "1 while a write-ahead journal is attached to the cluster store (KSS_JOURNAL_DIR).", m["journal_enabled"], typ="gauge")
+    counter("journal_records_total", "Records appended to the write-ahead journal (one per mutation event, or one per atomic wave/gang/bulk transaction).", m["journal_records_total"])
+    counter("journal_bytes_written_total", "Bytes appended to journal segments (record headers + payloads).", m["journal_bytes_written_total"])
+    counter("journal_fsyncs_total", "Journal records synced to disk (KSS_JOURNAL_FSYNC=1).", m["journal_fsyncs_total"])
+    counter("checkpoint_compactions_total", "Journal compactions: checkpoint written (SnapshotService.snap shape + extras), segments rotated and pruned.", m["checkpoint_compactions_total"])
+    counter("recovery_replayed_records_total", "Journal records replayed into the store by the last boot-time recovery.", m["recovery_replayed_records_total"])
+    counter("recovery_truncated_records_total", "Torn journal tails truncated by recovery (counted, never raised; nonzero after a clean SIGKILL = bug).", m["recovery_truncated_records_total"])
+    counter("recovery_partial_gangs_total", "PodGroups observed partially bound at the recovery point (wave/gang records are atomic, so nonzero = bug).", m["recovery_partial_gangs_total"])
     # node-axis mesh sharding (ops/mesh.py): the scale axis across chips
     counter("shard_devices", "Devices in the node-axis sharding mesh (0 = single-device).", m["shard_devices"], typ="gauge")
     counter("sharded_dispatches_total", "Kernel dispatches executed with the node axis sharded over the mesh (main scan + victim search + estimator).", m["sharded_dispatches_total"])

@@ -127,7 +127,15 @@ class ResourceWatcherService:
                         backlog = self.cluster_store.events_since(kind, int(rv))
                     except ResourceExpiredError:
                         # 410 Gone analog: relist (RetryWatcher recovery,
-                        # reference resourcewatcher.go:128-134)
+                        # reference resourcewatcher.go:128-134).  Raised
+                        # both for COMPACTED versions (bounded log /
+                        # checkpoint compaction) and for versions NEWER
+                        # than the store's log — the crash-recovery case:
+                        # a client that watched the previous incarnation
+                        # holds a resourceVersion the re-numbered log
+                        # never issued, and resuming it silently would
+                        # let the client's dedup watermark drop real
+                        # events (state/recovery.py).
                         backlog = None
                     if backlog is None:
                         for obj in self.cluster_store.list(kind):

@@ -84,7 +84,27 @@ class SnapshotService:
 
         Apply order mirrors the reference: scheduler config restart →
         namespaces → {PCs, SCs, PVCs, Nodes, Pods} → PVs (ClaimRef UIDs
-        re-resolved against the freshly applied PVCs)."""
+        re-resolved against the freshly applied PVCs).
+
+        A load during an active streaming session would interleave this
+        wholesale reset with an in-flight wave commit — the whole body
+        runs under the scheduler's stream quiesce gate (every active
+        StreamSession drains to a wave boundary first, counted as a
+        ``"snapshot load"`` stream drain, and stays parked until the
+        load finishes)."""
+        import contextlib
+
+        pauser = getattr(self.scheduler_service, "pause_streams", None)
+        gate = pauser("snapshot load") if pauser is not None else contextlib.nullcontext()
+        with gate:
+            self._load_gated(resources, ignore_err, ignore_scheduler_configuration)
+
+    def _load_gated(
+        self,
+        resources: Obj,
+        ignore_err: bool,
+        ignore_scheduler_configuration: bool,
+    ) -> None:
         if not ignore_scheduler_configuration:
             cfg = resources.get("schedulerConfig")
             try:

@@ -7,6 +7,21 @@ from kube_scheduler_simulator_tpu.state.store import (
     AlreadyExistsError,
     ResourceExpiredError,
 )
+from kube_scheduler_simulator_tpu.state.journal import (
+    Journal,
+    JournalError,
+    journal_from_env,
+    journal_knobs,
+)
+from kube_scheduler_simulator_tpu.state.recovery import (
+    RecoveryManager,
+    RecoveryReport,
+    boot_recover,
+    build_checkpoint,
+    restore_scheduler_state,
+    scheduler_meta_provider,
+    write_mark,
+)
 
 __all__ = [
     "KINDS",
@@ -16,4 +31,15 @@ __all__ = [
     "NotFoundError",
     "AlreadyExistsError",
     "ResourceExpiredError",
+    "Journal",
+    "JournalError",
+    "journal_from_env",
+    "journal_knobs",
+    "RecoveryManager",
+    "RecoveryReport",
+    "boot_recover",
+    "build_checkpoint",
+    "restore_scheduler_state",
+    "scheduler_meta_provider",
+    "write_mark",
 ]

@@ -27,6 +27,7 @@ Design points:
 
 from __future__ import annotations
 
+import contextlib
 import copy
 import threading
 import time
@@ -208,6 +209,23 @@ class ClusterStore:
         self._evicted_rv: dict[str, int] = {k: 0 for k in KINDS}
         self._subscribers: list[tuple[frozenset[str], Callable[[Event], None]]] = []
         self._update_hooks: dict[str, list[Callable[[Obj, Obj], None]]] = {k: [] for k in KINDS}
+        # durability (state/journal.py, opt-in): with a journal attached,
+        # every emitted event becomes a WAL record; journal_txn groups a
+        # bulk operation's events into ONE atomic record.  recovery_stats
+        # is populated by state/recovery.py after a boot-time replay.
+        self.journal: Any = None
+        self.recovery_stats: "dict[str, int] | None" = None
+        # per-THREAD transaction buffer: a journal_txn groups only the
+        # events its own thread emits (other threads' concurrent
+        # mutations are their own transactions), and holding no lock
+        # across the txn body keeps the journal-on path from serializing
+        # every store reader behind a whole scheduling attempt
+        self._txn_local = threading.local()
+        # open transactions across ALL threads (guarded by the store
+        # lock): the journal's compaction gate — a checkpoint taken
+        # while a wave's mutations are applied but its atomic record
+        # unwritten would persist the half-applied wave
+        self._active_txns = 0
 
     # ------------------------------------------------------------------ infra
 
@@ -237,6 +255,132 @@ class ClusterStore:
         c = self._uid_counter
         return f"{c:08x}-0000-4000-8000-{c:012x}"
 
+    # ------------------------------------------------------------ durability
+
+    def attach_journal(self, journal: Any) -> None:
+        """Attach a write-ahead journal (state/journal.py): every event
+        emitted from now on becomes a durable record before the mutating
+        call returns.  Attach at boot, before concurrent mutators exist —
+        the ``self.journal is None`` fast paths are deliberately read
+        without the lock."""
+        with self._lock:
+            self.journal = journal
+            journal.add_meta_provider(lambda: {"counters": self.durability_counters()})
+            # one total order for records and their meta deltas, and no
+            # checkpoint while a transaction's events are unwritten
+            journal.append_lock = self._lock
+            journal.compaction_gate = self._no_open_txns
+
+    def _no_open_txns(self) -> bool:
+        # lock-free: invoked by Journal.compact with the store lock
+        # already held (journal.append_lock IS self._lock)
+        return self._active_txns == 0
+
+    def journal_append(self, rtype: str, extra: "Obj | None" = None) -> None:
+        """Append a non-event record (config/boot/mark) — the journal
+        itself serializes on the store lock via ``append_lock``."""
+        # lock-free: self.journal is written once at attach (boot) and
+        # never cleared; the append itself takes the store lock inside
+        if self.journal is not None:
+            self.journal.append(rtype, extra=extra)
+
+    @contextlib.contextmanager
+    def journal_txn(self, label: str = "txn"):
+        """Group every event THIS THREAD emits inside the block into ONE
+        atomic journal record (labelled ``label``) — the wave-atomicity
+        seam: a batch commit wave, a gang release, a bulk_update, a
+        sequential scheduling attempt each journal all-or-nothing, so
+        recovery can never observe them half-applied.  Nested
+        transactions flatten into the outermost.  The buffer is
+        thread-local and NO lock is held across the body — a journaled
+        deployment must not serialize every store reader behind a whole
+        scheduling attempt; individual mutations still buffer/write
+        under the store lock inside ``_emit``.  No journal = free no-op."""
+        # lock-free: self.journal is written once at attach (boot, before
+        # concurrent mutators exist) and never cleared — the journal-off
+        # fast path must not pay a lock round-trip per wave
+        if self.journal is None:
+            yield
+            return
+        tl = self._txn_local
+        depth = getattr(tl, "depth", 0)
+        if depth == 0:
+            tl.events = []
+            with self._lock:
+                self._active_txns += 1
+        tl.depth = depth + 1
+        try:
+            yield
+        finally:
+            tl.depth -= 1
+            if tl.depth == 0:
+                events, tl.events = tl.events, None
+                with self._lock:
+                    self._active_txns -= 1
+                    if events:
+                        self.journal.append(label, events=events)
+
+    def durability_counters(self) -> dict[str, int]:
+        """The store counters a byte-identical recovery must restore
+        (rides on every journal record's meta)."""
+        return {
+            "rv": self._rv,
+            "uid": self._uid_counter,
+            "gen": self._generate_name_counter,
+        }
+
+    def restore_durability_counters(self, counters: Mapping[str, int]) -> None:
+        with self._lock:
+            self._rv = max(self._rv, int(counters.get("rv", 0)))
+            self._uid_counter = max(self._uid_counter, int(counters.get("uid", 0)))
+            self._generate_name_counter = max(
+                self._generate_name_counter, int(counters.get("gen", 0))
+            )
+
+    def replay_object(self, kind: str, obj: Mapping[str, Any]) -> None:
+        """Recovery-only: place a checkpointed object into its bucket
+        VERBATIM — uid, resourceVersion and creationTimestamp preserved,
+        no admission, no events (pre-checkpoint history is compacted
+        away; ``expire_events_before`` makes stale watchers relist)."""
+        with self._lock:
+            o = _clone(dict(obj))
+            meta = o.setdefault("metadata", {})
+            if kind in NAMESPACED_KINDS:
+                meta.setdefault("namespace", "default")
+            self._bucket(kind)[_key(o)] = o
+            rv = int(meta.get("resourceVersion") or 0)
+            self._rv = max(self._rv, rv)
+
+    def replay_event(self, kind: str, type_: str, obj: Mapping[str, Any]) -> None:
+        """Recovery-only: re-apply one journaled event — bucket update
+        plus an event-log append (so watchers can resume from replayed
+        resourceVersions), WITHOUT notifying subscribers (recovery runs
+        before any component subscribes)."""
+        with self._lock:
+            bucket = self._bucket(kind)
+            o = _clone(dict(obj))
+            k = _key(o)
+            if type_ == EVENT_DELETED:
+                bucket.pop(k, None)
+            else:
+                bucket[k] = o
+            rv = int(o["metadata"].get("resourceVersion") or 0)
+            self._rv = max(self._rv, rv)
+            ev = Event(kind, type_, _clone(o), rv)
+            log = self._event_log[kind]
+            if log.maxlen is not None and len(log) == log.maxlen:
+                self._evicted_rv[kind] = log[0].resource_version
+            log.append(ev)
+
+    def expire_events_before(self, rv: int) -> None:
+        """Mark every kind's event log as compacted below ``rv``: a
+        watcher resuming from an older resourceVersion gets the
+        410-relist path (checkpoint compaction discards the journaled
+        events a checkpoint supersedes)."""
+        with self._lock:
+            for kind in KINDS:
+                self._evicted_rv[kind] = max(self._evicted_rv[kind], int(rv))
+
     def _emit(self, kind: str, type_: str, obj: Obj, old: Obj | None = None) -> None:
         # ONE clone serves the event log, subscribers, and update hooks:
         # consumers receive a shared read-only snapshot (all in-tree
@@ -255,6 +399,21 @@ class ClusterStore:
         if type_ == EVENT_MODIFIED and old is not None:
             for hook in list(self._update_hooks[kind]):
                 hook(old, ev.obj)
+        if self.journal is not None:
+            # WAL: the event is durable before the mutating call returns
+            # (or buffered for this thread's enclosing journal_txn's
+            # atomic record).  Written AFTER the synchronous
+            # subscriber/hook dispatch so the record's meta — read at
+            # write time — already reflects this event's own
+            # consequences (the scheduling queue's move, the reflector's
+            # bookkeeping): recovery restores process state from the
+            # last record's meta, and a meta snapshotted BEFORE dispatch
+            # would lose the final event's transitions to the crash.
+            triple = [kind, type_, ev.obj]
+            if getattr(self._txn_local, "depth", 0) > 0:
+                self._txn_local.events.append(triple)
+            else:
+                self.journal.append("event", events=[triple])
 
     def subscribe(self, kinds: Iterable[str], cb: Callable[[Event], None]) -> Callable[[], None]:
         """Register a synchronous event callback; returns an unsubscribe fn."""
@@ -297,6 +456,18 @@ class ClusterStore:
             if rv < self._evicted_rv[kind]:
                 raise ResourceExpiredError(
                     f"{kind}: resourceVersion {rv} expired (oldest retained > {self._evicted_rv[kind]})"
+                )
+            if rv > self._rv:
+                # A version this store never issued: the client watched a
+                # previous incarnation whose log tail died with it (crash
+                # recovery re-numbers from the last durable record).
+                # Resuming silently would replay versions the client
+                # already saw — and its dedup watermark would then drop
+                # the REAL events.  Same contract as an expired version:
+                # relist.
+                raise ResourceExpiredError(
+                    f"{kind}: resourceVersion {rv} is newer than this store's log "
+                    f"(current {self._rv}; recovered/re-numbered event log) — relist"
                 )
             return [e for e in self._event_log[kind] if e.resource_version > rv]
 
@@ -467,7 +638,10 @@ class ClusterStore:
         mutation order.  Returns the number of objects changed."""
         applied = 0
         events: list[tuple[str, Obj, Obj | None]] = []
-        with self._lock:
+        # one bulk-apply = one atomic journal record (nested waves — the
+        # batch commit pipeline's bind + flush_wave — flatten into their
+        # outer journal_txn)
+        with self.journal_txn("bulk"), self._lock:
             bucket = self._bucket(kind)
             for name, namespace, fn in mutations:
                 if kind in NAMESPACED_KINDS:
@@ -617,7 +791,8 @@ class ClusterStore:
             for k in apply_first + tuple(k for k in KINDS if k not in apply_first)
             if k not in preserved
         )
-        with self._lock:
+        # a restore is one atomic state transition — and one journal record
+        with self.journal_txn("restore"), self._lock:
             for kind in delete_order:
                 # Delete everything not in the target state.  Key
                 # computation must default the namespace exactly like

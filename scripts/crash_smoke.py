@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Crash-consistency smoke (tier-1): the process-kill adversary.
+
+A fixed churn scenario runs as a JOURNALED subprocess on the batch
+path (wave-atomic commit records, small commit waves, mid-run
+checkpoint compaction), is SIGKILLed at three seeded journal-record
+indices (early / middle / late), recovered in a fresh process, and
+finished — the recovered run's full annotation trail must be
+byte-identical to an uninterrupted run at every kill point, with
+
+- ``recovery_truncated_records_total == 0`` (a SIGKILL at a record
+  boundary never tears a record),
+- zero partially-committed waves observable (wave records are atomic —
+  divergence would expose one) and zero partially-bound gang groups,
+- compaction engaged at least once (the checkpoint + rotation path is
+  exercised, not just the flat log).
+
+Then the metrics wiring: a live in-process journaled service must
+surface the ``journal_*`` / ``checkpoint_*`` / ``recovery_*`` counters
+through ``/metrics`` (docs/durability.md).
+
+Exit 0 = crash parity holds; nonzero = divergence or harness failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:  # the axon plugin dials the TPU tunnel even when CPU-pinned
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+
+def _node(i: int) -> dict:
+    return {
+        "op": "create",
+        "kind": "nodes",
+        "object": {
+            "metadata": {"name": f"crn-{i}", "labels": {"zone": f"z{i % 2}"}},
+            "status": {
+                "allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"},
+                "capacity": {"cpu": "8", "memory": "16Gi", "pods": "110"},
+            },
+        },
+    }
+
+
+def _pod(i: int, cpu: str = "500m") -> dict:
+    return {
+        "op": "create",
+        "kind": "pods",
+        "object": {
+            "metadata": {"name": f"crp-{i}"},
+            "spec": {
+                "containers": [
+                    {"name": "c", "resources": {"requests": {"cpu": cpu, "memory": "256Mi"}}}
+                ]
+            },
+        },
+    }
+
+
+def smoke_scenario() -> dict:
+    """Fixed journaled-churn timeline: node adds, pod storms sized to
+    produce multiple commit waves (commit_wave=4), a pod delete, a node
+    delete, and a taint patch — every tick a different mutation class."""
+    return {
+        "name": "crash-smoke",
+        "features": ["churn"],
+        "stepSeconds": 1.0,
+        "profile": "default",
+        "ticks": [
+            [_node(0), _node(1)] + [_pod(i) for i in range(8)],
+            [_pod(i) for i in range(8, 14)]
+            + [{"op": "delete", "kind": "pods", "name": "crp-1", "namespace": "default"}],
+            [
+                _node(2),
+                {
+                    "op": "patch",
+                    "kind": "nodes",
+                    "name": "crn-0",
+                    "body": {"spec": {"unschedulable": True}},
+                },
+            ]
+            + [_pod(i) for i in range(14, 18)],
+            [
+                {"op": "delete", "kind": "nodes", "name": "crn-1"},
+                {
+                    "op": "patch",
+                    "kind": "nodes",
+                    "name": "crn-0",
+                    "body": {"spec": {"unschedulable": None}},
+                },
+                _pod(18),
+            ],
+        ],
+    }
+
+
+def main() -> int:
+    from kube_scheduler_simulator_tpu.fuzz.chaos import ProcessChaos
+
+    t0 = time.monotonic()
+    role = {"use_batch": "auto", "commit_wave": 4, "checkpoint_every": 10}
+    # seeds normalize against the baseline's record count: 5 lands
+    # early, the primes land mid/late (spread by modulo)
+    chaos = ProcessChaos(
+        smoke_scenario(), kill_records=(5, 19, 10**9 + 7), role=role, child_timeout_s=240
+    )
+    v = chaos.run()
+    print(
+        f"crash-smoke: records={v['records']} kill_points={v['kill_points']} "
+        f"replayed={v['replayed_records']} compactions={v['journal'].get('compactions')}"
+    )
+    if v["divergences"]:
+        print(
+            "crash-smoke FAIL: recovered run diverged at kill points "
+            f"{v['divergences']}: {json.dumps(v['first_mismatch'])[:4000]}",
+            file=sys.stderr,
+        )
+        return 1
+    if v["truncated_records"] != 0:
+        print(
+            f"crash-smoke FAIL: recovery_truncated_records_total={v['truncated_records']} "
+            "after clean SIGKILLs (records must never tear at a kill boundary)",
+            file=sys.stderr,
+        )
+        return 1
+    if v["partial_gangs"] != 0:
+        print(f"crash-smoke FAIL: {v['partial_gangs']} partially-bound gangs", file=sys.stderr)
+        return 1
+    if v["replayed_records"] <= 0:
+        print("crash-smoke FAIL: recovery never replayed a record", file=sys.stderr)
+        return 1
+    if int(v["journal"].get("compactions") or 0) <= 0:
+        print("crash-smoke FAIL: checkpoint compaction never engaged", file=sys.stderr)
+        return 1
+
+    # ---- metrics wiring: a live journaled service surfaces the counters
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.server.metrics import render_metrics
+    from kube_scheduler_simulator_tpu.state.journal import Journal
+    from kube_scheduler_simulator_tpu.state.recovery import RecoveryManager
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+    from kube_scheduler_simulator_tpu.utils.simclock import SimClock
+
+    with tempfile.TemporaryDirectory(prefix="kss-crash-metrics-") as td:
+        store = ClusterStore(clock=SimClock(1_700_000_000.0))
+        journal = Journal(td)
+        store.attach_journal(journal)
+        store.create("namespaces", {"metadata": {"name": "default"}})
+        store.create("nodes", {"metadata": {"name": "m1"}})
+        # recover the journaled history into a scratch store, then hang
+        # the recovery stats on the RENDERED store — the wiring under
+        # test is service.metrics() -> render_metrics surfacing them
+        store2 = ClusterStore(clock=SimClock(0.0))
+        store.recovery_stats = RecoveryManager(td).recover(store2).stats()
+        svc = SchedulerService(store, use_batch="off")
+        svc.start_scheduler(None)
+
+        class _DI:
+            cluster_store = store
+
+            def scheduler_service(self):
+                return svc
+
+        text = render_metrics(_DI())
+        for needle in (
+            "simulator_journal_records_total",
+            "simulator_journal_bytes_written_total",
+            "simulator_checkpoint_compactions_total",
+            "simulator_recovery_replayed_records_total",
+            "simulator_recovery_truncated_records_total",
+        ):
+            if needle not in text:
+                print(f"crash-smoke FAIL: /metrics missing {needle}", file=sys.stderr)
+                return 1
+        if "simulator_journal_records_total 0" in text:
+            print("crash-smoke FAIL: journaled service reports zero records", file=sys.stderr)
+            return 1
+        if "simulator_recovery_replayed_records_total 0" in text:
+            print("crash-smoke FAIL: recovery stats not surfaced in /metrics", file=sys.stderr)
+            return 1
+
+    wall = time.monotonic() - t0
+    print(
+        f"crash-smoke OK: {len(v['kill_points'])} kill points byte-identical after "
+        f"recovery ({v['records']} records, {v['replayed_records']} replayed, "
+        f"{v['journal'].get('compactions')} compactions, 0 torn, 0 partial waves/gangs), "
+        f"metrics wired; {wall:.0f}s"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -201,6 +201,78 @@ def main() -> int:
         print("fuzz-smoke: the mesh-stream leg never streamed a wave", file=sys.stderr)
         return 1
 
+    # ---- process-kill leg: the crash adversary (fuzz/chaos.py
+    # ProcessChaos + state/journal.py): a generated composite scenario
+    # runs journaled in a subprocess, is SIGKILLed at seeded
+    # journal-record indices, recovers in a fresh process, finishes, and
+    # must byte-match an uninterrupted subprocess run — with zero torn
+    # records and zero partially-bound gangs at the recovery point
+    from kube_scheduler_simulator_tpu.fuzz import ProcessChaos
+
+    crash_scn = generate_scenario(
+        knobs["seed"] + 10, 0, features=frozenset({"preemption", "churn", "retune"})
+    )
+    cv = ProcessChaos(
+        crash_scn, kill_records=(knobs["seed"] + 13, 7), child_timeout_s=240
+    ).run()
+    report["scenarios"] += 1
+    # second composite: gang × autoscale × churn — the features whose
+    # process state (parked quorums, unneeded-streak timers) burned the
+    # most recovery bugs during bring-up; one mid-run kill point
+    gang_scn = generate_scenario(
+        knobs["seed"] + 11, 0, features=frozenset({"gang", "autoscale", "churn"})
+    )
+    gv = ProcessChaos(gang_scn, kill_records=(55,), child_timeout_s=240).run()
+    report["scenarios"] += 1
+    if gv["divergences"] or gv["truncated_records"] or gv["partial_gangs"]:
+        print(
+            f"fuzz-smoke: gang ProcessChaos leg broke: div={gv['divergences']} "
+            f"torn={gv['truncated_records']} partial_gangs={gv['partial_gangs']}",
+            file=sys.stderr,
+        )
+        print(json.dumps(gv["first_mismatch"], indent=1)[:4000], file=sys.stderr)
+        return 1
+    if cv["truncated_records"] or cv["partial_gangs"]:
+        print(
+            f"fuzz-smoke: ProcessChaos invariants broke: torn={cv['truncated_records']} "
+            f"partial_gangs={cv['partial_gangs']}",
+            file=sys.stderr,
+        )
+        return 1
+    if cv["divergences"]:
+        print(
+            f"fuzz-smoke: ProcessChaos diverged at kill points {cv['divergences']}",
+            file=sys.stderr,
+        )
+        print(json.dumps(cv["first_mismatch"], indent=1)[:4000], file=sys.stderr)
+        report["divergences"]["process-crash"] = len(cv["divergences"])
+        # shrink through the SAME ddmin machinery as the differential
+        # legs — still_fails re-runs the whole kill/recover cycle, so the
+        # check budget is deliberately small (3 subprocesses per check);
+        # reproduce against the kill point that actually DIVERGED
+        kill_seed = cv["divergences"][0]
+
+        def crash_still_fails(s):
+            vv = ProcessChaos(s, kill_records=(kill_seed,), child_timeout_s=240).run()
+            return bool(vv["divergences"])
+
+        mini, sstats = shrink(crash_scn, crash_still_fails, max_checks=12)
+        report["shrink_steps"] += sstats["steps"]
+        path = f"/tmp/kss_fuzz_crash_{crash_scn['name']}.json"
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(
+                {"kinds": ["process-crash"], "kill_records": [kill_seed], "scenario": mini},
+                f,
+                sort_keys=True,
+                indent=2,
+            )
+        failures.append(
+            {"scenario": crash_scn["name"], "kinds": ["process-crash"], "repro": path}
+        )
+    if cv["replayed_records"] <= 0:
+        print("fuzz-smoke: ProcessChaos recovery replayed nothing", file=sys.stderr)
+        return 1
+
     # ---- metrics wiring: the sweep reports into a live service
     _store_m, svc_m = harness.service("default", "batch")
     svc_m.note_fuzz_report(report)
@@ -235,6 +307,8 @@ def main() -> int:
         f"fuzz-smoke OK: {report['scenarios']} scenarios, 0 unexplained divergences, "
         f"chaos degrade counted ({trips['n']} trips), shard leg sharded, "
         f"mesh-stream leg streamed {fuse_m['stream_waves_total']} sharded waves, "
+        f"process-crash leg byte-identical at kill points {cv['kill_points']} "
+        f"({cv['replayed_records']} records replayed, 0 torn), "
         f"{wall:.0f}s; coverage: {json.dumps(cov.summary())}"
     )
     return 0

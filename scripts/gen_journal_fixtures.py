@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""Regenerate the committed torn-write journal fixtures.
+
+``kube_scheduler_simulator_tpu/state/fixtures/`` holds three damaged
+journal directories with EXACT expected recovered bytes (the
+``analysis/`` / ``fuzz/fixtures/`` golden-fixture discipline — a
+recovery whose output drifts by one byte fails tier-1,
+tests/test_recovery.py):
+
+- ``torn-tail/``     — the last record cut mid-payload (a crash mid-write);
+  recovery must truncate it (counted) and land on the state BEFORE the
+  torn record's operation.
+- ``crc-flip/``      — one byte of a MIDDLE record's payload flipped;
+  recovery must stop at the bad CRC, truncating it and everything after.
+- ``stale-checkpoint/`` — a valid checkpoint plus newer journal records
+  after it, and a NEWER but corrupt checkpoint; recovery must count the
+  bad checkpoint, fall back to the valid one, and replay the tail.
+
+Every fixture's ``expected.json`` carries the full recovered store dump
+and counters, derived INDEPENDENTLY by re-applying the surviving
+operation prefix to a fresh store — not by replaying the damaged
+journal — so the expectation pins recovery against the semantics, not
+against itself.  Timelines run on SimClocks with fixed op sequences, so
+regeneration is byte-stable.
+
+Usage: python scripts/gen_journal_fixtures.py   (rewrites the fixtures)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kube_scheduler_simulator_tpu.state.journal import (  # noqa: E402
+    _HEADER,
+    Journal,
+    list_checkpoints,
+    list_segments,
+    read_records,
+)
+from kube_scheduler_simulator_tpu.state.recovery import (  # noqa: E402
+    RecoveryManager,
+    build_checkpoint,
+)
+from kube_scheduler_simulator_tpu.state.store import ClusterStore  # noqa: E402
+from kube_scheduler_simulator_tpu.utils.simclock import SimClock  # noqa: E402
+
+FIXTURE_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "kube_scheduler_simulator_tpu",
+    "state",
+    "fixtures",
+)
+
+
+def _ops() -> list:
+    """The flat fixture timeline: one op per journal record (no
+    transactions), so 'record k' maps 1:1 to 'op k'."""
+
+    def node(i):
+        return ("create", "nodes", {"metadata": {"name": f"fn-{i}"},
+                                    "status": {"allocatable": {"cpu": "4"}}})
+
+    def pod(i):
+        return ("create", "pods", {"metadata": {"name": f"fp-{i}"},
+                                   "spec": {"containers": [{"name": "c"}]}})
+
+    return [
+        ("create", "namespaces", {"metadata": {"name": "default"}}),
+        node(0),
+        node(1),
+        pod(0),
+        pod(1),
+        ("bind", "fp-0", "fn-0"),
+        ("patch", "pods", "fp-1", {"metadata": {"annotations": {"k": "v1"}}}),
+        pod(2),
+        ("delete", "pods", "fp-2"),
+        ("patch", "pods", "fp-1", {"metadata": {"annotations": {"k": "v2"}}}),
+        ("delete", "nodes", "fn-1"),
+    ]
+
+
+def _apply(store: ClusterStore, op: tuple) -> None:
+    kind = op[0]
+    if kind == "create":
+        store.create(op[1], op[2])
+    elif kind == "bind":
+        store.bind_pod("default", op[1], op[2])
+    elif kind == "patch":
+        store.patch(op[1], op[2], op[3], "default")
+    elif kind == "delete":
+        store.delete(op[1], op[2], "default")
+    else:  # pragma: no cover
+        raise ValueError(op)
+
+
+def _fresh_store() -> ClusterStore:
+    return ClusterStore(clock=SimClock(1_700_000_000.0))
+
+
+def _build(directory: str, n_ops: "int | None" = None, journal: "Journal | None" = None):
+    """Run the (prefix of the) timeline journaled into ``directory``."""
+    store = _fresh_store()
+    j = journal or Journal(directory)
+    store.attach_journal(j)
+    ops = _ops()[: n_ops if n_ops is not None else None]
+    for op in ops:
+        _apply(store, op)
+    j.close()
+    return store
+
+
+def _reference(n_ops: int) -> ClusterStore:
+    """The independent expectation: the first ``n_ops`` operations
+    applied to a plain, unjournaled store."""
+    store = _fresh_store()
+    for op in _ops()[:n_ops]:
+        _apply(store, op)
+    return store
+
+
+def _expected_doc(store: ClusterStore, stats: dict) -> dict:
+    return {
+        "stats": stats,
+        "resource_version": store.resource_version,
+        "counters": store.durability_counters(),
+        "dump": store.dump(),
+    }
+
+
+def _write_expected(directory: str, doc: dict) -> None:
+    with open(os.path.join(directory, "expected.json"), "w", encoding="utf-8") as f:
+        json.dump(doc, f, sort_keys=True, indent=1)
+        f.write("\n")
+
+
+def _record_offsets(path: str) -> list[int]:
+    """Byte offset of every intact record, in order."""
+    return [off for off, payload in read_records(path) if payload is not None]
+
+
+def gen_torn_tail(root: str) -> None:
+    d = os.path.join(root, "torn-tail")
+    shutil.rmtree(d, ignore_errors=True)
+    _build(d)
+    seg = list_segments(d)[-1][1]
+    offs = _record_offsets(seg)
+    # cut INSIDE the last record's payload: header intact, payload short
+    with open(seg, "r+b") as f:
+        f.truncate(offs[-1] + _HEADER.size + 5)
+    # expected: everything before the torn record (= all ops but the last)
+    ref = _reference(len(_ops()) - 1)
+    _write_expected(
+        d,
+        _expected_doc(
+            ref,
+            {
+                "replayed_records": len(_ops()) - 1,
+                "truncated_records": 1,
+                "bad_checkpoints": 0,
+                "checkpoint_loaded": 0,
+            },
+        ),
+    )
+
+
+def gen_crc_flip(root: str) -> None:
+    d = os.path.join(root, "crc-flip")
+    shutil.rmtree(d, ignore_errors=True)
+    _build(d)
+    seg = list_segments(d)[-1][1]
+    offs = _record_offsets(seg)
+    flip_record = 7  # 0-based: damage record #7 → records 0..6 survive
+    with open(seg, "r+b") as f:
+        f.seek(offs[flip_record] + _HEADER.size + 3)
+        b = f.read(1)
+        f.seek(offs[flip_record] + _HEADER.size + 3)
+        f.write(bytes([b[0] ^ 0x40]))
+    ref = _reference(flip_record)
+    _write_expected(
+        d,
+        _expected_doc(
+            ref,
+            {
+                "replayed_records": flip_record,
+                "truncated_records": 1,
+                "bad_checkpoints": 0,
+                "checkpoint_loaded": 0,
+            },
+        ),
+    )
+
+
+def gen_stale_checkpoint(root: str) -> None:
+    d = os.path.join(root, "stale-checkpoint")
+    shutil.rmtree(d, ignore_errors=True)
+    # run the first 6 ops, compact (checkpoint-2 + fresh segment-2),
+    # then run the remaining ops into segment-2
+    store = _fresh_store()
+    j = Journal(d)
+    store.attach_journal(j)
+    ops = _ops()
+    for op in ops[:6]:
+        _apply(store, op)
+    j.checkpoint_provider = lambda: build_checkpoint(store)
+    j.compact()
+    for op in ops[6:]:
+        _apply(store, op)
+    j.close()
+    # a NEWER but corrupt checkpoint: recovery must count it and fall
+    # back to the valid one + the journal tail
+    good = list_checkpoints(d)[-1][1]
+    bad = good.replace("00000002", "00000009")
+    shutil.copyfile(good, bad)
+    with open(bad, "r+b") as f:
+        f.seek(32)
+        b = f.read(1)
+        f.seek(32)
+        f.write(bytes([b[0] ^ 0x20]))
+    ref = _reference(len(ops))
+    _write_expected(
+        d,
+        _expected_doc(
+            ref,
+            {
+                "replayed_records": len(ops) - 6,
+                "truncated_records": 0,
+                "bad_checkpoints": 1,
+                "checkpoint_loaded": 1,
+            },
+        ),
+    )
+
+
+def verify(root: str) -> int:
+    """Replay each fixture (on a COPY — recovery truncates torn tails in
+    place) and diff against expected.json; the tier-1 test runs the same
+    check (tests/test_recovery.py)."""
+    rc = 0
+    for name in sorted(os.listdir(root)):
+        d = os.path.join(root, name)
+        if not os.path.isdir(d):
+            continue
+        with open(os.path.join(d, "expected.json"), encoding="utf-8") as f:
+            expected = json.load(f)
+        with tempfile.TemporaryDirectory() as td:
+            work = os.path.join(td, name)
+            shutil.copytree(d, work)
+            store = _fresh_store()
+            report = RecoveryManager(work).recover(store)
+            got = _expected_doc(
+                store,
+                {
+                    k: report.stats()[k]
+                    for k in (
+                        "replayed_records",
+                        "truncated_records",
+                        "bad_checkpoints",
+                        "checkpoint_loaded",
+                    )
+                },
+            )
+        if json.dumps(got, sort_keys=True) != json.dumps(expected, sort_keys=True):
+            print(f"FIXTURE MISMATCH: {name}", file=sys.stderr)
+            for k in ("stats", "resource_version", "counters"):
+                if got[k] != expected[k]:
+                    print(f"  {k}: got {got[k]} want {expected[k]}", file=sys.stderr)
+            if got["dump"] != expected["dump"]:
+                print("  dump differs", file=sys.stderr)
+            rc = 1
+        else:
+            print(f"fixture OK: {name}")
+    return rc
+
+
+def main() -> int:
+    os.makedirs(FIXTURE_ROOT, exist_ok=True)
+    gen_torn_tail(FIXTURE_ROOT)
+    gen_crc_flip(FIXTURE_ROOT)
+    gen_stale_checkpoint(FIXTURE_ROOT)
+    return verify(FIXTURE_ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,6 +45,12 @@ if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/shard_stream_smoke.p
 # divergence is shrunk, dumped to /tmp for triage, and fails tier-1.
 # Long-haul nightlies rerun it with KSS_FUZZ_BUDGET=<seconds>.
 if ! timeout -k 10 420 env JAX_PLATFORMS=cpu python scripts/fuzz_smoke.py; then rc=1; fi
+# Crash-consistency smoke (docs/durability.md): a journaled churn run
+# on the batch path, SIGKILLed at three seeded record indices,
+# recovered in fresh processes — byte parity vs uninterrupted,
+# recovery_truncated_records_total == 0, zero partial waves/gangs,
+# compaction engaged, /metrics wiring (scripts/crash_smoke.py).
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/crash_smoke.py; then rc=1; fi
 # Kernel-contract checker (docs/static-analysis.md): FIRST the fixture
 # self-test (every rule must fire on its known-bad fixtures and stay
 # silent on the good ones — a broken rule must not silently pass the

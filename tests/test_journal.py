@@ -1,0 +1,311 @@
+"""Write-ahead journal (state/journal.py): framing, atomicity, rotation.
+
+The crash-parity END-TO-END legs live in scripts/crash_smoke.py and the
+fuzz smoke's ProcessChaos leg (real SIGKILLed subprocesses); this suite
+pins the write-side mechanics in-process: record framing round-trips,
+transaction grouping (a commit wave / gang release / bulk_update is ONE
+atomic record), torn-tail detection, checkpoint rotation, and the env
+knob validation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+
+import pytest
+
+from kube_scheduler_simulator_tpu.state.journal import (
+    _HEADER,
+    Journal,
+    JournalError,
+    journal_knobs,
+    list_checkpoints,
+    list_segments,
+    read_records,
+)
+from kube_scheduler_simulator_tpu.state.recovery import RecoveryManager, build_checkpoint
+from kube_scheduler_simulator_tpu.state.store import ClusterStore, ResourceExpiredError
+from kube_scheduler_simulator_tpu.utils.simclock import SimClock
+
+
+def _store() -> ClusterStore:
+    return ClusterStore(clock=SimClock(1_700_000_000.0))
+
+
+def _records(directory: str) -> list[dict]:
+    out = []
+    for _idx, path in list_segments(directory):
+        for _off, payload in read_records(path):
+            assert payload is not None, "unexpected torn record"
+            out.append(payload)
+    return out
+
+
+# ------------------------------------------------------------------ framing
+
+
+def test_record_framing_roundtrip(tmp_path):
+    j = Journal(str(tmp_path))
+    j.append("event", events=[["pods", "ADDED", {"metadata": {"name": "a", "resourceVersion": "1"}}]])
+    j.append("mark", extra={"tick": 3})
+    j.close()
+    recs = _records(str(tmp_path))
+    assert [r["t"] for r in recs] == ["event", "mark"]
+    assert recs[0]["events"][0][2]["metadata"]["name"] == "a"
+    assert recs[1]["x"] == {"tick": 3}
+    assert j.stats["records"] == 2
+    assert j.stats["bytes"] > 0
+
+
+def test_deterministic_bytes(tmp_path):
+    """The same logical op sequence serializes to identical segment
+    bytes — what lets the torn-write fixtures commit exact files."""
+    paths = []
+    for sub in ("a", "b"):
+        d = tmp_path / sub
+        s = _store()
+        j = Journal(str(d))
+        s.attach_journal(j)
+        s.create("namespaces", {"metadata": {"name": "default"}})
+        s.create("pods", {"metadata": {"name": "p"}, "spec": {}})
+        j.close()
+        paths.append(list_segments(str(d))[0][1])
+    assert open(paths[0], "rb").read() == open(paths[1], "rb").read()
+
+
+def test_torn_tail_detected(tmp_path):
+    j = Journal(str(tmp_path))
+    j.append("event", events=[["pods", "ADDED", {"metadata": {"name": "a", "resourceVersion": "1"}}]])
+    j.close()
+    seg = list_segments(str(tmp_path))[0][1]
+    with open(seg, "ab") as f:
+        f.write(_HEADER.pack(999, 0) + b"short")
+    got = list(read_records(seg))
+    assert got[-1][1] is None  # torn marker
+    assert got[0][1] is not None
+
+
+def test_crc_flip_detected(tmp_path):
+    j = Journal(str(tmp_path))
+    j.append("event", events=[["pods", "ADDED", {"metadata": {"name": "a", "resourceVersion": "1"}}]])
+    j.close()
+    seg = list_segments(str(tmp_path))[0][1]
+    data = bytearray(open(seg, "rb").read())
+    data[-3] ^= 0x10
+    open(seg, "wb").write(bytes(data))
+    assert list(read_records(seg))[-1][1] is None
+
+
+# ----------------------------------------------------------------- atomicity
+
+
+def test_single_mutations_one_record_each(tmp_path):
+    s = _store()
+    s.attach_journal(Journal(str(tmp_path)))
+    s.create("namespaces", {"metadata": {"name": "default"}})
+    s.create("pods", {"metadata": {"name": "p"}, "spec": {}})
+    s.delete("pods", "p", "default")
+    recs = _records(str(tmp_path))
+    assert [r["t"] for r in recs] == ["event", "event", "event"]
+    # every record carries the store counters at its write
+    assert recs[-1]["meta"]["counters"]["rv"] == 3
+
+
+def test_txn_groups_into_one_atomic_record(tmp_path):
+    s = _store()
+    s.attach_journal(Journal(str(tmp_path)))
+    s.create("namespaces", {"metadata": {"name": "default"}})
+    s.create("nodes", {"metadata": {"name": "n"}})
+    s.create("pods", {"metadata": {"name": "p"}, "spec": {}})
+    with s.journal_txn("wave"):
+        s.bind_pod("default", "p", "n")
+        with s.journal_txn("inner"):  # nested txns flatten
+            s.patch("pods", "p", {"metadata": {"annotations": {"a": "1"}}}, "default")
+    recs = _records(str(tmp_path))
+    assert [r["t"] for r in recs] == ["event", "event", "event", "wave"]
+    wave = recs[-1]
+    assert len(wave["events"]) == 2
+    assert all(t == "MODIFIED" for _k, t, _o in wave["events"])
+
+
+def test_bulk_update_is_one_record(tmp_path):
+    s = _store()
+    s.attach_journal(Journal(str(tmp_path)))
+    s.create("namespaces", {"metadata": {"name": "default"}})
+    for i in range(3):
+        s.create("pods", {"metadata": {"name": f"p{i}"}, "spec": {}})
+    s.bulk_update(
+        "pods",
+        [(f"p{i}", "default", lambda cur: {**cur, "metadata": dict(cur["metadata"]), "spec": {**cur["spec"], "nodeName": "n"}}) for i in range(3)],
+    )
+    recs = _records(str(tmp_path))
+    assert recs[-1]["t"] == "bulk"
+    assert len(recs[-1]["events"]) == 3
+
+
+def test_empty_txn_writes_nothing(tmp_path):
+    s = _store()
+    s.attach_journal(Journal(str(tmp_path)))
+    with s.journal_txn("wave"):
+        pass
+    assert _records(str(tmp_path)) == []
+
+
+def test_no_journal_is_inert(tmp_path):
+    s = _store()
+    with s.journal_txn("wave"):
+        s.create("namespaces", {"metadata": {"name": "default"}})
+    assert s.journal is None and s.count("namespaces") == 1
+
+
+# ---------------------------------------------------------------- compaction
+
+
+def test_checkpoint_rotation_prunes_and_recovers(tmp_path):
+    s = _store()
+    j = Journal(str(tmp_path), checkpoint_every=4)
+    s.attach_journal(j)
+    j.checkpoint_provider = lambda: build_checkpoint(s)
+    s.create("namespaces", {"metadata": {"name": "default"}})
+    for i in range(9):
+        s.create("pods", {"metadata": {"name": f"p{i}"}, "spec": {}})
+    assert j.stats["compactions"] >= 2
+    segs = [i for i, _ in list_segments(str(tmp_path))]
+    cks = [i for i, _ in list_checkpoints(str(tmp_path))]
+    assert len(cks) == 1 and len(segs) == 1 and segs[0] == cks[0]
+    s2 = _store()
+    rep = RecoveryManager(str(tmp_path)).recover(s2)
+    assert rep.checkpoint_loaded
+    assert s2.dump() == s.dump()
+    assert s2.resource_version == s.resource_version
+
+
+def test_checkpoint_resources_is_resources_for_snap_shape(tmp_path):
+    """The checkpoint's ``resources`` field reuses SnapshotService.snap
+    — a ResourcesForSnap document the existing snapshot tooling could
+    import directly."""
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.services.snapshot import SnapshotService
+
+    s = _store()
+    svc = SchedulerService(s, use_batch="off", clock=SimClock(0.0))
+    svc.start_scheduler(None)
+    s.create("namespaces", {"metadata": {"name": "default"}})
+    s.create("nodes", {"metadata": {"name": "n"}})
+    ckpt = build_checkpoint(s, SnapshotService(s, svc))
+    assert set(ckpt["resources"]) == {
+        "pods", "nodes", "pvs", "pvcs", "storageClasses",
+        "priorityClasses", "namespaces", "schedulerConfig",
+    }
+    assert ckpt["resources"]["schedulerConfig"] is not None
+    # the filtered 'default' namespace is preserved losslessly in extra
+    assert any(
+        o["metadata"]["name"] == "default" for o in ckpt["extra"].get("namespaces", [])
+    )
+
+
+def test_fsync_knob_counts(tmp_path):
+    j = Journal(str(tmp_path), fsync=True)
+    j.append("mark", extra={"tick": 0})
+    assert j.stats["fsyncs"] == 1
+    j.close()
+
+
+# ---------------------------------------------------------------- env knobs
+
+
+def test_journal_knobs_default_off(monkeypatch):
+    monkeypatch.delenv("KSS_JOURNAL_DIR", raising=False)
+    assert journal_knobs() is None
+
+
+def test_journal_knobs_validation(monkeypatch, tmp_path):
+    monkeypatch.setenv("KSS_JOURNAL_DIR", str(tmp_path))
+    monkeypatch.setenv("KSS_JOURNAL_FSYNC", "1")
+    monkeypatch.setenv("KSS_CHECKPOINT_EVERY", "128")
+    knobs = journal_knobs()
+    assert knobs == {"directory": str(tmp_path), "fsync": True, "checkpoint_every": 128}
+    monkeypatch.setenv("KSS_CHECKPOINT_EVERY", "nope")
+    with pytest.raises(JournalError):
+        journal_knobs()
+    monkeypatch.setenv("KSS_CHECKPOINT_EVERY", "-1")
+    with pytest.raises(JournalError):
+        journal_knobs()
+
+
+# -------------------------------------------------- re-numbered log (watch)
+
+
+def test_events_since_future_rv_is_expired():
+    """A resourceVersion the store never issued (a recovered,
+    re-numbered log) must 410 so the watcher relists — resuming
+    silently would make the client's dedup watermark drop real events."""
+    s = _store()
+    s.create("namespaces", {"metadata": {"name": "default"}})
+    s.create("pods", {"metadata": {"name": "p"}, "spec": {}})
+    assert s.events_since("pods", 2) == []
+    with pytest.raises(ResourceExpiredError):
+        s.events_since("pods", 99)
+
+
+# ------------------------------------------------------------ batch wave WAL
+
+
+def test_batch_commit_wave_is_one_atomic_record(tmp_path):
+    """The wave-atomicity pin, in-process: a batch round's bulk commit
+    wave — result-store wave, binds, reflector flush_wave — lands as
+    ONE journal record whose events cover every pod's bind AND its
+    annotation write, so recovery can never see a half-committed wave."""
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.state.recovery import scheduler_meta_provider
+
+    s = _store()
+    svc = SchedulerService(
+        s, use_batch="auto", batch_min_work=0, tie_break="first", clock=SimClock(0.0)
+    )
+    j = Journal(str(tmp_path))
+    s.attach_journal(j)
+    j.add_meta_provider(scheduler_meta_provider(svc))
+    s.create("namespaces", {"metadata": {"name": "default"}})
+    svc.start_scheduler(None)
+    s.create(
+        "nodes",
+        {
+            "metadata": {"name": "wn"},
+            "status": {
+                "allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"},
+                "capacity": {"cpu": "8", "memory": "16Gi", "pods": "110"},
+            },
+        },
+    )
+    for i in range(4):
+        s.create(
+            "pods",
+            {
+                "metadata": {"name": f"wp{i}"},
+                "spec": {
+                    "containers": [
+                        {"name": "c", "resources": {"requests": {"cpu": "100m"}}}
+                    ]
+                },
+            },
+        )
+    results = svc.schedule_pending(max_rounds=2)
+    assert sum(1 for r in results.values() if r.success) == 4
+    assert svc.stats["batch_commits"] >= 1, svc.stats["batch_fallbacks"]
+    waves = [r for r in _records(str(tmp_path)) if r["t"] == "wave"]
+    assert waves, "no wave record journaled"
+    wave = waves[0]
+    # per pod: the bind MODIFIED + the annotation-flush MODIFIED, plus
+    # the wave's Scheduled events — all in the one record
+    pod_events = [e for e in wave["events"] if e[0] == "pods"]
+    names = {e[2]["metadata"]["name"] for e in pod_events}
+    assert names == {"wp0", "wp1", "wp2", "wp3"}
+    annotated = [
+        e for e in pod_events if (e[2]["metadata"].get("annotations") or {})
+    ]
+    assert len(annotated) == 4, "annotation flush must ride in the wave record"
+    # the record's meta carries the post-wave attempt counter
+    assert wave["meta"]["sched"]["default-scheduler"][0] == 4
