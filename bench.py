@@ -1516,6 +1516,193 @@ def run_shard_stream_report(
     return row
 
 
+def run_profile_report(N=600, per_tick=100, ticks=96, seed_bound=4000, runs=2, quick=False):
+    """cfg13-hostpath: the host-path takedown row (ISSUE 16) — the fused
+    (streamed) path vs the serial per-tick round loop on THE SAME host,
+    min-of-N walls, byte parity, and the per-wave stage profiler's
+    attribution of where the fused wall actually goes (ops/profile.py —
+    the always-on stamps this report simply reads back).  The acceptance
+    bar is fused ≥ 1.0x of serial single-device: the streamed pipeline's
+    overlap plus the capsule-resident annotation renderer must at least
+    pay for their own bookkeeping on a CPU host with no device shadow to
+    hide under.  Supersedes scripts/profile_cfg5.py: the stage table IS
+    the "where do the seconds go" answer, measured on the live paths
+    (streamed + capsule commit) instead of the pre-stream round loop.
+
+    When ``KSS_MESH_PROCESSES`` is set in the environment the fused leg
+    inherits it (engagement/fallback lands in the row's ``procmesh``
+    block); the default row runs without it."""
+    import collections
+
+    import jax
+
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+    from kube_scheduler_simulator_tpu.utils.parity import pod_parity_state
+
+    if quick:
+        ticks, seed_bound = 16, 800
+
+    def stamp(p, i):
+        p["metadata"]["creationTimestamp"] = (
+            f"2024-03-01T{i // 3600 % 24:02d}:{i // 60 % 60:02d}:{i % 60:02d}Z"
+        )
+        return p
+
+    def build():
+        rng = random.Random(7)
+        store = ClusterStore(clock=lambda: 1700000000.0)
+        for i in range(N):
+            store.create("nodes", mk_node(i))
+        settled = collections.deque()
+        for i in range(seed_bound):
+            p = stamp(mk_pod(1_000_000 + i, rng, spread=i % 3 == 0), i)
+            p["metadata"]["name"] = f"seed-{i}"
+            p["spec"]["nodeName"] = f"node-{i % N}"
+            store.create("pods", p)
+            settled.append(f"seed-{i}")
+        svc = SchedulerService(store, tie_break="first", use_batch="force")
+        svc.start_scheduler(None)
+        return svc, store, settled
+
+    def steady_feed(store, settled, n_ticks, start):
+        rng = random.Random(11 + start)
+        state = {"created": start}
+
+        def feed(tick: int) -> bool:
+            if tick >= n_ticks:
+                return False
+            fresh = []
+            for _ in range(per_tick):
+                i = state["created"]
+                state["created"] += 1
+                store.create("pods", stamp(mk_pod(i, rng, spread=i % 3 == 0), seed_bound + i))
+                fresh.append(f"pod-{i}")
+            for _ in range(min(per_tick, max(0, len(settled) - 2 * per_tick))):
+                nm = settled.popleft()
+                try:
+                    store.delete("pods", nm, "default")
+                except KeyError:
+                    pass
+            settled.extend(fresh)
+            return True
+
+        return feed
+
+    def run_mode(mode: str):
+        svc, store, settled = build()
+        # prime tick through the mode's own path (compile + cold encode)
+        if mode == "serial":
+            f = steady_feed(store, settled, 1, 0)
+            f(0)
+            svc.schedule_pending()
+        else:
+            svc.schedule_stream(feed=steady_feed(store, settled, 1, 0), streaming=True)
+        prof0 = svc.profiler.snapshot()  # prime-session spend to subtract
+        t0 = time.perf_counter()
+        if mode == "serial":
+            feed = steady_feed(store, settled, ticks, per_tick)
+            tick, alive, results = 0, True, {}
+            while alive:
+                alive = feed(tick)
+                tick += 1
+                results.update(svc.schedule_pending())
+        else:
+            results = svc.schedule_stream(
+                feed=steady_feed(store, settled, ticks, per_tick), streaming=True
+            )
+        wall = time.perf_counter() - t0
+        ok = sum(1 for r in results.values() if r.success)
+        return wall, ok, svc.metrics(), prof0, store
+
+    def stage_table(prof, prof0):
+        """Timed-window stage attribution: the snapshot minus the prime
+        session's spend, as {stage: {seconds, share_pct, stamps, max_s}}."""
+        base = {s: st["total_s"] for s, st in prof0.get("stages", {}).items()}
+        basec = {s: st["count"] for s, st in prof0.get("stages", {}).items()}
+        wall = prof["wall_s"] - prof0.get("wall_s", 0.0)
+        out = {}
+        for s, st in sorted(prof["stages"].items()):
+            sec = st["total_s"] - base.get(s, 0.0)
+            if st["count"] - basec.get(s, 0) <= 0 and sec < 1e-6:
+                continue
+            out[s] = {
+                "seconds": round(sec, 3),
+                "share_pct": round(100.0 * sec / wall, 1) if wall > 0 else 0.0,
+                "stamps": st["count"] - basec.get(s, 0),
+                "max_s": round(st["max_s"], 4),
+            }
+        return out, round(wall, 3)
+
+    rows: dict = {}
+    keep: dict = {}
+    for mode in ("serial", "fused"):
+        for _ in range(runs):
+            wall, ok, m, prof0, store = run_mode(mode)
+            rows.setdefault(mode, []).append((wall, ok))
+            if wall == min(w for w, _ in rows[mode]):
+                keep[mode] = (m, prof0, store)
+
+    walls = {mode: min(w for w, _ in rs) for mode, rs in rows.items()}
+    scheduled = {mode: rs[0][1] for mode, rs in rows.items()}
+    m_fused, prof0_fused, store_fused = keep["fused"]
+    m_serial, prof0_serial, store_serial = keep["serial"]
+    stages_fused, prof_wall_fused = stage_table(m_fused["profile"], prof0_fused)
+    stages_serial, prof_wall_serial = stage_table(m_serial["profile"], prof0_serial)
+
+    d_fused = pod_parity_state(store_fused)
+    d_serial = pod_parity_state(store_serial)
+    keys = set(d_fused) | set(d_serial)
+    mismatches = sum(1 for k in keys if d_fused.get(k) != d_serial.get(k))
+
+    for label, stages, wall in (
+        ("serial", stages_serial, walls["serial"]),
+        ("fused", stages_fused, walls["fused"]),
+    ):
+        print(f"[profile] {label} wall {wall:.2f}s — where it goes:", file=sys.stderr)
+        for s, st in sorted(stages.items(), key=lambda kv: -kv[1]["seconds"]):
+            print(
+                f"[profile]   {s:<16} {st['seconds']:>8.3f}s  {st['share_pct']:>5.1f}%"
+                f"  ({st['stamps']} stamps, max {st['max_s']:.4f}s)",
+                file=sys.stderr,
+            )
+
+    row = {
+        "config": "cfg13-hostpath",
+        "kernel_platform": jax.default_backend(),
+        "nodes": N,
+        "seed_bound": seed_bound,
+        "per_tick": per_tick,
+        "ticks": ticks,
+        "runs_per_mode": runs,
+        "scheduled": scheduled["fused"],
+        "wall_s_serial": round(walls["serial"], 2),
+        "wall_s_fused": round(walls["fused"], 2),
+        # the ISSUE 16 acceptance bar: >= 1.0 on this same CPU host
+        "fused_speedup_vs_serial": round(walls["serial"] / walls["fused"], 2),
+        "pods_per_s_serial": round(scheduled["serial"] / walls["serial"], 1),
+        "pods_per_s_fused": round(scheduled["fused"] / walls["fused"], 1),
+        # per-wave stage attribution over the timed window (prime
+        # excluded); stage seconds sum to the profiled wall by
+        # construction (host_other is the derived remainder)
+        "profile_stages_fused": stages_fused,
+        "profile_stages_serial": stages_serial,
+        "profile_wall_s_fused": prof_wall_fused,
+        "profile_wall_s_serial": prof_wall_serial,
+        "stream_waves_total": m_fused["stream_waves_total"],
+        "stream_overlap_s": round(m_fused["stream_overlap_s"], 3),
+        "stream_stall_s": round(m_fused["stream_stall_s"], 3),
+        "procmesh": m_fused.get("procmesh"),
+        "parity_pods_compared": len(keys),
+        "parity_mismatches_fused_vs_serial": mismatches,
+        "parity_note": (
+            "bindings+annotations+conditions byte-compared, streamed fused "
+            "path vs serial per-tick round loop, identical deterministic feed"
+        ),
+    }
+    return row
+
+
 def _mean_annotation_bytes(store) -> int:
     total = n = 0
     for p in store.list("pods", copy_objects=False):
@@ -1857,7 +2044,20 @@ def main() -> None:
         action="store_true",
         help="run cfg12-shard-stream (50k-node sustained churn stream, sharded + streamed vs serial single-device byte parity) and write BENCH_shard_stream.json",
     )
+    ap.add_argument(
+        "--profile-report",
+        action="store_true",
+        help="run cfg13-hostpath (fused streamed path vs serial round loop on this host, with the per-wave stage profiler's attribution table) and write BENCH_hostpath.json",
+    )
     args = ap.parse_args()
+
+    if args.profile_report:
+        rows = [run_profile_report(quick=args.quick)]
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_hostpath.json")
+        with open(path, "w") as f:
+            json.dump(rows, f, indent=1)
+        print(json.dumps(rows, indent=1))
+        return
 
     if args.shard_stream_report:
         # the virtual mesh needs multiple CPU devices; must be set before

@@ -1246,6 +1246,199 @@ done:
     return rc;
 }
 
+/* ----------------------------------------------- batched wave rendering */
+
+/* wave_filter_many(cap, starts_i64[M], procs_i64[M], fail_row_i64|None,
+ *                  fail_ids_i64|None, fail_uidx_i64|None, ftable|None)
+ *     -> list[str]  (one plain filter document per row)
+ *
+ * The whole commit wave's filter documents in ONE call — replaces the
+ * per-pod wave_filter_json loop (3 Python->C transitions + row slicing
+ * per pod) on the commit path.  Failure entries arrive concatenated in
+ * ascending row order (fail_row[i] names the row each (id, uidx) pair
+ * belongs to); fail_uidx indexes the SHARED fragment table, deduped
+ * across the wave by the caller. */
+static PyObject *py_wave_filter_many(PyObject *self, PyObject *args) {
+    PyObject *cap, *starts_o, *procs_o, *frow_o, *fids_o, *fuidx_o, *ftable;
+    Wave *w;
+    Py_buffer st_v = {0}, pr_v = {0}, fr_v = {0}, fi_v = {0}, fu_v = {0};
+    const long long *starts = NULL, *procs = NULL, *frow = NULL,
+                    *fids = NULL, *fuidx = NULL;
+    Py_ssize_t M = 0, M2 = 0, NF = 0, NF2 = 0, NF3 = 0, TBL = 0, m, c = 0;
+    Frag *ftab = NULL;
+    PyObject *out = NULL, *docs = NULL;
+    int nonascii_tab = 0;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OOOOOOO", &cap, &starts_o, &procs_o, &frow_o,
+                          &fids_o, &fuidx_o, &ftable))
+        return NULL;
+    if (!(w = wave_arg(cap))) return NULL;
+    if (get_i64(starts_o, &st_v, &starts, &M) < 0) return NULL;
+    if (get_i64(procs_o, &pr_v, &procs, &M2) < 0) goto done;
+    if (get_i64(frow_o, &fr_v, &frow, &NF) < 0) goto done;
+    if (get_i64(fids_o, &fi_v, &fids, &NF2) < 0) goto done;
+    if (get_i64(fuidx_o, &fu_v, &fuidx, &NF3) < 0) goto done;
+    if (M != M2 || NF != NF2 || NF != NF3) {
+        PyErr_SetString(PyExc_ValueError, "wave_filter_many: length mismatch");
+        goto done;
+    }
+    if (ftable != Py_None) {
+        TBL = PyList_Check(ftable) ? PyList_GET_SIZE(ftable) : -1;
+        if (TBL < 0) {
+            PyErr_SetString(PyExc_TypeError, "wave_filter_many: ftable must be a list");
+            goto done;
+        }
+        if (TBL && !(ftab = resolve_frags(ftable, TBL, &nonascii_tab))) goto done;
+    }
+    docs = PyList_New(M);
+    if (!docs) goto done;
+    for (m = 0; m < M; m++) {
+        Py_ssize_t c0, sz = 0;
+        Buf b;
+        PyObject *s;
+        if (c < NF && frow[c] < m) {
+            PyErr_SetString(PyExc_ValueError,
+                            "wave_filter_many: fail rows not ascending");
+            goto done;
+        }
+        c0 = c;
+        while (c < NF && frow[c] == m) c++;
+        if (wave_filter_core(NULL, w, 0, starts[m], procs[m], fids + c0,
+                             fuidx + c0, c - c0, ftab, TBL, &sz) < 0)
+            goto done;
+        if (buf_init(&b, sz) < 0) goto done;
+        if (w->nonascii || nonascii_tab) b.nonascii = 1;
+        if (wave_filter_core(&b, w, 0, starts[m], procs[m], fids + c0,
+                             fuidx + c0, c - c0, ftab, TBL, NULL) < 0) {
+            buf_release(&b);
+            goto done;
+        }
+        s = buf_take(&b);
+        if (!s) goto done;
+        PyList_SET_ITEM(docs, m, s);
+    }
+    if (c != NF) {
+        /* leftover entries: rows out of range or not ascending */
+        PyErr_SetString(PyExc_ValueError, "wave_filter_many: unconsumed fail rows");
+        goto done;
+    }
+    out = docs;
+    docs = NULL;
+done:
+    Py_XDECREF(docs);
+    PyMem_Free(ftab);
+    if (st_v.obj) PyBuffer_Release(&st_v);
+    if (pr_v.obj) PyBuffer_Release(&pr_v);
+    if (fr_v.obj) PyBuffer_Release(&fr_v);
+    if (fi_v.obj) PyBuffer_Release(&fi_v);
+    if (fu_v.obj) PyBuffer_Release(&fu_v);
+    return out;
+}
+
+/* wave_score_many(cap, which, counts_i64[M], ns2d_i64[M*T], perm2d_i64[M*T],
+ *                 inv2d_bufs) -> list[str]
+ *
+ * The wave's score (which=0) or finalScore (which=1) documents in ONE
+ * call.  ns2d/perm2d are row-major [M, T] int64 matrices (T inferred);
+ * row m uses its first counts[m] columns.  inv2d_bufs: K contiguous
+ * [M, W] int64 matrices (np.unique inverse rows, gathered per rendered
+ * pod).  A row with counts[m]==0 emits "{}". */
+static PyObject *py_wave_score_many(PyObject *self, PyObject *args) {
+    PyObject *cap, *cnt_o, *ns_o, *perm_o, *inv_o;
+    int which;
+    Wave *w;
+    Py_buffer cnt_v = {0}, ns_v = {0}, perm_v = {0};
+    Py_buffer *views = NULL;
+    const long long *cnt = NULL, *ns = NULL, *perm = NULL;
+    const long long **inv = NULL;
+    Py_ssize_t *inv_n = NULL;
+    const long long **inv_row = NULL;
+    Py_ssize_t *inv_w = NULL;
+    Py_ssize_t M = 0, NT = 0, NT2 = 0, T = 0, W = 0, m, k;
+    PyObject *out = NULL, *docs = NULL;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OiOOOO", &cap, &which, &cnt_o, &ns_o, &perm_o, &inv_o))
+        return NULL;
+    if (!(w = wave_arg(cap))) return NULL;
+    views = (Py_buffer *)PyMem_Calloc((size_t)(w->K > 0 ? w->K : 1), sizeof(Py_buffer));
+    inv = (const long long **)PyMem_Calloc((size_t)(w->K > 0 ? w->K : 1), sizeof(long long *));
+    inv_n = (Py_ssize_t *)PyMem_Calloc((size_t)(w->K > 0 ? w->K : 1), sizeof(Py_ssize_t));
+    inv_row = (const long long **)PyMem_Calloc((size_t)(w->K > 0 ? w->K : 1), sizeof(long long *));
+    inv_w = (Py_ssize_t *)PyMem_Calloc((size_t)(w->K > 0 ? w->K : 1), sizeof(Py_ssize_t));
+    if (!views || !inv || !inv_n || !inv_row || !inv_w) {
+        PyErr_NoMemory();
+        goto done;
+    }
+    if (get_i64(cnt_o, &cnt_v, &cnt, &M) < 0) goto done;
+    if (get_i64(ns_o, &ns_v, &ns, &NT) < 0) goto done;
+    if (get_i64(perm_o, &perm_v, &perm, &NT2) < 0) goto done;
+    if (NT != NT2 || (M > 0 && NT % M != 0)) {
+        PyErr_SetString(PyExc_ValueError, "wave_score_many: ns/perm shape mismatch");
+        goto done;
+    }
+    T = M > 0 ? NT / M : 0;
+    if (wave_score_invs(inv_o, w->K, views, inv, inv_n) < 0) goto done;
+    if (w->K > 0 && M > 0) {
+        if (inv_n[0] % M != 0) {
+            PyErr_SetString(PyExc_ValueError, "wave_score_many: inv shape mismatch");
+            goto done;
+        }
+        W = inv_n[0] / M;
+        for (k = 0; k < w->K; k++) {
+            if (inv_n[k] != M * W) {
+                PyErr_SetString(PyExc_ValueError, "wave_score_many: inv shape mismatch");
+                goto done;
+            }
+        }
+    }
+    docs = PyList_New(M);
+    if (!docs) goto done;
+    for (m = 0; m < M; m++) {
+        Py_ssize_t Tm = (Py_ssize_t)cnt[m], sz = 0;
+        Buf b;
+        PyObject *s;
+        if (Tm < 0 || Tm > T) {
+            PyErr_SetString(PyExc_IndexError, "wave_score_many: count out of range");
+            goto done;
+        }
+        for (k = 0; k < w->K; k++) {
+            inv_row[k] = inv[k] + m * W;
+            inv_w[k] = W;
+        }
+        if (wave_score_core(NULL, w, 0, which, ns + m * T, perm + m * T, Tm,
+                            inv_row, inv_w, &sz) < 0)
+            goto done;
+        if (buf_init(&b, sz) < 0) goto done;
+        if (w->nonascii) b.nonascii = 1;
+        if (buf_putc(&b, '{') < 0 ||
+            wave_score_core(&b, w, 0, which, ns + m * T, perm + m * T, Tm,
+                            inv_row, inv_w, NULL) < 0 ||
+            buf_putc(&b, '}') < 0) {
+            buf_release(&b);
+            goto done;
+        }
+        s = buf_take(&b);
+        if (!s) goto done;
+        PyList_SET_ITEM(docs, m, s);
+    }
+    out = docs;
+    docs = NULL;
+done:
+    Py_XDECREF(docs);
+    if (cnt_v.obj) PyBuffer_Release(&cnt_v);
+    if (ns_v.obj) PyBuffer_Release(&ns_v);
+    if (perm_v.obj) PyBuffer_Release(&perm_v);
+    if (views)
+        for (k = 0; k < w->K; k++)
+            if (views[k].obj) PyBuffer_Release(&views[k]);
+    PyMem_Free(views);
+    PyMem_Free(inv);
+    PyMem_Free(inv_n);
+    PyMem_Free(inv_row);
+    PyMem_Free(inv_w);
+    return out;
+}
+
 /* ------------------------------------------------- lazy history assembly */
 
 /* Emit the history-escaped body of a filter annotation STRAIGHT into the
@@ -1579,6 +1772,10 @@ static PyMethodDef methods[] = {
      "plain filter annotation JSON from a wave capsule's tables"},
     {"wave_score_json", py_wave_score_json, METH_VARARGS,
      "plain score/finalScore annotation JSON from a wave capsule's LUTs"},
+    {"wave_filter_many", py_wave_filter_many, METH_VARARGS,
+     "a whole commit wave's filter documents in one call"},
+    {"wave_score_many", py_wave_score_many, METH_VARARGS,
+     "a whole commit wave's score/finalScore documents in one call"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -1,0 +1,351 @@
+"""Multi-process shard workers — the ``KSS_MESH_PROCESSES`` opt-in.
+
+``KSS_MESH_PROCESSES=N`` (N >= 1) asks the batch engine to execute its
+scan dispatches on an ensemble of N ``jax.distributed`` worker
+PROCESSES instead of the in-process virtual mesh.  The topology is
+dictated by a jax constraint: ``jax.distributed.initialize`` must run
+before the process's backends initialize, and the scheduler's own
+process initialized its backend long ago — so the parent can never join
+the ensemble.  Every member (including process 0) is a subprocess
+(``ops/procmesh_worker.py``, reusing the crash-child env-pinning
+plumbing), the parent orchestrates over pipes, and worker 0 gathers the
+ensemble's outputs back to the parent.  Workers resolve their scan
+executables exclusively from the PR-11 AOT artifact cache — they load,
+never compile, so the RecompileGuard invariant (0 steady-state
+recompiles) holds across the ensemble by construction.
+
+The pool ENGAGES only after a three-stage bring-up, each stage a
+counted fallback to the virtual mesh when it fails (``KSS_MESH_DEVICES``
+behavior is untouched by a fallback):
+
+1. spawn + ``jax.distributed`` init handshake from every worker;
+2. the collectives probe — a sharded device_put + process_allgather
+   round-trip.  This is the load-bearing gate: on jax CPU backends
+   ``initialize()`` succeeds but "Multiprocess computations aren't
+   implemented", which only a real cross-process computation reveals;
+3. per-scan AOT artifact resolution on every worker (a missing or
+   version-rejected artifact is "artifact_missing", not a compile).
+
+Dispatch is ASYNC, mirroring the device's: ``run`` writes the command
+frames and returns a handle; reading the reply is the fetch, so the
+streamed path's overlap (wave k+1 encoding while wave k runs in the
+ensemble) carries over.  ``snapshot()`` feeds ``metrics()["procmesh"]``
+and the /metrics renderer; every fallback reason is counted there.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import select
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Any
+
+from kube_scheduler_simulator_tpu.ops.procmesh_worker import read_frame, write_frame
+
+_ENV = "KSS_MESH_PROCESSES"
+
+
+def procs_from_env() -> int:
+    """The ``KSS_MESH_PROCESSES`` knob: 0 = disabled (default)."""
+    raw = os.environ.get("KSS_MESH_PROCESSES", "").strip()
+    if not raw:
+        return 0
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(f"{_ENV} must be a positive integer, got {raw!r}")
+    if n < 0:
+        raise ValueError(f"{_ENV} must be >= 0, got {n}")
+    return n
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _Worker:
+    """One ensemble member: the subprocess plus its two pipe ends."""
+
+    def __init__(self, rank: int, nprocs: int, coordinator: str):
+        r, w = os.pipe()
+        os.set_inheritable(w, True)
+        env = dict(os.environ)
+        # the worker pins its own platform from the parent's; never let a
+        # stale device-count flag force a virtual mesh inside the worker
+        flags = env.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = " ".join(
+            f for f in flags.split() if "xla_force_host_platform_device_count" not in f
+        )
+        self.proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "kube_scheduler_simulator_tpu.ops.procmesh_worker",
+                "--rank", str(rank),
+                "--nprocs", str(nprocs),
+                "--coordinator", coordinator,
+                "--out-fd", str(w),
+            ],
+            stdin=subprocess.PIPE,
+            pass_fds=(w,),
+            env=env,
+            cwd=os.getcwd(),
+        )
+        os.close(w)
+        self.rank = rank
+        self.rfd = r
+        self.rfile = os.fdopen(r, "rb")
+
+    def send(self, msg: dict) -> None:
+        write_frame(self.proc.stdin, msg)
+
+    def recv(self, deadline: float) -> "dict | None":
+        """One reply frame, or None on timeout/EOF/dead worker."""
+        while True:
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                return None
+            ready, _, _ = select.select([self.rfd], [], [], min(budget, 0.25))
+            if ready:
+                try:
+                    return read_frame(self.rfile)
+                except Exception:
+                    return None
+            if self.proc.poll() is not None:
+                return None
+
+    def kill(self) -> None:
+        try:
+            if self.proc.stdin:
+                self.proc.stdin.close()
+        except Exception:
+            pass
+        try:
+            self.proc.kill()
+            self.proc.wait(timeout=5)
+        except Exception:
+            pass
+        try:
+            self.rfile.close()
+        except Exception:
+            pass
+
+
+class ProcMeshPool:
+    """The live ensemble: lockstep command broadcast, rank-0 data plane.
+
+    Single-dispatcher discipline (the scheduling thread drives it, like
+    the device queue it stands in for); ``_mu`` only guards teardown
+    racing a dispatch from the metrics/atexit paths."""
+
+    def __init__(self, nprocs: int, timeout_s: float):
+        self.nprocs = nprocs
+        self.timeout_s = timeout_s
+        self.coordinator = f"127.0.0.1:{_free_port()}"
+        self.workers: list[_Worker] = []
+        self.dead = False
+        self.dispatches = 0
+        self.loaded: set[str] = set()
+        self._mu = threading.Lock()
+        self._inflight = 0
+
+    # ----------------------------------------------------------- bring-up
+
+    def start(self) -> "str | None":
+        """Spawn + handshake + collectives probe; returns a fallback
+        reason (pool unusable, already torn down) or None (engaged)."""
+        deadline = time.monotonic() + self.timeout_s
+        try:
+            self.workers = [
+                _Worker(i, self.nprocs, self.coordinator) for i in range(self.nprocs)
+            ]
+        except Exception as e:
+            self.close()
+            return f"spawn_failed: {type(e).__name__}"
+        for w in self.workers:
+            hello = w.recv(deadline)
+            if not hello or not hello.get("ok"):
+                reason = (hello or {}).get("reason", "init timeout")
+                self.close()
+                return f"distributed_init_unavailable: {reason}"
+        replies = self._lockstep({"cmd": "probe"}, deadline=deadline)
+        if replies is None:
+            self.close()
+            return "probe_timeout"
+        bad = [r for r in replies if not r.get("ok")]
+        if bad:
+            self.close()
+            return f"collectives_unavailable: {bad[0].get('reason', '?')}"
+        return None
+
+    def _lockstep(self, msg: dict, deadline: "float | None" = None) -> "list[dict] | None":
+        """Broadcast one command; collect one reply per worker in rank
+        order.  None (and a dead pool) on any timeout/EOF."""
+        if self.dead:
+            return None
+        if deadline is None:
+            deadline = time.monotonic() + self.timeout_s
+        try:
+            for w in self.workers:
+                w.send(msg)
+        except Exception:
+            self.close()
+            return None
+        out = []
+        for w in self.workers:
+            r = w.recv(deadline)
+            if r is None:
+                self.close()
+                return None
+            out.append(r)
+        return out
+
+    # ----------------------------------------------------------- dispatch
+
+    def load_scan(self, key: str, meta: dict, cache_dir: str) -> "str | None":
+        """Resolve the scan's AOT artifact on every worker; returns a
+        fallback reason or None.  Memoized per pool."""
+        if key in self.loaded:
+            return None
+        replies = self._lockstep(
+            {"cmd": "load_scan", "key": key, "meta": meta, "cache_dir": cache_dir}
+        )
+        if replies is None:
+            return "worker_lost"
+        bad = [r for r in replies if not r.get("ok")]
+        if bad:
+            return str(bad[0].get("reason", "artifact_missing"))
+        self.loaded.add(key)
+        return None
+
+    def run(self, key: str, host_dp: Any) -> "_PendingRun | None":
+        """ASYNC dispatch: write the command frames and return a handle
+        (the fetch blocks in ``_PendingRun.fetch``).  None when the pool
+        died mid-write."""
+        if self.dead or self._inflight:
+            return None
+        try:
+            for w in self.workers:
+                w.send({"cmd": "run", "key": key, "dp": host_dp})
+        except Exception:
+            self.close()
+            return None
+        self.dispatches += 1
+        self._inflight = 1
+        return _PendingRun(self)
+
+    def close(self) -> None:
+        with self._mu:
+            if self.dead:
+                return
+            self.dead = True
+        for w in self.workers:
+            w.kill()
+
+    def snapshot(self) -> dict:
+        return {
+            "processes": self.nprocs,
+            "engaged": int(not self.dead),
+            "dispatches": self.dispatches,
+            "scans_loaded": len(self.loaded),
+        }
+
+
+class _PendingRun:
+    """The in-flight ensemble dispatch; ``fetch`` is the block point."""
+
+    def __init__(self, pool: ProcMeshPool):
+        self.pool = pool
+
+    def fetch(self) -> "Any | None":
+        pool = self.pool
+        pool._inflight = 0
+        deadline = time.monotonic() + pool.timeout_s
+        out = None
+        for w in pool.workers:
+            r = w.recv(deadline)
+            if r is None or not r.get("ok"):
+                pool.close()
+                return None
+            if w.rank == 0:
+                out = r.get("out")
+        return out
+
+
+# --------------------------------------------------------- module state
+
+_LOCK = threading.Lock()
+_POOL: "ProcMeshPool | None" = None
+_VERDICT: "str | None" = None  # memoized bring-up fallback reason
+_STATS = {
+    "requested_processes": 0,
+    "fallbacks_by_reason": {},  # type: dict[str, int]
+    "run_fallbacks_by_reason": {},  # type: dict[str, int]
+}
+
+
+def _count(table: str, reason: str) -> None:
+    d = _STATS[table]
+    d[reason] = d.get(reason, 0) + 1
+
+
+def acquire() -> "ProcMeshPool | None":
+    """The engine's entry point: the shared pool when
+    ``KSS_MESH_PROCESSES`` is set AND bring-up succeeded, else None with
+    the reason counted.  Bring-up runs once per process (the verdict is
+    memoized — a broken ensemble is not re-probed per engine)."""
+    global _POOL, _VERDICT
+    n = procs_from_env()
+    if n == 0:
+        return None
+    with _LOCK:
+        _STATS["requested_processes"] = n
+        if _POOL is not None and not _POOL.dead:
+            return _POOL
+        if _VERDICT is not None:
+            return None
+        timeout_s = float(os.environ.get("KSS_PROCMESH_TIMEOUT_S", "30"))
+        pool = ProcMeshPool(n, timeout_s)
+        reason = pool.start()
+        if reason is not None:
+            _VERDICT = reason
+            _count("fallbacks_by_reason", reason)
+            return None
+        _POOL = pool
+        atexit.register(shutdown)
+        return pool
+
+
+def count_run_fallback(reason: str) -> None:
+    """A dispatch-time degrade (pool died mid-wave, artifact missing for
+    a new scan shape): counted, and the engine falls back to the virtual
+    mesh for the wave — never a partial commit."""
+    with _LOCK:
+        _count("run_fallbacks_by_reason", reason)
+
+
+def stats() -> dict:
+    with _LOCK:
+        s = {
+            "requested_processes": _STATS["requested_processes"],
+            "fallbacks_by_reason": dict(_STATS["fallbacks_by_reason"]),
+            "run_fallbacks_by_reason": dict(_STATS["run_fallbacks_by_reason"]),
+            "verdict": _VERDICT,
+        }
+        s["pool"] = _POOL.snapshot() if _POOL is not None else None
+        return s
+
+
+def shutdown() -> None:
+    global _POOL
+    with _LOCK:
+        if _POOL is not None:
+            _POOL.close()
+            _POOL = None

@@ -1,0 +1,218 @@
+"""Per-shard ``jax.distributed`` worker process (``KSS_MESH_PROCESSES``).
+
+``ops.procmesh.ProcMeshPool`` launches N of these as subprocesses
+(``python -m kube_scheduler_simulator_tpu.ops.procmesh_worker``) — the
+multi-process twin of the in-process virtual mesh (``KSS_MESH_DEVICES``).
+The PARENT stays OUTSIDE the ensemble: its jax backend initialized long
+ago (you cannot call ``jax.distributed.initialize`` after backend init),
+so every ensemble member — including process 0 — is a subprocess, and
+the parent orchestrates over pipes.
+
+Workers **load, never compile**: the scan executable comes exclusively
+from the PR-11 AOT artifact cache (``ops/aot.py`` jax.export
+round-trips); a missing or rejected artifact is a counted pool fallback
+("artifact_missing"), never a worker-side trace+compile.  The
+RecompileGuard invariant — 0 steady-state recompiles — is therefore
+structural here.
+
+Protocol (length-prefixed pickle frames; commands on stdin, replies on
+the ``--out-fd`` pipe so stray stdout writes from jax can never corrupt
+the channel):
+
+- ``init`` handshake (automatic): the worker reports distributed-init
+  success + its device counts before the first command.
+- ``probe``: the cross-process collectives smoke — a sharded
+  ``device_put`` + ``process_allgather`` round-trip.  This is what
+  actually gates the pool: on jax CPU backends ``initialize()``
+  SUCCEEDS but multiprocess computations are unimplemented, so only a
+  compute round-trip proves the ensemble is usable.
+- ``load_scan``: resolve the AOT artifact for a scan meta (memoized).
+- ``run``: device_put the shipped host planes, run the scan, and reply
+  with host numpy outputs (rank 0 carries the payload; other ranks
+  participate in the collective and reply a bare ack).
+- ``quit`` / EOF: exit.
+
+Every reply is ``{"ok": bool, ...}``; failures carry a short ``reason``
+the pool surfaces in its counted-fallback stats — a broken worker
+degrades the pool to the virtual mesh, it never crashes the scheduler.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import struct
+import sys
+from typing import Any
+
+
+def _pin_env() -> None:
+    """Env pinning BEFORE any jax import (crash_child pattern); the
+    parent forwards its platform so a TPU parent gets TPU workers.
+    Called from ``main()`` only — this module is also imported by the
+    parent-side pool (for the frame helpers), where mutating jax env
+    would be a side effect."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("JAX_PLATFORM_NAME", os.environ["JAX_PLATFORMS"].split(",")[0])
+    os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+
+def _depin_axon() -> None:
+    try:  # the axon plugin dials the TPU tunnel even when CPU-pinned
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
+
+
+def read_frame(f) -> "Any | None":
+    """One length-prefixed pickle frame; None on EOF."""
+    hdr = f.read(8)
+    if len(hdr) < 8:
+        return None
+    (n,) = struct.unpack("<Q", hdr)
+    buf = f.read(n)
+    if len(buf) < n:
+        return None
+    return pickle.loads(buf)
+
+
+def write_frame(f, obj: Any) -> None:
+    b = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    f.write(struct.pack("<Q", len(b)))
+    f.write(b)
+    f.flush()
+
+
+def _err(stage: str, e: BaseException) -> dict:
+    return {"ok": False, "stage": stage, "reason": f"{type(e).__name__}: {e}"}
+
+
+def _probe(jax, nprocs: int) -> dict:
+    """The collectives smoke: prove a cross-process sharded computation
+    actually runs (CPU backends pass init but fail here)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    if nprocs == 1:
+        # single-worker ensemble: local compute is the whole story
+        v = float(
+            jnp.sum(jnp.arange(8, dtype=jnp.float32) * 2, dtype=jnp.float32)
+        )
+        return {"ok": v == 56.0, "reason": None if v == 56.0 else "bad local compute"}
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("nodes",))
+    x = jax.device_put(
+        jnp.arange(len(devs), dtype=jnp.float32),
+        NamedSharding(mesh, PartitionSpec("nodes")),
+    )
+    g = multihost_utils.process_allgather(jnp.sum(x))
+    want = float(len(devs)) * (len(devs) - 1) / 2.0
+    ok = bool(np.all(np.asarray(g) == want))
+    return {"ok": ok, "reason": None if ok else "allgather value mismatch"}
+
+
+def _load_scan(msg: dict, state: dict) -> dict:
+    """AOT-only scan resolution — a worker NEVER traces or compiles."""
+    from kube_scheduler_simulator_tpu.ops.aot import AotScanCache
+
+    meta = msg["meta"]
+    key = msg["key"]
+    if key in state["scans"]:
+        return {"ok": True, "cached": True}
+    cache = state.get("cache")
+    if cache is None or cache.cache_dir != msg["cache_dir"]:
+        cache = state["cache"] = AotScanCache(msg["cache_dir"])
+    fn = cache.load_scan(meta, donate=False)
+    if fn is None:
+        reasons = cache.fallbacks_by_reason
+        return {"ok": False, "reason": f"artifact_missing:{';'.join(sorted(reasons)) or 'absent'}"}
+    state["scans"][key] = fn
+    return {"ok": True, "cached": False}
+
+
+def _run(jax, msg: dict, state: dict, rank: int, nprocs: int) -> dict:
+    """Place the shipped host planes, run the AOT scan, reply numpy."""
+    import numpy as np
+
+    fn = state["scans"].get(msg["key"])
+    if fn is None:
+        return {"ok": False, "reason": "scan not loaded"}
+    dp = jax.tree_util.tree_map(jax.device_put, msg["dp"])
+    out_dev = fn(dp)
+    if nprocs > 1:
+        from jax.experimental import multihost_utils
+
+        out_dev = multihost_utils.process_allgather(out_dev)
+        if rank != 0:
+            return {"ok": True, "out": None}
+    out = jax.tree_util.tree_map(np.asarray, out_dev)
+    return {"ok": True, "out": out}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--nprocs", type=int, required=True)
+    ap.add_argument("--coordinator", required=True)
+    ap.add_argument("--out-fd", type=int, required=True)
+    args = ap.parse_args()
+    _pin_env()
+    _depin_axon()
+    out = os.fdopen(args.out_fd, "wb")
+    inp = sys.stdin.buffer
+    try:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.nprocs,
+            process_id=args.rank,
+        )
+    except Exception as e:
+        write_frame(out, _err("init", e))
+        return 1
+    write_frame(
+        out,
+        {
+            "ok": True,
+            "stage": "init",
+            "rank": args.rank,
+            "processes": jax.process_count(),
+            "devices": len(jax.devices()),
+            "local_devices": len(jax.local_devices()),
+        },
+    )
+    state: dict = {"scans": {}}
+    while True:
+        msg = read_frame(inp)
+        if msg is None or msg.get("cmd") == "quit":
+            break
+        try:
+            cmd = msg["cmd"]
+            if cmd == "ping":
+                reply = {"ok": True}
+            elif cmd == "probe":
+                reply = _probe(jax, args.nprocs)
+            elif cmd == "load_scan":
+                reply = _load_scan(msg, state)
+            elif cmd == "run":
+                reply = _run(jax, msg, state, args.rank, args.nprocs)
+            else:
+                reply = {"ok": False, "reason": f"unknown command {cmd!r}"}
+        except Exception as e:  # degrade, never crash the channel
+            reply = _err(msg.get("cmd", "?"), e)
+        write_frame(out, reply)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,182 @@
+"""Per-wave stage profiler — where does the wall go?
+
+Always-on (``KSS_PROFILE=0`` opts out), near-zero overhead: one dict
+bump and one histogram-bucket increment per stamp, a handful of stamps
+per wave.  The stages partition a scheduling wave's HOST timeline:
+
+- ``admit``        — streamed-path admission: queue drain, gate checks,
+                     and the store listings feeding the wave (zero on
+                     the direct ``schedule()`` path)
+- ``encode``       — cluster state -> padded host problem (ops/encode,
+                     delta or full) + lowering to device-dtype planes
+- ``upload``       — host planes -> device (DevicePlacer scatter/put or
+                     the direct ``jax.device_put``)
+- ``dispatch``     — executable resolution (jit cache / AOT load; cold
+                     waves pay tracing+compile here) + the async kernel
+                     dispatch call
+- ``device_blocked`` — host blocked on the scan's packed per-pod fetch
+                     (device time the host PAID; overlapped device time
+                     never shows up)
+- ``trace_fetch``  — trace compaction blob fetch + unpack + host-side
+                     trace reconstruction
+- ``annotate``     — trace -> annotation bytes (the wave-capsule C
+                     renderer, or the per-pod Python path)
+- ``commit``       — store writes: ResultStore merge, binding, events,
+                     reflector flush
+- ``host_other``   — the remainder of the wave's wall (queue/snapshot
+                     work between stamps), computed at close so the
+                     stage vector always sums EXACTLY to the wall
+
+The stamps are disjoint single-thread host intervals, so per wave
+``sum(named stages) <= wall`` must hold; a negative ``host_other``
+means a double-counted stamp and fails the tier-1 invariant test
+(tests/test_profile.py).  Records are dicts carried through
+``BatchEngine._prep`` -> ``PendingBatch`` -> ``BatchResult`` -> the
+commit path; overlapped streamed waves each own their record (wave
+k+1's encode interval lies inside wave k's wall but is attributed to
+k+1 — attribution follows the work, not the clock).
+
+Surfaces: ``SchedulerService.metrics()["profile"]`` (aggregate totals,
+per-stage max, log4 latency histogram, the last closed wave) rendered
+as a Prometheus histogram family by server/metrics.py, and
+``bench.py --profile-report`` (the cfg5/cfg9/cfg12 stage attribution
+tables).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any
+
+# the stage vector (order = presentation order); host_other is derived
+STAGES = (
+    "admit",
+    "encode",
+    "upload",
+    "dispatch",
+    "device_blocked",
+    "trace_fetch",
+    "annotate",
+    "commit",
+    "host_other",
+)
+
+# log4 latency buckets (seconds), Prometheus-style upper bounds; the
+# last implicit bucket is +Inf.  100 us floor: stamps below it are
+# bookkeeping noise, not optimization targets.
+BUCKETS = tuple(1e-4 * (4.0**i) for i in range(9))  # 100us .. ~6.6s
+
+
+def _enabled_from_env() -> bool:
+    return os.environ.get("KSS_PROFILE", "1") != "0"
+
+
+class WaveProfiler:
+    """Aggregates per-wave stage stamps; one instance per
+    SchedulerService, shared by its engines and stream sessions.
+
+    Single-writer discipline (the scheduling thread); the metrics
+    scrape copies under the GIL like every other stats surface."""
+
+    def __init__(self, enabled: "bool | None" = None):
+        self.enabled = _enabled_from_env() if enabled is None else enabled
+        self.waves = 0
+        self.wall_s = 0.0
+        # stage -> [count, total_s, max_s]
+        self.totals: dict[str, list] = {s: [0, 0.0, 0.0] for s in STAGES}
+        # stage -> per-bucket counts (len(BUCKETS)+1, last is +Inf)
+        self.hist: dict[str, list] = {s: [0] * (len(BUCKETS) + 1) for s in STAGES}
+        self.last_wave: dict[str, Any] = {}
+        # ambient record for stamp sites that can't thread one through
+        # (ResultStore.add_wave_results) — set around the commit block
+        self.current: "dict | None" = None
+
+    # ------------------------------------------------------------ waves
+
+    def open(self) -> "dict | None":
+        """Start a wave record at the first host touch (engine _prep)."""
+        if not self.enabled:
+            return None
+        return {"_t0": time.perf_counter(), "_walled": 0.0, "_closed": False}
+
+    def note(self, rec: "dict | None", stage: str, dt: float) -> None:
+        """Attribute ``dt`` seconds to ``stage`` (disjoint intervals!)."""
+        if rec is None or not self.enabled:
+            return
+        rec[stage] = rec.get(stage, 0.0) + dt
+        self._agg(stage, dt)
+
+    def note_current(self, stage: str, dt: float) -> None:
+        self.note(self.current, stage, dt)
+
+    def close(self, rec: "dict | None", pods: int = 0) -> None:
+        """Close (idempotently re-close) a wave at commit end: the wall
+        extends to now, ``host_other`` re-derives as wall - sum(named),
+        and only the DELTA since the previous close aggregates — the
+        windowed round path closes once per committed window."""
+        if rec is None or not self.enabled:
+            return
+        wall = time.perf_counter() - rec["_t0"]
+        named = sum(rec.get(s, 0.0) for s in STAGES if s != "host_other")
+        prev_other = rec.get("host_other", 0.0)
+        other = wall - named
+        rec["host_other"] = other
+        self._agg("host_other", other - prev_other, count=not rec["_closed"])
+        self.wall_s += wall - rec["_walled"]
+        rec["_walled"] = wall
+        rec["wall"] = wall
+        if pods:
+            rec["pods"] = rec.get("pods", 0) + pods
+        if not rec["_closed"]:
+            self.waves += 1
+            rec["_closed"] = True
+        self.last_wave = {
+            k: v for k, v in rec.items() if not k.startswith("_")
+        }
+
+    # -------------------------------------------------------- internals
+
+    def _agg(self, stage: str, dt: float, count: bool = True) -> None:
+        t = self.totals.setdefault(stage, [0, 0.0, 0.0])
+        if count:
+            t[0] += 1
+        t[1] += dt
+        if dt > t[2]:
+            t[2] = dt
+        h = self.hist.setdefault(stage, [0] * (len(BUCKETS) + 1))
+        for i, ub in enumerate(BUCKETS):
+            if dt <= ub:
+                h[i] += 1
+                break
+        else:
+            h[-1] += 1
+
+    # --------------------------------------------------------- surfaces
+
+    def snapshot(self) -> dict:
+        """The metrics()/bench view — plain data, copy-on-read."""
+        return {
+            "enabled": int(self.enabled),
+            "waves": self.waves,
+            "wall_s": self.wall_s,
+            "stages": {
+                s: {"count": t[0], "total_s": t[1], "max_s": t[2]}
+                for s, t in self.totals.items()
+            },
+            "hist_buckets": list(BUCKETS),
+            "hist": {s: list(h) for s, h in self.hist.items()},
+            "last_wave": dict(self.last_wave),
+        }
+
+    def report(self) -> str:
+        """Human-readable attribution table (bench --profile-report)."""
+        lines = [f"{'stage':<15}{'count':>8}{'total_s':>10}{'max_s':>9}{'share':>8}"]
+        denom = self.wall_s or 1.0
+        for s in STAGES:
+            c, tot, mx = self.totals.get(s, [0, 0.0, 0.0])
+            lines.append(
+                f"{s:<15}{c:>8}{tot:>10.3f}{mx:>9.3f}{tot / denom:>7.1%}"
+            )
+        lines.append(f"{'wall':<15}{self.waves:>8}{self.wall_s:>10.3f}")
+        return "\n".join(lines)

@@ -15,6 +15,7 @@ known hot-loop bottleneck (SURVEY.md section 6 cost shape).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any
 
 from kube_scheduler_simulator_tpu.plugins import annotations as anno
@@ -103,6 +104,10 @@ class ResultStore:
         self._mu = threading.Lock()
         self._results: dict[str, dict[str, Any]] = {}
         self._weights = dict(score_plugin_weight or {})
+        # wave-stage profiler hook (ops/profile.py), installed by the
+        # service's commit path; add_wave_results reports its merge time
+        # into the ambient wave record as the "resultstore_s" sub-series
+        self.profiler: Any = None
 
     def set_weights(self, score_plugin_weight: "dict[str, Any]") -> None:
         """Swap the finalScore weighting (the service's plugin-weight
@@ -229,9 +234,13 @@ class ResultStore:
         prefilter/reserve/bind status maps are identical for every pod)
         — dict categories are merged by ``update`` into each pod's own
         maps, so sharing never aliases mutable state between pods."""
+        prof = self.profiler
+        t0 = time.perf_counter() if prof is not None else 0.0
         with self._mu:
             for ns, pod_name, categories in entries:
                 _merge_categories(self._entry(ns, pod_name), categories)
+        if prof is not None:
+            prof.note_current("resultstore_s", time.perf_counter() - t0)
 
     # ------------------------------------------------------------------ read
 

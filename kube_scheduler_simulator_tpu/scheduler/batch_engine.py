@@ -31,6 +31,7 @@ import numpy as np
 from kube_scheduler_simulator_tpu.models.framework import Status
 from kube_scheduler_simulator_tpu.ops import batch as B
 from kube_scheduler_simulator_tpu.ops import encode as E
+from kube_scheduler_simulator_tpu.ops.profile import WaveProfiler
 from kube_scheduler_simulator_tpu.plugins.intree import interpodaffinity as ip
 from kube_scheduler_simulator_tpu.plugins.intree import node_basic as nb
 from kube_scheduler_simulator_tpu.plugins.intree import nodeaffinity as na
@@ -199,6 +200,10 @@ class BatchResult:
     fragments — at bench scale, per-element numpy indexing and ``str()``
     calls are the difference between seconds and minutes of annotation
     building."""
+
+    # the wave-profiler record this round accumulates into (set by the
+    # producing path; None on paths that don't profile)
+    prof_rec: "dict | None" = None
 
     def __init__(
         self,
@@ -860,6 +865,126 @@ class BatchResult:
             ("{" + ",".join(f_parts) + "}", None),
         )
 
+    def materialize_wave(self, js: "list[int]") -> "dict[int, dict] | None":
+        """Render the whole commit wave's annotation documents in O(1) C
+        calls: one ``wave_filter_many`` for every pod's filter document
+        plus two ``wave_score_many`` (score / finalScore) for the pods
+        that score — replacing the 3-calls-per-pod commit loop.  Returns
+        ``{j: {"filter": pair, "score": pair, "finalScore": pair}}``
+        ("score"/"finalScore" only when ``feasible_count[j] > 1``), with
+        pods outside the capsule envelope (PreFilter-narrowed node sets)
+        omitted — the caller renders those per-pod.  Returns None when
+        the batched path can't engage at all (no native extension, no
+        wave capsule, lone surrogates); the per-pod builders stay the
+        byte-identical fallback either way, and the parity suites pin
+        all paths to the same bytes."""
+        from kube_scheduler_simulator_tpu import native
+
+        fj = native.fastjson
+        if fj is None or not hasattr(fj, "wave_filter_many"):
+            return None
+        wave = self._wave()
+        if wave is None:
+            return None
+        tr = self._tr()
+        try:
+            render = [j for j in js if self._prefilter_node_set(j) is None]
+            if not render:
+                return {}
+            cap = wave["cap"]
+            starts_m = np.ascontiguousarray(
+                np.asarray(self.out["sample_start"], dtype=np.int64)[render]
+            )
+            procs_m = np.ascontiguousarray(
+                np.asarray(self.out["sample_processed"], dtype=np.int64)[render]
+            )
+            # concatenate every pod's failure entries, rebasing the
+            # per-pod fragment-table indices into ONE wave-shared table
+            # (the entry memo already dedups fragments across pods, so
+            # the index dict hits by object identity)
+            frag_index: dict[str, int] = {}
+            ftable: list[str] = []
+            frow_l: list = []
+            fids_l: list = []
+            fuidx_l: list = []
+            # per-pod local tables ride along for the deferred escaped
+            # twins ("wfilter" specs) the history writer consumes
+            fail_specs: dict[int, tuple] = {}
+            for m, j in enumerate(render):
+                ids_j, uidx_j, ft_j, et_j = self._fail_tables(j, tr, fj)
+                if ids_j is None:
+                    fail_specs[j] = (None, None, [])
+                    continue
+                rebase = np.empty(len(ft_j), dtype=np.int64)
+                for t, frag in enumerate(ft_j):
+                    u = frag_index.get(frag)
+                    if u is None:
+                        u = frag_index[frag] = len(ftable)
+                        ftable.append(frag)
+                    rebase[t] = u
+                frow_l.append(np.full(len(ids_j), m, dtype=np.int64))
+                fids_l.append(ids_j)
+                fuidx_l.append(rebase[uidx_j])
+                fail_specs[j] = (ids_j, uidx_j, et_j)
+            if frow_l:
+                frow = np.ascontiguousarray(np.concatenate(frow_l))
+                fids = np.ascontiguousarray(np.concatenate(fids_l))
+                fuidx = np.ascontiguousarray(np.concatenate(fuidx_l))
+            else:
+                frow = fids = fuidx = None
+            filt_docs = fj.wave_filter_many(
+                cap, starts_m, procs_m, frow, fids, fuidx, ftable or None
+            )
+            out: dict[int, dict] = {}
+            for m, j in enumerate(render):
+                ids_j, uidx_j, et_j = fail_specs[j]
+                out[j] = {
+                    "filter": (
+                        filt_docs[m],
+                        (
+                            "wfilter", cap, int(starts_m[m]), int(procs_m[m]),
+                            ids_j, uidx_j, et_j,
+                        ),
+                    )
+                }
+            scoring = [j for j in render if int(self.feasible_count[j]) > 1]
+            if scoring:
+                sjs = np.asarray(scoring, dtype=np.int64)
+                cnts = np.ascontiguousarray(
+                    np.asarray(wave["counts"], dtype=np.int64)[sjs]
+                )
+                ns2 = np.ascontiguousarray(wave["ns"][sjs])
+                perm2 = np.ascontiguousarray(wave["perm"][sjs])
+                raw2 = [
+                    np.ascontiguousarray(np.asarray(inv, dtype=np.int64)[sjs])
+                    for inv in wave["raw_inv"]
+                ]
+                fin2 = [
+                    np.ascontiguousarray(np.asarray(inv, dtype=np.int64)[sjs])
+                    for inv in wave["fin_inv"]
+                ]
+                score_docs = fj.wave_score_many(cap, 0, cnts, ns2, perm2, raw2)
+                final_docs = fj.wave_score_many(cap, 1, cnts, ns2, perm2, fin2)
+                for m2, j in enumerate(scoring):
+                    T = int(cnts[m2])
+                    if T == 0:
+                        out[j]["score"] = ("{}", "{}")
+                        out[j]["finalScore"] = ("{}", "{}")
+                        continue
+                    ns_row = ns2[m2, :T]
+                    perm_row = perm2[m2, :T]
+                    out[j]["score"] = (
+                        score_docs[m2],
+                        ("wscore", cap, 0, ns_row, perm_row, [r[m2] for r in raw2]),
+                    )
+                    out[j]["finalScore"] = (
+                        final_docs[m2],
+                        ("wscore", cap, 1, ns_row, perm_row, [r[m2] for r in fin2]),
+                    )
+            return out
+        except UnicodeEncodeError:
+            return None
+
     def totals_map(self, i: int) -> dict[int, int]:
         """FEASIBLE node index → weighted score total (Σ weight ×
         normalized, recomputed from the compact trace — trace mode).
@@ -1052,6 +1177,17 @@ class BatchEngine:
 
         self._aot = AotScanCache.from_env()
         self._aot_pending: "tuple | None" = None  # export deferred past dispatch
+        # multi-process shard ensemble (ops/procmesh.py): the
+        # KSS_MESH_PROCESSES opt-in.  acquire() is a fast None when the
+        # knob is unset; every bring-up failure is a counted fallback to
+        # the in-process virtual mesh.  Workers load executables from
+        # the AOT artifact cache ONLY, so the ensemble requires one.
+        from kube_scheduler_simulator_tpu.ops import procmesh
+
+        self._procmesh = procmesh.acquire()
+        if self._procmesh is not None and self._aot is None:
+            procmesh.count_run_fallback("aot_cache_disabled")
+            self._procmesh = None
         # H2D traffic on the non-cached placement path (the placer keeps
         # its own counter); encode_full counter for cache-off engines
         self._direct_bytes_uploaded = 0
@@ -1071,6 +1207,10 @@ class BatchEngine:
         # back mid-run would churn compact executables — only widen
         self._raw_dtypes: dict[int, str] = {}
         self.last_timings: dict[str, float] = {}
+        # per-wave stage profiler (ops/profile.py): engine-owned by
+        # default; SchedulerService installs its own shared instance so
+        # stream/commit stamps and all profile engines aggregate together
+        self.profiler = WaveProfiler()
         # Cumulative observability counters (surfaced by /api/v1/metrics):
         # rounds = schedule() calls, compiles = jit-cache misses,
         # cum_timings = per-phase seconds summed over rounds.
@@ -1358,17 +1498,23 @@ class BatchEngine:
         volumes: "dict[str, list[Obj]] | None",
         nominated: "list[tuple[Obj, str]] | None" = None,
         bank: int = 0,
+        prof_rec: "dict | None" = None,
     ) -> dict:
         """Encode + pad + lower + place a round's problem; shared by the
         one-dispatch path (``_schedule``), the pipelined windowed path
         (``schedule_waves``) and the streaming pipeline
         (``schedule_async``).  ``bank`` selects the DevicePlacer's
         resident plane set — streamed rounds alternate banks so a wave's
-        uploads never touch buffers the in-flight wave still reads."""
+        uploads never touch buffers the in-flight wave still reads.
+        ``prof_rec``: an already-open wave-profiler record (the stream
+        session opens one before its admission work); None opens a
+        fresh one here."""
         from kube_scheduler_simulator_tpu.scheduler.framework_runner import (
             num_feasible_nodes_to_find,
         )
 
+        prof = self.profiler
+        rec = prof_rec if prof_rec is not None else prof.open()
         t0 = time.perf_counter()
         if self.encode_cache is not None:
             pr = self.encode_cache.encode(
@@ -1429,6 +1575,10 @@ class BatchEngine:
             w = min(dims["N"], E_._bucket(max(int(sample_k), 1)))
             if w < dims["N"]:
                 ws0 = w
+        tl = time.perf_counter()
+        # stage attribution: everything up to here is host problem
+        # building (encode + pad + lowering); placement is the upload
+        prof.note(rec, "encode", tl - t0)
         key = (
             tuple(sorted(dims.items())),
             cfg,
@@ -1462,9 +1612,10 @@ class BatchEngine:
             # pay the full tunnel latency (lower() returns host arrays)
             self._direct_bytes_uploaded += B.tree_nbytes(dp)
             dp = jax.device_put(dp)
+        prof.note(rec, "upload", time.perf_counter() - tl)
         return dict(
             pr=pr, dp=dp, dims=dims, cfg=cfg, ws0=ws0, key=key,
-            nodes=nodes, pending=pending, t0=t0, t1=t1,
+            nodes=nodes, pending=pending, t0=t0, t1=t1, prof=rec,
         )
 
     @staticmethod
@@ -1652,17 +1803,21 @@ class BatchEngine:
         dev_wait = 0.0
         est_scan = None
         fr_shared: dict = {}  # one O(N) fragment build per ROUND
+        prof, rec = self.profiler, ctx.get("prof")
         try:
             ys = fnw(carry, dp, np.int32(0))
+            prof.note(rec, "dispatch", time.perf_counter() - t2)
             for c in range(n_windows):
                 offset = c * Wp
                 tw = time.perf_counter()
                 packed = np.asarray(ys["packed_pod"])  # blocks on window c's scan
                 wait = time.perf_counter() - tw
                 dev_wait += wait
+                prof.note(rec, "device_blocked", wait)
                 if est_scan is None:
                     est_scan = wait  # first window never overlaps anything
                 out = self._packed_out(packed)
+                tw = time.perf_counter()
                 blob, manifest, raw_dtypes, WS = self._compact_dispatch(
                     cfg, wdims, wkey, ws0, ys, packed, pr.N_true
                 )
@@ -1670,6 +1825,7 @@ class BatchEngine:
                 # window's compaction and ahead of the host commit
                 if c + 1 < n_windows:
                     ys = fnw(ys["_final_carry"], dp, np.int32(offset + Wp))
+                prof.note(rec, "dispatch", time.perf_counter() - tw)
                 tw = time.perf_counter()
                 fetched = B.unpack_compact_blob(np.asarray(blob), manifest)
                 dev_wait += time.perf_counter() - tw
@@ -1685,6 +1841,7 @@ class BatchEngine:
                     cnt,
                     WS,
                 )
+                prof.note(rec, "trace_fetch", time.perf_counter() - tw)
                 result = BatchResult(
                     self,
                     pending[offset : offset + cnt],
@@ -1693,6 +1850,9 @@ class BatchEngine:
                     nodes,
                     fr_shared=fr_shared,
                 )
+                # all windows of the round share ONE wave record; the
+                # commit path re-closes it per window (idempotent delta)
+                result.prof_rec = rec
                 yield result, offset, cnt
         finally:
             t3 = time.perf_counter()
@@ -1737,6 +1897,11 @@ class BatchEngine:
             meta = self._aot.scan_meta(
                 ctx["dims"], ctx["cfg"], ctx["ws0"], self.mesh, split_carry=donate
             )
+            if self._procmesh is not None and not self._procmesh.dead:
+                fn = self._procmesh_fn(key, ctx, meta)
+                if fn is not None:
+                    self._fn_cache[key] = fn
+                    return fn
             fn = self._aot.load_scan(meta, donate=donate)
         if fn is None:
             fn = B.build_batch_fn(ctx["cfg"], ctx["dims"], donate=donate, ws0=ctx["ws0"])
@@ -1758,6 +1923,52 @@ class BatchEngine:
         self._fn_cache[key] = fn
         return fn
 
+    def _procmesh_fn(self, key, ctx: dict, meta: dict):
+        """A scan callable backed by the multi-process shard ensemble
+        (``KSS_MESH_PROCESSES``): the wave's placed planes ship to the
+        workers as host numpy, every worker runs its AOT-loaded scan
+        executable (workers never compile), and rank 0's gathered
+        outputs come back as a host-side out_dev dict — downstream
+        packed/blob fetches are instant, and the trace compaction still
+        runs in-parent (its jit re-uploads the numpy planes implicitly).
+
+        None when the ensemble can't serve this scan — the artifact is
+        missing or rejected on a worker — counted, and the caller
+        continues down the local path for this key.  An ensemble lost
+        MID-RUN degrades in-wave: the local executable is rebuilt under
+        the same key and finishes the wave, so a dead worker never
+        surfaces as a scheduling error."""
+        import json
+
+        from kube_scheduler_simulator_tpu.ops import procmesh
+
+        pool = self._procmesh
+        skey = json.dumps(meta, sort_keys=True)
+        reason = pool.load_scan(skey, meta, self._aot.cache_dir)
+        if reason is not None:
+            procmesh.count_run_fallback(reason)
+            return None
+        cfg, dims, ws0 = ctx["cfg"], ctx["dims"], ctx["ws0"]
+        eng = self
+
+        def fn(dp):
+            import jax
+
+            host_dp = jax.tree_util.tree_map(np.asarray, dp)
+            handle = pool.run(skey, host_dp)
+            out = handle.fetch() if handle is not None else None
+            if out is not None:
+                return out
+            procmesh.count_run_fallback("worker_lost")
+            local = eng._aot.load_scan(meta, donate=False) if eng._aot else None
+            if local is None:
+                local = B.build_batch_fn(cfg, dims, donate=False, ws0=ws0)
+                eng.compiles += 1
+            eng._fn_cache[key] = local
+            return local(dp)
+
+        return fn
+
     def _aot_flush(self) -> None:
         """Write the pending AOT export, if any — called right after a
         round's kernel dispatch so the export's re-trace overlaps the
@@ -1774,14 +1985,19 @@ class BatchEngine:
         schedule_waves when the pod axis is too small to split)."""
         pr, dp, dims = ctx["pr"], ctx["dp"], ctx["dims"]
         cfg, ws0, key = ctx["cfg"], ctx["ws0"], ctx["key"]
+        prof, rec = self.profiler, ctx.get("prof")
         fn = self._fn_cache.get(key)
         t2 = time.perf_counter()
         if fn is None:
             fn = self._scan_fn(ctx)
         out_dev = fn(dp)
         self._aot_flush()  # pending export overlaps the in-flight kernel
+        td = time.perf_counter()
+        prof.note(rec, "dispatch", td - t2)
         packed = np.asarray(out_dev["packed_pod"])
         out = self._packed_out(packed)
+        tb = time.perf_counter()
+        prof.note(rec, "device_blocked", tb - td)
         if self.trace:
             blob, manifest, raw_dtypes, WS = self._compact_dispatch(
                 cfg, dims, key, ws0, out_dev, packed, pr.N_true
@@ -1792,6 +2008,7 @@ class BatchEngine:
                 pr.N_true, out["feasible_count"], raw_dtypes,
                 len(ctx["pending"]), WS,
             )
+            prof.note(rec, "trace_fetch", time.perf_counter() - tb)
         t3 = time.perf_counter()
         self._note_round(
             {
@@ -1801,7 +2018,9 @@ class BatchEngine:
                 "total_s": t3 - ctx["t0"],
             }
         )
-        return BatchResult(self, ctx["pending"], out, pr, ctx["nodes"])
+        res = BatchResult(self, ctx["pending"], out, pr, ctx["nodes"])
+        res.prof_rec = rec
+        return res
 
     def schedule_async(
         self,
@@ -1814,6 +2033,7 @@ class BatchEngine:
         volumes: "dict[str, list[Obj]] | None" = None,
         nominated: "list[tuple[Obj, str]] | None" = None,
         bank: int = 0,
+        prof_rec: "dict | None" = None,
     ) -> "PendingBatch":
         """Dispatch one batch pass WITHOUT blocking on its results — the
         streaming pipeline's producer (scheduler/stream.py): wave k+1's
@@ -1836,12 +2056,13 @@ class BatchEngine:
         assert self.trace, "streamed rounds are trace rounds"
         ctx = self._prep(
             nodes, all_pods, pending, namespaces, base_counter, start_index,
-            volumes, nominated, bank=bank,
+            volumes, nominated, bank=bank, prof_rec=prof_rec,
         )
         t2 = time.perf_counter()
         fn = self._scan_fn(ctx)
         out_dev = fn(ctx.pop("dp"))
         self._aot_flush()  # pending export overlaps the in-flight kernel
+        self.profiler.note(ctx.get("prof"), "dispatch", time.perf_counter() - t2)
         return PendingBatch(self, ctx, out_dev, t2)
 
     # ----------------------------------------------------- trace helpers
@@ -1922,9 +2143,12 @@ class PendingBatch:
         dispatched (not fetched) before returning."""
         if self._out is None:
             assert self._out_dev is not None
+            prof, rec = self._eng.profiler, self._ctx.get("prof")
             tw = time.perf_counter()
             packed = np.asarray(self._out_dev["packed_pod"])
-            self._dev_wait += time.perf_counter() - tw
+            tb = time.perf_counter()
+            self._dev_wait += tb - tw
+            prof.note(rec, "device_blocked", tb - tw)
             ctx = self._ctx
             self._out = self._eng._packed_out(packed)
             self._blob, self._manifest, self._raw_dtypes, self._WS = (
@@ -1933,6 +2157,7 @@ class PendingBatch:
                     self._out_dev, packed, ctx["pr"].N_true,
                 )
             )
+            prof.note(rec, "dispatch", time.perf_counter() - tb)
         return self._out
 
     @property
@@ -1961,6 +2186,7 @@ class PendingBatch:
                 len(ctx["pending"]), self._WS,
             )
             t3 = time.perf_counter()
+            eng.profiler.note(ctx.get("prof"), "trace_fetch", t3 - tw)
             eng._note_round(
                 {
                     "encode_s": ctx["t1"] - ctx["t0"],
@@ -1975,6 +2201,7 @@ class PendingBatch:
                 eng, ctx["pending"], out, ctx["pr"], ctx["nodes"],
                 weight_override=self._weight_override,
             )
+            self._result.prof_rec = ctx.get("prof")
             self._out_dev = None  # release the round's device references
             self._blob = None
         return self._result

@@ -246,6 +246,12 @@ class SchedulerService:
         self._last_tuning_report: "Obj | None" = None
         # guards batch_fallbacks against the metrics scrape thread
         self._stats_lock = threading.Lock()
+        # per-wave stage profiler (ops/profile.py): ONE instance shared
+        # by every profile engine, the stream sessions and the commit
+        # path, so the whole service's wall attributes into one table
+        from kube_scheduler_simulator_tpu.ops.profile import WaveProfiler
+
+        self.profiler = WaveProfiler()
         # stream quiesce machinery (pause_streams): an exclusive store
         # operation — snapshot load, boot recovery — drains every active
         # StreamSession to a wave boundary (counted per reason) and holds
@@ -935,6 +941,7 @@ class SchedulerService:
         eng = self._batch_engines.get(fw.profile_name)
         if eng is None:
             eng = BatchEngine.from_framework(fw, trace=True, mesh=self.mesh)
+            eng.profiler = self.profiler  # shared per-wave stage profiler
             self._batch_engines[fw.profile_name] = eng
             if fw is self.framework:
                 self._batch_engine = eng  # metrics/back-compat handle
@@ -1354,6 +1361,9 @@ class SchedulerService:
                     self.stats["batch_pods"] += 1
                     results[key] = res
                     if res.nominated_node:
+                        # preemption restarts the kernel: this window's
+                        # record ends here (same close as the window end)
+                        self.profiler.close(getattr(result, "prof_rec", None))
                         return base_i + off + j + 1
                     continue
                 # Exact sequential cycle for this pod: same snapshot
@@ -1368,8 +1378,16 @@ class SchedulerService:
                 self.stats["commit_s"] += time.perf_counter() - tc
                 results[key] = res
                 if res.nominated_node:
+                    self.profiler.close(getattr(result, "prof_rec", None))
                     return base_i + off + j + 1
         flush_wave()
+        # the wave record must close even when NOTHING committed (an
+        # all-failure window never reaches _commit_batch_wave) — an open
+        # record leaks its stage stamps into the totals with no wall,
+        # breaking the sum(stages) == wall invariant.  Idempotent for
+        # committed windows: the re-close aggregates only the replay
+        # tail since the last commit.
+        self.profiler.close(getattr(result, "prof_rec", None))
         pctx = (pholder or {}).get("ctx")
         if pctx is not None:
             # later windows' dry runs must see this window's commits
@@ -1476,6 +1494,22 @@ class SchedulerService:
         with self._stats_lock:
             self.stats["preempt_nominations"] += 1
             self.stats["preempt_victims"] += len(decision.victims)
+
+    @staticmethod
+    def _procmesh_stats() -> "dict[str, Any] | None":
+        """The shard-ensemble stats (ops/procmesh.py), or None when the
+        KSS_MESH_PROCESSES knob was never exercised — the common case
+        stays out of the metrics payload entirely."""
+        from kube_scheduler_simulator_tpu.ops import procmesh
+
+        s = procmesh.stats()
+        if (
+            not s["requested_processes"]
+            and not s["fallbacks_by_reason"]
+            and not s["run_fallbacks_by_reason"]
+        ):
+            return None
+        return s
 
     def metrics(self) -> dict[str, Any]:
         """Observability snapshot for the metrics endpoint (the reference
@@ -1623,6 +1657,12 @@ class SchedulerService:
             # copying the captured object is race-free
             "engine_last_timings": last_t,
             "engine_cum_timings": dict(eng.cum_timings) if eng else {},
+            # per-wave stage profiler (ops/profile.py): where the wall
+            # goes, stage by stage, with a latency histogram per stage
+            "profile": self.profiler.snapshot(),
+            # multi-process shard ensemble (ops/procmesh.py): requested
+            # size, engagement, and the counted-fallback reason tables
+            "procmesh": self._procmesh_stats(),
             # capacity engine (None when off or never engaged)
             "autoscaler": asc_m,
         }
@@ -1649,6 +1689,9 @@ class SchedulerService:
         from kube_scheduler_simulator_tpu.plugins.resultstore import SUCCESS_MESSAGE
 
         rs = fw.result_store
+        prof = self.profiler
+        prof_rec = getattr(result, "prof_rec", None)
+        t_ann = time.perf_counter()
         pf_names = point_names["pre_filter"]
         # per-wave shared category maps — identical content for every pod
         # in the wave (add_wave_results merges them into per-pod state)
@@ -1664,11 +1707,20 @@ class SchedulerService:
         bind = {point_names["bind"][0]: SUCCESS_MESSAGE} if point_names["bind"] else None
         entries: list[tuple[str, str, dict]] = []
         bound: list[tuple[Obj, str, str, str]] = []
+        # capsule-resident batched rendering: the whole wave's filter/
+        # score documents in O(1) C calls (None / missing pods fall back
+        # to the byte-identical per-pod builders below)
+        wave_docs = (
+            result.materialize_wave(js)
+            if hasattr(result, "materialize_wave")
+            else None
+        )
         for j in js:
             pod = tail[j]
             ns = pod["metadata"].get("namespace", "default")
             name = pod["metadata"]["name"]
             node_name = result.node_names[int(result.selected[j])]
+            docs = wave_docs.get(j) if wave_docs is not None else None
             cats: dict = {}
             if pf_names:
                 cats["preFilterStatus"] = pf_status
@@ -1676,11 +1728,17 @@ class SchedulerService:
                     names = result._engine.prefilter_node_names(pod)
                     if names is not None:
                         cats["preFilterResult"] = {"NodeAffinity": sorted(names)}
-            cats["filter"] = result.filter_annotation_pair(j)
+            cats["filter"] = (
+                docs["filter"] if docs is not None
+                else result.filter_annotation_pair(j)
+            )
             if int(result.feasible_count[j]) > 1:
                 if pre_score:
                     cats["preScore"] = pre_score
-                score_pair, final_pair = result.score_annotations_pairs(j)
+                if docs is not None:
+                    score_pair, final_pair = docs["score"], docs["finalScore"]
+                else:
+                    score_pair, final_pair = result.score_annotations_pairs(j)
                 cats["score"] = score_pair
                 cats["finalScore"] = final_pair
             if reserve:
@@ -1697,25 +1755,37 @@ class SchedulerService:
                 cats["bind"] = bind
             entries.append((ns, name, cats))
             bound.append((pod, ns, name, node_name))
-        rs.add_wave_results(entries)
-        committed: list[tuple[Obj, str, str, str]] = []
-        for pod, ns, name, node_name in bound:
-            try:
-                self.cluster_store.bind_pod(ns, name, node_name)
-            except KeyError:
-                # deleted between the kernel's decision and this wave's
-                # commit: nothing to bind, nothing to flush — the
-                # reflector's store entry dies with the round
-                continue
-            if snapshot is not None:
-                snapshot.assume(pod, node_name)
-            results[_pod_key(pod)] = ScheduleResult(selected_node=node_name)
-            committed.append((pod, ns, name, node_name))
-        self.reflector.flush_wave(self.cluster_store, [p for p, *_ in committed])
-        for pod, ns, name, node_name in committed:
-            self._record_event(
-                pod, "Normal", "Scheduled", f"Successfully assigned {ns}/{name} to {node_name}"
-            )
+        t_commit = time.perf_counter()
+        prof.note(prof_rec, "annotate", t_commit - t_ann)
+        # ambient record for the ResultStore's own sub-stamp (its merge
+        # time reports as the informational "resultstore_s" series,
+        # INSIDE the commit stage — not a stage itself)
+        rs.profiler = prof
+        prof.current = prof_rec
+        try:
+            rs.add_wave_results(entries)
+            committed: list[tuple[Obj, str, str, str]] = []
+            for pod, ns, name, node_name in bound:
+                try:
+                    self.cluster_store.bind_pod(ns, name, node_name)
+                except KeyError:
+                    # deleted between the kernel's decision and this wave's
+                    # commit: nothing to bind, nothing to flush — the
+                    # reflector's store entry dies with the round
+                    continue
+                if snapshot is not None:
+                    snapshot.assume(pod, node_name)
+                results[_pod_key(pod)] = ScheduleResult(selected_node=node_name)
+                committed.append((pod, ns, name, node_name))
+            self.reflector.flush_wave(self.cluster_store, [p for p, *_ in committed])
+            for pod, ns, name, node_name in committed:
+                self._record_event(
+                    pod, "Normal", "Scheduled", f"Successfully assigned {ns}/{name} to {node_name}"
+                )
+        finally:
+            prof.current = None
+        prof.note(prof_rec, "commit", time.perf_counter() - t_commit)
+        prof.close(prof_rec, pods=len(js))
 
     def _commit_batch_pod(
         self,

@@ -274,22 +274,31 @@ class StreamSession:
         bank: int,
         volumes: "dict | None",
         binds: "dict[str, str] | None" = None,
+        prof_rec: "dict | None" = None,
     ) -> dict:
         """Encode + upload + dispatch one wave (non-blocking); returns
         the in-flight record the commit step consumes.  ``volumes`` is
-        the listing the gate's supported() check already built."""
+        the listing the gate's supported() check already built.
+        ``prof_rec``: the wave-profiler record opened at this wave's
+        admission (the "admit" stage accrued there; encode/upload/
+        dispatch accrue inside the engine)."""
         svc = self.svc
         fw = svc.framework
         eng = svc._engine_for(fw)
+        ta = time.perf_counter()
+        pods_view = self._view_pods(binds or {})
+        namespaces = svc.cluster_store.list("namespaces", copy_objects=False)
+        eng.profiler.note(prof_rec, "admit", time.perf_counter() - ta)
         pb = eng.schedule_async(
             nodes,
-            self._view_pods(binds or {}),
+            pods_view,
             pending,
-            svc.cluster_store.list("namespaces", copy_objects=False),
+            namespaces,
             base_counter=base_counter,
             start_index=start_index,
             volumes=volumes if volumes is not None else eng._volumes(),
             bank=bank,
+            prof_rec=prof_rec,
         )
         return {
             "pb": pb,
@@ -474,6 +483,11 @@ class StreamSession:
                 # arrivals).
                 if not self._waves_left():
                     break
+                # profiler record opens at the wave's first host touch;
+                # abandoned records (empty admission, gated round) are
+                # simply dropped — nothing aggregates before note()
+                rec = svc.profiler.open()
+                ta = time.perf_counter()
                 pending = self._admit(frozenset())
                 if not pending:
                     if not self._admitting():
@@ -485,11 +499,13 @@ class StreamSession:
                 if gate is not None:
                     self._drain_round(gate)
                     continue
+                svc.profiler.note(rec, "admit", time.perf_counter() - ta)
                 fw = svc.framework
                 try:
                     flight = self._dispatch(
                         pending, nodes, fw.sched_counter,
                         fw.next_start_node_index, bank, volumes,
+                        prof_rec=rec,
                     )
                 except Exception as e:  # device crash: nothing committed
                     # the same pods re-drain through the sequential path
@@ -558,6 +574,8 @@ class StreamSession:
                 # (no feed tick is consumed here).
                 self._count_drain("unschedulable requeue")
             elif self.streaming and self._waves_left(in_flight=1):
+                rec2 = svc.profiler.open()
+                ta2 = time.perf_counter()
                 pending2 = self._admit(flight["keys"])
                 if pending2:
                     nodes = svc.cluster_store.list("nodes", copy_objects=False)
@@ -582,6 +600,9 @@ class StreamSession:
                             if s >= 0:
                                 binds[_pod_key(p)] = pb.node_names[s]
                         fw = flight["fw"]
+                        svc.profiler.note(
+                            rec2, "admit", time.perf_counter() - ta2
+                        )
                         t0 = time.perf_counter()
                         bank ^= 1
                         try:
@@ -589,6 +610,7 @@ class StreamSession:
                                 pending2, nodes,
                                 fw.sched_counter + len(pb.pending),
                                 pb.final_start, bank, volumes, binds=binds,
+                                prof_rec=rec2,
                             )
                         except Exception as e:
                             # overlap dispatch crashed: wave k commits

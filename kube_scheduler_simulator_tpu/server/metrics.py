@@ -267,6 +267,74 @@ def render_metrics(di: Any) -> str:
             typ="gauge",
         )
 
+    # per-wave stage profiler (ops/profile.py): where the wall goes.
+    # Disjoint host stamps per wave; sum over stages == committed wall.
+    prof = m.get("profile")
+    if prof:
+        counter("wave_profile_enabled", "1 while the per-wave stage profiler is on (KSS_PROFILE).", prof["enabled"], typ="gauge")
+        counter("wave_profile_waves_total", "Waves closed by the stage profiler.", prof["waves"])
+        counter("wave_profile_wall_seconds_total", "Cumulative profiled wave wall (== sum of all stage seconds).", round(prof["wall_s"], 6))
+        for stage, st in sorted(prof["stages"].items()):
+            counter(
+                "wave_stage_seconds_total",
+                "Cumulative host seconds attributed to a wave stage (disjoint stamps; host_other is the derived remainder).",
+                round(st["total_s"], 6),
+                {"stage": stage},
+            )
+            counter(
+                "wave_stage_stamps_total",
+                "Stamp count per wave stage.",
+                st["count"],
+                {"stage": stage},
+            )
+            counter(
+                "wave_stage_seconds_max",
+                "Largest single stamp observed per wave stage (cold-wave compiles spike dispatch).",
+                round(st["max_s"], 6),
+                {"stage": stage},
+                typ="gauge",
+            )
+        # Prometheus histogram per stage (log4 buckets, cumulative le)
+        hfull = f"{_PREFIX}_wave_stage_duration_seconds"
+        lines.append(f"# HELP {hfull} Per-stamp stage latency histogram (log4 buckets).")
+        lines.append(f"# TYPE {hfull} histogram")
+        ubs = prof["hist_buckets"]
+        for stage, hs in sorted(prof["hist"].items()):
+            cum = 0
+            for ub, n in zip(ubs, hs):
+                cum += n
+                lines.append(f'{hfull}_bucket{{stage="{stage}",le="{ub:g}"}} {cum}')
+            cum += hs[-1]
+            lines.append(f'{hfull}_bucket{{stage="{stage}",le="+Inf"}} {cum}')
+            st = prof["stages"].get(stage, {"total_s": 0.0})
+            lines.append(f'{hfull}_sum{{stage="{stage}"}} {round(st["total_s"], 6)}')
+            lines.append(f'{hfull}_count{{stage="{stage}"}} {cum}')
+
+    # multi-process shard ensemble (ops/procmesh.py) — only once the
+    # KSS_MESH_PROCESSES knob has been exercised
+    pm = m.get("procmesh")
+    if pm is not None:
+        counter("procmesh_requested_processes", "KSS_MESH_PROCESSES as last read by an engine.", pm["requested_processes"], typ="gauge")
+        pool = pm.get("pool")
+        counter("procmesh_engaged", "1 while a live worker ensemble is serving scans.", int(bool(pool and pool["engaged"])), typ="gauge")
+        if pool:
+            counter("procmesh_dispatches_total", "Scan waves dispatched to the worker ensemble.", pool["dispatches"])
+            counter("procmesh_scans_loaded", "Distinct AOT scan executables resolved on every worker.", pool["scans_loaded"], typ="gauge")
+        for reason, n in sorted(pm["fallbacks_by_reason"].items()):
+            counter(
+                "procmesh_fallbacks_total",
+                "Ensemble bring-up failures degraded (counted) to the in-process virtual mesh.",
+                n,
+                {"reason": reason.split(":", 1)[0]},
+            )
+        for reason, n in sorted(pm["run_fallbacks_by_reason"].items()):
+            counter(
+                "procmesh_run_fallbacks_total",
+                "Dispatch-time ensemble degrades (per scan key or per wave), by reason.",
+                n,
+                {"reason": reason.split(":", 1)[0]},
+            )
+
     # capacity engine (autoscaler/) — only once it has been constructed
     asc = m.get("autoscaler")
     if asc is not None:

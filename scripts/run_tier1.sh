@@ -51,6 +51,13 @@ if ! timeout -k 10 420 env JAX_PLATFORMS=cpu python scripts/fuzz_smoke.py; then 
 # recovery_truncated_records_total == 0, zero partial waves/gangs,
 # compaction engaged, /metrics wiring (scripts/crash_smoke.py).
 if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/crash_smoke.py; then rc=1; fi
+# Host-path perf smoke (docs/batch-engine.md "Where the wall goes"):
+# the fused streamed path vs the serial per-tick loop at smoke size,
+# min-of-3 walls, byte parity + per-wave stage profiles asserted, and
+# the fused/serial ratio pinned above a generous committed floor —
+# a host-path perf regression fails tier-1 loudly (scripts/perf_smoke.py;
+# bench cfg13-hostpath / BENCH_hostpath.json is the at-scale row).
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/perf_smoke.py; then rc=1; fi
 # Kernel-contract checker (docs/static-analysis.md): FIRST the fixture
 # self-test (every rule must fire on its known-bad fixtures and stay
 # silent on the good ones — a broken rule must not silently pass the

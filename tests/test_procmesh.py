@@ -1,0 +1,195 @@
+"""Multi-process shard workers (ops/procmesh.py — ``KSS_MESH_PROCESSES``).
+
+The ensemble is an opt-in execution substrate, not a semantics change:
+with the knob set, scan dispatches run on ``jax.distributed`` worker
+processes that LOAD the PR-11 AOT artifacts (never compile), and every
+way the ensemble can be unavailable is a counted fallback to the
+in-process virtual mesh with byte-identical scheduling either way.
+
+The end-to-end tests SKIP LOUDLY (with the counted bring-up verdict)
+when the ensemble can't engage on the host: on jax CPU backends
+``jax.distributed.initialize`` succeeds but cross-process collectives
+are unimplemented, so the N>=2 ensemble only engages on real multi-chip
+hosts — the N=1 ensemble exercises the whole protocol (spawn, init
+handshake, probe, artifact load, dispatch/fetch) everywhere.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any
+
+import pytest
+
+from kube_scheduler_simulator_tpu.ops import procmesh
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+from tests.test_batch_parity import mk_node, mk_pod
+
+Obj = dict[str, Any]
+
+
+@pytest.fixture
+def pm_state():
+    """Reset the module-level pool/verdict memo around each test — the
+    bring-up verdict is memoized per process by design."""
+
+    def reset():
+        procmesh.shutdown()
+        with procmesh._LOCK:
+            procmesh._VERDICT = None
+            procmesh._STATS["requested_processes"] = 0
+            procmesh._STATS["fallbacks_by_reason"] = {}
+            procmesh._STATS["run_fallbacks_by_reason"] = {}
+
+    reset()
+    yield procmesh
+    reset()
+
+
+# ------------------------------------------------------------------ unit
+
+
+def test_procs_from_env(monkeypatch):
+    monkeypatch.delenv("KSS_MESH_PROCESSES", raising=False)
+    assert procmesh.procs_from_env() == 0
+    monkeypatch.setenv("KSS_MESH_PROCESSES", "0")
+    assert procmesh.procs_from_env() == 0
+    monkeypatch.setenv("KSS_MESH_PROCESSES", "3")
+    assert procmesh.procs_from_env() == 3
+    monkeypatch.setenv("KSS_MESH_PROCESSES", "two")
+    with pytest.raises(ValueError):
+        procmesh.procs_from_env()
+    monkeypatch.setenv("KSS_MESH_PROCESSES", "-1")
+    with pytest.raises(ValueError):
+        procmesh.procs_from_env()
+
+
+def test_metrics_silent_until_knob_exercised(pm_state, monkeypatch):
+    """metrics()['procmesh'] stays None (and /metrics renders nothing)
+    while KSS_MESH_PROCESSES has never been set — the common case pays
+    no payload."""
+    monkeypatch.delenv("KSS_MESH_PROCESSES", raising=False)
+    assert SchedulerService._procmesh_stats() is None
+
+
+def test_acquire_without_aot_cache_counts_fallback(pm_state, monkeypatch):
+    """An engine with the knob set but no AOT cache drops the pool with
+    a counted reason (workers load, never compile — no cache means
+    nothing for them to load)."""
+    monkeypatch.delenv("KSS_AOT_CACHE_DIR", raising=False)
+    monkeypatch.setenv("KSS_MESH_PROCESSES", "1")
+    store = _cluster()
+    svc = _service(store)
+    svc.schedule_pending()
+    st = procmesh.stats()
+    assert st["requested_processes"] == 1
+    assert st["run_fallbacks_by_reason"].get("aot_cache_disabled", 0) >= 1
+    # scheduling was unaffected
+    assert any((p.get("spec") or {}).get("nodeName") for p in store.list("pods"))
+
+
+# ------------------------------------------------------------------- e2e
+
+
+def _cluster() -> ClusterStore:
+    rng = random.Random(7)
+    store = ClusterStore()
+    for i in range(12):
+        taints = (
+            [{"key": "dedicated", "value": "infra", "effect": "NoSchedule"}]
+            if i % 5 == 0
+            else None
+        )
+        store.create(
+            "nodes", mk_node(f"n{i}", cpu_m=4000 + 500 * (i % 3), mem_mi=8192,
+                             taints=taints)
+        )
+    for i in range(30):
+        p = mk_pod(
+            f"p{i}",
+            cpu_m=rng.choice([100, 250, 3900]),
+            mem_mi=rng.choice([64, 256]),
+            labels={"app": f"a{i % 4}"},
+        )
+        if i % 7 == 0:
+            p["spec"]["tolerations"] = [{"key": "dedicated", "operator": "Exists"}]
+        store.create("pods", p)
+    return store
+
+
+def _service(store) -> SchedulerService:
+    svc = SchedulerService(
+        store, tie_break="first", seed=3, use_batch="force", batch_min_work=0
+    )
+    svc.start_scheduler({"percentageOfNodesToScore": 100})
+    return svc
+
+
+def _run() -> dict:
+    store = _cluster()
+    svc = _service(store)
+    svc.schedule_pending()
+    return {
+        p["metadata"]["name"]: (
+            (p.get("spec") or {}).get("nodeName"),
+            p["metadata"].get("annotations") or {},
+        )
+        for p in store.list("pods")
+    }
+
+
+def test_single_worker_ensemble_end_to_end(pm_state, monkeypatch, tmp_path):
+    """N=1: the full protocol — spawn, jax.distributed handshake,
+    collectives probe, AOT artifact load on the worker, async
+    dispatch/fetch — with scheduling byte-identical to the in-process
+    run that exported the artifacts."""
+    monkeypatch.setenv("KSS_AOT_CACHE_DIR", str(tmp_path / "aot"))
+    monkeypatch.setenv("KSS_PROCMESH_TIMEOUT_S", "120")
+    baseline = _run()  # in-process; exports the scan artifact
+
+    monkeypatch.setenv("KSS_MESH_PROCESSES", "1")
+    ensemble = _run()
+    st = procmesh.stats()
+    assert ensemble == baseline, "ensemble scheduling diverged from in-process run"
+    if st["pool"] is None:
+        pytest.skip(
+            "SKIPPING LOUDLY: single-worker jax.distributed ensemble could not "
+            f"engage on this host — verdict={st['verdict']!r}, "
+            f"fallbacks={st['fallbacks_by_reason']}"
+        )
+    assert st["pool"]["engaged"] == 1
+    assert st["pool"]["dispatches"] >= 1
+    # load-never-compile: the scan resolved from the artifact cache on
+    # every worker (a compile inside a worker is structurally impossible
+    # — procmesh_worker.py has no build path)
+    assert st["pool"]["scans_loaded"] >= 1
+    assert st["run_fallbacks_by_reason"] == {}, st
+
+
+def test_multiprocess_ensemble_parity_or_loud_skip(pm_state, monkeypatch, tmp_path):
+    """N=2: on hosts where cross-process collectives exist the ensemble
+    engages and must match the in-process bytes; everywhere else the
+    bring-up probe fails, the fallback is COUNTED, scheduling still
+    matches, and the test skips loudly with the verdict."""
+    monkeypatch.setenv("KSS_AOT_CACHE_DIR", str(tmp_path / "aot"))
+    monkeypatch.setenv("KSS_PROCMESH_TIMEOUT_S", "120")
+    baseline = _run()
+
+    monkeypatch.setenv("KSS_MESH_PROCESSES", "2")
+    ensemble = _run()
+    st = procmesh.stats()
+    # parity holds whether or not the ensemble engaged
+    assert ensemble == baseline, "N=2 run diverged from in-process run"
+    if st["pool"] is None:
+        assert st["fallbacks_by_reason"], st
+        assert st["verdict"], st
+        pytest.skip(
+            "SKIPPING LOUDLY: multi-process jax.distributed ensemble could not "
+            f"engage on this host — verdict={st['verdict']!r} "
+            "(expected on CPU backends: initialize() succeeds but "
+            "cross-process collectives are unimplemented)"
+        )
+    assert st["pool"]["processes"] == 2
+    assert st["pool"]["dispatches"] >= 1

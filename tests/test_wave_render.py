@@ -1,0 +1,253 @@
+"""The capsule-resident wave annotation renderer (native/fastjson.c
+``wave_filter_many`` / ``wave_score_many`` via
+``BatchResult.materialize_wave``).
+
+The commit path renders a whole wave's filter/score/finalScore documents
+in O(1) C calls; the contract is BYTE identity with the per-pod Python
+builders it replaced — for every shape the commit path can see: plain
+fits, failure tables (taints, resource misses), selector pins, spread
+constraints, gang waves, and preemption rounds.  The Python renderer is
+forced by nulling ``native.fastjson`` (the engine reads it at call time
+and every native fast path gates on it), which is also how a build
+without the C extension runs — so these suites double as the
+no-extension parity pins.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any
+
+import pytest
+
+from kube_scheduler_simulator_tpu import native
+from kube_scheduler_simulator_tpu.scheduler.batch_engine import BatchEngine
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+from tests.test_batch_parity import mk_node, mk_pod, profile_with
+from tests.test_commit_pipeline import _mixed_cluster, _mixed_pods, _pod_states
+
+Obj = dict[str, Any]
+
+needs_capsule = pytest.mark.skipif(
+    native.fastjson is None or not hasattr(native.fastjson, "wave_filter_many"),
+    reason="native wave-capsule renderer unavailable (C extension not built)",
+)
+
+
+# ------------------------------------------------- result-level parity
+
+
+@needs_capsule
+def test_capsule_docs_match_python_perpod_renderer(monkeypatch):
+    """materialize_wave's documents vs the per-pod builders running pure
+    Python, over a workload that exercises failure tables (taints,
+    giant pods) and single-feasible pods (no score docs)."""
+    rng = random.Random(11)
+    store = ClusterStore()
+    for i in range(10):
+        taints = (
+            [{"key": "dedicated", "value": "infra", "effect": "NoSchedule"}]
+            if i % 4 == 0
+            else None
+        )
+        store.create(
+            "nodes", mk_node(f"n{i}", cpu_m=4000 + 500 * (i % 3), mem_mi=8192,
+                             taints=taints)
+        )
+    for i in range(36):
+        p = mk_pod(
+            f"p{i}",
+            cpu_m=rng.choice([100, 250, 3900]),
+            mem_mi=rng.choice([64, 256]),
+            labels={"app": f"a{i % 4}"},
+        )
+        if i % 7 == 0:
+            p["spec"]["tolerations"] = [{"key": "dedicated", "operator": "Exists"}]
+        store.create("pods", p)
+
+    svc = SchedulerService(store, tie_break="first", seed=3)
+    svc.start_scheduler({"percentageOfNodesToScore": 100})
+    fw = svc.framework
+    eng = BatchEngine.from_framework(fw, trace=True)
+    assert eng.supported
+    pending = fw.sort_pods(svc.pending_pods())
+    batch = eng.schedule(
+        store.list("nodes"), store.list("pods"), pending, store.list("namespaces")
+    )
+    js = [j for j in range(len(pending)) if int(batch.selected[j]) >= 0]
+    assert js
+    docs = batch.materialize_wave(js)
+    assert docs, "capsule path did not engage"
+
+    # per-pod builders, pure Python from here on: null the C module AND
+    # the wave capsule (a no-extension run never builds the capsule; the
+    # per-pod wave fast paths assume the module whenever the capsule
+    # exists)
+    monkeypatch.setattr(native, "fastjson", None)
+    monkeypatch.setattr(batch, "_wave", lambda: None)
+    compared_scores = 0
+    for j in js:
+        d = docs.get(j)
+        if d is None:
+            continue  # outside the capsule envelope: caller renders per-pod
+        assert d["filter"][0] == batch.filter_annotation_pair(j)[0], f"pod {j}"
+        if int(batch.feasible_count[j]) > 1:
+            sp, fp = batch.score_annotations_pairs(j)
+            assert d["score"][0] == sp[0], f"pod {j} score"
+            assert d["finalScore"][0] == fp[0], f"pod {j} finalScore"
+            compared_scores += 1
+    assert compared_scores > 0
+
+
+# ------------------------------------------------ service-level parity
+
+
+def _drain(svc, store, rounds):
+    for pods in rounds:
+        for p in pods:
+            store.create("pods", dict(p))
+        svc.schedule_pending()
+
+
+def _build_churn():
+    store = ClusterStore()
+    for n in _mixed_cluster(32):
+        store.create("nodes", n)
+    svc = SchedulerService(
+        store, seed=5, use_batch="force", batch_min_work=0, commit_wave=8,
+        pipeline=True,
+    )
+    svc.start_scheduler(
+        {
+            "profiles": [
+                profile_with(
+                    ["NodeResourcesFit", "TaintToleration", "NodeAffinity",
+                     "PodTopologySpread"]
+                )
+            ],
+            "percentageOfNodesToScore": 100,
+        }
+    )
+    return store, svc
+
+
+@needs_capsule
+def test_capsule_service_parity_randomized_churn(monkeypatch):
+    """Full commit path, multi-round churn (arrivals + deletions between
+    rounds): annotations byte-identical with the renderer swapped."""
+    rounds = [_mixed_pods(0, 40), _mixed_pods(40, 56)]
+
+    def run() -> dict:
+        store, svc = _build_churn()
+        _drain(svc, store, rounds[:1])
+        # churn: some scheduled pods leave before the next round
+        for i in range(0, 12, 3):
+            store.delete("pods", f"pod-{i}")
+        _drain(svc, store, rounds[1:])
+        return _pod_states(store)
+
+    capsule = run()
+    with monkeypatch.context() as m:
+        m.setattr(native, "fastjson", None)
+        python = run()
+
+    assert capsule.keys() == python.keys()
+    for name in sorted(capsule):
+        assert capsule[name][0] == python[name][0], f"{name}: node divergence"
+        c_ann, p_ann = capsule[name][1], python[name][1]
+        assert c_ann.keys() == p_ann.keys(), f"{name}: annotation keys differ"
+        for k in p_ann:
+            assert c_ann[k] == p_ann[k], (
+                f"{name} annotation {k} diverges:\n capsule={c_ann[k][:300]}\n"
+                f" python={p_ann[k][:300]}"
+            )
+
+
+@needs_capsule
+def test_capsule_service_parity_gang_shapes(monkeypatch):
+    """Gang waves (Permit park/release, PodGroup quorum) through both
+    renderers: the released members' trails must match byte-for-byte."""
+    from tests.test_gang import (
+        gang_service,
+        mk_group,
+        mk_member,
+        new_store,
+        pod_state,
+    )
+    from tests.test_gang import mk_node as mk_gnode
+
+    def run() -> dict:
+        store = new_store()
+        for i in range(6):
+            store.create("nodes", mk_gnode(f"node-{i}", cpu="8", zone=f"zone-{i % 3}"))
+        svc = gang_service(store, use_batch="auto")
+        rng = random.Random(21)
+        jid = 0
+        for wave in range(2):
+            for _ in range(2):
+                members = rng.randint(2, 4)
+                g = f"job-{jid}"
+                jid += 1
+                store.create("podgroups", mk_group(g, members, timeout=300))
+                for m2 in range(members):
+                    store.create(
+                        "pods",
+                        mk_member(f"{g}-m{m2}", g, cpu=str(rng.choice([1, 2]))),
+                    )
+            store.create("pods", mk_member(f"solo-{wave}", None))
+            svc.schedule_pending(max_rounds=3)
+        return pod_state(store)
+
+    capsule = run()
+    with monkeypatch.context() as m:
+        m.setattr(native, "fastjson", None)
+        python = run()
+    assert capsule == python
+
+
+@needs_capsule
+def test_capsule_service_parity_preemption_shapes(monkeypatch):
+    """A preemption round (nomination + victim eviction + the nominee's
+    later landing) through both renderers — the PostFilter trail and the
+    restarted wave's annotations must match byte-for-byte."""
+
+    def stamp(p: Obj, i: int, start: "str | None" = None) -> Obj:
+        p["metadata"]["creationTimestamp"] = f"2024-01-01T00:00:{i:02d}Z"
+        if start is not None:
+            p.setdefault("status", {})["startTime"] = start
+        return p
+
+    def run() -> dict:
+        store = ClusterStore()
+        for i in range(6):
+            store.create("nodes", mk_node(f"node-{i}", cpu_m=1000, mem_mi=2048))
+        for i in range(6):
+            v = mk_pod(f"victim-{i}", cpu_m=800, mem_mi=128)
+            v["spec"]["nodeName"] = f"node-{i}"
+            v["spec"]["priority"] = 0
+            store.create("pods", stamp(v, i, start=f"2024-01-01T01:00:{i:02d}Z"))
+        vip = mk_pod("vip", cpu_m=700, mem_mi=64)
+        vip["spec"]["priority"] = 1000
+        store.create("pods", stamp(vip, 30))
+        svc = SchedulerService(
+            store, tie_break="first", use_batch="auto", batch_min_work=0
+        )
+        svc.start_scheduler({"percentageOfNodesToScore": 100})
+        svc.schedule_pending()
+        out = {}
+        for p in store.list("pods"):
+            out[p["metadata"]["name"]] = (
+                (p.get("spec") or {}).get("nodeName"),
+                (p.get("status") or {}).get("nominatedNodeName"),
+                p["metadata"].get("annotations") or {},
+            )
+        return out
+
+    capsule = run()
+    assert capsule["vip"][0]  # the preemptor landed
+    with monkeypatch.context() as m:
+        m.setattr(native, "fastjson", None)
+        python = run()
+    assert capsule == python
