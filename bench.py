@@ -1703,6 +1703,205 @@ def run_profile_report(N=600, per_tick=100, ticks=96, seed_bound=4000, runs=2, q
     return row
 
 
+def run_replica_report(
+    readers=8, seed_pods=400, duration_s=4.0, target_waves_per_s=60.0, runs=2, quick=False
+):
+    """cfg14-replica: read offload onto read replicas — a journaled
+    primary under write churn PACED at a fixed target wave rate, with N
+    reader threads doing deep-copying list() traffic (the API server's
+    default read path, lock-held for the whole clone) against the
+    primary alone (R=0) or spread across R live-fed replicas (R=1, 2).
+    Per R, best-of-``runs`` fixed-duration windows, each metric taken
+    independently (shared-GIL scheduling noise must not couple the
+    claims to one lottery draw):
+
+    - aggregate read ops/s (the scaling claim),
+    - primary write waves/s achieved vs target (the flat-writes claim:
+      shipping is pull-based tailing, so the primary must sustain its
+      target REGARDLESS of attached replicas — and offloading readers
+      off its lock protects the write path from read pressure),
+    - post-drain replica parity (every replica dump byte-equals the
+      primary's) and residual lag.
+
+    CAVEAT, stated in the row: everything runs in ONE Python process,
+    so aggregate read throughput is GIL-capped near one core no matter
+    how many replica stores serve it — what this row can honestly show
+    is store-LOCK relief (reads stop convoying behind the primary's
+    writer and split across replica locks), parity, and lag.  The
+    KSS_REPLICA_OF multi-process server mode adds real cores on top;
+    this in-process row is the conservative floor."""
+    import tempfile
+    import threading
+
+    from kube_scheduler_simulator_tpu.replication.apply import ReplicaApplier
+    from kube_scheduler_simulator_tpu.state.journal import Journal
+    from kube_scheduler_simulator_tpu.state.recovery import build_checkpoint
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+    from kube_scheduler_simulator_tpu.utils.simclock import SimClock
+
+    if quick:
+        seed_pods, duration_s, runs = 100, 1.0, 1
+
+    try:
+        import jax
+
+        platform = jax.default_backend()
+    except Exception:
+        platform = "unknown"
+
+    def run_mode(n_replicas: int):
+        with tempfile.TemporaryDirectory(prefix="kss-bench-replica-") as td:
+            primary = ClusterStore(clock=SimClock(1_700_000_000.0))
+            journal = Journal(td)
+            primary.attach_journal(journal)
+            journal.checkpoint_provider = lambda: build_checkpoint(primary)
+            primary.create("namespaces", {"metadata": {"name": "default"}})
+            for i in range(seed_pods):
+                primary.create(
+                    "pods",
+                    {"metadata": {"name": f"seed-{i}"}, "spec": {"containers": [{"name": "c"}]}},
+                )
+            replicas = [ClusterStore(clock=SimClock(0.0)) for _ in range(n_replicas)]
+            appliers = [ReplicaApplier(r, td, notify=True) for r in replicas]
+            for a in appliers:
+                a.bootstrap()
+                a.step()
+            stop = threading.Event()
+            counts = {"reads": 0, "waves": 0}
+            lock = threading.Lock()
+
+            def writer():
+                # PACED at the target rate, not free-running: an
+                # unbounded writer in a shared-GIL process turns the row
+                # into a CPU lottery between reads and writes.  The flat-
+                # writes claim is "the primary sustains its target wave
+                # rate regardless of read pressure and attached replicas"
+                # — achieved/target is the number reported.
+                interval = 1.0 / target_waves_per_s
+                next_t = time.perf_counter()
+                i = 0
+                while not stop.is_set():
+                    now = time.perf_counter()
+                    if now < next_t:
+                        time.sleep(min(next_t - now, 0.01))
+                        continue
+                    next_t += interval
+                    with primary.journal_txn("wave"):
+                        for _ in range(4):
+                            primary.create(
+                                "pods",
+                                {
+                                    "metadata": {"name": f"churn-{i}"},
+                                    "spec": {"containers": [{"name": "c"}]},
+                                },
+                            )
+                            i += 1
+                        if i > 8:
+                            primary.delete("pods", f"churn-{i - 8}", "default")
+                    with lock:
+                        counts["waves"] += 1
+
+            def follower(a: ReplicaApplier):
+                while not stop.is_set():
+                    a.step()
+                    stop.wait(0.002)
+
+            def reader(k: int):
+                # R=0 reads hit the primary; R>0 reads spread round-robin
+                # across the replicas — the offload under measurement.
+                # Deep-copying list() (the API server's default read
+                # path) holds the store lock for the whole clone, so
+                # each read is real lock-held work, not a GIL spin.
+                src = primary if not replicas else replicas[k % len(replicas)]
+                n = 0
+                while not stop.is_set():
+                    objs = src.list("pods")
+                    n += 1
+                    if objs and n % 16 == 0:
+                        src.count("nodes")
+                with lock:
+                    counts["reads"] += n
+
+            threads = [threading.Thread(target=writer, daemon=True)]
+            threads += [threading.Thread(target=follower, args=(a,), daemon=True) for a in appliers]
+            threads += [threading.Thread(target=reader, args=(k,), daemon=True) for k in range(readers)]
+            for t in threads:
+                t.start()
+            time.sleep(duration_s)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30.0)
+            journal.close()
+            for a in appliers:
+                a.step()  # drain to the seal
+            want = primary.dump()
+            mismatches = sum(1 for r in replicas if r.dump() != want)
+            max_lag = max((a.stats["lag_records"] for a in appliers), default=0)
+            return {
+                "read_ops_per_s": counts["reads"] / duration_s,
+                "write_waves_per_s": counts["waves"] / duration_s,
+                "parity_mismatches": mismatches,
+                "post_drain_lag_records": max_lag,
+            }
+
+    per_r: dict = {}
+    for n_replicas in (0, 1, 2):
+        windows = []
+        for _ in range(runs):
+            windows.append(run_mode(n_replicas))
+            if windows[-1]["parity_mismatches"]:
+                break  # a parity failure must never be masked by best-of
+        # best-of per METRIC independently: in a shared-GIL process one
+        # window's thread-scheduling noise would otherwise couple the
+        # read-scaling and flat-writes claims to the same lottery draw
+        per_r[str(n_replicas)] = {
+            "read_ops_per_s": round(max(w["read_ops_per_s"] for w in windows), 1),
+            "write_waves_per_s": round(max(w["write_waves_per_s"] for w in windows), 1),
+            "parity_mismatches": sum(w["parity_mismatches"] for w in windows),
+            "post_drain_lag_records": max(w["post_drain_lag_records"] for w in windows),
+        }
+        print(
+            f"[replica] R={n_replicas}: {per_r[str(n_replicas)]['read_ops_per_s']:.0f} reads/s, "
+            f"{per_r[str(n_replicas)]['write_waves_per_s']:.0f} waves/s, "
+            f"{per_r[str(n_replicas)]['parity_mismatches']} parity mismatches",
+            file=sys.stderr,
+        )
+
+    return {
+        "config": "cfg14-replica",
+        "kernel_platform": platform,
+        "readers": readers,
+        "seed_pods": seed_pods,
+        "duration_s": duration_s,
+        "target_waves_per_s": target_waves_per_s,
+        "runs_per_mode": runs,
+        "per_replica_count": per_r,
+        "read_scaling_2_vs_0": (
+            round(per_r["2"]["read_ops_per_s"] / per_r["0"]["read_ops_per_s"], 2)
+            if per_r["0"]["read_ops_per_s"]
+            else None
+        ),
+        # the flat-writes claim, as achieved/target fractions: attaching
+        # replicas must not slow the primary (pull-based shipping), and
+        # offloading reads off its lock should RESTORE any rate lost to
+        # read pressure at R=0
+        "write_rate_achieved_frac": {
+            r: round(v["write_waves_per_s"] / target_waves_per_s, 2) for r, v in per_r.items()
+        },
+        "parity_note": (
+            "after draining to the closing seal, every replica dump byte-equals "
+            "the primary's (mismatch counts above)"
+        ),
+        "caveat": (
+            "single-process measurement: aggregate read throughput is GIL-capped "
+            "near one core regardless of replica count — this row shows store-lock "
+            "relief (reads stop convoying behind the primary's writer), write-path "
+            "protection, parity, and lag; the KSS_REPLICA_OF multi-process server "
+            "mode adds real cores on top"
+        ),
+    }
+
+
 def _mean_annotation_bytes(store) -> int:
     total = n = 0
     for p in store.list("pods", copy_objects=False):
@@ -2049,6 +2248,11 @@ def main() -> None:
         action="store_true",
         help="run cfg13-hostpath (fused streamed path vs serial round loop on this host, with the per-wave stage profiler's attribution table) and write BENCH_hostpath.json",
     )
+    ap.add_argument(
+        "--replica-report",
+        action="store_true",
+        help="run cfg14-replica (N reader threads vs 0/1/2 live-fed read replicas: read scaling, flat primary writes, post-drain parity) and write BENCH_replica.json",
+    )
     args = ap.parse_args()
 
     if args.profile_report:
@@ -2094,6 +2298,14 @@ def main() -> None:
     if args.tune_report:
         rows = run_tune_report(quick=args.quick)
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_tune.json")
+        with open(path, "w") as f:
+            json.dump(rows, f, indent=1)
+        print(json.dumps(rows, indent=1))
+        return
+
+    if args.replica_report:
+        rows = [run_replica_report(quick=args.quick)]
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_replica.json")
         with open(path, "w") as f:
             json.dump(rows, f, indent=1)
         print(json.dumps(rows, indent=1))

@@ -177,17 +177,37 @@ class ProcessChaos:
 
     # ------------------------------------------------------------- children
 
-    def _child(
-        self, mode: str, journal_dir: str, plan_path: str, out_path: str
-    ) -> subprocess.CompletedProcess:
+    @staticmethod
+    def _child_env() -> dict:
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
         env.setdefault("JAX_PLATFORM_NAME", "cpu")
         env["PYTHONPATH"] = _REPO_ROOT + (
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
         )
+        return env
+
+    @staticmethod
+    def _child_argv(mode: str, journal_dir: str, plan_path: str, out_path: str) -> list:
+        return [
+            sys.executable,
+            "-m",
+            "kube_scheduler_simulator_tpu.fuzz.crash_child",
+            "--mode",
+            mode,
+            "--journal-dir",
+            journal_dir,
+            "--plan",
+            plan_path,
+            "--out",
+            out_path,
+        ]
+
+    def _child(
+        self, mode: str, journal_dir: str, plan_path: str, out_path: str
+    ) -> subprocess.CompletedProcess:
         try:
-            return self._exec(mode, journal_dir, plan_path, out_path, env)
+            return self._exec(mode, journal_dir, plan_path, out_path, self._child_env())
         except subprocess.TimeoutExpired as e:
             raise ProcessChaosError(
                 f"{mode} child hung past {self.child_timeout_s:.0f}s"
@@ -197,19 +217,7 @@ class ProcessChaos:
         self, mode: str, journal_dir: str, plan_path: str, out_path: str, env: dict
     ) -> subprocess.CompletedProcess:
         return subprocess.run(
-            [
-                sys.executable,
-                "-m",
-                "kube_scheduler_simulator_tpu.fuzz.crash_child",
-                "--mode",
-                mode,
-                "--journal-dir",
-                journal_dir,
-                "--plan",
-                plan_path,
-                "--out",
-                out_path,
-            ],
+            self._child_argv(mode, journal_dir, plan_path, out_path),
             cwd=_REPO_ROOT,
             env=env,
             capture_output=True,
@@ -297,6 +305,163 @@ class ProcessChaos:
                             baseline["state"], recovered["state"], k
                         )
         return verdict
+
+
+class FailoverChaos(ProcessChaos):
+    """Kill-the-primary-mid-wave failover drill (replication/).
+
+    A hot-standby ``--mode follow`` child runs CONCURRENTLY with the
+    primary, tailing its live journal through a ``ReplicaApplier``.
+    The parent coordinates via a marker file: once the primary exits —
+    SIGKILLed at a seeded record index (the failover legs) or cleanly
+    (the ``kill_records=()`` churn leg) — the parent creates the plan's
+    ``promote_file`` and the follower promotes, resumes the scenario,
+    and reports.  The verdict extends ProcessChaos's with the
+    follower's ``max_lag`` (max post-drain backlog in records — one
+    record == one commit wave, so the ISSUE's "within one wave" bar is
+    ``max_lag <= 1``), ``torn_records`` and ``records_shipped``; byte
+    parity of the promoted state against the uninterrupted baseline is
+    the judgment, exactly as in the kill/recover differential.
+    """
+
+    def _spawn_follow(self, journal_dir: str, plan_path: str, out_path: str) -> subprocess.Popen:
+        return subprocess.Popen(
+            self._child_argv("follow", journal_dir, plan_path, out_path),
+            cwd=_REPO_ROOT,
+            env=self._child_env(),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+
+    def _follow_leg(
+        self, td: str, tag: str, jdir: str, promote_file: str
+    ) -> "tuple[subprocess.Popen, str]":
+        plan_path = os.path.join(td, f"plan-follow-{tag}.json")
+        with open(plan_path, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "scenario": self.scenario,
+                    "role": self.role,
+                    "promote_file": promote_file,
+                    "follow_deadline_s": self.child_timeout_s,
+                },
+                f,
+                sort_keys=True,
+            )
+        out_path = os.path.join(td, f"follow-{tag}.json")
+        return self._spawn_follow(jdir, plan_path, out_path), out_path
+
+    def _join_follow(
+        self, follower: subprocess.Popen, out_path: str, tag: str
+    ) -> Obj:
+        try:
+            _stdout, stderr = follower.communicate(timeout=self.child_timeout_s)
+        except subprocess.TimeoutExpired:
+            follower.kill()
+            follower.communicate()
+            raise ProcessChaosError(f"follow child {tag} hung past {self.child_timeout_s:.0f}s")
+        if follower.returncode != 0:
+            raise ProcessChaosError(
+                f"follow child {tag} rc={follower.returncode}: "
+                f"{stderr.decode(errors='replace')[-2000:]}"
+            )
+        try:
+            with open(out_path, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            raise ProcessChaosError(f"follow child {tag} produced no report") from None
+
+    def run(self) -> Obj:
+        verdict: Obj = {
+            "scenario": self.scenario.get("name", "scenario"),
+            "kill_points": [],
+            "divergences": [],
+            "truncated_records": 0,
+            "torn_records": 0,
+            "partial_gangs": 0,
+            "replayed_records": 0,
+            "records_shipped": 0,
+            "max_lag": 0,
+            "promotions": 0,
+            "first_mismatch": None,
+        }
+        with tempfile.TemporaryDirectory(prefix="kss-failover-") as td:
+            plan_path = os.path.join(td, "plan.json")
+            with open(plan_path, "w", encoding="utf-8") as f:
+                json.dump({"scenario": self.scenario, "role": self.role}, f, sort_keys=True)
+            base_jdir = os.path.join(td, "jr-base")
+            churn = not self.kill_records
+            follower = follow_out = None
+            promote_file = os.path.join(td, "promote-base")
+            if churn:
+                # the churn leg follows the BASELINE primary itself —
+                # clean exit, then promotion must reproduce its state
+                follower, follow_out = self._follow_leg(td, "base", base_jdir, promote_file)
+            base_out = os.path.join(td, "baseline.json")
+            proc = self._child("run", base_jdir, plan_path, base_out)
+            if proc.returncode != 0:
+                raise ProcessChaosError(
+                    f"baseline child rc={proc.returncode}: "
+                    f"{proc.stderr.decode(errors='replace')[-2000:]}"
+                )
+            baseline = self._read_out(base_out, "baseline", proc)
+            records = int(baseline["records"])
+            verdict["records"] = records
+            if churn:
+                with open(promote_file, "w", encoding="utf-8") as f:
+                    f.write("promote\n")
+                self._absorb(verdict, baseline, self._join_follow(follower, follow_out, "base"), 0)
+
+            for seed_k in self.kill_records:
+                k = 1 + (seed_k - 1) % max(records - 1, 1)
+                verdict["kill_points"].append(k)
+                jdir = os.path.join(td, f"jr-kill-{k}")
+                promote_file = os.path.join(td, f"promote-{k}")
+                follower, follow_out = self._follow_leg(td, str(k), jdir, promote_file)
+                kill_plan = os.path.join(td, f"plan-kill-{k}.json")
+                with open(kill_plan, "w", encoding="utf-8") as f:
+                    json.dump(
+                        {"scenario": self.scenario, "role": self.role, "kill_at": k},
+                        f,
+                        sort_keys=True,
+                    )
+                crash_out = os.path.join(td, f"crash-{k}.json")
+                try:
+                    proc = self._child("crash", jdir, kill_plan, crash_out)
+                except ProcessChaosError:
+                    follower.kill()
+                    follower.communicate()
+                    raise
+                if proc.returncode != -signal.SIGKILL:
+                    follower.kill()
+                    follower.communicate()
+                    raise ProcessChaosError(
+                        f"crash child at record {k} exited rc={proc.returncode} "
+                        f"instead of dying by SIGKILL: "
+                        f"{proc.stderr.decode(errors='replace')[-2000:]}"
+                    )
+                with open(promote_file, "w", encoding="utf-8") as f:
+                    f.write("promote\n")
+                self._absorb(verdict, baseline, self._join_follow(follower, follow_out, str(k)), k)
+        return verdict
+
+    @staticmethod
+    def _absorb(verdict: Obj, baseline: Obj, followed: Obj, kill_point: int) -> None:
+        stats = followed.get("recovery") or {}
+        promo = followed.get("promotion") or {}
+        verdict["truncated_records"] += int(stats.get("truncated_records", 0))
+        verdict["partial_gangs"] += int(stats.get("partial_gangs", 0))
+        verdict["replayed_records"] += int(stats.get("replayed_records", 0))
+        verdict["torn_records"] += int(promo.get("torn_records", 0))
+        verdict["records_shipped"] += int(followed.get("records_shipped", 0))
+        verdict["max_lag"] = max(verdict["max_lag"], int(followed.get("max_lag", 0)))
+        verdict["promotions"] += 1
+        if followed["state"] != baseline["state"]:
+            verdict["divergences"].append(kill_point)
+            if verdict["first_mismatch"] is None:
+                verdict["first_mismatch"] = _first_state_mismatch(
+                    baseline["state"], followed["state"], kill_point
+                )
 
 
 def _first_state_mismatch(a: list, b: list, kill_point: int) -> Obj:

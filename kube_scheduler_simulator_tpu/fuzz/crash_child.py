@@ -21,10 +21,20 @@ subprocesses (``python -m kube_scheduler_simulator_tpu.fuzz.crash_child``):
   last completed mark (re-running any partially-applied tick — scenario
   ops are idempotent by the fuzz runner's forgiveness rules).  Prints
   the final parity state + the recovery stats.
+- ``--mode follow`` (``fuzz.chaos.FailoverChaos``): a hot-standby
+  follower running CONCURRENTLY with the primary — a
+  ``replication.apply.ReplicaApplier`` tails the live journal,
+  tracking the max post-drain lag, until the parent creates the plan's
+  ``promote_file`` (its signal that the primary finished or was
+  SIGKILLed); then the follower PROMOTES
+  (``replication.promote.promote_replica``), resumes the scenario from
+  the shipped resume point exactly as ``recover`` would, and prints
+  the final parity state + promotion stats + ``max_lag``.
 
-The crash-parity pin: ``run`` state == ``recover`` state, byte for
-byte, with ``truncated_records == 0`` (a SIGKILL at a record boundary
-never tears) and ``partial_gangs == 0`` (wave/gang records are atomic).
+The crash-parity pin: ``run`` state == ``recover`` state == promoted
+``follow`` state, byte for byte, with ``truncated_records == 0`` (a
+SIGKILL at a record boundary never tears) and ``partial_gangs == 0``
+(wave/gang records are atomic).
 """
 
 from __future__ import annotations
@@ -70,6 +80,14 @@ def _depin_axon() -> None:
         pass
 
 
+def _profile_cfg(plan: Obj) -> "Obj | None":
+    if (plan["scenario"].get("profile") or "default") == "gang":
+        from kube_scheduler_simulator_tpu.gang import gang_scheduler_config
+
+        return gang_scheduler_config()
+    return None
+
+
 def _build_service(plan: Obj, store: Any):
     from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
     from kube_scheduler_simulator_tpu.utils.simclock import SimClock
@@ -85,12 +103,7 @@ def _build_service(plan: Obj, store: Any):
         autoscale=role["autoscale"],
         weights={},
     )
-    cfg = None
-    if (plan["scenario"].get("profile") or "default") == "gang":
-        from kube_scheduler_simulator_tpu.gang import gang_scheduler_config
-
-        cfg = gang_scheduler_config()
-    return svc, cfg, role
+    return svc, _profile_cfg(plan), role
 
 
 def _drive(scenario: Obj, store: Any, svc: Any, start_tick: int = 0) -> None:
@@ -167,11 +180,10 @@ def mode_run(plan: Obj, out_path: str, kill_at: "int | None") -> None:
 
 
 def mode_recover(plan: Obj, out_path: str) -> None:
-    from kube_scheduler_simulator_tpu.fuzz.runner import _settle, encode_state
+    from kube_scheduler_simulator_tpu.fuzz.runner import encode_state
     from kube_scheduler_simulator_tpu.state.recovery import (
         RecoveryManager,
         restore_scheduler_state,
-        write_mark,
     )
     from kube_scheduler_simulator_tpu.state.store import ClusterStore
     from kube_scheduler_simulator_tpu.utils.parity import pod_parity_state
@@ -189,21 +201,7 @@ def mode_recover(plan: Obj, out_path: str) -> None:
     # firing before the resumed run's first mark must embed it
     journal.last_mark = report.last_mark
 
-    mark = report.last_mark or {}
-    scenario = plan["scenario"]
-    resumed_from = -1
-    if mark.get("label") == "end":
-        # crash landed after the run finished: nothing to resume
-        resumed_from = len(scenario["ticks"]) + 1
-        write_mark(svc, resumed_from, label="end")
-    else:
-        resumed_from = int(mark.get("tick", -1)) + 1 if mark else 0
-        if resumed_from >= len(scenario["ticks"]):
-            # crash mid-settle: every tick completed; re-run the settle
-            _settle(store, svc, "autoscale" in scenario["features"])
-            write_mark(svc, len(scenario["ticks"]), label="end")
-        else:
-            _drive(scenario, store, svc, start_tick=resumed_from)
+    resumed_from = _resume(plan, store, svc, report)
     _emit(
         out_path,
         {
@@ -214,10 +212,87 @@ def mode_recover(plan: Obj, out_path: str) -> None:
     )
 
 
+def _resume(plan: Obj, store: Any, svc: Any, report: Any) -> int:
+    """Continue the scenario from the recovered/shipped resume point —
+    shared by the recovery leg and the promoted-follower leg (both must
+    rejoin the SAME timeline to hit byte parity with the baseline)."""
+    from kube_scheduler_simulator_tpu.fuzz.runner import _settle
+    from kube_scheduler_simulator_tpu.state.recovery import write_mark
+
+    mark = report.last_mark or {}
+    scenario = plan["scenario"]
+    if mark.get("label") == "end":
+        # crash landed after the run finished: nothing to resume
+        resumed_from = len(scenario["ticks"]) + 1
+        write_mark(svc, resumed_from, label="end")
+        return resumed_from
+    resumed_from = int(mark.get("tick", -1)) + 1 if mark else 0
+    if resumed_from >= len(scenario["ticks"]):
+        # crash mid-settle: every tick completed; re-run the settle
+        _settle(store, svc, "autoscale" in scenario["features"])
+        write_mark(svc, len(scenario["ticks"]), label="end")
+    else:
+        _drive(scenario, store, svc, start_tick=resumed_from)
+    return resumed_from
+
+
+def mode_follow(plan: Obj, out_path: str) -> int:
+    """Hot-standby leg: tail the primary's LIVE journal until the parent
+    signals (promote_file), then fail over and finish the scenario."""
+    import time
+
+    from kube_scheduler_simulator_tpu.fuzz.runner import encode_state
+    from kube_scheduler_simulator_tpu.replication.apply import ReplicaApplier
+    from kube_scheduler_simulator_tpu.replication.promote import promote_replica
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+    from kube_scheduler_simulator_tpu.utils.parity import pod_parity_state
+    from kube_scheduler_simulator_tpu.utils.simclock import SimClock
+
+    store = ClusterStore(clock=SimClock(1_700_000_000.0))
+    # notify=False: the follower has no subscribers during the drill —
+    # the HTTP replica mode is what rides notify=True
+    applier = ReplicaApplier(store, plan["journal_dir"], notify=False)
+    applier.bootstrap()
+    promote_file = plan["promote_file"]
+    poll_s = float(plan.get("poll_s") or 0.01)
+    deadline = time.monotonic() + float(plan.get("follow_deadline_s") or 240.0)
+    max_lag = 0
+    while not os.path.exists(promote_file):
+        applier.step()
+        max_lag = max(max_lag, int(applier.stats["lag_records"]))
+        if time.monotonic() > deadline:
+            print("follow child: promote_file never appeared", file=sys.stderr)
+            return 4
+        time.sleep(poll_s)
+    role = {**DEFAULT_ROLE, **(plan.get("role") or {})}
+    promotion = promote_replica(
+        applier,
+        lambda s: _build_service(plan, s)[0],
+        config_fallback=_profile_cfg(plan),
+    )
+    svc = promotion.service
+    report = promotion.recovery
+    journal = _attach(plan, role, store, svc, kill_at=None)
+    journal.last_mark = report.last_mark
+    resumed_from = _resume(plan, store, svc, report)
+    _emit(
+        out_path,
+        {
+            "state": encode_state(pod_parity_state(store)),
+            "recovery": report.stats(),
+            "promotion": promotion.stats(),
+            "max_lag": max_lag,
+            "records_shipped": applier.stats["records_shipped"],
+            "resumed_from": resumed_from,
+        },
+    )
+    return 0
+
+
 def main() -> int:
     _depin_axon()
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=("run", "crash", "recover"), required=True)
+    ap.add_argument("--mode", choices=("run", "crash", "recover", "follow"), required=True)
     ap.add_argument("--journal-dir", required=True)
     ap.add_argument("--plan", required=True, help="JSON plan: scenario + role (+ kill_at)")
     ap.add_argument("--out", required=True)
@@ -233,6 +308,8 @@ def main() -> int:
         # reaching here means the kill point never fired (index past the
         # end of the run) — the parent treats this exit code as a miss
         return 3
+    elif args.mode == "follow":
+        return mode_follow(plan, args.out)
     else:
         mode_recover(plan, args.out)
     return 0

@@ -359,6 +359,19 @@ def render_metrics(di: Any) -> str:
                     typ="gauge",
                 )
 
+    # journal shipping / read replica (replication/) — present only on
+    # a store fed by a ReplicaApplier (stays None on a primary)
+    rep = getattr(di.cluster_store, "replication_stats", None)
+    if rep is not None:
+        counter("replication_records_shipped_total", "Journal records shipped from the primary's WAL and applied to this replica's store.", rep["records_shipped"])
+        counter("replication_events_applied_total", "Store events applied by shipped records (a wave record carries many).", rep["events_applied"])
+        counter("replication_lag_records", "Complete journal records readable but not yet applied (one record == one commit wave).", rep["lag_records"], typ="gauge")
+        counter("replication_lag_seconds", "How long the apply backlog has been nonzero (0 when caught up with the durable stream).", round(rep["lag_seconds"], 6), typ="gauge")
+        counter("replication_torn_records_total", "Partial/corrupt frames observed while tailing (counted read-only; the tailer never truncates the primary's files).", rep["torn_records"])
+        counter("replication_rebases_total", "Follower rebases from a newer checkpoint after compaction pruned the segment being tailed.", rep["rebases"])
+        counter("replica_promotions_total", "Failovers: this replica finalized replay and became the primary.", rep["promotions"])
+        counter("replica_read_requests_total", "GET requests served by the replica's HTTP surface.", rep["read_requests"])
+
     store = di.cluster_store
     from kube_scheduler_simulator_tpu.state.store import KINDS
 

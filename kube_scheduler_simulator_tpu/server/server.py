@@ -149,8 +149,12 @@ class SimulatorServer:
             )
             self.kube_api_port = self.kube_api_server.start(background=True)
         # The scheduler runs continuously like the reference's
-        # `go sched.Run(ctx)` (scheduler.go:183).
-        self.di.scheduler_service().start_background()
+        # `go sched.Run(ctx)` (scheduler.go:183).  A read replica
+        # (replication/replica.py) has no scheduler to run — its store
+        # is FED by journal shipping, not driven — until promotion
+        # starts one itself.
+        if not getattr(self.di, "read_only", False):
+            self.di.scheduler_service().start_background()
         if background:
             self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
             self._thread.start()
@@ -259,10 +263,39 @@ def _make_handler(server: SimulatorServer):
         def do_OPTIONS(self) -> None:  # CORS preflight
             self._send_empty(204)
 
+        def _reject_read_only(self) -> bool:
+            """405 every write when the container is a read replica
+            (replication/replica.py): the replica's store is owned by
+            the journal-shipping applier, and a local mutation would
+            fork it from the primary's record stream."""
+            if not getattr(di, "read_only", False):
+                return False
+            data = json.dumps(
+                {"message": "read-only replica: writes go to the primary (or promote)"}
+            ).encode()
+            self.send_response(405)
+            self._cors_headers()
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Allow", "GET, OPTIONS")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return True
+
         def do_GET(self) -> None:
             url = urlparse(self.path)
             q = parse_qs(url.query)
+            note = getattr(di, "note_replica_read", None)
+            if note is not None:
+                note()
             try:
+                if url.path == "/api/v1/replication":
+                    status = getattr(di, "replication_status", None)
+                    if status is None:
+                        self._send_json(404, {"message": "not a replica"})
+                    else:
+                        self._send_json(200, status())
+                    return
                 if url.path in ("/", "/index.html"):
                     from kube_scheduler_simulator_tpu.server.webui import HTML
 
@@ -397,6 +430,21 @@ def _make_handler(server: SimulatorServer):
 
         def do_POST(self) -> None:
             url = urlparse(self.path)
+            if url.path == "/api/v1/replication/promote":
+                # the ONE write a replica accepts: failover. 201 with the
+                # promotion stats; idempotent (a repeat returns the first
+                # promotion's report).
+                promote = getattr(di, "promote", None)
+                if promote is None:
+                    self._send_json(404, {"message": "not a replica"})
+                    return
+                try:
+                    self._send_json(201, promote().stats())
+                except Exception as e:
+                    self._send_json(500, {"message": f"{type(e).__name__}: {e}"})
+                return
+            if self._reject_read_only():
+                return
             try:
                 if url.path == "/api/v1/schedulerconfiguration":
                     body = self._body() or {}
@@ -497,6 +545,8 @@ def _make_handler(server: SimulatorServer):
 
         def do_PUT(self) -> None:
             url = urlparse(self.path)
+            if self._reject_read_only():
+                return
             try:
                 if url.path == "/api/v1/reset":
                     di.reset_service().reset()
@@ -517,6 +567,8 @@ def _make_handler(server: SimulatorServer):
         def do_DELETE(self) -> None:
             url = urlparse(self.path)
             q = parse_qs(url.query)
+            if self._reject_read_only():
+                return
             try:
                 if (m := _NODEGROUP_RE.match(url.path)) and m.group(1):
                     # deleting a group stops future scaling; its nodes stay

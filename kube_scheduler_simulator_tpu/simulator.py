@@ -26,6 +26,38 @@ logger = logging.getLogger("simulator")
 def start_simulator(config_path: "str | None" = None, use_batch: str = "auto", block: bool = True):
     cfg = new_config(config_path)
 
+    # Read-replica mode (KSS_REPLICA_OF=<primary's KSS_JOURNAL_DIR>):
+    # boot the same HTTP server read-only over a journal-shipped store —
+    # no scheduler, no controllers, writes 405 until a promotion.
+    from kube_scheduler_simulator_tpu.replication.replica import replica_knobs
+
+    rknobs = replica_knobs()
+    if rknobs is not None:
+        from kube_scheduler_simulator_tpu.replication.replica import ReplicaContainer
+
+        rdi = ReplicaContainer(rknobs["directory"], poll_s=rknobs["poll_s"], use_batch=use_batch)
+        rdi.start_following()
+        rserver = SimulatorServer(
+            rdi,
+            port=cfg.port,
+            cors_allowed_origins=cfg.cors_allowed_origin_list,
+            kube_api_port=cfg.kube_api_port,
+        )
+        rport = rserver.start(background=True)
+        logger.info(
+            "read replica started on :%d following %s", rport, rknobs["directory"]
+        )
+        if not block:
+            return rserver
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        signal.signal(signal.SIGINT, lambda *_: stop.set())
+        try:
+            stop.wait()
+        finally:
+            rserver.shutdown()
+        return rserver
+
     external_source = None
     if cfg.external_import_enabled and cfg.kubeconfig:
         # The reference imports via client-go against a real cluster

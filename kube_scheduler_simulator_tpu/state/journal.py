@@ -42,6 +42,23 @@ Design points:
   the OS (surviving process death, the SIGKILL chaos model) without
   paying a disk sync per record; ``1`` syncs every record (surviving
   host power loss too).
+- **Publish ordering (shipping).**  A record's header + payload are
+  written as ONE buffered write followed by one flush, so a concurrent
+  reader of the live segment (:mod:`replication.ship` tails it while
+  the primary appends) always observes a strict PREFIX of the logical
+  record stream — never reordered or interleaved frame bytes.  That
+  prefix property is what makes the tailer's partial-vs-torn call
+  deterministic: a short frame at the tail is a mid-write record
+  (wait and re-poll), while a full-length frame whose CRC fails can
+  only be real damage.  ``kill_at`` fsyncs before killing, so the
+  "durable" a chaos kill publishes is the same durable a tailer reads.
+- **Seal markers.**  Rotation and clean close append a ``seal`` record
+  to the finished segment: a tailer that consumes a seal knows the
+  segment is COMPLETE and continues at index+1; a segment superseded
+  by a newer segment/checkpoint WITHOUT a seal marks a crash boundary.
+  Seals are framing metadata, not state: they are excluded from
+  ``stats["records"]`` and skipped (uncounted) by recovery and the
+  replication applier.
 
 Everything here is opt-in: with no journal attached the store takes one
 ``None`` check per emit and tier-1 stays byte-for-byte today's behavior.
@@ -69,6 +86,8 @@ _HEADER = struct.Struct("<II")  # payload length, crc32(payload)
 # sanity bound on a single record (a corrupt length field must not make
 # the reader try to allocate gigabytes): 256 MiB
 _MAX_RECORD = 256 << 20
+
+SEAL_TYPE = "seal"
 
 SEGMENT_PREFIX = "segment-"
 SEGMENT_SUFFIX = ".kssj"
@@ -176,6 +195,7 @@ class Journal:
             "bytes": 0,
             "compactions": 0,
             "fsyncs": 0,
+            "seals": 0,
         }
         os.makedirs(directory, exist_ok=True)
         segs = list_segments(directory)
@@ -246,8 +266,11 @@ class Journal:
                 raise JournalError("journal is closed")
             if rtype == "mark":
                 self.last_mark = extra
-            self._f.write(_HEADER.pack(len(data), zlib.crc32(data) & 0xFFFFFFFF))
-            self._f.write(data)
+            # ONE write for the whole frame, then one flush: a concurrent
+            # tailer of the live segment sees a strict prefix of the
+            # record stream, never a header published ahead of its
+            # payload (replication/ship.py leans on this)
+            self._f.write(_HEADER.pack(len(data), zlib.crc32(data) & 0xFFFFFFFF) + data)
             self._f.flush()
             if self.fsync:
                 os.fsync(self._f.fileno())
@@ -303,6 +326,23 @@ class Journal:
             meta = self._meta()
             return self._write_checkpoint(payload, meta)
 
+    def _seal_locked(self) -> None:
+        """Append the segment-sealed marker (``{"t": "seal"}``) to the
+        CURRENT segment — called under ``_mu`` at rotation and clean
+        close.  A tailer that reads a seal knows the segment is
+        complete and continues at the next index; damage after a seal,
+        or a superseded segment without one, is a crash, not a
+        mid-write tail.  Framing metadata only: not counted in
+        ``stats["records"]``, skipped by recovery and replication."""
+        data = _dumps({"t": SEAL_TYPE})
+        self._f.write(_HEADER.pack(len(data), zlib.crc32(data) & 0xFFFFFFFF) + data)
+        self._f.flush()
+        if self.fsync:
+            os.fsync(self._f.fileno())
+            self.stats["fsyncs"] += 1
+        self.stats["seals"] += 1
+        self.stats["bytes"] += _HEADER.size + len(data)
+
     def _write_checkpoint(self, payload: Obj, meta: Obj) -> "str | None":
         with self._mu:
             if self._closed:
@@ -320,7 +360,10 @@ class Journal:
                 f.flush()
                 os.fsync(f.fileno())
             # rotate, then prune: the checkpoint at index k covers every
-            # record in segments < k
+            # record in segments < k.  Seal the finished segment FIRST —
+            # a tailer mid-segment follows the seal into the new index
+            # without ever needing the checkpoint it already replayed.
+            self._seal_locked()
             self._f.close()
             self._seg_index = new_index
             self._f = self._open_segment(new_index)
@@ -342,7 +385,9 @@ class Journal:
     def close(self) -> None:
         with self._mu:
             if not self._closed:
-                self._f.flush()
+                # clean shutdown seals the live segment: a follower can
+                # tell "primary exited" from "primary crashed mid-write"
+                self._seal_locked()
                 self._f.close()
                 self._closed = True
 

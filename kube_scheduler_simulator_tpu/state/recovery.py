@@ -167,6 +167,86 @@ class RecoveryReport:
         }
 
 
+def load_checkpoint(store: Any, payload: Obj, report: RecoveryReport) -> None:
+    """Load one checkpoint document into ``store`` (objects verbatim,
+    counters restored, pre-checkpoint watch versions expired) and seed
+    ``report``'s meta/mark/config base from it.  Shared by boot-time
+    recovery and the replication applier's bootstrap
+    (:mod:`replication.apply`)."""
+    x = payload.get("x") or {}
+    resources = x.get("resources") or {}
+    report.scheduler_config = resources.get("schedulerConfig")
+    for json_key, kind in _SNAP_KEYS:
+        for o in resources.get(json_key) or []:
+            store.replay_object(kind, o)
+    for kind, objs in (x.get("extra") or {}).items():
+        for o in objs:
+            store.replay_object(kind, o)
+    counters = x.get("counters")
+    if counters:
+        store.restore_durability_counters(counters)
+        # pre-checkpoint events are compacted away: a watcher holding
+        # an older resourceVersion must 410-relist, not resume
+        store.expire_events_before(int(counters.get("rv", 0)))
+    report.last_meta = dict(payload.get("meta") or {})
+    report.last_meta["counters"] = counters
+    # the resume point the compacted segments carried (journal
+    # rotation must never lose the last completed mark)
+    if payload.get("mark") is not None:
+        report.last_mark = payload["mark"]
+
+
+def apply_record(store: Any, payload: Obj, report: RecoveryReport, notify: bool = False) -> bool:
+    """Apply ONE journal record to a live store — the incremental replay
+    seam.  Boot-time recovery calls it per record over a fresh,
+    unsubscribed store; the replication applier (:mod:`replication.apply`)
+    calls it per SHIPPED record against a serving replica store, with
+    ``notify=True`` so the replica's own watchers see the events.
+
+    The record's events apply under the store lock as one unit (a wave
+    or gang record is atomic to concurrent replica readers, exactly as
+    it is atomic across a crash).  Returns True for a state record;
+    False for framing/base records — ``seal`` markers are skipped
+    outright, and a ``checkpoint`` document (the tailer injects one
+    when it crosses a rotation) only refreshes the meta/mark/counter
+    base: its objects were already applied record by record."""
+    rtype = payload.get("t")
+    if rtype == "seal":
+        return False
+    if rtype == "checkpoint":
+        # a FULL meta base (records after it carry deltas against it —
+        # including fields that drifted record-lessly, e.g. rotation
+        # counters bumped by guard-skipped attempts)
+        report.last_meta = dict(payload.get("meta") or {})
+        counters = (payload.get("x") or {}).get("counters")
+        if counters:
+            report.last_meta["counters"] = counters
+        if payload.get("mark") is not None:
+            report.last_mark = payload["mark"]
+        cfg = ((payload.get("x") or {}).get("resources") or {}).get("schedulerConfig")
+        if cfg is not None:
+            report.scheduler_config = cfg
+        return False
+    meta = payload.get("meta") or {}
+    events = payload.get("events") or []
+    if events:
+        with store.lock:
+            for kind, type_, obj in events:
+                store.replay_event(kind, type_, obj, notify=notify)
+                report.replayed_events += 1
+    if meta:
+        # MERGE, don't replace: providers omit unchanged fields
+        # (the queue snapshot is delta-emitted), so an absent key
+        # means "same as the previous record", not "empty"
+        report.last_meta.update(meta)
+    if rtype == "mark":
+        report.last_mark = payload.get("x") or {}
+    elif rtype == "config":
+        report.scheduler_config = (payload.get("x") or {}).get("config")
+    report.replayed_records += 1
+    return True
+
+
 class RecoveryManager:
     """Replays a journal directory into a fresh store.
 
@@ -197,7 +277,7 @@ class RecoveryManager:
             if payload is None:
                 report.bad_checkpoints += 1
                 continue
-            self._load_checkpoint(store, payload, report)
+            load_checkpoint(store, payload, report)
             report.checkpoint_loaded = True
             report.checkpoint_index = idx
             start_index = idx
@@ -211,8 +291,8 @@ class RecoveryManager:
                     torn_at = offset
                     report.truncated_records += 1
                     break
-                self._apply_record(store, payload, report)
-                report.replayed_records += 1
+                # seal markers are framing metadata (skipped, uncounted)
+                apply_record(store, payload, report)
             if torn_at is not None:
                 # truncate the torn tail in place (the next boot reads a
                 # clean file) and stop: records after a tear are garbage
@@ -224,47 +304,6 @@ class RecoveryManager:
             store.restore_durability_counters(counters)
         store.recovery_stats = report.stats()
         return report
-
-    # ------------------------------------------------------------- internals
-
-    def _load_checkpoint(self, store: Any, payload: Obj, report: RecoveryReport) -> None:
-        x = payload.get("x") or {}
-        resources = x.get("resources") or {}
-        report.scheduler_config = resources.get("schedulerConfig")
-        for json_key, kind in _SNAP_KEYS:
-            for o in resources.get(json_key) or []:
-                store.replay_object(kind, o)
-        for kind, objs in (x.get("extra") or {}).items():
-            for o in objs:
-                store.replay_object(kind, o)
-        counters = x.get("counters")
-        if counters:
-            store.restore_durability_counters(counters)
-            # pre-checkpoint events are compacted away: a watcher holding
-            # an older resourceVersion must 410-relist, not resume
-            store.expire_events_before(int(counters.get("rv", 0)))
-        report.last_meta = dict(payload.get("meta") or {})
-        report.last_meta["counters"] = counters
-        # the resume point the compacted segments carried (journal
-        # rotation must never lose the last completed mark)
-        if payload.get("mark") is not None:
-            report.last_mark = payload["mark"]
-
-    def _apply_record(self, store: Any, payload: Obj, report: RecoveryReport) -> None:
-        rtype = payload.get("t")
-        meta = payload.get("meta") or {}
-        for kind, type_, obj in payload.get("events") or []:
-            store.replay_event(kind, type_, obj)
-            report.replayed_events += 1
-        if meta:
-            # MERGE, don't replace: providers omit unchanged fields
-            # (the queue snapshot is delta-emitted), so an absent key
-            # means "same as the previous record", not "empty"
-            report.last_meta.update(meta)
-        if rtype == "mark":
-            report.last_mark = payload.get("x") or {}
-        elif rtype == "config":
-            report.scheduler_config = (payload.get("x") or {}).get("config")
 
     # ------------------------------------------------------------ invariants
 

@@ -215,6 +215,9 @@ class ClusterStore:
         # is populated by state/recovery.py after a boot-time replay.
         self.journal: Any = None
         self.recovery_stats: "dict[str, int] | None" = None
+        # live journal-shipping counters (replication/apply.py): set by a
+        # ReplicaApplier feeding this store; stays None on a primary
+        self.replication_stats: "dict[str, Any] | None" = None
         # per-THREAD transaction buffer: a journal_txn groups only the
         # events its own thread emits (other threads' concurrent
         # mutations are their own transactions), and holding no lock
@@ -351,11 +354,15 @@ class ClusterStore:
             rv = int(meta.get("resourceVersion") or 0)
             self._rv = max(self._rv, rv)
 
-    def replay_event(self, kind: str, type_: str, obj: Mapping[str, Any]) -> None:
-        """Recovery-only: re-apply one journaled event — bucket update
+    def replay_event(self, kind: str, type_: str, obj: Mapping[str, Any], notify: bool = False) -> None:
+        """Replay-only: re-apply one journaled event — bucket update
         plus an event-log append (so watchers can resume from replayed
-        resourceVersions), WITHOUT notifying subscribers (recovery runs
-        before any component subscribes)."""
+        resourceVersions).  Boot-time recovery leaves ``notify`` off
+        (replay runs before any component subscribes); a live read
+        replica (replication/apply.py) passes ``notify=True`` so its
+        OWN subscribers — the watcher service's streams — see shipped
+        events as they apply.  Update hooks and the journal are never
+        involved: a replayed event is history, not a new mutation."""
         with self._lock:
             bucket = self._bucket(kind)
             o = _clone(dict(obj))
@@ -371,6 +378,21 @@ class ClusterStore:
             if log.maxlen is not None and len(log) == log.maxlen:
                 self._evicted_rv[kind] = log[0].resource_version
             log.append(ev)
+            if notify:
+                for kinds, cb in list(self._subscribers):
+                    if kind in kinds:
+                        cb(ev)
+
+    def clear_for_replay(self) -> None:
+        """Replication rebase (replication/apply.py): drop every bucket
+        and event log so a NEWER checkpoint can be loaded verbatim after
+        compaction pruned the segment a follower was reading.  Counters
+        are kept — ``restore_durability_counters`` max-merges, so the
+        resourceVersions connected watchers hold never regress."""
+        with self._lock:
+            for kind in KINDS:
+                self._objs[kind].clear()
+                self._event_log[kind].clear()
 
     def expire_events_before(self, rv: int) -> None:
         """Mark every kind's event log as compacted below ``rv``: a

@@ -140,8 +140,15 @@ def _write_expected(directory: str, doc: dict) -> None:
 
 
 def _record_offsets(path: str) -> list[int]:
-    """Byte offset of every intact record, in order."""
-    return [off for off, payload in read_records(path) if payload is not None]
+    """Byte offset of every intact STATE record, in order — seal markers
+    (appended by clean close/rotation) are framing metadata, and the
+    torn-tail fixture must truncate inside the last OP record, not
+    inside the trailing seal."""
+    return [
+        off
+        for off, payload in read_records(path)
+        if payload is not None and payload.get("t") != "seal"
+    ]
 
 
 def gen_torn_tail(root: str) -> None:

@@ -1,0 +1,327 @@
+#!/usr/bin/env python
+"""Replication smoke (tier-1): the two-process failover drill.
+
+A fixed churn scenario runs as a JOURNALED primary subprocess while a
+hot-standby ``--mode follow`` subprocess tails its live journal
+concurrently (replication/, docs/replication.md).  Three legs:
+
+- **churn**: the primary exits cleanly; the follower must track it
+  within ONE commit wave (``max_lag <= 1`` — one journal record is one
+  wave) and its promotion must reproduce the primary's full annotation
+  trail byte-for-byte.
+- **failover**: the primary is SIGKILLed mid-wave at seeded record
+  indices; the follower promotes and finishes the scenario — the
+  promoted run must byte-match an uninterrupted baseline, with the
+  follower's ``recovery_truncated_records_total == 0`` (the tailer
+  never truncates; a kill-boundary tail is a crash-boundary step-over,
+  not damage).
+- **serve**: an in-process read replica behind the real HTTP server —
+  reads 200 (and counted), writes 405, ``/metrics`` surfaces the
+  ``replication_*`` family, promotion over HTTP unlocks writes.
+
+A divergence ddmin-shrinks (fuzz/shrink.py) before reporting, like
+fuzz_smoke.  Exit 0 = failover parity holds; nonzero = divergence or
+harness failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:  # the axon plugin dials the TPU tunnel even when CPU-pinned
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+ROLE = {"use_batch": "auto", "commit_wave": 4, "checkpoint_every": 10}
+
+
+def _node(i: int) -> dict:
+    return {
+        "op": "create",
+        "kind": "nodes",
+        "object": {
+            "metadata": {"name": f"rpn-{i}", "labels": {"zone": f"z{i % 2}"}},
+            "status": {
+                "allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"},
+                "capacity": {"cpu": "8", "memory": "16Gi", "pods": "110"},
+            },
+        },
+    }
+
+
+def _pod(i: int, cpu: str = "500m") -> dict:
+    return {
+        "op": "create",
+        "kind": "pods",
+        "object": {
+            "metadata": {"name": f"rpp-{i}"},
+            "spec": {
+                "containers": [
+                    {"name": "c", "resources": {"requests": {"cpu": cpu, "memory": "256Mi"}}}
+                ]
+            },
+        },
+    }
+
+
+def smoke_scenario() -> dict:
+    """Fixed journaled-churn timeline (the crash_smoke shape): pod
+    storms sized to multiple commit waves, deletes, a cordon/uncordon
+    patch pair — every tick a different mutation class for the
+    follower to ship."""
+    return {
+        "name": "replica-smoke",
+        "features": ["churn"],
+        "stepSeconds": 1.0,
+        "profile": "default",
+        "ticks": [
+            [_node(0), _node(1)] + [_pod(i) for i in range(8)],
+            [_pod(i) for i in range(8, 14)]
+            + [{"op": "delete", "kind": "pods", "name": "rpp-1", "namespace": "default"}],
+            [
+                _node(2),
+                {
+                    "op": "patch",
+                    "kind": "nodes",
+                    "name": "rpn-0",
+                    "body": {"spec": {"unschedulable": True}},
+                },
+            ]
+            + [_pod(i) for i in range(14, 18)],
+            [
+                {"op": "delete", "kind": "nodes", "name": "rpn-1"},
+                {
+                    "op": "patch",
+                    "kind": "nodes",
+                    "name": "rpn-0",
+                    "body": {"spec": {"unschedulable": None}},
+                },
+                _pod(18),
+            ],
+        ],
+    }
+
+
+def _triage(scn: dict, kill_points: list, mismatch) -> None:
+    """A divergence is a bug: shrink the scenario to the minimal
+    failing timeline before reporting (the fuzz_smoke discipline)."""
+    from kube_scheduler_simulator_tpu.fuzz.chaos import FailoverChaos, ProcessChaosError
+    from kube_scheduler_simulator_tpu.fuzz.shrink import shrink
+
+    first = (kill_points or [0])[0]
+
+    def still_fails(cand: dict) -> bool:
+        try:
+            v = FailoverChaos(
+                cand,
+                kill_records=(first,) if first else (),
+                role=ROLE,
+                child_timeout_s=120,
+            ).run()
+        except ProcessChaosError:
+            return False  # harness failure, not the divergence under shrink
+        return bool(v["divergences"])
+
+    mini, stats = shrink(scn, still_fails, max_checks=6)
+    print(
+        f"replica-smoke FAIL: promoted state diverged at kill points {kill_points}: "
+        f"{json.dumps(mismatch)[:4000]}\n"
+        f"shrunk repro ({stats['steps']} reductions): {json.dumps(mini)[:4000]}",
+        file=sys.stderr,
+    )
+
+
+def _leg(verdict: dict, name: str, scn: dict) -> int:
+    if verdict["divergences"]:
+        _triage(scn, verdict["divergences"], verdict["first_mismatch"])
+        return 1
+    if verdict["truncated_records"] != 0:
+        print(
+            f"replica-smoke FAIL [{name}]: follower truncated "
+            f"{verdict['truncated_records']} records (the tailer must never truncate "
+            "and a kill boundary must read as a crash-boundary step-over)",
+            file=sys.stderr,
+        )
+        return 1
+    if verdict["torn_records"] != 0:
+        print(
+            f"replica-smoke FAIL [{name}]: {verdict['torn_records']} torn records "
+            "shipped from clean SIGKILL boundaries",
+            file=sys.stderr,
+        )
+        return 1
+    if verdict["records_shipped"] <= 0:
+        print(f"replica-smoke FAIL [{name}]: follower shipped no records", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _http_leg() -> int:
+    """In-process read replica behind the real SimulatorServer."""
+    import urllib.error
+    import urllib.request
+
+    from kube_scheduler_simulator_tpu.replication.replica import ReplicaContainer
+    from kube_scheduler_simulator_tpu.server.server import SimulatorServer
+    from kube_scheduler_simulator_tpu.state.journal import Journal
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+    from kube_scheduler_simulator_tpu.utils.simclock import SimClock
+
+    def fail(msg: str) -> int:
+        print(f"replica-smoke FAIL [serve]: {msg}", file=sys.stderr)
+        return 1
+
+    with tempfile.TemporaryDirectory(prefix="kss-replica-serve-") as td:
+        primary = ClusterStore(clock=SimClock(1_700_000_000.0))
+        journal = Journal(td)
+        primary.attach_journal(journal)
+        primary.create("namespaces", {"metadata": {"name": "default"}})
+        for i in range(3):
+            primary.create("nodes", _node(i)["object"])
+        with primary.journal_txn("wave"):
+            for i in range(5):
+                primary.create("pods", _pod(i)["object"])
+        journal.close()
+
+        di = ReplicaContainer(td, poll_s=0.01)
+        server = SimulatorServer(di, port=0)
+        port = server.start(background=True)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            with urllib.request.urlopen(f"{base}/api/v1/resources/pods") as r:
+                if r.status != 200:
+                    return fail(f"replica GET rc={r.status}")
+                names = {o["metadata"]["name"] for o in json.load(r)["items"]}
+            if names != {f"rpp-{i}" for i in range(5)}:
+                return fail(f"replica served wrong pods: {sorted(names)}")
+            try:
+                req = urllib.request.Request(
+                    f"{base}/api/v1/resources/pods",
+                    data=json.dumps(_pod(99)["object"]).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                urllib.request.urlopen(req)
+                return fail("write on a read replica did not 405")
+            except urllib.error.HTTPError as e:
+                if e.code != 405:
+                    return fail(f"write on a read replica rc={e.code}, want 405")
+            with urllib.request.urlopen(f"{base}/api/v1/replication") as r:
+                status = json.load(r)
+            if status["role"] != "replica" or status["readRequests"] < 1:
+                return fail(f"replication status wrong pre-promotion: {status}")
+            with urllib.request.urlopen(f"{base}/metrics") as r:
+                text = r.read().decode()
+            for needle in (
+                "simulator_replication_records_shipped_total",
+                "simulator_replication_lag_records",
+                "simulator_replication_lag_seconds",
+                "simulator_replica_promotions_total",
+                "simulator_replica_read_requests_total",
+            ):
+                if needle not in text:
+                    return fail(f"/metrics missing {needle}")
+            if "simulator_replication_records_shipped_total 0" in text:
+                return fail("/metrics reports zero shipped records on a fed replica")
+            promote = urllib.request.Request(
+                f"{base}/api/v1/replication/promote", data=b"", method="POST"
+            )
+            with urllib.request.urlopen(promote) as r:
+                if r.status != 201:
+                    return fail(f"promote rc={r.status}")
+            create = urllib.request.Request(
+                f"{base}/api/v1/resources/pods",
+                data=json.dumps(_pod(99)["object"]).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(create) as r:
+                if r.status != 201:
+                    return fail(f"post-promotion write rc={r.status}")
+            with urllib.request.urlopen(f"{base}/api/v1/replication") as r:
+                if json.load(r)["role"] != "primary":
+                    return fail("promoted replica still reports role=replica")
+        finally:
+            server.shutdown()
+            di.close()
+    return 0
+
+
+def main() -> int:
+    from kube_scheduler_simulator_tpu.fuzz.chaos import FailoverChaos
+
+    t0 = time.monotonic()
+    scn = smoke_scenario()
+
+    # ---- churn: clean primary exit; the lag bar and parity
+    churn = FailoverChaos(scn, kill_records=(), role=ROLE, child_timeout_s=240).run()
+    print(
+        f"replica-smoke churn: records={churn['records']} "
+        f"shipped={churn['records_shipped']} max_lag={churn['max_lag']}"
+    )
+    rc = _leg(churn, "churn", scn)
+    if rc:
+        return rc
+    if churn["max_lag"] > 1:
+        print(
+            f"replica-smoke FAIL [churn]: follower lag {churn['max_lag']} records "
+            "exceeds one commit wave",
+            file=sys.stderr,
+        )
+        return 1
+
+    # ---- failover: SIGKILL the primary mid-wave (early + late), promote
+    failover = FailoverChaos(
+        scn, kill_records=(7, 10**9 + 9), role=ROLE, child_timeout_s=240
+    ).run()
+    print(
+        f"replica-smoke failover: kill_points={failover['kill_points']} "
+        f"shipped={failover['records_shipped']} replayed={failover['replayed_records']} "
+        f"promotions={failover['promotions']}"
+    )
+    rc = _leg(failover, "failover", scn)
+    if rc:
+        return rc
+    if failover["promotions"] != 2:
+        print(
+            f"replica-smoke FAIL [failover]: {failover['promotions']} promotions, want 2",
+            file=sys.stderr,
+        )
+        return 1
+
+    # ---- serve: the read replica behind the real HTTP server
+    rc = _http_leg()
+    if rc:
+        return rc
+
+    wall = time.monotonic() - t0
+    print(
+        f"replica-smoke OK: churn lag <= 1 wave ({churn['max_lag']}), "
+        f"{len(failover['kill_points'])} failovers byte-identical "
+        f"({failover['records_shipped']} records shipped, 0 torn, 0 truncated), "
+        f"read replica served + promoted over HTTP; {wall:.0f}s"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

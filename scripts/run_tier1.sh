@@ -51,6 +51,13 @@ if ! timeout -k 10 420 env JAX_PLATFORMS=cpu python scripts/fuzz_smoke.py; then 
 # recovery_truncated_records_total == 0, zero partial waves/gangs,
 # compaction engaged, /metrics wiring (scripts/crash_smoke.py).
 if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/crash_smoke.py; then rc=1; fi
+# Replication smoke (docs/replication.md): a journaled churn primary
+# tailed LIVE by a hot-standby follower subprocess — follower lag <= 1
+# commit wave under churn, SIGKILL-the-primary failovers whose promoted
+# runs byte-match an uninterrupted baseline with zero truncated/torn
+# records, and an in-process read replica served over HTTP (reads 200 +
+# counted, writes 405, replication_* metrics, promotion unlocks writes).
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/replica_smoke.py; then rc=1; fi
 # Host-path perf smoke (docs/batch-engine.md "Where the wall goes"):
 # the fused streamed path vs the serial per-tick loop at smoke size,
 # min-of-3 walls, byte parity + per-wave stage profiles asserted, and

@@ -39,6 +39,10 @@ def _records(directory: str) -> list[dict]:
     for _idx, path in list_segments(directory):
         for _off, payload in read_records(path):
             assert payload is not None, "unexpected torn record"
+            # seal markers are framing metadata (rotation / clean close),
+            # not state records — every stats/content pin ignores them
+            if payload.get("t") == "seal":
+                continue
             out.append(payload)
     return out
 

@@ -1,0 +1,486 @@
+"""Replicated control plane (replication/): journal shipping, the
+hot-standby applier, read-replica serving, and failover promotion.
+
+The load-bearing pins:
+
+- partial-vs-torn is DETERMINISTIC: a frame truncated at EVERY possible
+  byte offset reads as a mid-write open tail (wait), never as damage,
+  while a full-length corrupted frame reads as torn — and the tailer
+  never truncates the primary's files either way;
+- a follower's store replays through the same ``apply_record`` seam as
+  boot recovery, so incremental shipping reaches byte-equal dumps;
+- promotion byte-matches recovery and re-numbers the watch epoch (a
+  replica-fed watcher relists, mirroring the kill-recover-resume 410
+  contract in tests/test_recovery.py);
+- the ``replication_*`` metrics family renders exactly when a store is
+  replica-fed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import pytest
+
+from kube_scheduler_simulator_tpu.replication.apply import ReplicaApplier
+from kube_scheduler_simulator_tpu.replication.promote import promote_replica
+from kube_scheduler_simulator_tpu.replication.replica import ReplicaContainer, replica_knobs
+from kube_scheduler_simulator_tpu.replication.ship import JournalTailer, SegmentPruned
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.services.resourcewatcher import ResourceWatcherService
+from kube_scheduler_simulator_tpu.state.journal import _HEADER, Journal, list_segments
+from kube_scheduler_simulator_tpu.state.recovery import build_checkpoint
+from kube_scheduler_simulator_tpu.state.store import ClusterStore, ResourceExpiredError
+from kube_scheduler_simulator_tpu.utils.simclock import SimClock
+
+
+def _store() -> ClusterStore:
+    return ClusterStore(clock=SimClock(1_700_000_000.0))
+
+
+def _pod(name: str) -> dict:
+    return {"metadata": {"name": name}, "spec": {}}
+
+
+def _journaled(tmp_path, **journal_kw):
+    store = _store()
+    journal = Journal(str(tmp_path), **journal_kw)
+    store.attach_journal(journal)
+    journal.checkpoint_provider = lambda: build_checkpoint(store)
+    return store, journal
+
+
+# ------------------------------------------------------------ partial vs torn
+
+
+def test_truncation_at_every_byte_offset_reads_as_open_tail(tmp_path):
+    """The single-write publish ordering makes a short tail ALWAYS a
+    mid-write transient: chop the final frame at every byte offset and
+    the tailer must consume exactly the complete prefix, classify the
+    tail as open (wait, re-poll), count nothing torn, and leave the
+    file bytes untouched."""
+    src = str(tmp_path / "src")
+    store, journal = _journaled(src)
+    store.create("namespaces", {"metadata": {"name": "default"}})
+    store.create("pods", _pod("tp0"))
+    store.create("pods", _pod("tp1"))
+    seg_path = list_segments(src)[-1][1]
+    with open(seg_path, "rb") as f:
+        blob = f.read()
+    # offsets of each complete frame start (skip the 8-byte magic)
+    offs = []
+    pos = 8
+    while pos < len(blob):
+        length = _HEADER.unpack(blob[pos : pos + _HEADER.size])[0]
+        offs.append(pos)
+        pos += _HEADER.size + length
+    last = offs[-1]
+    tdir = str(tmp_path / "cut")
+    os.makedirs(tdir)
+    cut_path = os.path.join(tdir, os.path.basename(seg_path))
+    for cut in range(last, len(blob)):  # every truncation point in the frame
+        with open(cut_path, "wb") as f:
+            f.write(blob[:cut])
+        tailer = JournalTailer(tdir)
+        got = tailer.poll()
+        assert len(got) == len(offs) - 1, f"cut at {cut}"
+        assert tailer.stats["torn_records"] == 0, f"cut at {cut}"
+        assert tailer.pending_records() == 0, f"cut at {cut}"
+        with open(cut_path, "rb") as f:
+            assert f.read() == blob[:cut], "tailer must never truncate"
+    # sanity: the uncut file ships every record
+    with open(cut_path, "wb") as f:
+        f.write(blob)
+    assert len(JournalTailer(tdir).poll()) == len(offs)
+
+
+def test_full_length_corruption_reads_as_torn_not_open(tmp_path):
+    """A full-length frame with a flipped payload byte is real damage:
+    counted torn exactly once across repeated polls, never waited out
+    — and never truncated."""
+    src = str(tmp_path / "src")
+    store, journal = _journaled(src)
+    store.create("namespaces", {"metadata": {"name": "default"}})
+    store.create("pods", _pod("cp0"))
+    store.create("pods", _pod("cp1"))
+    seg_path = list_segments(src)[-1][1]
+    with open(seg_path, "rb") as f:
+        blob = f.read()
+    # flip one byte in the LAST frame's payload
+    with open(seg_path, "r+b") as f:
+        f.seek(len(blob) - 3)
+        f.write(bytes([blob[-3] ^ 0xFF]))
+    tailer = JournalTailer(src)
+    got = tailer.poll()
+    assert len(got) == 2  # namespace + first pod survive
+    assert tailer.stats["torn_records"] == 1
+    tailer.poll()
+    tailer.poll()
+    assert tailer.stats["torn_records"] == 1, "a wedged tail is counted once"
+    with open(seg_path, "rb") as f:
+        assert os.path.getsize(seg_path) == len(blob), "tailer must never truncate"
+
+
+def test_tailer_crosses_seal_into_next_epoch(tmp_path):
+    """A clean close seals the segment; the successor epoch opens
+    index+1 on the same directory.  The tailer consumes the seal
+    silently and follows into the new segment — no torn count, no
+    rebase."""
+    store, journal = _journaled(str(tmp_path))
+    store.create("namespaces", {"metadata": {"name": "default"}})
+    tailer = JournalTailer(str(tmp_path))
+    store.create("pods", _pod("r0"))
+    assert len(tailer.poll()) == 2  # caught up BEFORE the epoch change
+    journal.close()  # seals segment 1
+    j2 = Journal(str(tmp_path))  # epoch 2 opens segment 2
+    store.attach_journal(j2)
+    store.create("pods", _pod("r1"))
+    shipped = tailer.poll()
+    assert [p.get("t") for p in shipped] == ["event"]  # seal consumed silently
+    assert shipped[0]["events"][0][2]["metadata"]["name"] == "r1"
+    assert tailer.stats["seals"] == 1
+    assert tailer.stats["segments_crossed"] == 1
+    assert tailer.stats["torn_records"] == 0
+
+
+def test_tailer_injects_checkpoint_at_crash_boundary(tmp_path):
+    """A tailer mid-segment when compaction rotates can win the race
+    with the prune: it finishes the (unsealed-looking) old segment,
+    sees a newer epoch, and must step across the crash boundary
+    injecting the boundary checkpoint as its fresh meta base — never
+    counting the clean end-of-file as torn."""
+    store, journal = _journaled(str(tmp_path))
+    store.create("namespaces", {"metadata": {"name": "default"}})
+    store.create("pods", _pod("pre"))
+    seg1 = list_segments(str(tmp_path))[-1][1]
+    with open(seg1, "rb") as f:
+        blob = f.read()  # the pre-rotation, unsealed bytes
+    journal.compact()  # checkpoint 2 + segment 2; prunes segment 1
+    store.create("pods", _pod("post"))
+    with open(seg1, "wb") as f:
+        f.write(blob)  # the shape the racing tailer observes
+    tailer = JournalTailer(str(tmp_path))
+    shipped = tailer.poll()
+    kinds = [p.get("t") for p in shipped]
+    assert "checkpoint" in kinds, f"boundary checkpoint not injected: {kinds}"
+    assert kinds.index("checkpoint") == len(kinds) - 2  # after seg-1 events
+    assert kinds[-1] == "event"  # the post-rotation record arrives last
+    assert tailer.stats["checkpoints_crossed"] == 1
+    assert tailer.stats["segments_crossed"] == 1
+    assert tailer.stats["torn_records"] == 0
+
+
+# -------------------------------------------------------------- apply loop
+
+
+def test_applier_reaches_byte_equal_dump_incrementally(tmp_path):
+    store, journal = _journaled(str(tmp_path))
+    replica = _store()
+    applier = ReplicaApplier(replica, str(tmp_path), notify=False)
+    applier.bootstrap()
+    store.create("namespaces", {"metadata": {"name": "default"}})
+    applier.step()
+    for i in range(6):
+        with store.journal_txn("wave"):
+            store.create("pods", _pod(f"ap{i}"))
+            if i >= 2:
+                store.delete("pods", f"ap{i - 2}")
+        applier.step()
+        assert applier.stats["lag_records"] == 0
+    assert replica.dump() == store.dump()
+    assert replica.resource_version == store.resource_version
+    assert applier.stats["records_shipped"] > 0
+    assert applier.stats["events_applied"] > 0
+    assert applier.report.truncated_records == 0
+    assert replica.replication_stats is applier.stats
+
+
+def test_wave_record_applies_atomically_to_replica_readers(tmp_path):
+    """A multi-event wave record is one store-lock unit on the replica:
+    a concurrent reader sees none of it or all of it."""
+    store, journal = _journaled(str(tmp_path))
+    replica = _store()
+    applier = ReplicaApplier(replica, str(tmp_path), notify=True)
+    applier.bootstrap()
+    store.create("namespaces", {"metadata": {"name": "default"}})
+    with store.journal_txn("gang"):
+        for i in range(4):
+            store.create("pods", _pod(f"gang-{i}"))
+    seen: list[int] = []
+    done = threading.Event()
+
+    def reader():
+        while not done.is_set():
+            seen.append(replica.count("pods"))
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    applier.step()
+    done.set()
+    t.join(timeout=5.0)
+    assert replica.count("pods") == 4
+    assert set(seen) <= {0, 4}, f"partially-applied wave observed: {sorted(set(seen))}"
+
+
+def test_notify_feeds_replica_subscribers(tmp_path):
+    store, journal = _journaled(str(tmp_path))
+    replica = _store()
+    applier = ReplicaApplier(replica, str(tmp_path), notify=True)
+    applier.bootstrap()
+    got: list[tuple[str, str]] = []
+    replica.subscribe({"pods"}, lambda ev: got.append((ev.type, ev.obj["metadata"]["name"])))
+    store.create("namespaces", {"metadata": {"name": "default"}})
+    store.create("pods", _pod("np"))
+    store.delete("pods", "np")
+    applier.step()
+    assert got == [("ADDED", "np"), ("DELETED", "np")]
+
+
+def test_compaction_prune_rebases_and_expires_watch_versions(tmp_path):
+    """A follower parked on a segment compaction deletes must rebase
+    from the newest checkpoint — counted — and its watchers' old
+    resourceVersions must 410-relist."""
+    store, journal = _journaled(str(tmp_path))
+    replica = _store()
+    applier = ReplicaApplier(replica, str(tmp_path), notify=True)
+    applier.bootstrap()
+    store.create("namespaces", {"metadata": {"name": "default"}})
+    store.create("pods", _pod("pre"))
+    applier.step()
+    old_rv = replica.resource_version
+    journal.compact()  # prunes segment 0 under the parked tailer
+    store.create("pods", _pod("post"))
+    applier.step()
+    assert applier.stats["rebases"] == 1
+    assert replica.dump() == store.dump()
+    with pytest.raises(ResourceExpiredError):
+        replica.events_since("pods", old_rv - 1)
+
+
+# --------------------------------------------------------------- promotion
+
+
+def _scheduled_primary(tmp_path):
+    from kube_scheduler_simulator_tpu.state.recovery import (
+        scheduler_meta_provider,
+        write_mark,
+    )
+
+    store = _store()
+    svc = SchedulerService(store, use_batch="off", tie_break="first", clock=SimClock(0.0))
+    journal = Journal(str(tmp_path))
+    store.attach_journal(journal)
+    journal.add_meta_provider(scheduler_meta_provider(svc))
+    journal.checkpoint_provider = lambda: build_checkpoint(store)
+    store.create("namespaces", {"metadata": {"name": "default"}})
+    svc.start_scheduler(None)
+    store.create(
+        "nodes",
+        {
+            "metadata": {"name": "fn"},
+            "status": {
+                "allocatable": {"cpu": "4", "memory": "8Gi", "pods": "10"},
+                "capacity": {"cpu": "4", "memory": "8Gi", "pods": "10"},
+            },
+        },
+    )
+    store.create(
+        "pods",
+        {
+            "metadata": {"name": "fp"},
+            "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "100m"}}}]},
+        },
+    )
+    svc.schedule_pending(max_rounds=2)
+    svc._clock.advance(3.0)
+    write_mark(svc, 4)
+    return store, svc, journal
+
+
+def test_promotion_byte_matches_primary_and_restores_scheduler(tmp_path):
+    store, svc, journal = _scheduled_primary(tmp_path)
+    replica = _store()
+    applier = ReplicaApplier(replica, str(tmp_path), notify=True)
+    applier.bootstrap()
+    applier.step()
+    promotion = promote_replica(
+        applier,
+        lambda s: SchedulerService(s, use_batch="off", tie_break="first", clock=SimClock(0.0)),
+    )
+    assert replica.dump() == store.dump()
+    assert replica.resource_version == store.resource_version
+    svc2 = promotion.service
+    assert svc2.framework.sched_counter == svc.framework.sched_counter
+    assert svc2.framework.next_start_node_index == svc.framework.next_start_node_index
+    assert svc2._clock.now == 3.0
+    assert promotion.recovery.last_mark["tick"] == 4
+    assert promotion.recovery.partial_gangs == 0
+    assert applier.stats["promotions"] == 1
+    assert replica.recovery_stats is not None
+
+
+def test_replica_watcher_relists_after_promotion(tmp_path):
+    """The promotion mirror of
+    tests/test_recovery.py::test_watcher_relists_after_renumbered_log:
+    a watcher that followed the replica holds a pre-promotion
+    resourceVersion; after failover the watch epoch is re-numbered, so
+    resuming must produce a clean full relist (ADDED events), never a
+    silent resume."""
+    store, svc, journal = _scheduled_primary(tmp_path)
+    replica = _store()
+    applier = ReplicaApplier(replica, str(tmp_path), notify=True)
+    applier.bootstrap()
+    applier.step()
+    stale_rv = str(replica.resource_version)  # held mid-stream by a watcher
+    store.create(
+        "pods",
+        {
+            "metadata": {"name": "fp2"},
+            "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "100m"}}}]},
+        },
+    )
+    applier.step()
+    promote_replica(
+        applier,
+        lambda s: SchedulerService(s, use_batch="off", tie_break="first", clock=SimClock(0.0)),
+    )
+    with pytest.raises(ResourceExpiredError):
+        replica.events_since("pods", int(stale_rv))
+
+    lines: list[bytes] = []
+
+    class _Stream:
+        def write(self, data: bytes) -> None:
+            lines.append(data)
+
+        def flush(self) -> None:
+            pass
+
+    stop = threading.Event()
+    stop.set()  # emit the initial list/backlog, then exit immediately
+    ResourceWatcherService(replica).list_watch(_Stream(), {"pods": stale_rv}, stop=stop)
+    events = [json.loads(ln) for ln in b"".join(lines).splitlines() if ln.strip()]
+    pods = [e for e in events if e["Kind"] == "pods"]
+    assert pods and all(e["EventType"] == "ADDED" for e in pods)
+    assert {e["Obj"]["metadata"]["name"] for e in pods} == {"fp", "fp2"}
+
+
+# ------------------------------------------------------------ replica server
+
+
+def test_replica_knobs_validation(monkeypatch):
+    monkeypatch.delenv("KSS_REPLICA_OF", raising=False)
+    assert replica_knobs() is None
+    monkeypatch.setenv("KSS_REPLICA_OF", "/tmp/some-journal")
+    monkeypatch.setenv("KSS_REPLICA_POLL_S", "0.2")
+    knobs = replica_knobs()
+    assert knobs == {"directory": "/tmp/some-journal", "poll_s": 0.2}
+    monkeypatch.setenv("KSS_REPLICA_POLL_S", "nope")
+    with pytest.raises(ValueError):
+        replica_knobs()
+    monkeypatch.setenv("KSS_REPLICA_POLL_S", "0")
+    with pytest.raises(ValueError):
+        replica_knobs()
+
+
+def test_replica_container_serves_read_only_then_promotes(tmp_path):
+    """End to end over HTTP: reads 200 (and counted), writes 405,
+    promotion flips the container into a writable primary."""
+    import urllib.request
+
+    from kube_scheduler_simulator_tpu.server.server import SimulatorServer
+
+    store, svc, journal = _scheduled_primary(tmp_path)
+    journal.close()
+    di = ReplicaContainer(str(tmp_path), poll_s=0.01)
+    server = SimulatorServer(di, port=0)
+    port = server.start(background=True)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with urllib.request.urlopen(f"{base}/api/v1/resources/pods") as r:
+            assert r.status == 200
+            assert {o["metadata"]["name"] for o in json.load(r)["items"]} == {"fp"}
+        with urllib.request.urlopen(f"{base}/api/v1/replication") as r:
+            status = json.load(r)
+            assert status["role"] == "replica"
+            assert status["readRequests"] >= 1
+        req = urllib.request.Request(
+            f"{base}/api/v1/resources/pods",
+            data=json.dumps(_pod("denied")).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 405
+        promote_req = urllib.request.Request(
+            f"{base}/api/v1/replication/promote", data=b"", method="POST"
+        )
+        with urllib.request.urlopen(promote_req) as r:
+            assert r.status == 201
+        assert di.read_only is False
+        create_req = urllib.request.Request(
+            f"{base}/api/v1/resources/pods",
+            data=json.dumps(_pod("accepted")).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(create_req) as r:
+            assert r.status == 201
+    finally:
+        server.shutdown()
+
+
+# ------------------------------------------------------------------ metrics
+
+
+def test_replication_metrics_render_when_replica_fed(tmp_path):
+    from kube_scheduler_simulator_tpu.server.metrics import render_metrics
+
+    store, journal = _journaled(str(tmp_path))
+    store.create("namespaces", {"metadata": {"name": "default"}})
+    store.create("pods", _pod("mp"))
+    replica = _store()
+    applier = ReplicaApplier(replica, str(tmp_path), notify=False)
+    applier.bootstrap()
+    applier.step()
+    applier.stats["read_requests"] = 3
+    svc = SchedulerService(replica, use_batch="off")
+    svc.start_scheduler(None)
+
+    class _DI:
+        cluster_store = replica
+
+        def scheduler_service(self):
+            return svc
+
+    text = render_metrics(_DI())
+    for needle in (
+        "simulator_replication_records_shipped_total",
+        "simulator_replication_lag_records",
+        "simulator_replication_lag_seconds",
+        "simulator_replica_promotions_total",
+        "simulator_replica_read_requests_total",
+        "simulator_replication_torn_records_total",
+        "simulator_replication_rebases_total",
+    ):
+        assert needle in text, needle
+    assert "simulator_replication_records_shipped_total 0" not in text
+    assert "simulator_replica_read_requests_total 3" in text
+
+
+def test_replication_metrics_absent_on_primary(tmp_path):
+    from kube_scheduler_simulator_tpu.server.metrics import render_metrics
+
+    store = _store()
+    svc = SchedulerService(store, use_batch="off")
+    svc.start_scheduler(None)
+
+    class _DI:
+        cluster_store = store
+
+        def scheduler_service(self):
+            return svc
+
+    assert "replication_" not in render_metrics(_DI())
