@@ -477,3 +477,415 @@ def _first_state_mismatch(a: list, b: list, kill_point: int) -> Obj:
                 "recovered": db.get(key),
             }
     return {"kill_point": kill_point, "pod": None}
+
+
+# ------------------------------------------------------------ fault matrix
+
+
+def _env_scope(overrides: "dict[str, str | None]"):
+    """Context manager applying env overrides (None = delete) and
+    restoring the previous values on exit — the chaos legs flip the
+    procmesh/AOT knobs per leg without leaking into the caller."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def scope():
+        saved = {k: os.environ.get(k) for k in overrides}
+        try:
+            for k, v in overrides.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            yield
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    return scope()
+
+
+def leaked_worker_pids() -> list[int]:
+    """Every live ``procmesh_worker`` process on the host (cmdline scan
+    — zombies excluded, they are reaped, not leaked).  The no-leak bar
+    every worker-fault leg ends on."""
+    pids = []
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        try:
+            with open(f"/proc/{entry}/cmdline", "rb") as f:
+                cmd = f.read()
+        except OSError:
+            continue
+        if b"ops.procmesh_worker" in cmd.replace(b"\x00", b" "):
+            pids.append(int(entry))
+    return pids
+
+
+class WorkerChaos:
+    """Supervised-ensemble differential: fault a shard worker mid-churn,
+    demand byte parity plus a counted recovery.
+
+    Two in-process legs over the same scenario (a ``{"name", "nodes",
+    "pods"}`` dict of raw store objects):
+
+    - the BASELINE runs on the in-process path with the AOT cache
+      enabled — it both sets the parity bytes and exports the scan
+      artifacts the ensemble workers will load;
+    - the CHAOS leg runs with ``KSS_MESH_PROCESSES`` engaged and
+      ``ProcMeshPool.run`` wrapped so dispatch #``fault_at`` first
+      injects the fault into a seeded worker: ``kill`` SIGKILLs it,
+      ``stop`` SIGSTOPs it (the hang shape — alive, never replying),
+      ``sever`` writes a partial frame header down its command pipe and
+      closes it (a mid-frame pipe break: the worker reads a short
+      header and exits, the parent's next send fails).
+
+    The supervisor must detect the fault (``died`` or ``hang``
+    verdict), SIGKILL the straggler only, respawn the ensemble from the
+    AOT cache, and re-dispatch the abandoned wave — so the verdict's
+    bar is ``divergences == []`` AND a counted recovery (``respawns``
+    / ``hangs_detected`` / a run-fallback reason).  Silent divergence
+    is the only failing shape.  On hosts where the ensemble cannot
+    engage at all the verdict says so (``engaged == 0`` with the
+    counted bring-up reason) and the caller skips loudly — the no-leak
+    check still applies.
+    """
+
+    def __init__(
+        self,
+        scenario: Obj,
+        mode: str = "kill",
+        fault_at: int = 1,
+        worker_rank: int = 0,
+        nprocs: int = 1,
+        heartbeat_s: float = 0.3,
+        timeout_s: float = 120.0,
+        role: "Obj | None" = None,
+        clean_leg: bool = False,
+    ):
+        if mode not in ("kill", "stop", "sever"):
+            raise ValueError(f"mode must be kill|stop|sever, got {mode!r}")
+        self.scenario = scenario
+        self.mode = mode
+        self.fault_at = int(fault_at)
+        self.worker_rank = int(worker_rank)
+        self.nprocs = int(nprocs)
+        self.heartbeat_s = float(heartbeat_s)
+        self.timeout_s = float(timeout_s)
+        self.role = dict(role or {})
+        # clean_leg=True runs the ensemble once WITHOUT the fault first
+        # and reports both legs' backend-compile counts: the respawn
+        # must add ZERO recompiles over the identical clean run (the
+        # RecompileGuard bar — workers load-never-compile structurally,
+        # and the parent re-resolves from the same AOT cache)
+        self.clean_leg = bool(clean_leg)
+
+    # ------------------------------------------------------------------ legs
+
+    def _leg(self) -> Obj:
+        """One full scheduling pass over the scenario; returns the
+        annotation trail {pod: (nodeName, annotations)}."""
+        from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+        from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+        store = ClusterStore()
+        for n in self.scenario.get("nodes", []):
+            store.create("nodes", json.loads(json.dumps(n)))
+        for p in self.scenario.get("pods", []):
+            store.create("pods", json.loads(json.dumps(p)))
+        kw = dict(tie_break="first", seed=3, use_batch="force", batch_min_work=0)
+        kw.update(self.role)
+        svc = SchedulerService(store, **kw)
+        svc.start_scheduler({"percentageOfNodesToScore": 100})
+        svc.schedule_pending()
+        return {
+            p["metadata"]["name"]: (
+                (p.get("spec") or {}).get("nodeName"),
+                p["metadata"].get("annotations") or {},
+            )
+            for p in store.list("pods")
+        }
+
+    def _inject(self, pool: Any) -> None:
+        w = pool.workers[self.worker_rank % len(pool.workers)]
+        if self.mode == "kill":
+            os.kill(w.proc.pid, signal.SIGKILL)
+        elif self.mode == "stop":
+            os.kill(w.proc.pid, signal.SIGSTOP)
+        else:  # sever: half a frame header, then EOF — a mid-frame break
+            try:
+                w.proc.stdin.write(b"\xde\xad\xbe\xef")
+                w.proc.stdin.flush()
+            except Exception:
+                pass
+            try:
+                w.proc.stdin.close()
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------- run
+
+    def run(self) -> Obj:
+        import tempfile
+
+        from kube_scheduler_simulator_tpu.ops import procmesh
+
+        verdict: Obj = {
+            "scenario": self.scenario.get("name", "scenario"),
+            "mode": self.mode,
+            "fault_at": self.fault_at,
+            "engaged": 0,
+            "fired": 0,
+            "dispatches": 0,
+            "respawns": 0,
+            "hangs_detected": 0,
+            "breaker_state": None,
+            "bringup_verdict": None,
+            "run_fallbacks": {},
+            "divergences": [],
+            "first_mismatch": None,
+            "leaked_workers": [],
+            "clean_compiles": None,
+            "chaos_compiles": None,
+        }
+        from kube_scheduler_simulator_tpu.analysis.runtime import RecompileGuard
+
+        with tempfile.TemporaryDirectory(prefix="kss-worker-chaos-") as td:
+            cache = os.path.join(td, "aot")
+            with _env_scope({"KSS_AOT_CACHE_DIR": cache, "KSS_MESH_PROCESSES": None}):
+                baseline = self._leg()  # in-process; exports the artifacts
+            ensemble_env = {
+                "KSS_AOT_CACHE_DIR": cache,
+                "KSS_MESH_PROCESSES": str(self.nprocs),
+                "KSS_PROCMESH_TIMEOUT_S": str(self.timeout_s),
+                "KSS_PROCMESH_HEARTBEAT_S": str(self.heartbeat_s),
+            }
+            if self.clean_leg:
+                with _env_scope(ensemble_env):
+                    procmesh.reset()
+                    with RecompileGuard("clean ensemble leg", max_compiles=1 << 30) as g:
+                        clean = self._leg()
+                    procmesh.reset()
+                verdict["clean_compiles"] = g.compiles
+                for name in sorted(set(baseline) | set(clean)):
+                    if baseline.get(name) != clean.get(name):
+                        verdict["divergences"].append(f"clean:{name}")
+            state = {"dispatch": 0, "fired": 0}
+            harness = self
+            orig_run = procmesh.ProcMeshPool.run
+
+            def chaotic_run(pool_self, key, host_dp):
+                i = state["dispatch"]
+                state["dispatch"] += 1
+                if i == harness.fault_at and not state["fired"]:
+                    state["fired"] = 1
+                    harness._inject(pool_self)
+                return orig_run(pool_self, key, host_dp)
+
+            with _env_scope(ensemble_env):
+                procmesh.reset()
+                procmesh.ProcMeshPool.run = chaotic_run
+                try:
+                    with RecompileGuard("chaotic ensemble leg", max_compiles=1 << 30) as g:
+                        chaotic = self._leg()
+                finally:
+                    procmesh.ProcMeshPool.run = orig_run
+                st = procmesh.stats()
+                procmesh.reset()
+            verdict["chaos_compiles"] = g.compiles
+        pool = st.get("pool")
+        verdict["fired"] = state["fired"]
+        verdict["bringup_verdict"] = st.get("verdict")
+        verdict["run_fallbacks"] = dict(st.get("run_fallbacks_by_reason") or {})
+        if pool is not None:
+            verdict["engaged"] = 1
+            verdict["dispatches"] = pool["dispatches"]
+            verdict["respawns"] = pool["respawns"]
+            verdict["hangs_detected"] = pool["hangs_detected"]
+            verdict["breaker_state"] = pool["breaker_state"]
+        for name in sorted(set(baseline) | set(chaotic)):
+            if baseline.get(name) != chaotic.get(name):
+                verdict["divergences"].append(name)
+                if verdict["first_mismatch"] is None:
+                    verdict["first_mismatch"] = {
+                        "pod": name,
+                        "baseline": baseline.get(name),
+                        "chaotic": chaotic.get(name),
+                    }
+        verdict["leaked_workers"] = leaked_worker_pids()
+        return verdict
+
+
+class _FaultyIO:
+    """Counting ``state.journal._DirectIO`` stand-in: the ``op``
+    (``write`` | ``fsync``) raises ``OSError(err)`` on its
+    ``fail_at``-th invocation (0-based, counted per op).  ``once`` makes
+    the fault transient (ENOSPC that clears) vs persistent (a dead
+    disk); the journal's policy must hold either way because degrade is
+    terminal for the journal's lifetime."""
+
+    def __init__(self, fail_at: int, op: str = "write", err: int = 28, once: bool = True):
+        if op not in ("write", "fsync"):
+            raise ValueError(f"op must be write|fsync, got {op!r}")
+        self.fail_at = int(fail_at)
+        self.op = op
+        self.err = int(err)
+        self.once = bool(once)
+        self.counts = {"write": 0, "fsync": 0}
+        self.trips = 0
+
+    def _tick(self, op: str) -> None:
+        i = self.counts[op]
+        self.counts[op] += 1
+        if op == self.op and (i == self.fail_at or (not self.once and i >= self.fail_at)):
+            self.trips += 1
+            raise OSError(self.err, os.strerror(self.err))
+
+    def write(self, f, data: bytes) -> None:
+        self._tick("write")
+        f.write(data)
+
+    def flush(self, f) -> None:
+        f.flush()
+
+    def fsync(self, fd: int) -> None:
+        self._tick("fsync")
+        os.fsync(fd)
+
+
+class DiskChaos:
+    """Disk-fault differential under state/journal.py: a seeded
+    write/fsync fault mid-journal must end in the POLICY outcome —
+    ``degrade``: the store keeps scheduling byte-identically to an
+    unjournaled baseline, the fault is counted per errno, appends stop,
+    and the on-disk log is a clean prefix a fresh recovery replays with
+    ZERO torn records; ``wedge``: the faulting commit raises
+    :class:`state.journal.JournalWedged` loudly and every subsequent
+    transaction refuses at entry, BEFORE any store mutation.  Anything
+    else — an uncounted continuation, a torn prefix, a silent partial
+    commit — fails the verdict.
+
+    The scenario is a deterministic mutation plan: ``events`` pods
+    created then bound via ``journal_txn``-grouped waves, mirroring the
+    store traffic a scheduling run emits without dragging jax into a
+    disk-fault test."""
+
+    def __init__(
+        self,
+        mode: str = "degrade",
+        op: str = "write",
+        err: int = 28,  # ENOSPC
+        fail_record: int = 3,
+        events: int = 8,
+        fsync: bool = False,
+    ):
+        if mode not in ("degrade", "wedge"):
+            raise ValueError(f"mode must be degrade|wedge, got {mode!r}")
+        self.mode = mode
+        self.op = op
+        self.err = int(err)
+        self.fail_record = int(fail_record)
+        self.events = int(events)
+        self.fsync = bool(fsync) or op == "fsync"
+
+    @staticmethod
+    def _mutate(store: Any, i: int) -> None:
+        """One journaled wave: create a pod and bind it — two events,
+        one atomic record (the journal_txn shape scheduling commits
+        use)."""
+        with store.journal_txn("wave"):
+            created = store.create(
+                "pods",
+                {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {"name": f"dc-{i}", "namespace": "default"},
+                    "spec": {"containers": [{"name": "c", "image": "pause"}]},
+                },
+            )
+            created["spec"]["nodeName"] = f"n{i % 3}"
+            store.update("pods", created)
+
+    @staticmethod
+    def _trail(store: Any) -> list:
+        return sorted(
+            (p["metadata"]["name"], (p.get("spec") or {}).get("nodeName"))
+            for p in store.list("pods")
+        )
+
+    def run(self) -> Obj:
+        import tempfile
+
+        from kube_scheduler_simulator_tpu.state import journal as J
+        from kube_scheduler_simulator_tpu.state.recovery import RecoveryManager
+        from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+        verdict: Obj = {
+            "mode": self.mode,
+            "op": self.op,
+            "errno": self.err,
+            "fail_record": self.fail_record,
+            "fired": 0,
+            "wedged": 0,
+            "wedge_raised": 0,
+            "degraded_by_errno": {},
+            "records_dropped": 0,
+            "post_fault_refusals": 0,
+            "divergences": [],
+            "recovered_records": 0,
+            "recovered_torn": 0,
+        }
+        baseline = ClusterStore()
+        for i in range(self.events):
+            self._mutate(baseline, i)
+
+        with tempfile.TemporaryDirectory(prefix="kss-disk-chaos-") as td:
+            jdir = os.path.join(td, "journal")
+            io = _FaultyIO(self.fail_record, op=self.op, err=self.err)
+            jr = J.Journal(jdir, fsync=self.fsync, on_error=self.mode, io=io)
+            store = ClusterStore()
+            store.attach_journal(jr)
+            for i in range(self.events):
+                try:
+                    self._mutate(store, i)
+                except J.JournalWedged:
+                    verdict["wedge_raised"] += 1
+                    if self.mode == "wedge":
+                        # post-fault transactions must refuse AT ENTRY,
+                        # before any store mutation
+                        before = self._trail(store)
+                        for j in range(i + 1, self.events):
+                            try:
+                                self._mutate(store, j)
+                            except J.JournalWedged:
+                                verdict["post_fault_refusals"] += 1
+                        if self._trail(store) != before:
+                            verdict["divergences"].append("mutation_after_wedge")
+                        break
+            verdict["fired"] = io.trips
+            verdict["wedged"] = int(jr.wedged)
+            verdict["degraded_by_errno"] = dict(jr.degraded_by_errno)
+            verdict["records_dropped"] = jr.stats["records_dropped"]
+            jr.close()
+
+            if self.mode == "degrade":
+                # non-durable continuation must stay byte-identical
+                if self._trail(store) != self._trail(baseline):
+                    verdict["divergences"].append("degrade_trail")
+                # ... and the on-disk log must be a clean prefix
+                fresh = ClusterStore()
+                report = RecoveryManager(jdir).recover(fresh)
+                verdict["recovered_records"] = report.replayed_records
+                verdict["recovered_torn"] = report.truncated_records
+                if report.truncated_records:
+                    verdict["divergences"].append("torn_prefix")
+                recovered = {k: v for k, v in self._trail(fresh)}
+                full = {k: v for k, v in self._trail(store)}
+                for name, node in recovered.items():
+                    if full.get(name) != node:
+                        verdict["divergences"].append(f"recovered:{name}")
+        return verdict

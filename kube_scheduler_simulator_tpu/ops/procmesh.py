@@ -29,15 +29,43 @@ behavior is untouched by a fallback):
 Dispatch is ASYNC, mirroring the device's: ``run`` writes the command
 frames and returns a handle; reading the reply is the fetch, so the
 streamed path's overlap (wave k+1 encoding while wave k runs in the
-ensemble) carries over.  ``snapshot()`` feeds ``metrics()["procmesh"]``
-and the /metrics renderer; every fallback reason is counted there.
+ensemble) carries over.
+
+**Supervision** (the fault-tolerant execution plane — docs/resilience.md):
+an engaged ensemble is WATCHED, not trusted.  Every reply wait
+classifies its outcome — ``ok`` / ``died`` (EOF or a reaped pid) /
+``hang`` (the pid alive but sitting in the STOPPED state for a full
+``KSS_PROCMESH_HEARTBEAT_S`` — a SIGSTOP'd worker is distinguishable
+from a dead one via ``/proc/<pid>/stat``) / ``timeout``.  On a failure
+the STRAGGLER ALONE is SIGKILLed (SIGCONT first — never leave a stopped
+process to wedge the reaper), the healthy members are politely quit
+(losing one member kills a ``jax.distributed`` collective anyway), and
+a replacement ensemble respawns on a fresh coordinator, re-resolving
+every previously loaded scan from the AOT artifact cache —
+load-never-compile, so zero steady-state recompiles holds across the
+respawn by construction.  The in-flight wave is abandoned pre-commit
+and re-dispatched on the fresh ensemble (a counted retry,
+``retry_attempts_total{seam="procmesh"}``).  Only the
+:class:`~resilience.policy.Breaker` — ``KSS_PROCMESH_MAX_RESPAWNS``
+CONSECUTIVE ensemble failures without a successful wave between them —
+degrades the pool to the in-process virtual mesh (counted,
+trail-invisible): the one-strike pool death this module used to have is
+now the breaker's last resort.
+
+``snapshot()`` feeds ``metrics()["procmesh"]`` and the /metrics
+renderer; every fallback reason, respawn, detected hang and breaker
+transition is counted there.  An ``atexit`` reaper SIGCONT+SIGKILLs any
+worker subprocess still alive at interpreter exit — a SIGSTOP'd worker
+must never outlive the simulator.
 """
 
 from __future__ import annotations
 
 import atexit
+import contextlib
 import os
 import select
+import signal
 import socket
 import subprocess
 import sys
@@ -46,6 +74,7 @@ import time
 from typing import Any
 
 from kube_scheduler_simulator_tpu.ops.procmesh_worker import read_frame, write_frame
+from kube_scheduler_simulator_tpu.resilience import Breaker, note_retry
 
 _ENV = "KSS_MESH_PROCESSES"
 
@@ -64,16 +93,91 @@ def procs_from_env() -> int:
     return n
 
 
+def heartbeat_from_env() -> float:
+    """``KSS_PROCMESH_HEARTBEAT_S`` (default 1.0): how long a worker may
+    sit in the STOPPED state mid-wait before it is declared hung (and
+    how long an idle ``heartbeat()`` probe waits per worker)."""
+    raw = os.environ.get("KSS_PROCMESH_HEARTBEAT_S", "").strip()
+    if not raw:
+        return 1.0
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(f"KSS_PROCMESH_HEARTBEAT_S must be a number, got {raw!r}")
+    if v <= 0:
+        raise ValueError(f"KSS_PROCMESH_HEARTBEAT_S must be > 0, got {raw!r}")
+    return v
+
+
+def max_respawns_from_env() -> int:
+    """``KSS_PROCMESH_MAX_RESPAWNS`` (default 3): the breaker threshold
+    — this many CONSECUTIVE ensemble failures (no successful wave in
+    between) degrade the pool to the in-process virtual mesh."""
+    raw = os.environ.get("KSS_PROCMESH_MAX_RESPAWNS", "").strip()
+    if not raw:
+        return 3
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(f"KSS_PROCMESH_MAX_RESPAWNS must be an integer, got {raw!r}")
+    if v < 1:
+        raise ValueError(f"KSS_PROCMESH_MAX_RESPAWNS must be >= 1, got {v}")
+    return v
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 
 
+# ------------------------------------------------------- orphan reaping
+
+_CHILD_MU = threading.Lock()
+_CHILDREN: "set[Any]" = set()
+
+
+def _register_child(proc: Any) -> None:
+    with _CHILD_MU:
+        _CHILDREN.add(proc)
+
+
+def _forget_child(proc: Any) -> None:
+    with _CHILD_MU:
+        _CHILDREN.discard(proc)
+
+
+def _terminate(proc: Any, timeout: float = 5.0) -> None:
+    """SIGCONT → SIGKILL → reap: the only teardown that is safe against
+    a SIGSTOP'd worker.  The old ``kill(); wait(timeout=5)`` could park
+    the interpreter on a stopped child and leak the process past exit —
+    the satellite bug this PR fixes."""
+    try:
+        if proc.poll() is None:
+            with contextlib.suppress(OSError):
+                os.kill(proc.pid, signal.SIGCONT)
+            proc.kill()
+        proc.wait(timeout=timeout)
+        _forget_child(proc)
+    except Exception:
+        pass
+
+
+def _reap_orphans() -> None:
+    """atexit backstop: no ``procmesh_worker`` survives the parent."""
+    with _CHILD_MU:
+        procs = list(_CHILDREN)
+    for p in procs:
+        _terminate(p, timeout=2.0)
+
+
+atexit.register(_reap_orphans)
+
+
 class _Worker:
     """One ensemble member: the subprocess plus its two pipe ends."""
 
-    def __init__(self, rank: int, nprocs: int, coordinator: str):
+    def __init__(self, rank: int, nprocs: int, coordinator: str, generation: int = 0):
         r, w = os.pipe()
         os.set_inheritable(w, True)
         env = dict(os.environ)
@@ -92,6 +196,7 @@ class _Worker:
                 "--nprocs", str(nprocs),
                 "--coordinator", coordinator,
                 "--out-fd", str(w),
+                "--generation", str(generation),
             ],
             stdin=subprocess.PIPE,
             pass_fds=(w,),
@@ -99,27 +204,63 @@ class _Worker:
             cwd=os.getcwd(),
         )
         os.close(w)
+        _register_child(self.proc)
         self.rank = rank
+        self.generation = generation
         self.rfd = r
         self.rfile = os.fdopen(r, "rb")
 
     def send(self, msg: dict) -> None:
         write_frame(self.proc.stdin, msg)
 
-    def recv(self, deadline: float) -> "dict | None":
-        """One reply frame, or None on timeout/EOF/dead worker."""
+    def stat_state(self) -> "str | None":
+        """The kernel's one-char process state from ``/proc/<pid>/stat``
+        (``T``/``t`` = stopped/traced) — what lets supervision tell a
+        SIGSTOP'd worker (alive, never replying) from a dead one."""
+        try:
+            with open(f"/proc/{self.proc.pid}/stat", "rb") as f:
+                data = f.read()
+            return data.rsplit(b")", 1)[1].split()[0].decode("ascii")
+        except Exception:
+            return None
+
+    def recv_classified(
+        self, deadline: float, heartbeat_s: "float | None"
+    ) -> "tuple[dict | None, str]":
+        """One reply frame plus a verdict: ``ok`` (frame read), ``died``
+        (EOF / broken frame / reaped pid), ``hang`` (pid alive but in
+        the STOPPED state for a full heartbeat — a SIGSTOP'd straggler),
+        ``timeout`` (budget spent with the worker alive and runnable)."""
+        stopped_since: "float | None" = None
         while True:
-            budget = deadline - time.monotonic()
+            now = time.monotonic()
+            budget = deadline - now
             if budget <= 0:
-                return None
+                return None, "timeout"
             ready, _, _ = select.select([self.rfd], [], [], min(budget, 0.25))
             if ready:
                 try:
-                    return read_frame(self.rfile)
+                    msg = read_frame(self.rfile)
                 except Exception:
-                    return None
+                    return None, "died"
+                if msg is None:
+                    return None, "died"
+                return msg, "ok"
             if self.proc.poll() is not None:
-                return None
+                return None, "died"
+            if heartbeat_s is not None:
+                if self.stat_state() in ("T", "t"):
+                    if stopped_since is None:
+                        stopped_since = now
+                    elif now - stopped_since >= heartbeat_s:
+                        return None, "hang"
+                else:
+                    stopped_since = None
+
+    def recv(self, deadline: float) -> "dict | None":
+        """One reply frame, or None on timeout/EOF/dead worker."""
+        msg, _verdict = self.recv_classified(deadline, None)
+        return msg
 
     def kill(self) -> None:
         try:
@@ -127,11 +268,7 @@ class _Worker:
                 self.proc.stdin.close()
         except Exception:
             pass
-        try:
-            self.proc.kill()
-            self.proc.wait(timeout=5)
-        except Exception:
-            pass
+        _terminate(self.proc)
         try:
             self.rfile.close()
         except Exception:
@@ -145,14 +282,32 @@ class ProcMeshPool:
     the device queue it stands in for); ``_mu`` only guards teardown
     racing a dispatch from the metrics/atexit paths."""
 
-    def __init__(self, nprocs: int, timeout_s: float):
+    def __init__(
+        self,
+        nprocs: int,
+        timeout_s: float,
+        heartbeat_s: float = 1.0,
+        max_respawns: int = 3,
+    ):
         self.nprocs = nprocs
         self.timeout_s = timeout_s
+        self.heartbeat_s = float(heartbeat_s)
         self.coordinator = f"127.0.0.1:{_free_port()}"
         self.workers: list[_Worker] = []
         self.dead = False
         self.dispatches = 0
-        self.loaded: set[str] = set()
+        self.generation = 0  # bumped per respawned ensemble
+        # key -> (meta, cache_dir): everything a replacement ensemble
+        # must re-resolve from the AOT cache (load-never-compile)
+        self.loaded: dict[str, tuple[dict, str]] = {}
+        self.respawns = 0
+        self.hangs_detected = 0
+        self.failures_by_verdict: dict[str, int] = {}
+        # K consecutive ensemble failures (no successful wave between)
+        # open the breaker: the counted, terminal degradation to the
+        # in-process virtual mesh (cooldown_s=None = never half-opens —
+        # re-probing a broken ensemble every wave would stall scheduling)
+        self.breaker = Breaker(fail_threshold=max_respawns, cooldown_s=None)
         self._mu = threading.Lock()
         self._inflight = 0
 
@@ -164,7 +319,8 @@ class ProcMeshPool:
         deadline = time.monotonic() + self.timeout_s
         try:
             self.workers = [
-                _Worker(i, self.nprocs, self.coordinator) for i in range(self.nprocs)
+                _Worker(i, self.nprocs, self.coordinator, self.generation)
+                for i in range(self.nprocs)
             ]
         except Exception as e:
             self.close()
@@ -187,7 +343,9 @@ class ProcMeshPool:
 
     def _lockstep(self, msg: dict, deadline: "float | None" = None) -> "list[dict] | None":
         """Broadcast one command; collect one reply per worker in rank
-        order.  None (and a dead pool) on any timeout/EOF."""
+        order.  None (and a dead pool) on any timeout/EOF — the
+        UNSUPERVISED form, used during bring-up and respawn where a
+        failure means the candidate ensemble is unusable."""
         if self.dead:
             return None
         if deadline is None:
@@ -207,39 +365,174 @@ class ProcMeshPool:
             out.append(r)
         return out
 
+    # -------------------------------------------------------- supervision
+
+    def _handle_worker_failure(self, w: "_Worker | None", verdict: str) -> bool:
+        """The supervision seam: a worker failed a wait (``died`` /
+        ``hang`` / ``timeout``).  SIGKILL the straggler ONLY (SIGCONT
+        first), record the ensemble failure against the breaker, and —
+        unless the breaker just opened — respawn a replacement ensemble.
+        Returns True when the caller may re-dispatch on the fresh
+        ensemble."""
+        if self.dead:
+            return False
+        if verdict == "hang":
+            self.hangs_detected += 1
+        self.failures_by_verdict[verdict] = self.failures_by_verdict.get(verdict, 0) + 1
+        self.breaker.failure()
+        if w is not None:
+            w.kill()
+        if self.breaker.state == Breaker.OPEN:
+            # K consecutive ensemble failures: the counted, terminal
+            # degradation to the in-process virtual mesh
+            count_run_fallback("breaker_open")
+            self.close()
+            return False
+        return self._respawn()
+
+    def _respawn(self) -> bool:
+        """Replace the whole ensemble: quit the survivors politely
+        (losing one member kills a jax.distributed collective anyway),
+        reap stragglers, spawn N fresh workers on a fresh coordinator,
+        re-run the bring-up handshake + probe, and re-resolve every
+        previously loaded scan from the AOT artifact cache — workers
+        load, never compile, so RecompileGuard's zero steady-state
+        recompiles survives the respawn by construction."""
+        for ow in self.workers:
+            if ow.proc.poll() is None:
+                with contextlib.suppress(Exception):
+                    ow.send({"cmd": "quit"})
+        for ow in self.workers:
+            ow.kill()
+        self.generation += 1
+        self.coordinator = f"127.0.0.1:{_free_port()}"
+        deadline = time.monotonic() + self.timeout_s
+        try:
+            self.workers = [
+                _Worker(i, self.nprocs, self.coordinator, self.generation)
+                for i in range(self.nprocs)
+            ]
+        except Exception:
+            count_run_fallback("respawn_failed")
+            self.close()
+            return False
+        for w in self.workers:
+            hello = w.recv(deadline)
+            if not hello or not hello.get("ok"):
+                count_run_fallback("respawn_failed")
+                self.close()
+                return False
+        replies = self._lockstep({"cmd": "probe"}, deadline=deadline)
+        if replies is None or any(not r.get("ok") for r in replies):
+            count_run_fallback("respawn_failed")
+            self.close()
+            return False
+        for key, (meta, cache_dir) in list(self.loaded.items()):
+            replies = self._lockstep(
+                {"cmd": "load_scan", "key": key, "meta": meta, "cache_dir": cache_dir}
+            )
+            if replies is None or any(not r.get("ok") for r in replies):
+                count_run_fallback("respawn_failed")
+                self.close()
+                return False
+        self.respawns += 1
+        note_retry("procmesh")
+        return True
+
+    def heartbeat(self) -> bool:
+        """Idle-time liveness probe: one ping round-trip per worker,
+        each bounded by ``heartbeat_s``.  A straggler goes through the
+        same supervision path a mid-wave failure does (SIGKILL the
+        straggler only, respawn, breaker on repeated failure).  True =
+        every worker answered."""
+        if self.dead or self._inflight:
+            return not self.dead
+        failed: "tuple[_Worker, str] | None" = None
+        try:
+            for w in self.workers:
+                w.send({"cmd": "ping"})
+        except Exception:
+            failed = (w, "died")
+        if failed is None:
+            # the budget must comfortably exceed heartbeat_s, or the
+            # wait times out before a SIGSTOP'd worker has sat in the
+            # STOPPED state long enough to earn the ``hang`` verdict
+            deadline = time.monotonic() + max(2.5 * self.heartbeat_s, 1.0) * self.nprocs
+            for w in self.workers:
+                r, verdict = w.recv_classified(deadline, self.heartbeat_s)
+                if r is None or not r.get("ok"):
+                    failed = (w, verdict if r is None else "died")
+                    break
+        if failed is None:
+            # a full healthy round is a success for breaker purposes:
+            # the failure streak is CONSECUTIVE failures
+            self.breaker.success()
+            return True
+        return self._handle_worker_failure(*failed)
+
     # ----------------------------------------------------------- dispatch
 
     def load_scan(self, key: str, meta: dict, cache_dir: str) -> "str | None":
         """Resolve the scan's AOT artifact on every worker; returns a
-        fallback reason or None.  Memoized per pool."""
+        fallback reason or None.  Memoized per pool; a worker lost
+        mid-load goes through supervision (respawn + one retry) before
+        the caller falls back for the wave."""
         if key in self.loaded:
             return None
-        replies = self._lockstep(
-            {"cmd": "load_scan", "key": key, "meta": meta, "cache_dir": cache_dir}
-        )
-        if replies is None:
-            return "worker_lost"
-        bad = [r for r in replies if not r.get("ok")]
-        if bad:
-            return str(bad[0].get("reason", "artifact_missing"))
-        self.loaded.add(key)
-        return None
+        for _attempt in range(2):
+            if self.dead:
+                return "worker_lost"
+            failed: "tuple[_Worker | None, str] | None" = None
+            try:
+                for w in self.workers:
+                    w.send({"cmd": "load_scan", "key": key, "meta": meta, "cache_dir": cache_dir})
+            except Exception:
+                failed = (w, "died")
+            replies: list[dict] = []
+            if failed is None:
+                deadline = time.monotonic() + self.timeout_s
+                for w in self.workers:
+                    r, verdict = w.recv_classified(deadline, self.heartbeat_s)
+                    if r is None:
+                        failed = (w, verdict)
+                        break
+                    replies.append(r)
+            if failed is None:
+                bad = [r for r in replies if not r.get("ok")]
+                if bad:
+                    return str(bad[0].get("reason", "artifact_missing"))
+                self.loaded[key] = (meta, cache_dir)
+                return None
+            if not self._handle_worker_failure(*failed):
+                return "worker_lost"
+            # respawned: the fresh ensemble re-loaded self.loaded, but
+            # THIS key never landed — retry it once
+        return "worker_lost"
 
     def run(self, key: str, host_dp: Any) -> "_PendingRun | None":
         """ASYNC dispatch: write the command frames and return a handle
-        (the fetch blocks in ``_PendingRun.fetch``).  None when the pool
-        died mid-write."""
+        (the fetch blocks in ``_PendingRun.fetch``).  A worker lost
+        mid-write goes through supervision; None when the ensemble is
+        (or just went) unusable — the wave falls back to the in-process
+        path, never an error."""
         if self.dead or self._inflight:
             return None
-        try:
-            for w in self.workers:
-                w.send({"cmd": "run", "key": key, "dp": host_dp})
-        except Exception:
-            self.close()
-            return None
-        self.dispatches += 1
-        self._inflight = 1
-        return _PendingRun(self)
+        for _attempt in range(2):
+            failed: "tuple[_Worker, str] | None" = None
+            try:
+                for w in self.workers:
+                    w.send({"cmd": "run", "key": key, "dp": host_dp})
+            except Exception:
+                failed = (w, "died")
+            if failed is None:
+                self.dispatches += 1
+                self._inflight = 1
+                return _PendingRun(self, key, host_dp)
+            if not self._handle_worker_failure(*failed):
+                return None
+            # respawned mid-send: re-dispatch the wave on the fresh
+            # ensemble (send-side twin of the fetch-side re-dispatch)
+        return None
 
     def close(self) -> None:
         with self._mu:
@@ -255,28 +548,60 @@ class ProcMeshPool:
             "engaged": int(not self.dead),
             "dispatches": self.dispatches,
             "scans_loaded": len(self.loaded),
+            "respawns": self.respawns,
+            "hangs_detected": self.hangs_detected,
+            "generation": self.generation,
+            "failures_by_verdict": dict(self.failures_by_verdict),
+            "breaker_state": self.breaker.state,
+            "breaker_state_code": self.breaker.state_code,
+            "breaker_transitions": dict(self.breaker.stats),
         }
 
 
 class _PendingRun:
-    """The in-flight ensemble dispatch; ``fetch`` is the block point."""
+    """The in-flight ensemble dispatch; ``fetch`` is the block point.
 
-    def __init__(self, pool: ProcMeshPool):
+    Carries the wave's (key, host planes) so a worker failure mid-wave
+    can abandon the dispatch PRE-COMMIT and re-dispatch the identical
+    wave on the respawned ensemble — the annotation trail stays
+    byte-identical because nothing of the failed attempt was ever
+    observable."""
+
+    def __init__(self, pool: ProcMeshPool, key: str, host_dp: Any):
         self.pool = pool
+        self.key = key
+        self.host_dp = host_dp
+        self._redispatched = 0
 
     def fetch(self) -> "Any | None":
         pool = self.pool
         pool._inflight = 0
         deadline = time.monotonic() + pool.timeout_s
         out = None
+        failed: "tuple[_Worker, str] | None" = None
         for w in pool.workers:
-            r = w.recv(deadline)
-            if r is None or not r.get("ok"):
-                pool.close()
-                return None
+            r, verdict = w.recv_classified(deadline, pool.heartbeat_s)
+            if r is None:
+                failed = (w, verdict)
+                break
+            if not r.get("ok"):
+                failed = (w, "error")
+                break
             if w.rank == 0:
                 out = r.get("out")
-        return out
+        if failed is None:
+            pool.breaker.success()
+            return out
+        if self._redispatched == 0 and pool._handle_worker_failure(*failed):
+            # the in-flight wave was abandoned pre-commit; re-dispatch
+            # it whole on the fresh ensemble (one retry — a second
+            # failure falls back to the in-process path for the wave)
+            self._redispatched = 1
+            handle = pool.run(self.key, self.host_dp)
+            if handle is not None:
+                handle._redispatched = 1
+                return handle.fetch()
+        return None
 
 
 # --------------------------------------------------------- module state
@@ -312,7 +637,12 @@ def acquire() -> "ProcMeshPool | None":
         if _VERDICT is not None:
             return None
         timeout_s = float(os.environ.get("KSS_PROCMESH_TIMEOUT_S", "30"))
-        pool = ProcMeshPool(n, timeout_s)
+        pool = ProcMeshPool(
+            n,
+            timeout_s,
+            heartbeat_s=heartbeat_from_env(),
+            max_respawns=max_respawns_from_env(),
+        )
         reason = pool.start()
         if reason is not None:
             _VERDICT = reason
@@ -325,8 +655,8 @@ def acquire() -> "ProcMeshPool | None":
 
 def count_run_fallback(reason: str) -> None:
     """A dispatch-time degrade (pool died mid-wave, artifact missing for
-    a new scan shape): counted, and the engine falls back to the virtual
-    mesh for the wave — never a partial commit."""
+    a new scan shape, breaker opened): counted, and the engine falls
+    back to the virtual mesh for the wave — never a partial commit."""
     with _LOCK:
         _count("run_fallbacks_by_reason", reason)
 
@@ -349,3 +679,19 @@ def shutdown() -> None:
         if _POOL is not None:
             _POOL.close()
             _POOL = None
+    _reap_orphans()
+
+
+def reset() -> None:
+    """Test/chaos hook: tear down the pool AND clear the memoized
+    verdict + counters.  The bring-up verdict is memoized per process by
+    design; harnesses running multiple legs (fuzz/chaos.py WorkerChaos,
+    scripts/resilience_smoke.py, tests) need a clean slate between
+    them."""
+    global _VERDICT
+    shutdown()
+    with _LOCK:
+        _VERDICT = None
+        _STATS["requested_processes"] = 0
+        _STATS["fallbacks_by_reason"] = {}
+        _STATS["run_fallbacks_by_reason"] = {}

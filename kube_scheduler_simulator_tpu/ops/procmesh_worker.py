@@ -164,6 +164,10 @@ def main() -> int:
     ap.add_argument("--nprocs", type=int, required=True)
     ap.add_argument("--coordinator", required=True)
     ap.add_argument("--out-fd", type=int, required=True)
+    # ensemble generation: 0 at bring-up, bumped per supervised respawn;
+    # echoed in the init handshake and every ping so tests and the
+    # supervisor can tell a replacement ensemble from the original
+    ap.add_argument("--generation", type=int, default=0)
     args = ap.parse_args()
     _pin_env()
     _depin_axon()
@@ -186,6 +190,7 @@ def main() -> int:
             "ok": True,
             "stage": "init",
             "rank": args.rank,
+            "generation": args.generation,
             "processes": jax.process_count(),
             "devices": len(jax.devices()),
             "local_devices": len(jax.local_devices()),
@@ -199,7 +204,7 @@ def main() -> int:
         try:
             cmd = msg["cmd"]
             if cmd == "ping":
-                reply = {"ok": True}
+                reply = {"ok": True, "rank": args.rank, "generation": args.generation}
             elif cmd == "probe":
                 reply = _probe(jax, args.nprocs)
             elif cmd == "load_scan":

@@ -31,6 +31,7 @@ import time
 from typing import Any
 
 from kube_scheduler_simulator_tpu.replication.ship import JournalTailer, SegmentPruned
+from kube_scheduler_simulator_tpu.resilience import RetryPolicy, note_retry
 from kube_scheduler_simulator_tpu.state import journal as J
 from kube_scheduler_simulator_tpu.state.recovery import (
     RecoveryReport,
@@ -64,10 +65,20 @@ class ReplicaApplier:
             "rebases": 0,
             "promotions": 0,
             "read_requests": 0,
+            "read_errors": 0,
+            "read_errors_by_errno": {},
+            "backoffs": 0,
         }
         store.replication_stats = self.stats
         # wall-clock moment the pending backlog last became nonzero
         self._pending_since: "float | None" = None
+        # transient read faults on the primary's directory (EACCES/EIO —
+        # classified by the tailer, never conflated with "not created
+        # yet") pace the poll loop through a seeded deterministic
+        # backoff instead of hammering a broken mount at poll_s
+        self.retry = RetryPolicy(base_s=0.05, factor=2.0, max_s=2.0, jitter=0.25, attempts=8)
+        self._error_streak = 0
+        self._backoff_until = 0.0
 
     # ----------------------------------------------------------- bootstrap
 
@@ -93,8 +104,16 @@ class ReplicaApplier:
     def step(self) -> int:
         """Drain everything currently shippable into the store; returns
         the number of records applied.  Never raises on journal damage —
-        a prune rebases, a torn live tail waits."""
+        a prune rebases, a torn live tail waits, and a read-side I/O
+        fault (EACCES/EIO on the primary's directory) backs off through
+        the seeded RetryPolicy: consecutive faulty polls space out
+        exponentially (counted — ``replication_backoffs_total`` and
+        ``retry_attempts_total{seam="replication"}``), and the first
+        clean poll resets the streak."""
+        if time.monotonic() < self._backoff_until:
+            return 0
         applied = 0
+        errors_before = self.tailer.stats["read_errors"]
         while True:
             try:
                 payloads = self.tailer.poll()
@@ -106,6 +125,17 @@ class ReplicaApplier:
             for payload in payloads:
                 if apply_record(self.store, payload, self.report, notify=self.notify):
                     applied += 1
+        if self.tailer.stats["read_errors"] > errors_before:
+            delay = self.retry.delay(min(self._error_streak, self.retry.attempts - 1))
+            self._error_streak += 1
+            self._backoff_until = time.monotonic() + delay
+            self.stats["backoffs"] += 1
+            note_retry("replication")
+            # skip the gauge refresh: pending_records() re-reads the
+            # faulty files and would double-count the same fault
+            self._sync_error_stats()
+            return applied
+        self._error_streak = 0
         self._refresh_gauges()
         return applied
 
@@ -135,10 +165,15 @@ class ReplicaApplier:
             f"segment pruned but no readable checkpoint remains in {self.directory}"
         )
 
+    def _sync_error_stats(self) -> None:
+        self.stats["read_errors"] = self.tailer.stats["read_errors"]
+        self.stats["read_errors_by_errno"] = dict(self.tailer.read_errors_by_errno)
+
     def _refresh_gauges(self) -> None:
         self.stats["records_shipped"] = self.report.replayed_records
         self.stats["events_applied"] = self.report.replayed_events
         self.stats["torn_records"] = self.tailer.stats["torn_records"]
+        self._sync_error_stats()
         pending = self.tailer.pending_records()
         self.stats["lag_records"] = pending
         if pending <= 0:

@@ -141,13 +141,16 @@ class ReplicaContainer:
             self._scheduler_service = svc
             # fresh journal epoch into the SAME directory: the promoted
             # node is now the writer, and a NEXT follower can tail it
-            from kube_scheduler_simulator_tpu.state.journal import Journal
+            from kube_scheduler_simulator_tpu.state.journal import (
+                Journal,
+                on_error_from_env,
+            )
             from kube_scheduler_simulator_tpu.state.recovery import (
                 build_checkpoint,
                 scheduler_meta_provider,
             )
 
-            self._journal = Journal(self.journal_dir)
+            self._journal = Journal(self.journal_dir, on_error=on_error_from_env())
             self._journal.last_mark = promotion.recovery.last_mark
             self._journal.add_meta_provider(scheduler_meta_provider(svc))
             self.cluster_store.attach_journal(self._journal)

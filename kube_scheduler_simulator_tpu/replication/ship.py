@@ -36,13 +36,14 @@ newest checkpoint (replication/apply.py) — the replica's watchers then
 
 from __future__ import annotations
 
+import errno as _errno
 import json
 import os
 import zlib
 from typing import Any
 
 from kube_scheduler_simulator_tpu.state import journal as J
-from kube_scheduler_simulator_tpu.state.journal import _HEADER, _MAX_RECORD
+from kube_scheduler_simulator_tpu.state.journal import _HEADER, _MAX_RECORD, classify_errno
 
 Obj = dict[str, Any]
 
@@ -77,13 +78,22 @@ class JournalTailer:
         # not re-count on every poll
         self._torn_key: "tuple[int, int] | None" = None
         self.finalized = False
+        # injectable open() — the resilience smoke/chaos harness lands
+        # EACCES/EIO on an exact poll without needing a non-root euid
+        self.io_open: Any = open
         self.stats: dict[str, int] = {
             "records": 0,
             "seals": 0,
             "torn_records": 0,
             "segments_crossed": 0,
             "checkpoints_crossed": 0,
+            "read_errors": 0,
         }
+        # read-side I/O faults by errno label — ENOENT is never here
+        # (an absent file is the "not created yet" wait state, not an
+        # error); EACCES/EIO/… are counted so a misconfigured
+        # KSS_REPLICA_OF surfaces instead of silently polling forever
+        self.read_errors_by_errno: dict[str, int] = {}
 
     # ------------------------------------------------------------ position
 
@@ -98,8 +108,32 @@ class JournalTailer:
         self._offset = 0
         self._torn_key = None
 
+    def _note_read_error(self, e: OSError) -> None:
+        """Count a non-ENOENT read-side I/O fault (EACCES, EIO, ENOTDIR
+        — the satellite bug: a bare ``except OSError`` classified a
+        permission-denied primary dir identically to "not created yet",
+        so a misconfigured ``KSS_REPLICA_OF`` polled forever in
+        silence).  Surfaced as ``replication_read_errors_total{errno}``;
+        the applier backs off through its RetryPolicy while these
+        accumulate."""
+        label = classify_errno(e)
+        self.stats["read_errors"] += 1
+        self.read_errors_by_errno[label] = self.read_errors_by_errno.get(label, 0) + 1
+
+    def _list(self, lister) -> list[tuple[int, str]]:
+        """Directory listing with the wait-vs-error split: ENOENT means
+        "not created yet" (wait, uncounted); anything else is a counted
+        read error and reads as empty until the fault clears."""
+        try:
+            return lister(self.directory)
+        except OSError as e:
+            if e.errno == _errno.ENOENT:
+                return []
+            self._note_read_error(e)
+            return []
+
     def _discover(self) -> "int | None":
-        for idx, _path in J.list_segments(self.directory):
+        for idx, _path in self._list(J.list_segments):
             if idx >= self._min_index:
                 return idx
         return None
@@ -107,8 +141,8 @@ class JournalTailer:
     def _newer_exists(self, idx: int) -> bool:
         """Any segment or checkpoint with index > ``idx`` — the writer
         has moved past ``idx``, so its tail can no longer grow."""
-        return any(i > idx for i, _ in J.list_segments(self.directory)) or any(
-            i > idx for i, _ in J.list_checkpoints(self.directory)
+        return any(i > idx for i, _ in self._list(J.list_segments)) or any(
+            i > idx for i, _ in self._list(J.list_checkpoints)
         )
 
     # ------------------------------------------------------------- reading
@@ -120,10 +154,12 @@ class JournalTailer:
         may be mid-write), ``sealed`` (seal consumed — segment
         complete), ``torn`` (full frame failed CRC/JSON or impossible
         length — real damage at ``new_offset``), ``missing`` (file
-        gone)."""
+        absent — ENOENT only), ``error`` (any other I/O fault — counted
+        via ``_note_read_error``; the caller waits and the applier
+        backs off)."""
         frames: list[Obj] = []
         try:
-            with open(path, "rb") as f:
+            with self.io_open(path, "rb") as f:
                 size = os.fstat(f.fileno()).st_size
                 if offset == 0:
                     if size < len(J.SEGMENT_MAGIC):
@@ -155,8 +191,11 @@ class JournalTailer:
                     if payload.get("t") == J.SEAL_TYPE:
                         return frames, offset, "sealed", 0
                     frames.append(payload)
-        except OSError:
-            return frames, offset, "missing", 0
+        except OSError as e:
+            if e.errno == _errno.ENOENT:
+                return frames, offset, "missing", 0
+            self._note_read_error(e)
+            return frames, offset, "error", 0
 
     def _advance(self) -> None:
         """Move to the next segment index (rotation and recovery epochs
@@ -204,6 +243,11 @@ class JournalTailer:
                 if ckpt is not None:
                     out.append(ckpt)
                 continue
+            if state == "error":
+                # transient (or persistent) I/O fault on the primary's
+                # files: counted above; hold position and let the
+                # applier's RetryPolicy pace the re-polls
+                return out
             if state == "missing":
                 if self._offset == 0 and self._newer_exists(self._seg - 1):
                     # compaction pruned it before we consumed it (or we

@@ -1959,7 +1959,13 @@ class BatchEngine:
             out = handle.fetch() if handle is not None else None
             if out is not None:
                 return out
-            procmesh.count_run_fallback("worker_lost")
+            # the supervised pool already re-dispatched once on a fresh
+            # ensemble; reaching here means the wave could not complete
+            # there.  Distinguish the breaker's terminal degradation
+            # (counted "breaker_open" by the pool itself) from a plain
+            # lost wave so /metrics can tell policy from incident.
+            if not (pool.dead and pool.breaker.state == pool.breaker.OPEN):
+                procmesh.count_run_fallback("worker_lost")
             local = eng._aot.load_scan(meta, donate=False) if eng._aot else None
             if local is None:
                 local = B.build_batch_fn(cfg, dims, donate=False, ws0=ws0)

@@ -27,6 +27,7 @@ from kube_scheduler_simulator_tpu.models.wrapped import WrappedPlugin, original_
 from kube_scheduler_simulator_tpu.plugins.intree import in_tree_registry
 from kube_scheduler_simulator_tpu.plugins.resultstore import ResultStore
 from kube_scheduler_simulator_tpu.plugins.storereflector import RESULT_STORE_KEY, StoreReflector
+from kube_scheduler_simulator_tpu.resilience import retry_stats as _retry_stats
 from kube_scheduler_simulator_tpu.scheduler.framework_runner import (
     Framework,
     FrameworkHandle,
@@ -1594,6 +1595,12 @@ class SchedulerService:
             "journal_records_total": jstats.get("records", 0),
             "journal_bytes_written_total": jstats.get("bytes", 0),
             "journal_fsyncs_total": jstats.get("fsyncs", 0),
+            # disk-fault policy (KSS_JOURNAL_ON_ERROR — docs/resilience.md)
+            "journal_wedges_total": jstats.get("wedges", 0),
+            "journal_records_dropped_total": jstats.get("records_dropped", 0),
+            "journal_degraded_by_errno": dict(
+                getattr(journal, "degraded_by_errno", None) or {}
+            ),
             "checkpoint_compactions_total": jstats.get("compactions", 0),
             "recovery_replayed_records_total": rstats.get("replayed_records", 0),
             "recovery_truncated_records_total": rstats.get("truncated_records", 0),
@@ -1663,6 +1670,9 @@ class SchedulerService:
             # multi-process shard ensemble (ops/procmesh.py): requested
             # size, engagement, and the counted-fallback reason tables
             "procmesh": self._procmesh_stats(),
+            # per-seam retry counters (resilience/policy.py note_retry):
+            # every counted retry taken at a cross-process seam
+            "retry_by_seam": _retry_stats(),
             # capacity engine (None when off or never engaged)
             "autoscaler": asc_m,
         }

@@ -149,6 +149,13 @@ class StreamSession:
     # ------------------------------------------------------------- stats
 
     def _count_drain(self, reason: str) -> None:
+        if reason.startswith("kernel error"):
+            # a kernel-error drain re-dispatches the wave's pods through
+            # the sequential path — a retry at the stream seam, counted
+            # like every other (retry_attempts_total{seam="stream"})
+            from kube_scheduler_simulator_tpu.resilience import note_retry
+
+            note_retry("stream")
         with self.svc._stats_lock:
             d = self.svc.stats["stream_drains"]
             d[reason] = d.get(reason, 0) + 1

@@ -58,6 +58,7 @@ class DIContainer:
                 _jknobs["directory"],
                 fsync=_jknobs["fsync"],
                 checkpoint_every=_jknobs["checkpoint_every"],
+                on_error=_jknobs["on_error"],
             )
             if _recovery_report is not None:
                 # the new epoch inherits the recovered resume point — a

@@ -241,6 +241,16 @@ def render_metrics(di: Any) -> str:
     counter("journal_records_total", "Records appended to the write-ahead journal (one per mutation event, or one per atomic wave/gang/bulk transaction).", m["journal_records_total"])
     counter("journal_bytes_written_total", "Bytes appended to journal segments (record headers + payloads).", m["journal_bytes_written_total"])
     counter("journal_fsyncs_total", "Journal records synced to disk (KSS_JOURNAL_FSYNC=1).", m["journal_fsyncs_total"])
+    # disk faults as policy (KSS_JOURNAL_ON_ERROR — docs/resilience.md)
+    counter("journal_wedges_total", "Disk faults that wedged the journal (KSS_JOURNAL_ON_ERROR=wedge): the commit failed loudly and all further mutations are refused.", m["journal_wedges_total"])
+    counter("journal_records_dropped_total", "Journal appends skipped while running non-durable after a degrade-mode disk fault.", m["journal_records_dropped_total"])
+    for label, n in sorted(m["journal_degraded_by_errno"].items()):
+        counter(
+            "journal_degraded_total",
+            "Disk faults absorbed by KSS_JOURNAL_ON_ERROR=degrade (journal marked torn at a record boundary, store continues non-durable), by errno.",
+            n,
+            {"errno": label},
+        )
     counter("checkpoint_compactions_total", "Journal compactions: checkpoint written (SnapshotService.snap shape + extras), segments rotated and pruned.", m["checkpoint_compactions_total"])
     counter("recovery_replayed_records_total", "Journal records replayed into the store by the last boot-time recovery.", m["recovery_replayed_records_total"])
     counter("recovery_truncated_records_total", "Torn journal tails truncated by recovery (counted, never raised; nonzero after a clean SIGKILL = bug).", m["recovery_truncated_records_total"])
@@ -320,6 +330,18 @@ def render_metrics(di: Any) -> str:
         if pool:
             counter("procmesh_dispatches_total", "Scan waves dispatched to the worker ensemble.", pool["dispatches"])
             counter("procmesh_scans_loaded", "Distinct AOT scan executables resolved on every worker.", pool["scans_loaded"], typ="gauge")
+            # supervision (docs/resilience.md): straggler-only kills,
+            # ensemble respawns, and the breaker's degradation state
+            counter("procmesh_respawns_total", "Worker-ensemble respawns after a supervised failure (straggler SIGKILLed, fresh ensemble re-loaded from the AOT cache).", pool["respawns"])
+            counter("procmesh_hangs_detected_total", "Workers declared hung (alive but STOPPED for a full KSS_PROCMESH_HEARTBEAT_S — e.g. SIGSTOP'd), distinguished from dead ones.", pool["hangs_detected"])
+            counter("procmesh_breaker_state", "Ensemble circuit breaker: 0 closed, 1 half-open, 2 open (open = counted permanent degradation to the in-process virtual mesh).", pool["breaker_state_code"], typ="gauge")
+            for verdict, n in sorted(pool["failures_by_verdict"].items()):
+                counter(
+                    "procmesh_worker_failures_total",
+                    "Supervised worker failures, by wait verdict (died/hang/timeout/error).",
+                    n,
+                    {"verdict": verdict},
+                )
         for reason, n in sorted(pm["fallbacks_by_reason"].items()):
             counter(
                 "procmesh_fallbacks_total",
@@ -371,6 +393,27 @@ def render_metrics(di: Any) -> str:
         counter("replication_rebases_total", "Follower rebases from a newer checkpoint after compaction pruned the segment being tailed.", rep["rebases"])
         counter("replica_promotions_total", "Failovers: this replica finalized replay and became the primary.", rep["promotions"])
         counter("replica_read_requests_total", "GET requests served by the replica's HTTP surface.", rep["read_requests"])
+        # read-side disk faults on the primary's directory, classified
+        # (ENOENT = not created yet, waits uncounted; everything else
+        # counts here and paces the poll loop through RetryPolicy)
+        counter("replication_backoffs_total", "Faulty polls that pushed the apply loop into seeded exponential backoff.", rep.get("backoffs", 0))
+        for label, n in sorted((rep.get("read_errors_by_errno") or {}).items()):
+            counter(
+                "replication_read_errors_total",
+                "Tailer read faults on the primary's journal directory (EACCES/EIO/...; never conflated with a journal that does not exist yet), by errno.",
+                n,
+                {"errno": label},
+            )
+
+    # per-seam retries (resilience/policy.py): every counted retry a
+    # cross-process seam took and survived
+    for seam, n in sorted((m.get("retry_by_seam") or {}).items()):
+        counter(
+            "retry_attempts_total",
+            "Retries taken at a cross-process seam (procmesh re-dispatch, replication backoff, stream kernel-error drain), by seam.",
+            n,
+            {"seam": seam},
+        )
 
     store = di.cluster_store
     from kube_scheduler_simulator_tpu.state.store import KINDS

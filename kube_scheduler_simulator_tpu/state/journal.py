@@ -60,6 +60,19 @@ Design points:
   ``stats["records"]`` and skipped (uncounted) by recovery and the
   replication applier.
 
+- **Disk faults are policy, not stack traces.**  Append/fsync/rotation
+  errors are classified by errno (ENOSPC, EIO, EROFS, …) and routed per
+  ``KSS_JOURNAL_ON_ERROR``: ``wedge`` (default) raises
+  :class:`JournalWedged` out of the failing commit and refuses every
+  later transaction at ENTRY (before any store mutation) — the
+  durability promise fails loudly; ``degrade`` counts
+  ``journal_degraded_total{errno}`` once and continues NON-durable,
+  with the log truncated back to the last record boundary so recovery
+  and a live tailer both read a clean prefix of durable records.  All
+  record bytes go through the injectable ``io`` seam so the chaos
+  harness (fuzz/chaos.py ``DiskChaos``) can land a fault at an exact
+  seeded record.
+
 Everything here is opt-in: with no journal attached the store takes one
 ``None`` check per emit and tier-1 stays byte-for-byte today's behavior.
 
@@ -72,6 +85,7 @@ a record boundary like a real mid-run kill.
 
 from __future__ import annotations
 
+import errno as _errno
 import json
 import os
 import struct
@@ -99,6 +113,40 @@ class JournalError(RuntimeError):
     """A journal WRITE-side invariant broke (bad configuration, closed
     journal).  Read-side damage is never an exception — recovery counts
     and truncates it."""
+
+
+class JournalWedged(JournalError):
+    """A disk fault hit the journal under ``KSS_JOURNAL_ON_ERROR=wedge``:
+    the durability promise could not be kept, so the commit fails LOUDLY
+    and the store refuses further mutations — every subsequent
+    ``journal_txn`` raises at entry, BEFORE any store mutation.  The
+    on-disk journal stays a clean prefix of durable records (the failed
+    frame is truncated back to its record boundary)."""
+
+
+def classify_errno(e: OSError) -> str:
+    """A disk fault's errno as a stable label: the named classes the
+    fault matrix drills (ENOSPC, EIO, EROFS), the symbolic name for any
+    other errno, ``EUNKNOWN`` when the OSError carries none."""
+    if e.errno is None:
+        return "EUNKNOWN"
+    return _errno.errorcode.get(e.errno, f"E{e.errno}")
+
+
+class _DirectIO:
+    """The journal's file-IO seam: every segment/seal byte goes through
+    these three calls so the chaos harness (fuzz/chaos.py ``DiskChaos``)
+    can inject ENOSPC/EIO/EROFS at a seeded record without touching a
+    real filesystem's failure modes."""
+
+    def write(self, f, data: bytes) -> None:
+        f.write(data)
+
+    def flush(self, f) -> None:
+        f.flush()
+
+    def fsync(self, fd: int) -> None:
+        os.fsync(fd)
 
 
 def _dumps(payload: Obj) -> bytes:
@@ -156,12 +204,28 @@ class Journal:
         fsync: bool = False,
         checkpoint_every: int = 0,
         kill_at: "int | None" = None,
+        on_error: str = "wedge",
+        io: "Any | None" = None,
     ):
         self.directory = directory
         self.fsync = bool(fsync)
         self.checkpoint_every = int(checkpoint_every)
         if self.checkpoint_every < 0:
             raise JournalError(f"checkpoint_every must be >= 0, got {checkpoint_every}")
+        if on_error not in ("wedge", "degrade"):
+            raise JournalError(
+                f"on_error must be 'wedge' or 'degrade', got {on_error!r}"
+            )
+        # disk-fault policy (KSS_JOURNAL_ON_ERROR): 'wedge' fails the
+        # commit loudly and refuses further mutations; 'degrade' counts
+        # the errno and continues NON-DURABLE (appends become no-ops)
+        # with the on-disk log truncated back to a record boundary so
+        # recovery and a live tailer both read a clean prefix.
+        self.on_error = on_error
+        self.io = io if io is not None else _DirectIO()
+        self.wedged = False
+        self.degraded_errno: "str | None" = None
+        self.degraded_by_errno: dict[str, int] = {}
         # test/chaos hook: SIGKILL this process once record #kill_at
         # (1-based) is durable (fuzz.chaos.ProcessChaos)
         self.kill_at = kill_at
@@ -196,6 +260,8 @@ class Journal:
             "compactions": 0,
             "fsyncs": 0,
             "seals": 0,
+            "wedges": 0,
+            "records_dropped": 0,  # appends skipped while degraded
         }
         os.makedirs(directory, exist_ok=True)
         segs = list_segments(directory)
@@ -212,6 +278,43 @@ class Journal:
             f.write(SEGMENT_MAGIC)
             f.flush()
         return f
+
+    def check_writable(self) -> None:
+        """Raise :class:`JournalWedged` once a wedge-mode disk fault has
+        hit.  ``ClusterStore.journal_txn`` calls this at transaction
+        ENTRY: after the first loud failure, no further store mutation
+        even begins against a journal that cannot make it durable."""
+        if self.wedged:
+            raise JournalWedged(
+                "journal is wedged after a disk fault (KSS_JOURNAL_ON_ERROR=wedge): "
+                "refusing further mutations"
+            )
+
+    def _handle_write_error(self, e: OSError, boundary: int) -> None:
+        """Route a disk fault per ``on_error`` — called under ``_mu``
+        with ``boundary`` the offset of the last durable record edge.
+        Both policies first truncate the maybe-partial frame back to the
+        boundary so the on-disk log stays a clean prefix of durable
+        records; if even the truncate fails, the leftover partial tail
+        is exactly the shape recovery and the tailer already classify
+        (torn, counted, stepped over) — the logical prefix stays clean
+        either way."""
+        label = classify_errno(e)
+        try:
+            self._f.truncate(boundary)
+            self._f.seek(boundary)
+        except (OSError, ValueError):
+            pass
+        if self.on_error == "degrade":
+            self.degraded_errno = label
+            self.degraded_by_errno[label] = self.degraded_by_errno.get(label, 0) + 1
+            return
+        self.wedged = True
+        self.stats["wedges"] += 1
+        raise JournalWedged(
+            f"journal write failed ({label}) under KSS_JOURNAL_ON_ERROR=wedge: "
+            "the commit is NOT durable — refusing this and all further mutations"
+        ) from e
 
     def add_meta_provider(self, provider: Callable[[], Obj]) -> None:
         self.meta_providers.append(provider)
@@ -264,17 +367,30 @@ class Journal:
         with self._mu:
             if self._closed:
                 raise JournalError("journal is closed")
-            if rtype == "mark":
-                self.last_mark = extra
+            self.check_writable()
+            if self.degraded_errno is not None:
+                # non-durable continuation: the fault was counted when it
+                # hit; further records drop (counted) so the on-disk
+                # prefix stays exactly the pre-fault durable stream
+                self.stats["records_dropped"] += 1
+                return
             # ONE write for the whole frame, then one flush: a concurrent
             # tailer of the live segment sees a strict prefix of the
             # record stream, never a header published ahead of its
             # payload (replication/ship.py leans on this)
-            self._f.write(_HEADER.pack(len(data), zlib.crc32(data) & 0xFFFFFFFF) + data)
-            self._f.flush()
-            if self.fsync:
-                os.fsync(self._f.fileno())
-                self.stats["fsyncs"] += 1
+            boundary = self._f.tell()
+            frame = _HEADER.pack(len(data), zlib.crc32(data) & 0xFFFFFFFF) + data
+            try:
+                self.io.write(self._f, frame)
+                self.io.flush(self._f)
+                if self.fsync:
+                    self.io.fsync(self._f.fileno())
+                    self.stats["fsyncs"] += 1
+            except OSError as e:
+                self._handle_write_error(e, boundary)
+                return
+            if rtype == "mark":
+                self.last_mark = extra
             self.stats["records"] += 1
             self.stats["bytes"] += _HEADER.size + len(data)
             self._records_since_checkpoint += 1
@@ -334,18 +450,31 @@ class Journal:
         or a superseded segment without one, is a crash, not a
         mid-write tail.  Framing metadata only: not counted in
         ``stats["records"]``, skipped by recovery and replication."""
+        if self.wedged or self.degraded_errno is not None:
+            return
         data = _dumps({"t": SEAL_TYPE})
-        self._f.write(_HEADER.pack(len(data), zlib.crc32(data) & 0xFFFFFFFF) + data)
-        self._f.flush()
-        if self.fsync:
-            os.fsync(self._f.fileno())
-            self.stats["fsyncs"] += 1
+        boundary = self._f.tell()
+        frame = _HEADER.pack(len(data), zlib.crc32(data) & 0xFFFFFFFF) + data
+        try:
+            self.io.write(self._f, frame)
+            self.io.flush(self._f)
+            if self.fsync:
+                self.io.fsync(self._f.fileno())
+                self.stats["fsyncs"] += 1
+        except OSError as e:
+            self._handle_write_error(e, boundary)
+            return
         self.stats["seals"] += 1
         self.stats["bytes"] += _HEADER.size + len(data)
 
     def _write_checkpoint(self, payload: Obj, meta: Obj) -> "str | None":
+        import contextlib
+
         with self._mu:
             if self._closed:
+                return None
+            self.check_writable()
+            if self.degraded_errno is not None:
                 return None
             new_index = self._seg_index + 1
             doc: Obj = {"t": "checkpoint", "meta": meta, "x": payload}
@@ -353,26 +482,46 @@ class Journal:
                 doc["mark"] = self.last_mark
             data = _dumps(doc)
             path = checkpoint_path(self.directory, new_index)
-            with open(path, "wb") as f:
-                f.write(CHECKPOINT_MAGIC)
-                f.write(_HEADER.pack(len(data), zlib.crc32(data) & 0xFFFFFFFF))
-                f.write(data)
-                f.flush()
-                os.fsync(f.fileno())
+            try:
+                with open(path, "wb") as f:
+                    f.write(CHECKPOINT_MAGIC)
+                    f.write(_HEADER.pack(len(data), zlib.crc32(data) & 0xFFFFFFFF))
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+            except OSError as e:
+                # a half-written checkpoint must never be discoverable —
+                # remove it before routing the fault (recovery would
+                # otherwise count it bad_checkpoint and fall back anyway)
+                with contextlib.suppress(OSError):
+                    os.unlink(path)
+                self._handle_write_error(e, self._f.tell())
+                return None
             # rotate, then prune: the checkpoint at index k covers every
             # record in segments < k.  Seal the finished segment FIRST —
             # a tailer mid-segment follows the seal into the new index
             # without ever needing the checkpoint it already replayed.
             self._seal_locked()
+            if self.degraded_errno is not None:
+                return None
             self._f.close()
             self._seg_index = new_index
-            self._f = self._open_segment(new_index)
-            for idx, p in list_segments(self.directory):
-                if idx < new_index:
-                    os.unlink(p)
-            for idx, p in list_checkpoints(self.directory):
-                if idx < new_index:
-                    os.unlink(p)
+            try:
+                self._f = self._open_segment(new_index)
+            except OSError as e:
+                self._handle_write_error(e, 0)
+                return None
+            # prune failures (e.g. the fs flipped read-only between the
+            # checkpoint fsync and here) are GC misses, not durability
+            # faults: stale files linger, recovery still picks the
+            # newest valid checkpoint
+            with contextlib.suppress(OSError):
+                for idx, p in list_segments(self.directory):
+                    if idx < new_index:
+                        os.unlink(p)
+                for idx, p in list_checkpoints(self.directory):
+                    if idx < new_index:
+                        os.unlink(p)
             self._records_since_checkpoint = 0
             # the checkpoint is the new recovery BASE: later records'
             # meta deltas must diff against ITS full meta, or a field
@@ -383,12 +532,17 @@ class Journal:
             return path
 
     def close(self) -> None:
+        import contextlib
+
         with self._mu:
             if not self._closed:
                 # clean shutdown seals the live segment: a follower can
                 # tell "primary exited" from "primary crashed mid-write"
+                # (_seal_locked is a no-op once wedged/degraded — the
+                # unsealed tail is the honest crash-boundary signal)
                 self._seal_locked()
-                self._f.close()
+                with contextlib.suppress(OSError, ValueError):
+                    self._f.close()
                 self._closed = True
 
 
@@ -473,7 +627,21 @@ def journal_knobs() -> "Obj | None":
         "directory": directory,
         "fsync": _env_flag(os.environ.get("KSS_JOURNAL_FSYNC")),
         "checkpoint_every": every,
+        "on_error": on_error_from_env(),
     }
+
+
+def on_error_from_env() -> str:
+    """The validated ``KSS_JOURNAL_ON_ERROR`` policy — read separately
+    from :func:`journal_knobs` because the promotion path builds a
+    journal for a directory named by ``KSS_REPLICA_OF``, with
+    ``KSS_JOURNAL_DIR`` unset."""
+    on_error = os.environ.get("KSS_JOURNAL_ON_ERROR", "").strip().lower() or "wedge"
+    if on_error not in ("wedge", "degrade"):
+        raise JournalError(
+            f"KSS_JOURNAL_ON_ERROR must be 'wedge' or 'degrade', got {on_error!r}"
+        )
+    return on_error
 
 
 def journal_from_env() -> "Journal | None":
@@ -485,4 +653,5 @@ def journal_from_env() -> "Journal | None":
         knobs["directory"],
         fsync=knobs["fsync"],
         checkpoint_every=knobs["checkpoint_every"],
+        on_error=knobs["on_error"],
     )

@@ -305,6 +305,11 @@ class ClusterStore:
         if self.journal is None:
             yield
             return
+        # a WEDGED journal (disk fault under KSS_JOURNAL_ON_ERROR=wedge)
+        # refuses the transaction HERE, before any store mutation runs —
+        # the durability promise fails loudly, never silently ahead of
+        # the on-disk stream
+        self.journal.check_writable()
         tl = self._txn_local
         depth = getattr(tl, "depth", 0)
         if depth == 0:

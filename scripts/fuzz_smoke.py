@@ -273,6 +273,57 @@ def main() -> int:
         print("fuzz-smoke: ProcessChaos recovery replayed nothing", file=sys.stderr)
         return 1
 
+    # ---- worker-fault leg: the execution-plane adversary (fuzz/chaos.py
+    # WorkerChaos + ops/procmesh.py supervision): a shard worker is
+    # SIGKILLed at a seeded dispatch — the supervisor must respawn the
+    # ensemble from the AOT cache, re-dispatch the abandoned wave, and
+    # match the in-process bytes; on hosts where the ensemble can't
+    # engage the leg is a loud counted skip (the fault-matrix smoke,
+    # scripts/resilience_smoke.py, carries the full matrix)
+    from kube_scheduler_simulator_tpu.fuzz.chaos import WorkerChaos, leaked_worker_pids
+
+    wnodes = [h["object"] for t in crash_scn["ticks"] for h in t
+              if h["op"] == "create" and h["kind"] == "nodes"]
+    # the WorkerChaos cluster is {nodes, pods} only — strip the
+    # PriorityClass references the composite scenario's pods may carry
+    # (admission would reject them); both legs see the same clones, so
+    # the parity bar is unaffected
+    wpods = []
+    for t in crash_scn["ticks"]:
+        for h in t:
+            if h["op"] == "create" and h["kind"] == "pods":
+                p = json.loads(json.dumps(h["object"]))
+                p["spec"].pop("priorityClassName", None)
+                p["spec"].pop("priority", None)
+                wpods.append(p)
+    if wnodes and wpods:
+        wv = WorkerChaos(
+            {"name": "worker-fault", "nodes": wnodes, "pods": wpods[:24]},
+            mode="kill", fault_at=0, nprocs=1, heartbeat_s=0.3, timeout_s=120.0,
+        ).run()
+        report["scenarios"] += 1
+        if wv["engaged"]:
+            if wv["divergences"] or not wv["fired"] or wv["respawns"] < 1:
+                print(
+                    f"fuzz-smoke: WorkerChaos leg broke: fired={wv['fired']} "
+                    f"respawns={wv['respawns']} div={wv['divergences'][:4]} "
+                    f"first={wv['first_mismatch']}",
+                    file=sys.stderr,
+                )
+                report["divergences"]["worker-fault"] = len(wv["divergences"]) or 1
+                return 1
+        else:
+            print(
+                f"fuzz-smoke: WorkerChaos leg skipped loudly — ensemble could not "
+                f"engage (verdict={wv['bringup_verdict']!r})"
+            )
+        if leaked_worker_pids():
+            print(
+                f"fuzz-smoke: WorkerChaos leaked workers {leaked_worker_pids()}",
+                file=sys.stderr,
+            )
+            return 1
+
     # ---- metrics wiring: the sweep reports into a live service
     _store_m, svc_m = harness.service("default", "batch")
     svc_m.note_fuzz_report(report)
@@ -309,6 +360,13 @@ def main() -> int:
         f"mesh-stream leg streamed {fuse_m['stream_waves_total']} sharded waves, "
         f"process-crash leg byte-identical at kill points {cv['kill_points']} "
         f"({cv['replayed_records']} records replayed, 0 torn), "
+        f"worker-fault leg "
+        + (
+            f"byte-identical across {wv['respawns']} respawn(s)"
+            if wnodes and wpods and wv["engaged"]
+            else "loudly skipped"
+        )
+        + ", "
         f"{wall:.0f}s; coverage: {json.dumps(cov.summary())}"
     )
     return 0

@@ -1,0 +1,339 @@
+#!/usr/bin/env python
+"""Fault-matrix resilience smoke (tier-1): one leg per fault class.
+
+The fault-tolerant execution plane's contract (docs/resilience.md) is
+that every injected fault ends in exactly one of two shapes — a COUNTED
+degradation with a byte-identical annotation trail, or a LOUD wedge.
+Silent divergence is the only failing verdict.  This smoke walks the
+matrix:
+
+- worker SIGKILL mid-churn   → supervised respawn, parity, and zero
+  extra backend compiles over the identical clean-ensemble run (the
+  respawned ensemble loads from the AOT cache, never compiles);
+- worker SIGSTOP (hang)      → the STOPPED worker is detected as a
+  HANG (not a timeout, not a death), SIGKILLed alone, ensemble
+  respawned, parity holds;
+- pipe sever mid-frame       → same counted respawn + parity;
+- ENOSPC, KSS_JOURNAL_ON_ERROR=degrade → journal counts the errno,
+  goes non-durable, on-disk log recovers as a clean prefix (0 torn),
+  store trail byte-identical to unjournaled;
+- ENOSPC, KSS_JOURNAL_ON_ERROR=wedge   → the faulting commit raises
+  JournalWedged loudly; every later transaction refuses at entry,
+  before any store mutation;
+- tailer EACCES              → the replica tailer classifies the read
+  fault (never conflated with "journal not created yet"), counts it
+  per errno, and paces its poll loop through the seeded RetryPolicy
+  backoff — then drains cleanly once the fault heals.
+
+Worker legs that cannot engage an ensemble on this host SKIP LOUDLY
+(with the counted bring-up verdict) — the no-leaked-worker assert runs
+regardless: no ``procmesh_worker`` may survive the smoke.  A worker-leg
+divergence triages itself: a pod-level ddmin shrinks the scenario while
+the divergence reproduces and prints the minimized cluster.
+
+Exit 0 = every leg landed on its contractual outcome.
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:  # the axon plugin dials the TPU tunnel even when CPU-pinned
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+
+def _node(i: int) -> dict:
+    return {
+        "metadata": {"name": f"rn{i}", "labels": {"zone": f"z{i % 2}"}},
+        "status": {
+            "allocatable": {"cpu": str(4 + (i % 3)), "memory": "8Gi", "pods": "110"},
+            "capacity": {"cpu": str(4 + (i % 3)), "memory": "8Gi", "pods": "110"},
+        },
+    }
+
+
+def _pod(i: int) -> dict:
+    return {
+        "metadata": {"name": f"rp{i}", "labels": {"app": f"a{i % 4}"}},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "image": "pause",
+                    "resources": {
+                        "requests": {
+                            "cpu": f"{[100, 250, 500][i % 3]}m",
+                            "memory": f"{[64, 256][i % 2]}Mi",
+                        }
+                    },
+                }
+            ]
+        },
+    }
+
+
+def _scenario(pods: "list | None" = None) -> dict:
+    return {
+        "name": "resilience",
+        "nodes": [_node(i) for i in range(8)],
+        "pods": pods if pods is not None else [_pod(i) for i in range(24)],
+    }
+
+
+def _ddmin_pods(mode: str, pods: list) -> list:
+    """Pod-level ddmin triage for a diverging worker leg: greedily drop
+    pods while the divergence reproduces (bounded checks — triage, not
+    proof of minimality)."""
+    from kube_scheduler_simulator_tpu.fuzz.chaos import WorkerChaos
+
+    def diverges(cand: list) -> bool:
+        v = WorkerChaos(_scenario(cand), mode=mode, fault_at=0, nprocs=1).run()
+        return bool(v["engaged"] and v["divergences"])
+
+    cur = list(pods)
+    checks = 0
+    chunk = max(1, len(cur) // 2)
+    while chunk >= 1 and checks < 10:
+        i = 0
+        while i < len(cur) and checks < 10:
+            cand = cur[:i] + cur[i + chunk :]
+            checks += 1
+            if cand and diverges(cand):
+                cur = cand
+            else:
+                i += chunk
+        chunk //= 2
+    return cur
+
+
+def _worker_leg(mode: str, *, want_hang: bool = False, clean_leg: bool = False) -> "int | None":
+    """One WorkerChaos leg; returns 0/1, or None for a loud skip."""
+    from kube_scheduler_simulator_tpu.fuzz.chaos import WorkerChaos
+
+    scn = _scenario()
+    v = WorkerChaos(
+        scn, mode=mode, fault_at=0, nprocs=1, heartbeat_s=0.3, timeout_s=120.0,
+        clean_leg=clean_leg,
+    ).run()
+    if not v["engaged"]:
+        print(
+            f"resilience-smoke SKIP (loud): worker-{mode} leg — single-worker "
+            f"ensemble could not engage on this host (verdict="
+            f"{v['bringup_verdict']!r})"
+        )
+        return None
+    if not v["fired"]:
+        print(f"resilience-smoke FAIL: worker-{mode} fault never fired", file=sys.stderr)
+        return 1
+    if v["divergences"]:
+        print(
+            f"resilience-smoke FAIL: worker-{mode} diverged: {v['divergences'][:4]} "
+            f"first={v['first_mismatch']}",
+            file=sys.stderr,
+        )
+        minimized = _ddmin_pods(mode, scn["pods"])
+        print(
+            f"resilience-smoke triage: divergence reproduces with "
+            f"{len(minimized)} pod(s): {[p['metadata']['name'] for p in minimized]}",
+            file=sys.stderr,
+        )
+        return 1
+    if v["respawns"] < 1:
+        print(
+            f"resilience-smoke FAIL: worker-{mode} recovered without a counted "
+            f"respawn (respawns={v['respawns']}, fallbacks={v['run_fallbacks']})",
+            file=sys.stderr,
+        )
+        return 1
+    if want_hang and v["hangs_detected"] < 1:
+        print(
+            f"resilience-smoke FAIL: SIGSTOP'd worker was not classified as a "
+            f"hang (verdicts counted: {v['run_fallbacks']})",
+            file=sys.stderr,
+        )
+        return 1
+    if clean_leg and v["chaos_compiles"] > v["clean_compiles"]:
+        print(
+            f"resilience-smoke FAIL: respawn recompiled — chaos leg "
+            f"{v['chaos_compiles']} backend compiles vs clean ensemble leg "
+            f"{v['clean_compiles']} (workers must load, never compile)",
+            file=sys.stderr,
+        )
+        return 1
+    if v["leaked_workers"]:
+        print(
+            f"resilience-smoke FAIL: worker-{mode} leaked processes "
+            f"{v['leaked_workers']}",
+            file=sys.stderr,
+        )
+        return 1
+    extras = ""
+    if clean_leg:
+        extras = f", compiles clean={v['clean_compiles']} chaos={v['chaos_compiles']}"
+    print(
+        f"resilience-smoke: worker-{mode} OK — parity, respawns={v['respawns']}, "
+        f"hangs={v['hangs_detected']}, dispatches={v['dispatches']}{extras}"
+    )
+    return 0
+
+
+def _disk_legs() -> int:
+    from kube_scheduler_simulator_tpu.fuzz.chaos import DiskChaos
+
+    v = DiskChaos(mode="degrade", op="write", err=_errno.ENOSPC, fail_record=3, events=8).run()
+    if (
+        not v["fired"]
+        or v["divergences"]
+        or v["degraded_by_errno"].get("ENOSPC") != 1
+        or v["records_dropped"] < 1
+        or v["recovered_torn"] != 0
+    ):
+        print(f"resilience-smoke FAIL: ENOSPC-degrade leg: {json.dumps(v)}", file=sys.stderr)
+        return 1
+    print(
+        f"resilience-smoke: ENOSPC-degrade OK — counted {v['degraded_by_errno']}, "
+        f"{v['records_dropped']} appends dropped non-durable, clean prefix of "
+        f"{v['recovered_records']} records recovered, 0 torn, trail byte-identical"
+    )
+
+    v = DiskChaos(mode="wedge", op="write", err=_errno.ENOSPC, fail_record=3, events=8).run()
+    if (
+        not v["fired"]
+        or v["divergences"]
+        or not v["wedged"]
+        or v["wedge_raised"] != 1
+        or v["post_fault_refusals"] < 1
+    ):
+        print(f"resilience-smoke FAIL: ENOSPC-wedge leg: {json.dumps(v)}", file=sys.stderr)
+        return 1
+    print(
+        f"resilience-smoke: ENOSPC-wedge OK — commit raised loudly, "
+        f"{v['post_fault_refusals']} later transactions refused at entry, "
+        f"no store mutation after the wedge"
+    )
+    return 0
+
+
+def _tailer_leg() -> int:
+    """EACCES on the primary's journal files: classified, counted per
+    errno, poll loop backs off through the seeded RetryPolicy, and the
+    drain completes once the fault heals."""
+    import tempfile
+
+    from kube_scheduler_simulator_tpu.replication.apply import ReplicaApplier
+    from kube_scheduler_simulator_tpu.resilience import reset_retry_stats, retry_stats
+    from kube_scheduler_simulator_tpu.state import journal as J
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+    reset_retry_stats()
+    with tempfile.TemporaryDirectory(prefix="kss-resil-tailer-") as td:
+        primary = ClusterStore()
+        jr = J.Journal(td)
+        primary.attach_journal(jr)
+        for i in range(4):
+            with primary.journal_txn("wave"):
+                p = primary.create(
+                    "pods",
+                    {"metadata": {"name": f"tp{i}"}, "spec": {"containers": []}},
+                )
+                p["spec"]["nodeName"] = "n0"
+                primary.update("pods", p)
+        jr.close()
+
+        replica = ClusterStore()
+        applier = ReplicaApplier(replica, td, notify=False)
+
+        def denied(path, *a, **kw):
+            raise PermissionError(_errno.EACCES, "permission denied", path)
+
+        applier.tailer.io_open = denied
+        applier.step()
+        st = applier.stats
+        if st["read_errors"] < 1 or st["read_errors_by_errno"].get("EACCES", 0) < 1:
+            print(f"resilience-smoke FAIL: EACCES not counted: {st}", file=sys.stderr)
+            return 1
+        if st["backoffs"] != 1 or applier._backoff_until <= time.monotonic() - 5:
+            print(f"resilience-smoke FAIL: no backoff after EACCES: {st}", file=sys.stderr)
+            return 1
+        if applier.step() != 0:  # inside the backoff window: no poll
+            print("resilience-smoke FAIL: poll ran inside the backoff window", file=sys.stderr)
+            return 1
+        if retry_stats().get("replication", 0) < 1:
+            print("resilience-smoke FAIL: replication retry not counted per seam", file=sys.stderr)
+            return 1
+        # heal the fault; the drain must complete
+        applier.tailer.io_open = open
+        applier._backoff_until = 0.0
+        applied = applier.step()
+        if applied < 4 or len(replica.list("pods")) != 4:
+            print(
+                f"resilience-smoke FAIL: post-heal drain applied {applied} records, "
+                f"{len(replica.list('pods'))} pods",
+                file=sys.stderr,
+            )
+            return 1
+        if applier._error_streak != 0:
+            print("resilience-smoke FAIL: clean poll did not reset the error streak", file=sys.stderr)
+            return 1
+    print(
+        f"resilience-smoke: tailer-EACCES OK — {st['read_errors_by_errno']} counted, "
+        f"1 backoff, retry seam counted, {applied} records drained after heal"
+    )
+    return 0
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    rc = 0
+    skipped = 0
+
+    from kube_scheduler_simulator_tpu.fuzz.chaos import leaked_worker_pids
+
+    for mode, kw in (
+        ("kill", {"clean_leg": True}),
+        ("stop", {"want_hang": True}),
+        ("sever", {}),
+    ):
+        leg = _worker_leg(mode, **kw)
+        if leg is None:
+            skipped += 1
+        else:
+            rc |= leg
+
+    rc |= _disk_legs()
+    rc |= _tailer_leg()
+
+    leaked = leaked_worker_pids()
+    if leaked:
+        print(f"resilience-smoke FAIL: leaked procmesh_worker pids {leaked}", file=sys.stderr)
+        rc = 1
+
+    wall = time.monotonic() - t0
+    if rc == 0:
+        print(
+            f"resilience-smoke OK: fault matrix green "
+            f"({3 - skipped} worker legs, {skipped} loud skips, 2 disk legs, "
+            f"1 tailer leg; 0 silent divergences, 0 leaked workers); {wall:.0f}s"
+        )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,14 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/crash_smoke.py; then
 # records, and an in-process read replica served over HTTP (reads 200 +
 # counted, writes 405, replication_* metrics, promotion unlocks writes).
 if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/replica_smoke.py; then rc=1; fi
+# Fault-matrix resilience smoke (docs/resilience.md): one leg per fault
+# class — worker SIGKILL / SIGSTOP-hang / pipe-sever (supervised
+# respawn, byte parity, zero extra recompiles, no leaked workers),
+# ENOSPC under KSS_JOURNAL_ON_ERROR=degrade|wedge, and tailer EACCES
+# (classified, counted per errno, seeded RetryPolicy backoff).  Every
+# injected fault must end in a counted degradation with byte parity or
+# a loud wedge; silent divergence fails tier-1.
+if ! timeout -k 10 590 env JAX_PLATFORMS=cpu python scripts/resilience_smoke.py; then rc=1; fi
 # Host-path perf smoke (docs/batch-engine.md "Where the wall goes"):
 # the fused streamed path vs the serial per-tick loop at smoke size,
 # min-of-3 walls, byte parity + per-wave stage profiles asserted, and

@@ -229,14 +229,142 @@ def test_journal_knobs_validation(monkeypatch, tmp_path):
     monkeypatch.setenv("KSS_JOURNAL_DIR", str(tmp_path))
     monkeypatch.setenv("KSS_JOURNAL_FSYNC", "1")
     monkeypatch.setenv("KSS_CHECKPOINT_EVERY", "128")
+    monkeypatch.delenv("KSS_JOURNAL_ON_ERROR", raising=False)
     knobs = journal_knobs()
-    assert knobs == {"directory": str(tmp_path), "fsync": True, "checkpoint_every": 128}
+    assert knobs == {
+        "directory": str(tmp_path),
+        "fsync": True,
+        "checkpoint_every": 128,
+        "on_error": "wedge",  # the default: durability faults fail loudly
+    }
+    monkeypatch.setenv("KSS_JOURNAL_ON_ERROR", "degrade")
+    assert journal_knobs()["on_error"] == "degrade"
+    monkeypatch.setenv("KSS_JOURNAL_ON_ERROR", "ignore")
+    with pytest.raises(JournalError):
+        journal_knobs()
+    monkeypatch.delenv("KSS_JOURNAL_ON_ERROR", raising=False)
     monkeypatch.setenv("KSS_CHECKPOINT_EVERY", "nope")
     with pytest.raises(JournalError):
         journal_knobs()
     monkeypatch.setenv("KSS_CHECKPOINT_EVERY", "-1")
     with pytest.raises(JournalError):
         journal_knobs()
+
+
+def test_boot_paths_honor_on_error_env(monkeypatch, tmp_path):
+    """The validated knob must actually reach the Journal every boot
+    path constructs — a regression here means KSS_JOURNAL_ON_ERROR=degrade
+    is silently ignored and a disk fault wedges a server that was
+    configured to survive it."""
+    monkeypatch.setenv("KSS_JOURNAL_DIR", str(tmp_path / "env"))
+    monkeypatch.setenv("KSS_JOURNAL_ON_ERROR", "degrade")
+    from kube_scheduler_simulator_tpu.server.di import DIContainer
+    from kube_scheduler_simulator_tpu.state.journal import journal_from_env
+
+    j = journal_from_env()
+    assert j.on_error == "degrade"
+    j.close()
+    di = DIContainer(use_batch="off")
+    try:
+        assert di.cluster_store.journal.on_error == "degrade"
+    finally:
+        di.close()
+
+
+# ----------------------------------------------------- disk faults as policy
+
+
+def _classify(code: int):
+    from kube_scheduler_simulator_tpu.state.journal import classify_errno
+
+    return classify_errno(OSError(code, os.strerror(code)))
+
+
+def test_classify_errno_labels():
+    import errno as _e
+
+    assert _classify(_e.ENOSPC) == "ENOSPC"
+    assert _classify(_e.EIO) == "EIO"
+    assert _classify(_e.EROFS) == "EROFS"
+    assert _classify(_e.EACCES) == "EACCES"
+    from kube_scheduler_simulator_tpu.state.journal import classify_errno
+
+    assert classify_errno(OSError("no errno")) == "EUNKNOWN"
+
+
+def test_wedge_mode_fails_loudly_and_refuses_further_mutations(tmp_path):
+    """KSS_JOURNAL_ON_ERROR=wedge: the faulty commit raises
+    JournalWedged, the on-disk log stays a clean prefix of durable
+    records, and every later journal_txn refuses AT ENTRY — before any
+    store mutation, so store and log can never silently diverge."""
+    import errno as _e
+
+    from kube_scheduler_simulator_tpu.fuzz.chaos import _FaultyIO
+    from kube_scheduler_simulator_tpu.state.journal import JournalWedged
+
+    s = _store()
+    io = _FaultyIO(fail_at=2, op="write", err=_e.ENOSPC)  # 0-based: 3rd record
+    j = Journal(str(tmp_path), on_error="wedge", io=io)
+    s.attach_journal(j)
+    s.create("namespaces", {"metadata": {"name": "default"}})  # record 1
+    s.create("pods", {"metadata": {"name": "p0"}, "spec": {}})  # record 2
+    with pytest.raises(JournalWedged):
+        s.create("pods", {"metadata": {"name": "p1"}, "spec": {}})
+    assert j.wedged and j.stats["wedges"] == 1
+    # refusal is at txn ENTRY: the store is not touched afterwards
+    before = s.dump()
+    with pytest.raises(JournalWedged):
+        with s.journal_txn("wave"):
+            s.create("pods", {"metadata": {"name": "p2"}, "spec": {}})
+    assert s.dump() == before
+    # the durable log is the clean 2-record prefix (failed frame gone)
+    assert [r["t"] for r in _records(str(tmp_path))] == ["event", "event"]
+
+
+def test_degrade_mode_counts_errno_and_continues_nondurable(tmp_path):
+    """KSS_JOURNAL_ON_ERROR=degrade: the fault is classified and
+    counted once per errno, the run continues with appends dropped
+    (counted), and recovery of the directory replays the clean prefix
+    with zero torn records."""
+    import errno as _e
+
+    from kube_scheduler_simulator_tpu.fuzz.chaos import _FaultyIO
+
+    s = _store()
+    io = _FaultyIO(fail_at=2, op="write", err=_e.EIO)  # 0-based: 3rd record
+    j = Journal(str(tmp_path), on_error="degrade", io=io)
+    s.attach_journal(j)
+    s.create("namespaces", {"metadata": {"name": "default"}})
+    for i in range(4):
+        s.create("pods", {"metadata": {"name": f"p{i}"}, "spec": {}})
+    # the run survived: all five mutations applied to the store
+    assert s.count("pods") == 4
+    assert j.degraded_errno == "EIO" and j.degraded_by_errno == {"EIO": 1}
+    assert j.stats["records_dropped"] >= 1
+    assert j.stats["wedges"] == 0
+    # the surviving log is a clean prefix; recovery sees zero torn
+    s2 = _store()
+    rep = RecoveryManager(str(tmp_path)).recover(s2)
+    assert rep.truncated_records == 0
+    assert rep.replayed_records == 2
+    assert s2.count("pods") == 1  # the prefix: namespace + p0
+
+
+def test_fsync_fault_routes_through_same_policy(tmp_path):
+    import errno as _e
+
+    from kube_scheduler_simulator_tpu.fuzz.chaos import _FaultyIO
+
+    j = Journal(
+        str(tmp_path), fsync=True, on_error="degrade",
+        io=_FaultyIO(fail_at=1, op="fsync", err=_e.EROFS),
+    )
+    j.append("mark", extra={"tick": 0})
+    j.append("mark", extra={"tick": 1})  # 0-based fsync #1 faults
+    assert j.degraded_by_errno == {"EROFS": 1}
+    j.append("mark", extra={"tick": 2})
+    assert j.stats["records_dropped"] >= 1
+    j.close()
 
 
 # -------------------------------------------------- re-numbered log (watch)

@@ -34,18 +34,9 @@ Obj = dict[str, Any]
 def pm_state():
     """Reset the module-level pool/verdict memo around each test — the
     bring-up verdict is memoized per process by design."""
-
-    def reset():
-        procmesh.shutdown()
-        with procmesh._LOCK:
-            procmesh._VERDICT = None
-            procmesh._STATS["requested_processes"] = 0
-            procmesh._STATS["fallbacks_by_reason"] = {}
-            procmesh._STATS["run_fallbacks_by_reason"] = {}
-
-    reset()
+    procmesh.reset()
     yield procmesh
-    reset()
+    procmesh.reset()
 
 
 # ------------------------------------------------------------------ unit
@@ -64,6 +55,60 @@ def test_procs_from_env(monkeypatch):
     monkeypatch.setenv("KSS_MESH_PROCESSES", "-1")
     with pytest.raises(ValueError):
         procmesh.procs_from_env()
+
+
+def test_heartbeat_from_env(monkeypatch):
+    monkeypatch.delenv("KSS_PROCMESH_HEARTBEAT_S", raising=False)
+    assert procmesh.heartbeat_from_env() == 1.0
+    monkeypatch.setenv("KSS_PROCMESH_HEARTBEAT_S", "0.25")
+    assert procmesh.heartbeat_from_env() == 0.25
+    monkeypatch.setenv("KSS_PROCMESH_HEARTBEAT_S", "fast")
+    with pytest.raises(ValueError):
+        procmesh.heartbeat_from_env()
+    monkeypatch.setenv("KSS_PROCMESH_HEARTBEAT_S", "0")
+    with pytest.raises(ValueError):
+        procmesh.heartbeat_from_env()
+
+
+def test_max_respawns_from_env(monkeypatch):
+    monkeypatch.delenv("KSS_PROCMESH_MAX_RESPAWNS", raising=False)
+    assert procmesh.max_respawns_from_env() == 3
+    monkeypatch.setenv("KSS_PROCMESH_MAX_RESPAWNS", "5")
+    assert procmesh.max_respawns_from_env() == 5
+    monkeypatch.setenv("KSS_PROCMESH_MAX_RESPAWNS", "0")
+    with pytest.raises(ValueError):
+        procmesh.max_respawns_from_env()
+    monkeypatch.setenv("KSS_PROCMESH_MAX_RESPAWNS", "many")
+    with pytest.raises(ValueError):
+        procmesh.max_respawns_from_env()
+
+
+def test_terminate_reaps_a_stopped_child():
+    """The shutdown-path satellite fix: ``kill()`` alone leaves a
+    SIGSTOP'd child unreaped (SIGKILL is delivered but ``wait`` can park
+    while the tracer state settles under load); ``_terminate`` SIGCONTs
+    first and must reap within its timeout."""
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    proc = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(600)"])
+    try:
+        procmesh._register_child(proc)
+        import os
+
+        os.kill(proc.pid, signal.SIGSTOP)
+        t0 = time.monotonic()
+        procmesh._terminate(proc, timeout=10.0)
+        assert proc.poll() is not None, "stopped child was not reaped"
+        assert time.monotonic() - t0 < 10.0
+        with procmesh._CHILD_MU:
+            assert proc not in procmesh._CHILDREN
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
 
 
 def test_metrics_silent_until_knob_exercised(pm_state, monkeypatch):
@@ -193,3 +238,33 @@ def test_multiprocess_ensemble_parity_or_loud_skip(pm_state, monkeypatch, tmp_pa
         )
     assert st["pool"]["processes"] == 2
     assert st["pool"]["dispatches"] >= 1
+
+
+# ------------------------------------------------------------- supervision
+
+
+def test_worker_respawn_parity_or_loud_skip(pm_state):
+    """The supervised-failure pin: SIGKILL a worker at the first
+    dispatch — the pool must detect the death, respawn the ensemble
+    from the AOT cache (``procmesh_respawns_total == 1``), re-dispatch
+    the abandoned wave, and match the in-process bytes, leaking no
+    worker processes.  Skips loudly where the ensemble can't engage."""
+    from kube_scheduler_simulator_tpu.fuzz.chaos import WorkerChaos, leaked_worker_pids
+
+    scn = {
+        "name": "respawn-parity",
+        "nodes": [mk_node(f"sn{i}", cpu_m=4000, mem_mi=8192) for i in range(4)],
+        "pods": [mk_pod(f"sp{i}", cpu_m=250, mem_mi=64) for i in range(12)],
+    }
+    v = WorkerChaos(scn, mode="kill", fault_at=0, nprocs=1, heartbeat_s=0.3).run()
+    if not v["engaged"]:
+        pytest.skip(
+            "SKIPPING LOUDLY: single-worker ensemble could not engage on this "
+            f"host — verdict={v['bringup_verdict']!r}"
+        )
+    assert v["fired"] == 1
+    assert v["divergences"] == [], v["first_mismatch"]
+    assert v["respawns"] == 1
+    assert v["breaker_state"] == "closed"
+    assert v["leaked_workers"] == []
+    assert leaked_worker_pids() == []

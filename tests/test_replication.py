@@ -432,6 +432,87 @@ def test_replica_container_serves_read_only_then_promotes(tmp_path):
         server.shutdown()
 
 
+# -------------------------------------------------- classified read faults
+
+
+def test_tailer_enoent_waits_uncounted(tmp_path):
+    """A journal directory that does not exist yet is the NORMAL boot
+    race (the primary has not created it) — the tailer waits, counting
+    nothing: ENOENT must never be conflated with a read fault."""
+    tailer = JournalTailer(str(tmp_path / "not-yet"))
+    assert tailer.poll() == []
+    assert tailer.poll() == []
+    assert tailer.stats["read_errors"] == 0
+    assert tailer.read_errors_by_errno == {}
+
+
+def test_tailer_counts_permission_errors_by_errno(tmp_path):
+    """The satellite fix: a bare ``except OSError: return []`` swallowed
+    EACCES as 'nothing to ship'.  Real read faults are classified and
+    counted per errno (``replication_read_errors_total{errno}``), and
+    the tailer holds position — healing the mount resumes shipping with
+    nothing lost."""
+    import errno as _e
+
+    src = str(tmp_path / "src")
+    store, _journal = _journaled(src)
+    store.create("namespaces", {"metadata": {"name": "default"}})
+    store.create("pods", _pod("ep0"))
+    tailer = JournalTailer(src)
+
+    real_open = tailer.io_open
+
+    def denied(*a, **k):
+        raise PermissionError(_e.EACCES, "Permission denied")
+
+    tailer.io_open = denied
+    assert tailer.poll() == []
+    assert tailer.poll() == []
+    assert tailer.stats["read_errors"] == 2
+    assert tailer.read_errors_by_errno == {"EACCES": 2}
+    assert tailer.stats["torn_records"] == 0  # a fault is not damage
+    # heal: the held position ships the full stream
+    tailer.io_open = real_open
+    assert len(tailer.poll()) == 2
+
+
+def test_applier_backs_off_through_seeded_retry_policy(tmp_path):
+    """Consecutive faulty polls push the apply loop into counted
+    exponential backoff (``replication_backoffs_total``; inside the
+    window ``step()`` does not touch the tailer), and one clean poll
+    resets the streak."""
+    import errno as _e
+
+    src = str(tmp_path / "src")
+    store, _journal = _journaled(src)
+    store.create("namespaces", {"metadata": {"name": "default"}})
+    store.create("pods", _pod("bp0"))
+    replica = _store()
+    applier = ReplicaApplier(replica, src, notify=False)
+
+    real_open = applier.tailer.io_open
+
+    def denied(*a, **k):
+        raise PermissionError(_e.EACCES, "Permission denied")
+
+    applier.tailer.io_open = denied
+    assert applier.step() == 0
+    assert applier.stats["backoffs"] == 1
+    assert applier._error_streak == 1
+    errors_at_backoff = applier.tailer.stats["read_errors"]
+    # inside the backoff window the tailer is not hammered
+    assert applier.step() == 0
+    assert applier.tailer.stats["read_errors"] == errors_at_backoff
+    assert applier.stats["backoffs"] == 1
+    # heal the mount and expire the window: shipping resumes, streak resets
+    applier.tailer.io_open = real_open
+    applier._backoff_until = 0.0
+    assert applier.step() >= 2
+    assert applier._error_streak == 0
+    assert replica.count("pods") == 1
+    assert applier.stats["read_errors_by_errno"] == {"EACCES": 1}
+
+
 # ------------------------------------------------------------------ metrics
 
 

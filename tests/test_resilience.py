@@ -1,0 +1,312 @@
+"""Resilience substrate (resilience/policy.py) and its /metrics wiring.
+
+The primitives every cross-process seam leans on: Deadline (a monotonic
+budget waits slice from), RetryPolicy (SEEDED jittered exponential
+backoff — same ``KSS_RETRY_SEED`` ⇒ identical schedule in every
+process, which is what keeps retry timing replayable by the chaos
+harnesses), and Breaker (the counted closed → open → half-open circuit;
+``cooldown_s=None`` is the terminal permanent-degradation shape the
+procmesh pool uses).  The fault-matrix END-TO-END legs live in
+scripts/resilience_smoke.py; this suite pins the primitives and the
+metrics surface in-process.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kube_scheduler_simulator_tpu.resilience import (
+    Breaker,
+    Deadline,
+    RetryPolicy,
+    note_retry,
+    reset_retry_stats,
+    retry_seed_from_env,
+    retry_stats,
+)
+
+
+class _Clock:
+    """A hand-advanced monotonic clock — time never passes on its own."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ---------------------------------------------------------------- deadline
+
+
+def test_deadline_budget_slices():
+    clk = _Clock()
+    d = Deadline(10.0, clock=clk)
+    assert d.elapsed() == 0.0 and d.remaining() == 10.0 and not d.expired()
+    assert d.slice(3.0) == 3.0  # per-step cap binds
+    clk.t += 8.0
+    assert d.remaining() == pytest.approx(2.0)
+    assert d.slice(3.0) == pytest.approx(2.0)  # remaining budget binds
+    clk.t += 5.0
+    assert d.expired() and d.remaining() == 0.0 and d.slice(3.0) == 0.0
+
+
+def test_deadline_after_uses_real_clock():
+    d = Deadline.after(60.0)
+    assert not d.expired() and 0.0 <= d.elapsed() < 60.0
+
+
+# ------------------------------------------------------------- retry policy
+
+
+def test_retry_schedule_is_deterministic_per_seed():
+    a = RetryPolicy(seed=7)
+    b = RetryPolicy(seed=7)
+    c = RetryPolicy(seed=8)
+    assert a.schedule() == b.schedule()
+    assert a.schedule() != c.schedule()
+    # attempt k's jitter is independent of whether 0..k-1 were taken
+    assert a.delay(3) == RetryPolicy(seed=7).delay(3)
+
+
+def test_retry_delays_stay_in_jitter_band():
+    p = RetryPolicy(base_s=0.05, factor=2.0, max_s=2.0, jitter=0.25, attempts=10, seed=3)
+    for i, d in enumerate(p.schedule()):
+        nominal = min(p.max_s, p.base_s * p.factor**i)
+        assert nominal * (1 - p.jitter) <= d <= nominal * (1 + p.jitter), (i, d)
+    # no single sleep can exceed the cap even at max jitter
+    assert max(p.schedule()) <= p.max_s * (1 + p.jitter)
+
+
+def test_retry_zero_jitter_is_exact_exponential():
+    p = RetryPolicy(base_s=0.1, factor=2.0, max_s=1.0, jitter=0.0, attempts=6, seed=0)
+    assert p.schedule() == [
+        pytest.approx(v) for v in (0.1, 0.2, 0.4, 0.8, 1.0, 1.0)
+    ]
+
+
+def test_retry_exhaustion_bound():
+    p = RetryPolicy(attempts=3, seed=0)
+    assert not p.exhausted(2) and p.exhausted(3) and p.exhausted(99)
+
+
+def test_retry_param_validation():
+    for kwargs in (
+        {"base_s": 0.0},
+        {"factor": 0.5},
+        {"max_s": 0.0},
+        {"jitter": 1.0},
+        {"jitter": -0.1},
+    ):
+        with pytest.raises(ValueError):
+            RetryPolicy(seed=0, **kwargs)
+
+
+def test_retry_seed_env_knob(monkeypatch):
+    monkeypatch.delenv("KSS_RETRY_SEED", raising=False)
+    assert retry_seed_from_env() == 0
+    monkeypatch.setenv("KSS_RETRY_SEED", "17")
+    assert retry_seed_from_env() == 17
+    # seed=None policies pick the env seed up at construction
+    assert RetryPolicy().schedule() == RetryPolicy(seed=17).schedule()
+    monkeypatch.setenv("KSS_RETRY_SEED", "seventeen")
+    with pytest.raises(ValueError):
+        retry_seed_from_env()
+
+
+# ------------------------------------------------------------------ breaker
+
+
+def test_breaker_opens_on_consecutive_failures_only():
+    b = Breaker(fail_threshold=3)
+    b.failure(); b.failure()
+    assert b.state == b.CLOSED
+    b.success()  # resets the streak
+    b.failure(); b.failure()
+    assert b.state == b.CLOSED
+    b.failure()
+    assert b.state == b.OPEN and b.state_code == 2
+    assert b.stats == {"opened": 1, "half_opened": 0, "closed": 0}
+
+
+def test_breaker_terminal_when_cooldown_none():
+    clk = _Clock()
+    b = Breaker(fail_threshold=1, cooldown_s=None, clock=clk)
+    b.failure()
+    assert b.state == b.OPEN and not b.allow()
+    clk.t += 1e9  # no amount of waiting half-opens a terminal breaker
+    assert not b.allow() and b.state == b.OPEN
+    assert b.stats["half_opened"] == 0
+
+
+def test_breaker_halfopen_probe_cycle():
+    clk = _Clock()
+    b = Breaker(fail_threshold=2, cooldown_s=5.0, clock=clk)
+    assert b.allow()  # closed: calls flow
+    b.failure(); b.failure()
+    assert b.state == b.OPEN and not b.allow()
+    clk.t += 5.0
+    assert b.allow()  # cooldown elapsed: ONE probe admitted
+    assert b.state == b.HALF_OPEN and b.state_code == 1
+    assert not b.allow()  # the probe is exclusive
+    b.success()
+    assert b.state == b.CLOSED and b.allow()
+    # a failing probe re-opens (and restarts the cooldown)
+    b.failure(); b.failure()
+    clk.t += 5.0
+    assert b.allow() and b.state == b.HALF_OPEN
+    b.failure()
+    assert b.state == b.OPEN and not b.allow()
+    clk.t += 4.9
+    assert not b.allow()  # cooldown restarted at the probe failure
+    assert b.stats == {"opened": 3, "half_opened": 2, "closed": 1}
+
+
+def test_breaker_validation():
+    with pytest.raises(ValueError):
+        Breaker(fail_threshold=0)
+
+
+# ------------------------------------------------------------ retry counter
+
+
+def test_note_retry_counts_per_seam():
+    reset_retry_stats()
+    try:
+        note_retry("procmesh")
+        note_retry("replication", 2)
+        note_retry("procmesh")
+        snap = retry_stats()
+        assert snap == {"procmesh": 2, "replication": 2}
+        snap["procmesh"] = 99  # snapshots are copies
+        assert retry_stats()["procmesh"] == 2
+    finally:
+        reset_retry_stats()
+    assert retry_stats() == {}
+
+
+# ------------------------------------------------------------------ metrics
+
+
+def test_resilience_metrics_wiring(monkeypatch, tmp_path):
+    """Every counter the fault matrix leans on renders on /metrics with
+    the simulator_ prefix and its labels: per-seam retries, journal
+    disk-fault policy outcomes, procmesh supervision, and classified
+    tailer read errors."""
+    import errno as _e
+
+    from kube_scheduler_simulator_tpu.fuzz.chaos import _FaultyIO
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.server.metrics import render_metrics
+    from kube_scheduler_simulator_tpu.state.journal import Journal
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+    store = ClusterStore()
+    # a REAL degrade-mode disk fault populates the journal counters
+    j = Journal(
+        str(tmp_path), on_error="degrade",
+        io=_FaultyIO(fail_at=1, op="write", err=_e.ENOSPC),
+    )
+    store.attach_journal(j)
+    store.create("namespaces", {"metadata": {"name": "default"}})
+    store.create("pods", {"metadata": {"name": "p0"}, "spec": {}})  # faults
+    store.create("pods", {"metadata": {"name": "p1"}, "spec": {}})  # dropped
+    assert j.degraded_by_errno == {"ENOSPC": 1}
+
+    reset_retry_stats()
+    note_retry("procmesh")
+    note_retry("replication", 2)
+
+    # a degraded supervised pool, as procmesh.stats() shapes it
+    monkeypatch.setattr(
+        SchedulerService,
+        "_procmesh_stats",
+        staticmethod(
+            lambda: {
+                "requested_processes": 1,
+                "verdict": "ok",
+                "fallbacks_by_reason": {},
+                "run_fallbacks_by_reason": {"breaker_open": 1},
+                "pool": {
+                    "processes": 1,
+                    "engaged": 0,
+                    "dispatches": 3,
+                    "scans_loaded": 1,
+                    "respawns": 2,
+                    "hangs_detected": 1,
+                    "generation": 2,
+                    "failures_by_verdict": {"died": 2, "hang": 1},
+                    "breaker_state": "open",
+                    "breaker_state_code": 2,
+                    "breaker_transitions": {"opened": 1, "half_opened": 0, "closed": 0},
+                },
+            }
+        ),
+    )
+    # a replica's classified read-error counters (shape: apply.py stats)
+    store.replication_stats = {
+        "records_shipped": 4,
+        "events_applied": 9,
+        "lag_records": 0,
+        "lag_seconds": 0.0,
+        "torn_records": 0,
+        "rebases": 0,
+        "promotions": 0,
+        "read_requests": 0,
+        "read_errors": 2,
+        "backoffs": 2,
+        "read_errors_by_errno": {"EACCES": 2},
+    }
+
+    svc = SchedulerService(store, use_batch="off")
+    svc.start_scheduler(None)
+
+    class _DI:
+        cluster_store = store
+
+        def scheduler_service(self):
+            return svc
+
+    try:
+        text = render_metrics(_DI())
+    finally:
+        reset_retry_stats()
+    for needle in (
+        "simulator_journal_wedges_total 0",
+        # p1's record + the config record start_scheduler journals, both
+        # dropped (counted) while running non-durable after the fault
+        "simulator_journal_records_dropped_total 2",
+        'simulator_journal_degraded_total{errno="ENOSPC"} 1',
+        "simulator_procmesh_respawns_total 2",
+        "simulator_procmesh_hangs_detected_total 1",
+        "simulator_procmesh_breaker_state 2",
+        'simulator_procmesh_worker_failures_total{verdict="died"} 2',
+        'simulator_procmesh_worker_failures_total{verdict="hang"} 1',
+        'simulator_procmesh_run_fallbacks_total{reason="breaker_open"} 1',
+        "simulator_replication_backoffs_total 2",
+        'simulator_replication_read_errors_total{errno="EACCES"} 2',
+        'simulator_retry_attempts_total{seam="procmesh"} 1',
+        'simulator_retry_attempts_total{seam="replication"} 2',
+    ):
+        assert needle in text, needle
+
+
+def test_retry_metrics_silent_without_retries():
+    """The common case pays no payload: with no seam having retried,
+    retry_attempts_total does not render at all."""
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+    from kube_scheduler_simulator_tpu.server.metrics import render_metrics
+    from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+    reset_retry_stats()
+    store = ClusterStore()
+    svc = SchedulerService(store, use_batch="off")
+    svc.start_scheduler(None)
+
+    class _DI:
+        cluster_store = store
+
+        def scheduler_service(self):
+            return svc
+
+    assert "retry_attempts_total" not in render_metrics(_DI())
