@@ -1658,11 +1658,20 @@ class BatchEngine:
         ckey = (key, W, WS, raw_dtypes, pack_mode)
         entry = self._compact_cache.get(ckey)
         if entry is None:
-            entry = B.build_compact_fn(
-                cfg, dims, W, WS, raw_dtypes, code_max, in_step_ws0=ws0
-            )
+            # value-based cross-engine key (the per-engine ckey embeds
+            # id(mesh) via key[3]); pack_mode is the equivalence class the
+            # per-engine cache already relies on for code_max
+            from kube_scheduler_simulator_tpu.tenancy.substrate import SUBSTRATE
+
+            skey = (key[0], cfg, key[2], self.mesh, W, WS, raw_dtypes, pack_mode)
+            entry = SUBSTRATE.lookup("compact", skey)
+            if entry is None:
+                entry = B.build_compact_fn(
+                    cfg, dims, W, WS, raw_dtypes, code_max, in_step_ws0=ws0
+                )
+                self.compiles += 1
+            entry = SUBSTRATE.publish("compact", skey, entry)
             self._compact_cache[ckey] = entry
-            self.compiles += 1
         cfn, manifest = entry
         tr_keys = (
             "sample_start", "sample_processed", "feasible",
@@ -1903,6 +1912,17 @@ class BatchEngine:
                     self._fn_cache[key] = fn
                     return fn
             fn = self._aot.load_scan(meta, donate=donate)
+        # Cross-engine substrate (tenancy/substrate.py): the per-engine
+        # cache keys on id(mesh); the process-wide table keys on the mesh
+        # VALUE, so another session's engine with an equal config hands us
+        # its already-traced fn — a jit cache hit, zero backend compiles.
+        # Consulted after the AOT load (which already avoided the trace and
+        # keeps its own hit/miss counters) and before a fresh build.
+        from kube_scheduler_simulator_tpu.tenancy.substrate import SUBSTRATE
+
+        skey = (key[0], ctx["cfg"], ctx["ws0"], self.mesh, donate)
+        if fn is None:
+            fn = SUBSTRATE.lookup("scan", skey)
         if fn is None:
             fn = B.build_batch_fn(ctx["cfg"], ctx["dims"], donate=donate, ws0=ctx["ws0"])
             self.compiles += 1
@@ -1920,6 +1940,10 @@ class BatchEngine:
                     getattr(fn, "jit_target", None),
                     _export_args(ctx["dp"], split_carry=donate),
                 )
+        # publish whatever we ended up with (fresh build or AOT load) —
+        # first to land wins a race, so every engine converges on one
+        # object and one jit cache entry per value key
+        fn = SUBSTRATE.publish("scan", skey, fn)
         self._fn_cache[key] = fn
         return fn
 

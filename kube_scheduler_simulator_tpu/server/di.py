@@ -29,6 +29,7 @@ class DIContainer:
         enable_simulator_operator: bool = True,
         autoscale: str = "off",
         autoscaler_opts: "dict | None" = None,
+        journal_dir: "str | None" = None,
     ):
         self.cluster_store = cluster_store or ClusterStore()
         # Durability boot (opt-in via KSS_JOURNAL_DIR, state/journal.py):
@@ -37,11 +38,29 @@ class DIContainer:
         # then attach a fresh journal epoch so everything from the
         # controllers onward is WAL-covered.  With the env unset this
         # whole block is inert and the store behaves exactly as before.
-        from kube_scheduler_simulator_tpu.state.journal import Journal, journal_knobs
+        from kube_scheduler_simulator_tpu.state.journal import (
+            Journal,
+            journal_knobs,
+            on_error_from_env,
+        )
 
         self._journal = None
         _recovery_report = None
         _jknobs = journal_knobs()
+        if journal_dir is not None:
+            # session-plane override (tenancy/manager.py): journal into
+            # the given namespace regardless of KSS_JOURNAL_DIR, keeping
+            # the env's durability knobs when it is set
+            if _jknobs is not None:
+                _jknobs = dict(_jknobs, directory=journal_dir)
+            else:
+                _jknobs = {
+                    "directory": journal_dir,
+                    "fsync": False,
+                    "checkpoint_every": 0,
+                    "on_error": on_error_from_env(),
+                }
+        self.journal_dir = _jknobs["directory"] if _jknobs is not None else None
         if _jknobs is not None:
             from kube_scheduler_simulator_tpu.state.recovery import boot_recover
 

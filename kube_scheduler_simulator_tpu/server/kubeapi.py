@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import json
 import queue
+import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
@@ -48,6 +49,13 @@ from kube_scheduler_simulator_tpu.utils.k8s_selectors import (
 )
 
 Obj = dict[str, Any]
+
+# session-scoped kube-API routing (tenancy/): /sessions/<id>/api/... —
+# the un-prefixed surface keeps hitting the default session's store
+_SESSION_PREFIX_RE = re.compile(r"^/sessions/([^/]+)(/.+)$")
+# session containers never run the simulator operator — their CRD kinds
+# 404 per session (see server.py _SESSION_DISABLED)
+_SESSION_DISABLED = frozenset({"simulators", "schedulersimulations"})
 
 # (group, version, resource, kind name, store kind)
 CORE_RESOURCES = (
@@ -191,15 +199,25 @@ class KubeAPIServer:
     """The simulator's kube-API port (reference layout: kube API on its
     own port next to the simulator API)."""
 
-    def __init__(self, cluster_store: Any, port: int = 3131, disabled_kinds: "frozenset[str]" = frozenset()):
+    def __init__(
+        self,
+        cluster_store: Any,
+        port: int = 3131,
+        disabled_kinds: "frozenset[str]" = frozenset(),
+        sessions: Any = None,
+    ):
         # disabled_kinds: store kinds this apiserver does NOT serve —
         # e.g. a spawned KEP-159 simulator instance has no simulator
         # operator, so its apiserver must 404 the operator CRDs exactly
         # as a real apiserver without those CRDs installed would, rather
         # than accept objects nothing will ever reconcile
+        # sessions: the SimulatorServer's SessionManager (tenancy/) —
+        # enables /sessions/<id>/api/... and X-KSS-Session routing to
+        # per-session stores; None (the default) serves one store only
         self.store = cluster_store
         self.port = port
         self.disabled_kinds = frozenset(disabled_kinds)
+        self.sessions = sessions
         self._httpd: "ThreadingHTTPServer | None" = None
         self._thread: "threading.Thread | None" = None
         self._stop = threading.Event()
@@ -226,14 +244,6 @@ class KubeAPIServer:
 
 def _make_handler(server: KubeAPIServer):
     store = server.store
-
-    def resolve_active(path: str) -> "_Route | None":
-        """resolve(), minus this apiserver's disabled kinds — a route to
-        an uninstalled CRD must 404 like a real apiserver's would."""
-        rt = resolve(path)
-        if rt is not None and rt.store_kind in server.disabled_kinds:
-            return None
-        return rt
 
     def envelope(obj: Obj, api_version: str, kind: str) -> Obj:
         out = dict(obj)
@@ -268,15 +278,63 @@ def _make_handler(server: KubeAPIServer):
                 },
             )
 
-        def _body(self) -> Obj:
+        def _raw_body(self) -> bytes:
             length = int(self.headers.get("Content-Length") or 0)
-            raw = self.rfile.read(length) if length else b""
+            return self.rfile.read(length) if length else b""
+
+        def _body(self) -> Obj:
+            raw = self._raw_body()
             return json.loads(raw) if raw else {}
+
+        # --------------------------------------------------------- routing
+
+        def _resolve_active(self, path: str) -> "_Route | None":
+            """resolve(), minus this request's disabled kinds — a route
+            to an uninstalled CRD must 404 like a real apiserver's
+            would (session containers additionally hide the operator
+            CRDs; see _route)."""
+            rt = resolve(path)
+            if rt is not None and rt.store_kind in self._disabled:
+                return None
+            return rt
+
+        def _route(self):
+            """Resolve this request's SESSION (tenancy/): the
+            ``/sessions/<id>/api/...`` prefix or the ``X-KSS-Session``
+            header select a per-session store; otherwise the default
+            store, byte-for-byte as before.  Returns (store, url), or
+            None when a 404 for an unknown session was already sent."""
+            url = urlparse(self.path)
+            self._disabled = server.disabled_kinds
+            mgr = server.sessions
+            if mgr is not None:
+                m = _SESSION_PREFIX_RE.match(url.path)
+                if m:
+                    sid, rest = m.group(1), m.group(2)
+                    url = url._replace(path=rest)
+                else:
+                    sid = (self.headers.get("X-KSS-Session") or "").strip() or None
+                if sid and sid != "default":
+                    from kube_scheduler_simulator_tpu.tenancy import (
+                        UnknownSessionError,
+                    )
+
+                    try:
+                        sstore = mgr.resolve_store(sid)
+                    except UnknownSessionError as e:
+                        self._status_err(404, "NotFound", str(e))
+                        return None
+                    self._disabled = server.disabled_kinds | _SESSION_DISABLED
+                    return sstore, url
+            return store, url
 
         # ------------------------------------------------------------- GET
 
         def do_GET(self) -> None:
-            url = urlparse(self.path)
+            r = self._route()
+            if r is None:
+                return
+            store, url = r
             q = parse_qs(url.query)
             # the handshake endpoints kubectl/client-go probe first
             if url.path == "/version":
@@ -298,11 +356,11 @@ def _make_handler(server: KubeAPIServer):
                 self.end_headers()
                 self.wfile.write(data)
                 return
-            doc = discovery_document(url.path, server.disabled_kinds)
+            doc = discovery_document(url.path, self._disabled)
             if doc is not None:
                 self._send_json(200, doc)
                 return
-            rt = resolve_active(url.path)
+            rt = self._resolve_active(url.path)
             if rt is None:
                 self._status_err(404, "NotFound", f"no handler for {url.path}")
                 return
@@ -325,7 +383,7 @@ def _make_handler(server: KubeAPIServer):
                         except ValueError:
                             self._status_err(400, "BadRequest", "resourceVersion must be an integer")
                             return
-                        self._watch(rt, rv, sel)
+                        self._watch(store, rt, rv, sel)
                     else:
                         items = store.list(rt.store_kind, rt.namespace)
                         if sel is not None:
@@ -345,7 +403,7 @@ def _make_handler(server: KubeAPIServer):
             except NotFoundError as e:
                 self._status_err(404, "NotFound", str(e))
 
-        def _watch(self, rt: "_Route", rv: int, sel=None) -> None:
+        def _watch(self, store: Any, rt: "_Route", rv: int, sel=None) -> None:
             """Chunked kube watch stream: {"type": ..., "object": ...}.
 
             With a selector, transitions are synthesized the way the real
@@ -449,8 +507,11 @@ def _make_handler(server: KubeAPIServer):
         # ------------------------------------------------------------ POST
 
         def do_POST(self) -> None:
-            url = urlparse(self.path)
-            rt = resolve_active(url.path)
+            r = self._route()
+            if r is None:
+                return
+            store, url = r
+            rt = self._resolve_active(url.path)
             if rt is None:
                 self._status_err(404, "NotFound", f"no handler for {url.path}")
                 return
@@ -482,8 +543,11 @@ def _make_handler(server: KubeAPIServer):
         # ---------------------------------------------------- PUT / PATCH
 
         def do_PUT(self) -> None:
-            url = urlparse(self.path)
-            rt = resolve_active(url.path)
+            r = self._route()
+            if r is None:
+                return
+            store, url = r
+            rt = self._resolve_active(url.path)
             if rt is None or rt.name is None:
                 self._status_err(404, "NotFound", f"no handler for {url.path}")
                 return
@@ -520,14 +584,43 @@ def _make_handler(server: KubeAPIServer):
                 self._status_err(400, "BadRequest", f"{type(e).__name__}: {e}")
 
         def do_PATCH(self) -> None:
-            url = urlparse(self.path)
-            rt = resolve_active(url.path)
+            r = self._route()
+            if r is None:
+                return
+            store, url = r
+            rt = self._resolve_active(url.path)
             if rt is None or rt.name is None:
                 self._status_err(404, "NotFound", f"no handler for {url.path}")
                 return
+            from kube_scheduler_simulator_tpu.server.patches import (
+                ApplyConflictError,
+                PatchApplyError,
+                PatchError,
+            )
+
+            ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip().lower()
             try:
-                patched = store.patch(rt.store_kind, rt.name, self._body(), rt.namespace)
-                self._send_json(200, envelope(patched, rt.api_version, rt.kind))
+                if ctype == "application/apply-patch+yaml":
+                    self._apply_patch(store, rt, parse_qs(url.query))
+                elif ctype == "application/json-patch+json":
+                    self._json_patch(store, rt)
+                else:
+                    # default: merge-patch-lite (the store's patch —
+                    # JSON merge semantics, strategic-merge-lite)
+                    patched = store.patch(rt.store_kind, rt.name, self._body(), rt.namespace)
+                    self._send_json(200, envelope(patched, rt.api_version, rt.kind))
+            except ApplyConflictError as e:
+                # the SSA conflict protocol: 409 Status naming the
+                # owning manager(s); the client retries with force=true
+                # to take ownership
+                self._status_err(409, "Conflict", str(e))
+            except PatchApplyError as e:
+                # well-formed patch that cannot apply (missing path,
+                # failed test op): 422, the apiserver's invalid-patch
+                # classification
+                self._status_err(422, "Invalid", str(e))
+            except PatchError as e:
+                self._status_err(400, "BadRequest", str(e))
             except NotFoundError as e:
                 self._status_err(404, "NotFound", str(e))
             except ConflictError as e:
@@ -535,11 +628,89 @@ def _make_handler(server: KubeAPIServer):
             except Exception as e:
                 self._status_err(400, "BadRequest", f"{type(e).__name__}: {e}")
 
+        def _apply_patch(self, store: Any, rt: "_Route", q: dict) -> None:
+            """Server-side apply (application/apply-patch+yaml):
+            field-manager-lite upsert — see server/patches.py for the
+            ownership model and documented deviations."""
+            import yaml
+
+            from kube_scheduler_simulator_tpu.server.patches import (
+                PatchError,
+                server_side_apply,
+            )
+
+            manager = (q.get("fieldManager") or [""])[0].strip()
+            force = (q.get("force") or ["false"])[0].lower() in ("1", "true")
+            try:
+                patch = yaml.safe_load(self._raw_body().decode() or "{}")
+            except yaml.YAMLError as e:
+                raise PatchError(f"apply configuration is not valid YAML: {e}") from None
+            if not isinstance(patch, dict):
+                raise PatchError("an apply configuration must be an object")
+            pmeta = patch.get("metadata") or {}
+            pname = pmeta.get("name")
+            if pname is not None and pname != rt.name:
+                raise PatchError(
+                    f"metadata.name {pname!r} does not match the URL name {rt.name!r}"
+                )
+            # atomic read-modify-write under the store lock: concurrent
+            # appliers serialize, each seeing the other's managedFields
+            with store.lock:
+                try:
+                    existing = store.get(rt.store_kind, rt.name, rt.namespace)
+                except NotFoundError:
+                    existing = None
+                new, created = server_side_apply(
+                    existing, patch, manager, force, api_version=rt.api_version
+                )
+                new.setdefault("metadata", {}).setdefault("name", rt.name)
+                if rt.namespace:
+                    new["metadata"].setdefault("namespace", rt.namespace)
+                if created:
+                    out = store.create(rt.store_kind, new)
+                else:
+                    new["metadata"]["resourceVersion"] = existing["metadata"].get(
+                        "resourceVersion"
+                    )
+                    out = store.update(rt.store_kind, new, owned=True)
+            self._send_json(201 if created else 200, envelope(out, rt.api_version, rt.kind))
+
+        def _json_patch(self, store: Any, rt: "_Route") -> None:
+            """RFC 6902 (application/json-patch+json): the ordered
+            operation list applies atomically under the store lock."""
+            from kube_scheduler_simulator_tpu.server.patches import (
+                PatchApplyError,
+                PatchError,
+                apply_json_patch,
+            )
+
+            try:
+                ops = json.loads(self._raw_body() or b"[]")
+            except ValueError as e:
+                raise PatchError(f"patch is not valid JSON: {e}") from None
+            with store.lock:
+                obj = store.get(rt.store_kind, rt.name, rt.namespace)
+                patched = apply_json_patch(obj, ops)
+                pm = patched.get("metadata") or {}
+                om = obj["metadata"]
+                if pm.get("name") != om.get("name") or (
+                    rt.store_kind in NAMESPACED_KINDS
+                    and (pm.get("namespace") or "default") != (om.get("namespace") or "default")
+                ):
+                    raise PatchApplyError("a patch may not rename or move an object")
+                # the patched doc carries the observed resourceVersion —
+                # update()'s optimistic concurrency still applies
+                out = store.update(rt.store_kind, patched, owned=True)
+            self._send_json(200, envelope(out, rt.api_version, rt.kind))
+
         # ---------------------------------------------------------- DELETE
 
         def do_DELETE(self) -> None:
-            url = urlparse(self.path)
-            rt = resolve_active(url.path)
+            r = self._route()
+            if r is None:
+                return
+            store, url = r
+            rt = self._resolve_active(url.path)
             if rt is None or rt.name is None:
                 self._status_err(404, "NotFound", f"no handler for {url.path}")
                 return

@@ -22,8 +22,33 @@ def _esc(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
-def render_metrics(di: Any) -> str:
-    """Render the whole registry from the DI container's live services."""
+def _label_session(text: str, session: str) -> str:
+    """Stamp every sample line with a ``session`` label (the per-session
+    ``/metrics`` view: ``/api/v1/sessions/<id>/metrics``).  Text-level so
+    the histogram block and every counter family need no plumbing."""
+    out = []
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            out.append(line)
+            continue
+        head, _, value = line.rpartition(" ")
+        if head.endswith("}"):
+            head = head[:-1] + f',session="{_esc(session)}"}}'
+        else:
+            head = head + f'{{session="{_esc(session)}"}}'
+        out.append(f"{head} {value}")
+    return "\n".join(out) + "\n"
+
+
+def render_metrics(di: Any, session: "str | None" = None, sessions: Any = None) -> str:
+    """Render the whole registry from the DI container's live services.
+
+    ``session`` labels every sample with the session id (the container
+    passed in is that session's).  ``sessions`` is the server's
+    SessionManager; once the session plane has ever been used, the
+    DEFAULT render additionally exposes the plane's lifecycle counters
+    and the shared-substrate hit/miss counters — before first use the
+    output stays byte-for-byte what a sessionless server rendered."""
     svc = di.scheduler_service()
     m = svc.metrics()
     lines: list[str] = []
@@ -426,4 +451,26 @@ def render_metrics(di: Any) -> str:
             {"kind": kind},
             typ="gauge",
         )
-    return "\n".join(lines) + "\n"
+
+    # session plane (tenancy/) — only once a session has ever existed,
+    # and only on the default (unlabeled) render: a plain single-tenant
+    # scrape stays byte-identical to the pre-session-plane output
+    if session is None and sessions is not None and getattr(sessions, "ever_used", False):
+        st = sessions.stats()
+        counter("sessions_active", "Live sessions beyond the default (tenancy/manager.py).", st["sessions_active"], typ="gauge")
+        counter("sessions_created_total", "Sessions created over /api/v1/sessions.", st["sessions_created_total"])
+        counter("sessions_destroyed_total", "Sessions explicitly destroyed (journal namespace purged).", st["sessions_destroyed_total"])
+        counter("sessions_expired_total", "Sessions reaped by the idle TTL (KSS_SESSION_TTL_S).", st["sessions_expired_total"])
+        counter("sessions_rejected_total", "Session creations rejected by the admission cap (KSS_MAX_SESSIONS, HTTP 429).", st["sessions_rejected_total"])
+        counter("sessions_recovered_total", "Sessions restored at boot from per-session journal namespaces.", st["sessions_recovered_total"])
+        from kube_scheduler_simulator_tpu.tenancy.substrate import SUBSTRATE
+
+        ss = SUBSTRATE.stats()
+        counter("substrate_fn_hits_total", "Compiled executables another engine already published (tenant admission with a seen config = all hits, zero compiles).", ss["substrate_fn_hits_total"])
+        counter("substrate_fn_misses_total", "Substrate lookups that found no published executable (first engine to see a value key).", ss["substrate_fn_misses_total"])
+        counter("substrate_fn_entries", "Executables in the process-wide shared substrate, across families.", ss["substrate_fn_entries"], typ="gauge")
+
+    text = "\n".join(lines) + "\n"
+    if session is not None:
+        return _label_session(text, session)
+    return text

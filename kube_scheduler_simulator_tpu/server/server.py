@@ -47,6 +47,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 from urllib.parse import parse_qs, urlparse
 
+from kube_scheduler_simulator_tpu import tenancy
 from kube_scheduler_simulator_tpu.server.di import DIContainer
 from kube_scheduler_simulator_tpu.services.resourcewatcher import PARAM_KINDS
 from kube_scheduler_simulator_tpu.state.store import KINDS, AlreadyExistsError, NotFoundError
@@ -58,6 +59,13 @@ _EXTENDER_RE = re.compile(r"^/api/v1/extender/(filter|prioritize|preempt|bind)/(
 _RESOURCE_RE = re.compile(r"^/api/v1/resources/([a-z]+)(?:/([^/]+))?$")
 _NODEGROUP_RE = re.compile(r"^/api/v1/nodegroups(?:/([^/]+))?$")
 _PODGROUP_RE = re.compile(r"^/api/v1/podgroups(?:/([^/]+))?$")
+# the session plane (tenancy/): CRUD at /api/v1/sessions[/<id>], every
+# other simulator route session-scoped at /api/v1/sessions/<id>/<rest>
+_SESSION_RE = re.compile(r"^/api/v1/sessions(?:/([^/]+))?(/.+)?$")
+# session containers run without the simulator operator (a tenant
+# spawning tenants is recursion bait) — their CRD kinds 404 per session,
+# exactly as KEP-159 spawned instances already do
+_SESSION_DISABLED = frozenset({"simulators", "schedulersimulations"})
 
 
 def _run_tuning_request(svc: Any, body: Obj) -> Obj:
@@ -126,6 +134,16 @@ class SimulatorServer:
             if di.simulator_operator() is not None
             else frozenset({"simulators", "schedulersimulations"})
         )
+        # The session plane (tenancy/): a read replica stays single-
+        # surface (its store is FED by journal shipping — per-session
+        # stores would have no feeder), every primary gets a manager.
+        # Sessions created over HTTP schedule continuously like the
+        # default container (start_background=True).
+        self.sessions: Any = None
+        if not getattr(di, "read_only", False):
+            from kube_scheduler_simulator_tpu.tenancy import SessionManager
+
+            self.sessions = SessionManager(di, start_background=True)
         self._httpd: "ThreadingHTTPServer | None" = None
         self._thread: "threading.Thread | None" = None
         self._stop = threading.Event()  # ends open watch streams on shutdown
@@ -146,6 +164,7 @@ class SimulatorServer:
                 self.di.cluster_store,
                 port=self.kube_api_port,
                 disabled_kinds=self.disabled_kinds,
+                sessions=self.sessions,
             )
             self.kube_api_port = self.kube_api_server.start(background=True)
         # The scheduler runs continuously like the reference's
@@ -170,6 +189,10 @@ class SimulatorServer:
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd = None
+        if self.sessions is not None:
+            # containers down, journal namespaces KEPT — a restarted
+            # server recovers every session (tenancy/manager.py)
+            self.sessions.close()
         self.di.close()
 
 
@@ -245,9 +268,88 @@ def _make_handler(server: SimulatorServer):
                 return yaml.safe_load(raw.decode())
             return json.loads(raw.decode())
 
+        # --------------------------------------------------------- routing
+
+        def _route(self, method: str):
+            """Resolve this request's SESSION (tenancy/): the
+            ``/api/v1/sessions/<id>/<rest>`` prefix (rewritten to the
+            plain route) or the ``X-KSS-Session`` header select a
+            session's container; no session → the default container,
+            every route byte-for-byte as before the session plane
+            existed.  Returns (di, url, q), or None when the response
+            was already sent (sessions CRUD, unknown session)."""
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
+            self._disabled = server.disabled_kinds
+            self._session = None
+            mgr = server.sessions
+            sid = None
+            if mgr is not None:
+                m = _SESSION_RE.match(url.path)
+                if m:
+                    sid, rest = m.group(1), m.group(2)
+                    if not rest:
+                        self._sessions_crud(method, sid, q)
+                        return None
+                    url = url._replace(path="/api/v1" + rest)
+                else:
+                    sid = (self.headers.get("X-KSS-Session") or "").strip() or None
+                if sid and sid != tenancy.DEFAULT_SESSION:
+                    try:
+                        sdi = mgr.resolve_di(sid)
+                    except tenancy.UnknownSessionError as e:
+                        self._send_json(404, {"message": str(e)})
+                        return None
+                    self._disabled = server.disabled_kinds | _SESSION_DISABLED
+                    self._session = sid
+                    return sdi, url, q
+            return di, url, q
+
+        def _sessions_crud(self, method: str, sid: "str | None", q: dict) -> None:
+            """/api/v1/sessions[/<id>]: the session plane's own CRUD."""
+            mgr = server.sessions
+            try:
+                if method == "GET":
+                    if sid is None:
+                        self._send_json(200, {"items": mgr.list(), **mgr.stats()})
+                    elif sid == tenancy.DEFAULT_SESSION:
+                        self._send_json(200, {"id": sid, "default": True})
+                    else:
+                        self._send_json(200, mgr.info(mgr.get(sid)))
+                elif method == "POST" and sid is None:
+                    if self._reject_read_only():
+                        return
+                    body = self._body() or {}
+                    info = mgr.create(
+                        body.get("id"),
+                        use_batch=body.get("useBatch"),
+                        seed=int(body.get("seed") or 0),
+                        scheduler_cfg=body.get("schedulerConfig"),
+                    )
+                    self._send_json(201, info)
+                elif method == "DELETE" and sid is not None:
+                    if self._reject_read_only():
+                        return
+                    mgr.destroy(sid)
+                    self._send_empty(200)
+                else:
+                    self._send_json(404, {"message": "not found"})
+            except tenancy.TooManySessionsError as e:
+                self._send_json(429, {"message": str(e)})
+            except tenancy.SessionExistsError as e:
+                self._send_json(409, {"message": str(e)})
+            except tenancy.InvalidSessionError as e:
+                self._send_json(400, {"message": str(e)})
+            except tenancy.UnknownSessionError as e:
+                self._send_json(404, {"message": str(e)})
+            except (ValueError, TypeError) as e:
+                self._send_json(400, {"message": str(e)})
+            except Exception as e:  # pragma: no cover - defensive 500
+                self._send_json(500, {"message": f"{type(e).__name__}: {e}"})
+
         # --------------------------------------------------------- methods
 
-        def _group_with_status(self, group: Obj) -> Obj:
+        def _group_with_status(self, di: Any, group: Obj) -> Obj:
             """NodeGroup + live status (current size from the ownership
             label — the store is the source of truth, not a counter)."""
             from kube_scheduler_simulator_tpu.autoscaler.nodegroups import group_nodes
@@ -283,8 +385,10 @@ def _make_handler(server: SimulatorServer):
             return True
 
         def do_GET(self) -> None:
-            url = urlparse(self.path)
-            q = parse_qs(url.query)
+            r = self._route("GET")
+            if r is None:
+                return
+            di, url, q = r
             note = getattr(di, "note_replica_read", None)
             if note is not None:
                 note()
@@ -320,7 +424,9 @@ def _make_handler(server: SimulatorServer):
                 elif url.path in ("/api/v1/metrics", "/metrics"):
                     from kube_scheduler_simulator_tpu.server.metrics import render_metrics
 
-                    data = render_metrics(di).encode()
+                    data = render_metrics(
+                        di, session=self._session, sessions=server.sessions
+                    ).encode()
                     self.send_response(200)
                     self._cors_headers()
                     self.send_header("Content-Type", "text/plain; version=0.0.4; charset=utf-8")
@@ -360,13 +466,13 @@ def _make_handler(server: SimulatorServer):
                     name = m.group(1)
                     if name is None:
                         items = [
-                            self._group_with_status(g)
+                            self._group_with_status(di, g)
                             for g in di.cluster_store.list("nodegroups")
                         ]
                         self._send_json(200, {"items": items})
                     else:
                         g = di.cluster_store.get("nodegroups", name)
-                        self._send_json(200, self._group_with_status(g))
+                        self._send_json(200, self._group_with_status(di, g))
                 elif m := _PODGROUP_RE.match(url.path):
                     from kube_scheduler_simulator_tpu.gang import group_status
 
@@ -398,7 +504,7 @@ def _make_handler(server: SimulatorServer):
                 elif url.path == "/api/v1/export":
                     self._send_json(200, di.snapshot_service().snap())
                 elif url.path == "/api/v1/listwatchresources":
-                    self._list_watch(q)
+                    self._list_watch(di, q)
                 elif url.path.startswith("/api/v1/templates/"):
                     # YAML creation templates per kind (the reference web
                     # UI ships web/components/lib/templates/*.yaml)
@@ -413,7 +519,7 @@ def _make_handler(server: SimulatorServer):
                     kind, name = m.group(1), m.group(2)
                     ns = (q.get("namespace") or [None])[0]
                     as_yaml = (q.get("format") or [""])[0] == "yaml"
-                    if kind not in KINDS or kind in server.disabled_kinds:
+                    if kind not in KINDS or kind in self._disabled:
                         self._send_json(404, {"message": f"unknown resource kind {kind}"})
                     elif name is None:
                         obj = {"items": di.cluster_store.list(kind, ns)}
@@ -429,7 +535,10 @@ def _make_handler(server: SimulatorServer):
                 self._send_json(500, {"message": f"{type(e).__name__}: {e}"})
 
         def do_POST(self) -> None:
-            url = urlparse(self.path)
+            r = self._route("POST")
+            if r is None:
+                return
+            di, url, q = r
             if url.path == "/api/v1/replication/promote":
                 # the ONE write a replica accepts: failover. 201 with the
                 # promotion stats; idempotent (a repeat returns the first
@@ -522,7 +631,7 @@ def _make_handler(server: SimulatorServer):
                     self._send_json(201, di.cluster_store.create("podgroups", body))
                 elif m := _RESOURCE_RE.match(url.path):
                     kind = m.group(1)
-                    if kind not in KINDS or kind in server.disabled_kinds:
+                    if kind not in KINDS or kind in self._disabled:
                         self._send_json(404, {"message": f"unknown resource kind {kind}"})
                     else:
                         self._send_json(201, di.cluster_store.create(kind, self._body() or {}))
@@ -544,7 +653,10 @@ def _make_handler(server: SimulatorServer):
                 self._send_json(500, {"message": f"{type(e).__name__}: {e}"})
 
         def do_PUT(self) -> None:
-            url = urlparse(self.path)
+            r = self._route("PUT")
+            if r is None:
+                return
+            di, url, q = r
             if self._reject_read_only():
                 return
             try:
@@ -553,7 +665,7 @@ def _make_handler(server: SimulatorServer):
                     self._send_empty(202)
                 elif m := _RESOURCE_RE.match(url.path):
                     kind, name = m.group(1), m.group(2)
-                    if kind not in KINDS or kind in server.disabled_kinds or name is None:
+                    if kind not in KINDS or kind in self._disabled or name is None:
                         self._send_json(404, {"message": "not found"})
                     else:
                         body = self._body() or {}
@@ -565,8 +677,10 @@ def _make_handler(server: SimulatorServer):
                 self._send_json(500, {"message": f"{type(e).__name__}: {e}"})
 
         def do_DELETE(self) -> None:
-            url = urlparse(self.path)
-            q = parse_qs(url.query)
+            r = self._route("DELETE")
+            if r is None:
+                return
+            di, url, q = r
             if self._reject_read_only():
                 return
             try:
@@ -584,7 +698,7 @@ def _make_handler(server: SimulatorServer):
                 elif m := _RESOURCE_RE.match(url.path):
                     kind, name = m.group(1), m.group(2)
                     ns = (q.get("namespace") or [None])[0]
-                    if kind not in KINDS or kind in server.disabled_kinds or name is None:
+                    if kind not in KINDS or kind in self._disabled or name is None:
                         self._send_json(404, {"message": "not found"})
                     else:
                         di.cluster_store.delete(kind, name, ns)
@@ -598,7 +712,7 @@ def _make_handler(server: SimulatorServer):
 
         # ----------------------------------------------------------- watch
 
-        def _list_watch(self, q: dict) -> None:
+        def _list_watch(self, di: Any, q: dict) -> None:
             lrv = {}
             for param, kind in PARAM_KINDS:
                 v = (q.get(f"{param}LastResourceVersion") or [""])[0]

@@ -61,6 +61,7 @@ HTML = r"""<!doctype html>
 <body>
 <header>
   <h1>kube-scheduler-simulator <span class="muted" style="color:#cfe0ff">TPU-native</span></h1>
+  <select id="sessionsel" onchange="onSessionPick()" title="session" style="border:none;border-radius:4px;padding:5px 8px"><option value="default">default</option></select>
   <input id="search" type="search" placeholder="filter…" style="border:none;border-radius:4px;padding:5px 8px;min-width:140px" oninput="onSearch()">
   <button id="viewtoggle" onclick="toggleView()">Tables</button>
   <button onclick="openMetrics()">Metrics</button>
@@ -104,6 +105,8 @@ _ASSET_DIR = _os.path.join(_os.path.dirname(__file__), "webui_assets")
 MODULE_ORDER = [
     "state.js",      # shared store: kinds, objects-by-key, search filter
     "api.js",        # fetch wrapper + HTML escaping + full refresh
+    "sessions.js",   # session picker: X-KSS-Session fetch routing
+
     "quantity.js",   # kube resource.Quantity parsing + usage bars
     "editor.js",     # YAML editor pane: gutter, highlighting, error lines
     "clusterview.js",# nodes-and-pods view with utilization badges

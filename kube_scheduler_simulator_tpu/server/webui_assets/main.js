@@ -1,1 +1,1 @@
-refreshAll().then(() => { watchLoop(); pollWorkloads(); });
+refreshSessions().then(() => refreshAll()).then(() => { watchLoop(); pollWorkloads(); });

@@ -1,0 +1,33 @@
+"""Multi-tenant session plane (docs/multitenancy.md).
+
+Isolated per-session control planes — store, scheduler, queue, watch
+epoch, journal namespace — over ONE shared compiled-kernel substrate,
+so N tenants with the same scheduler config cost one compile, not N.
+"""
+
+from kube_scheduler_simulator_tpu.tenancy.manager import (
+    DEFAULT_SESSION,
+    InvalidSessionError,
+    Session,
+    SessionError,
+    SessionExistsError,
+    SessionManager,
+    TooManySessionsError,
+    UnknownSessionError,
+    session_knobs,
+)
+from kube_scheduler_simulator_tpu.tenancy.substrate import SUBSTRATE, ExecutableSubstrate
+
+__all__ = [
+    "DEFAULT_SESSION",
+    "ExecutableSubstrate",
+    "InvalidSessionError",
+    "SUBSTRATE",
+    "Session",
+    "SessionError",
+    "SessionExistsError",
+    "SessionManager",
+    "TooManySessionsError",
+    "UnknownSessionError",
+    "session_knobs",
+]

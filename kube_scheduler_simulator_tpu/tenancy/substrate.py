@@ -1,0 +1,126 @@
+"""Process-wide compiled-executable substrate shared by every tenant.
+
+The expensive state in a simulator process is not the cluster stores —
+it is the compiled XLA executables.  A session plane that rebuilt them
+per tenant would turn N tenants into N compiles of the SAME kernel;
+this module is the dedupe point: one registry, keyed by the exact
+value-based shape key the engines already compute (dims bucket tuple,
+``BatchConfig``, in-step compaction width, mesh, donation convention),
+so any engine in the process — the default session's, a tenant's, a
+KEP-184 throwaway — that asks for an executable another engine already
+built gets the SAME jit-wrapped callable back.  jax's jit cache lives
+on the function object, so a shared object means the k+1-th tenant's
+first dispatch is a jit cache HIT: zero tracing, zero backend compiles
+(the ``RecompileGuard`` pin in scripts/tenant_smoke.py and the bench's
+cfg15-tenant row).
+
+Keys must be VALUE-based: the per-engine ``_fn_cache`` keys on
+``id(mesh)`` (cheap, correct within one engine), but two tenants build
+two ``Mesh`` objects — ``jax.sharding.Mesh`` compares by device list +
+axis names, so the mesh object itself participates in the key here and
+equal meshes dedupe.  Entries live for the process lifetime, exactly
+like the jit caches they front; diversity is bounded by config/shape
+diversity, the same bound the AOT artifact cache lives under.
+
+The registry is consulted AFTER the per-engine jit cache and the AOT
+artifact cache (both existing behavior, byte-for-byte preserved) and
+BEFORE a fresh ``build_batch_fn`` trace — it only ever replaces the
+build, never a load path that already avoided one.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Hashable
+
+
+class ExecutableSubstrate:
+    """One process-wide table per executable family (scan, compaction).
+
+    ``lookup`` / ``publish`` are the whole protocol: engines look up
+    before building and publish what they built.  ``publish`` keeps the
+    FIRST entry on a race (two tenants tracing the same key
+    concurrently) so every later caller converges on one object.
+
+    The registry is an opt-in seam: it only engages while a session
+    plane holds it enabled (refcounted — ``SessionManager`` construction
+    enables, its ``close`` disables).  Disabled, ``lookup`` misses
+    nothing and ``publish`` registers nothing, so a plain single-tenant
+    process — and every existing test's engine — behaves byte-for-byte
+    as before.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._tables: dict[str, dict[Hashable, Any]] = {}
+        self._enabled = 0
+        self.hits = 0
+        self.misses = 0
+
+    # ------------------------------------------------------------- gating
+
+    def enable(self) -> None:
+        with self._lock:
+            self._enabled += 1
+
+    def disable(self) -> None:
+        with self._lock:
+            self._enabled = max(0, self._enabled - 1)
+
+    @property
+    def enabled(self) -> bool:
+        # lock-free: GIL-atomic read of an int refcount; a stale read only
+        # routes one publish/lookup through the inert path, which is safe
+        return self._enabled > 0
+
+    # ----------------------------------------------------------- protocol
+
+    def lookup(self, family: str, key: Hashable) -> Any:
+        with self._lock:
+            if not self._enabled:
+                return None
+            fn = self._tables.get(family, {}).get(key)
+            if fn is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+            return fn
+
+    def publish(self, family: str, key: Hashable, fn: Any) -> Any:
+        """Register ``fn`` under ``key``; returns the registered object
+        (the first one to land, under a race)."""
+        with self._lock:
+            if not self._enabled:
+                return fn
+            table = self._tables.setdefault(family, {})
+            return table.setdefault(key, fn)
+
+    def get_or_build(self, family: str, key: Hashable, build: Callable[[], Any]) -> Any:
+        fn = self.lookup(family, key)
+        if fn is None:
+            fn = self.publish(family, key, build())
+        return fn
+
+    def entries(self) -> int:
+        with self._lock:
+            return sum(len(t) for t in self._tables.values())
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "substrate_fn_hits_total": self.hits,
+                "substrate_fn_misses_total": self.misses,
+                "substrate_fn_entries": sum(len(t) for t in self._tables.values()),
+            }
+
+    def clear(self) -> None:
+        """Test isolation only — a live process never drops executables."""
+        with self._lock:
+            self._tables.clear()
+            self._enabled = 0
+            self.hits = 0
+            self.misses = 0
+
+
+#: the process-wide registry every BatchEngine consults
+SUBSTRATE = ExecutableSubstrate()

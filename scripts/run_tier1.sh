@@ -66,6 +66,14 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/replica_smoke.py; th
 # injected fault must end in a counted degradation with byte parity or
 # a loud wedge; silent divergence fails tier-1.
 if ! timeout -k 10 590 env JAX_PLATFORMS=cpu python scripts/resilience_smoke.py; then rc=1; fi
+# Multi-tenant session-plane smoke (docs/multitenancy.md): three
+# sessions churn concurrently over the shared compiled-executable
+# substrate — per-session byte parity vs a solo single-tenant run,
+# RecompileGuard(0) over tenants 2..3 admitting a seen config, and a
+# SIGKILLed journaled manager recovering ALL sessions' stores plus the
+# default (scripts/tenant_smoke.py; bench cfg15-tenant is the at-scale
+# row).
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu python scripts/tenant_smoke.py; then rc=1; fi
 # Host-path perf smoke (docs/batch-engine.md "Where the wall goes"):
 # the fused streamed path vs the serial per-tick loop at smoke size,
 # min-of-3 walls, byte parity + per-wave stage profiles asserted, and

@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""Multi-tenant session-plane smoke (tier-1; docs/multitenancy.md).
+
+Three legs, each pinning a load-bearing session-plane contract:
+
+- ISOLATION + PARITY: three sessions churn CONCURRENTLY (one thread per
+  session, identical scenario) over the shared compiled-executable
+  substrate; every session's binding + annotation trail must be
+  byte-identical to the same churn run SOLO in a plain container with
+  the substrate disengaged.  A shared executable that leaks state
+  between tenants, or changes a single annotation byte, fails here.
+
+- ZERO CROSS-SESSION RECOMPILES: tenant 1 warms the substrate; tenants
+  2 and 3 then churn the identical scheduler config under a
+  RecompileGuard(max_compiles=0) — admission of tenant k+1 with a seen
+  BatchConfig must not trigger a single new backend compile.
+
+- JOURNAL KILL + RECOVER: a child process boots a journaled manager,
+  populates three sessions with distinct clusters, schedules them,
+  reports every trail, then SIGKILLs itself mid-flight.  A fresh
+  manager over the same journal root must recover ALL three sessions
+  (plus the default store) with byte-identical trails.
+
+Exit 0 = every leg green; any divergence prints the offending session
+and differing keys.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+
+SESSIONS = ("t1", "t2", "t3")
+NODES = 8
+WAVES = 2
+PODS_PER_WAVE = 16
+
+
+def seed_nodes(store) -> None:
+    for i in range(NODES):
+        store.create(
+            "nodes",
+            {
+                "metadata": {
+                    "name": f"node-{i}",
+                    "labels": {
+                        "kubernetes.io/hostname": f"node-{i}",
+                        "topology.kubernetes.io/zone": f"z{i % 2}",
+                        "disk": "ssd" if i % 2 else "hdd",
+                    },
+                },
+                "status": {"allocatable": {"cpu": "8000m", "memory": "16Gi", "pods": "110"}},
+                "spec": {},
+            },
+        )
+
+
+def churn(svc, store) -> "dict[str, tuple]":
+    import random
+
+    rng = random.Random(7)
+    created = 0
+    for _ in range(WAVES):
+        for _ in range(PODS_PER_WAVE):
+            p = {
+                "metadata": {
+                    "name": f"pod-{created}",
+                    "namespace": "default",
+                    "labels": {"app": f"a{created % 3}"},
+                },
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "resources": {
+                                "requests": {"cpu": f"{100 + (created % 4) * 50}m", "memory": "128Mi"}
+                            },
+                        }
+                    ]
+                },
+            }
+            if created % 4 == 0:
+                p["spec"]["nodeSelector"] = {"disk": "ssd"}
+            store.create("pods", p)
+            created += 1
+        svc.schedule_pending(max_rounds=2)
+        bound = [p for p in store.list("pods") if (p.get("spec") or {}).get("nodeName")]
+        for p in rng.sample(bound, max(1, len(bound) // 8)):
+            store.delete("pods", p["metadata"]["name"], p["metadata"].get("namespace"))
+        svc.schedule_pending(max_rounds=1)
+    return trail(store)
+
+
+def trail(store) -> "dict[str, tuple]":
+    out = {}
+    for p in store.list("pods"):
+        k = p["metadata"]["namespace"] + "/" + p["metadata"]["name"]
+        out[k] = (
+            (p.get("spec") or {}).get("nodeName"),
+            tuple(sorted((p["metadata"].get("annotations") or {}).items())),
+        )
+    return out
+
+
+def diff(name: str, got: dict, want: dict) -> bool:
+    if got == want:
+        return True
+    keys = sorted(set(got) | set(want))
+    bad = [k for k in keys if got.get(k) != want.get(k)]
+    print(f"FAIL {name}: {len(bad)} diverging pod(s): {bad[:6]}")
+    for k in bad[:2]:
+        print(f"  {k}:\n    got  {got.get(k)}\n    want {want.get(k)}")
+    return False
+
+
+def leg_isolation_and_recompiles() -> bool:
+    from kube_scheduler_simulator_tpu.analysis.runtime import (
+        RecompileError,
+        RecompileGuard,
+    )
+    from kube_scheduler_simulator_tpu.server.di import DIContainer
+    from kube_scheduler_simulator_tpu.tenancy import SUBSTRATE, SessionManager
+
+    # solo baseline: plain container, substrate disengaged — the exact
+    # single-tenant path the session plane must not perturb
+    assert not SUBSTRATE.enabled, "substrate must be off outside a manager"
+    solo_di = DIContainer(use_batch="force", enable_simulator_operator=False)
+    seed_nodes(solo_di.cluster_store)
+    want = churn(solo_di.scheduler_service(), solo_di.cluster_store)
+    solo_di.close()
+    assert any(v[0] for v in want.values()), "baseline churn bound nothing"
+
+    boot_di = DIContainer(use_batch="off")
+    mgr = SessionManager(boot_di, use_batch="force")
+    ok = True
+    try:
+        assert SUBSTRATE.enabled, "manager must engage the substrate"
+        for sid in SESSIONS:
+            mgr.create(sid)
+            seed_nodes(mgr.resolve_store(sid))
+
+        # tenant 1 warms the shared substrate (builds + publishes)...
+        got1 = churn(mgr.resolve_di(SESSIONS[0]).scheduler_service(),
+                     mgr.resolve_store(SESSIONS[0]))
+        ok &= diff("session t1 vs solo", got1, want)
+        warmed = SUBSTRATE.stats()["substrate_fn_entries"]
+        assert warmed > 0, "tenant 1 published nothing into the substrate"
+
+        # ...then tenants 2+3 churn CONCURRENTLY with zero new compiles.
+        # Retry-with-memory on a tripped guard: a timing-dependent round
+        # split (loaded CI host) can present a tiny commit-path helper
+        # shape for its FIRST compile — not a tenancy leak, and once
+        # compiled it sits in the process-wide jit cache, so the retry can
+        # only pass when the substrate genuinely serves every tenant; a
+        # real per-tenant executable leak recompiles on every retry.
+        results: "dict[str, dict]" = {}
+        for attempt in range(3):
+            tenants = [f"{sid}-r{attempt}" if attempt else sid for sid in SESSIONS[1:]]
+            for sid in tenants:
+                if attempt:
+                    mgr.create(sid)
+                    seed_nodes(mgr.resolve_store(sid))
+            results.clear()
+            errors: "list[BaseException]" = []
+
+            def run(sid: str) -> None:
+                try:
+                    results[sid] = churn(mgr.resolve_di(sid).scheduler_service(),
+                                         mgr.resolve_store(sid))
+                except BaseException as e:  # noqa: BLE001 - reported below
+                    errors.append(e)
+
+            try:
+                with RecompileGuard("tenant admission with a seen config",
+                                    max_compiles=0):
+                    threads = [threading.Thread(target=run, args=(sid,))
+                               for sid in tenants]
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+            except RecompileError:
+                if attempt == 2:
+                    raise
+                print("note: guard tripped on a first-sight helper shape — "
+                      "retrying against the now-warm jit cache")
+                continue
+            if errors:
+                print(f"FAIL concurrent churn raised: {errors[0]!r}")
+                return False
+            break
+        for sid in tenants:
+            ok &= diff(f"session {sid} vs solo", results[sid], want)
+        hits = SUBSTRATE.stats()["substrate_fn_hits_total"]
+        assert hits > 0, "tenants 2/3 never hit the shared substrate"
+        print(
+            f"ok isolation+parity: 3 sessions == solo baseline; substrate "
+            f"entries={warmed} hits={hits}; 0 compiles for tenants 2..3"
+        )
+    finally:
+        mgr.close()
+        boot_di.close()
+    assert not SUBSTRATE.enabled, "manager close must release the substrate"
+    return ok
+
+
+def child_populate(jdir: str) -> None:
+    """Subprocess leg: journaled sessions, distinct data, then SIGKILL."""
+    from kube_scheduler_simulator_tpu.server.di import DIContainer
+    from kube_scheduler_simulator_tpu.tenancy import SessionManager
+
+    di = DIContainer(use_batch="off", journal_dir=jdir)
+    mgr = SessionManager(di, use_batch="off")
+    di.cluster_store.create("nodes", {"metadata": {"name": "boot-node"},
+                                      "status": {"allocatable": {"cpu": "4", "pods": "10"}}})
+    trails = {}
+    for i, sid in enumerate(SESSIONS):
+        mgr.create(sid, seed=i)
+        store = mgr.resolve_store(sid)
+        for n in range(2 + i):  # distinct cluster per session
+            store.create(
+                "nodes",
+                {"metadata": {"name": f"{sid}-node-{n}"},
+                 "status": {"allocatable": {"cpu": "4000m", "memory": "8Gi", "pods": "20"}}},
+            )
+        for n in range(3 + i):
+            store.create(
+                "pods",
+                {"metadata": {"name": f"{sid}-pod-{n}", "namespace": "default"},
+                 "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "100m"}}}]}},
+            )
+        mgr.resolve_di(sid).scheduler_service().schedule_pending(max_rounds=2)
+        trails[sid] = trail(store)
+    with open(os.path.join(jdir, "trails.json"), "w", encoding="utf-8") as f:
+        json.dump(trails, f)
+        f.flush()
+        os.fsync(f.fileno())
+    # die mid-flight: no close(), no flush beyond what each journal_txn
+    # already wrote — recovery must rebuild every tenant from its WAL
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def leg_journal_kill_recover() -> bool:
+    from kube_scheduler_simulator_tpu.server.di import DIContainer
+    from kube_scheduler_simulator_tpu.tenancy import SessionManager
+
+    ok = True
+    with tempfile.TemporaryDirectory(prefix="kss-tenant-smoke-") as jdir:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--populate-child", jdir],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            timeout=240,
+        )
+        if proc.returncode != -signal.SIGKILL:
+            print(f"FAIL child did not die by SIGKILL (rc={proc.returncode})")
+            return False
+        with open(os.path.join(jdir, "trails.json"), encoding="utf-8") as f:
+            want = json.load(f)
+
+        di = DIContainer(use_batch="off", journal_dir=jdir)
+        mgr = SessionManager(di, use_batch="off")
+        try:
+            if mgr.ids() != sorted(SESSIONS):
+                print(f"FAIL recovery: sessions {mgr.ids()} != {sorted(SESSIONS)}")
+                return False
+            assert mgr.stats()["sessions_recovered_total"] == len(SESSIONS)
+            for sid in SESSIONS:
+                # normalize tuples through the same JSON round-trip the
+                # child's trail file took
+                got = json.loads(json.dumps(trail(mgr.resolve_store(sid))))
+                ok &= diff(f"recovered session {sid}", got, want[sid])
+            boot = [n["metadata"]["name"] for n in di.cluster_store.list("nodes")]
+            if boot != ["boot-node"]:
+                print(f"FAIL recovery: default store nodes {boot}")
+                ok = False
+        finally:
+            mgr.close()
+            di.close()
+    if ok:
+        print(f"ok journal kill+recover: {len(SESSIONS)} sessions + default store restored")
+    return ok
+
+
+def main() -> int:
+    ok = leg_isolation_and_recompiles()
+    ok &= leg_journal_kill_recover()
+    print("TENANT SMOKE " + ("PASSED" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "--populate-child":
+        child_populate(sys.argv[2])
+        sys.exit(0)  # unreachable — the child SIGKILLs itself
+    sys.exit(main())

@@ -38,3 +38,17 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _substrate_reset():
+    """The cross-engine executable substrate (tenancy/substrate.py) is a
+    process-wide singleton; drop its tables and enable-refcount between
+    tests so a session-plane test can never leak compiled fns (or the
+    enabled state) into an engine test's compile/AOT expectations."""
+    yield
+    from kube_scheduler_simulator_tpu.tenancy.substrate import SUBSTRATE
+
+    SUBSTRATE.clear()

@@ -1,0 +1,371 @@
+"""The multi-tenant session plane (tenancy/): isolated sessions over a
+shared compiled-executable substrate, admission/TTL lifecycle, per-session
+journal namespaces with boot recovery, and the HTTP routing surface
+(/api/v1/sessions CRUD, prefix + X-KSS-Session routing, per-session
+/metrics labels).  docs/multitenancy.md is the prose for everything
+pinned here."""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+from typing import Any
+
+import pytest
+
+from kube_scheduler_simulator_tpu.server import DIContainer, SimulatorServer
+from kube_scheduler_simulator_tpu.tenancy import (
+    SUBSTRATE,
+    InvalidSessionError,
+    SessionError,
+    SessionExistsError,
+    SessionManager,
+    TooManySessionsError,
+    UnknownSessionError,
+    session_knobs,
+)
+
+Obj = dict[str, Any]
+
+
+# ---------------------------------------------------------------- substrate
+
+
+def test_substrate_disabled_by_default_is_inert():
+    assert not SUBSTRATE.enabled
+    assert SUBSTRATE.lookup("scan", ("k",)) is None
+    fn = object()
+    assert SUBSTRATE.publish("scan", ("k",), fn) is fn
+    SUBSTRATE.enable()
+    try:
+        # nothing was registered while disabled, and the disabled probes
+        # did not count
+        assert SUBSTRATE.lookup("scan", ("k",)) is None
+        s = SUBSTRATE.stats()
+        assert s["substrate_fn_entries"] == 0
+        assert s["substrate_fn_misses_total"] == 1  # the enabled lookup
+    finally:
+        SUBSTRATE.disable()
+
+
+def test_substrate_dedupes_first_wins_and_counts():
+    SUBSTRATE.enable()
+    try:
+        a, b = object(), object()
+        assert SUBSTRATE.publish("scan", ("cfg1",), a) is a
+        # a concurrent second builder loses the race: first-wins, the
+        # duplicate build is discarded and every caller shares one fn
+        assert SUBSTRATE.publish("scan", ("cfg1",), b) is a
+        assert SUBSTRATE.lookup("scan", ("cfg1",)) is a
+        assert SUBSTRATE.lookup("compact", ("cfg1",)) is None  # family-keyed
+        s = SUBSTRATE.stats()
+        assert s["substrate_fn_hits_total"] == 1
+        assert s["substrate_fn_misses_total"] == 1
+        assert s["substrate_fn_entries"] == 1
+    finally:
+        SUBSTRATE.disable()
+
+
+def test_substrate_refcount_nests():
+    SUBSTRATE.enable()
+    SUBSTRATE.enable()
+    SUBSTRATE.disable()
+    assert SUBSTRATE.enabled  # still held by the first enable
+    SUBSTRATE.disable()
+    assert not SUBSTRATE.enabled
+
+
+# -------------------------------------------------------------------- knobs
+
+
+def test_session_knobs_defaults_and_validation(monkeypatch):
+    monkeypatch.delenv("KSS_SESSION_TTL_S", raising=False)
+    monkeypatch.delenv("KSS_MAX_SESSIONS", raising=False)
+    assert session_knobs() == {"ttl_s": 0.0, "max_sessions": 16}
+    monkeypatch.setenv("KSS_SESSION_TTL_S", "2.5")
+    monkeypatch.setenv("KSS_MAX_SESSIONS", "3")
+    assert session_knobs() == {"ttl_s": 2.5, "max_sessions": 3}
+    for var, bad in (
+        ("KSS_SESSION_TTL_S", "soon"),
+        ("KSS_SESSION_TTL_S", "-1"),
+        ("KSS_MAX_SESSIONS", "many"),
+        ("KSS_MAX_SESSIONS", "0"),
+    ):
+        monkeypatch.setenv("KSS_SESSION_TTL_S", "1")
+        monkeypatch.setenv("KSS_MAX_SESSIONS", "1")
+        monkeypatch.setenv(var, bad)
+        with pytest.raises(SessionError):
+            session_knobs()
+
+
+# ------------------------------------------------------------ manager (unit)
+
+
+@pytest.fixture()
+def default_di():
+    di = DIContainer(use_batch="off")
+    yield di
+    di.close()
+
+
+def test_manager_admission_and_lifecycle(monkeypatch, default_di):
+    monkeypatch.setenv("KSS_MAX_SESSIONS", "2")
+    mgr = SessionManager(default_di, use_batch="off")
+    try:
+        info = mgr.create("t1")
+        assert info["id"] == "t1" and info["useBatch"] == "off"
+        with pytest.raises(SessionExistsError):
+            mgr.create("t1")
+        with pytest.raises(InvalidSessionError):
+            mgr.create("default")
+        with pytest.raises(InvalidSessionError):
+            mgr.create("Bad_ID!")
+        with pytest.raises(InvalidSessionError):
+            mgr.create("t2", use_batch="warp")
+        mgr.create("t2")
+        with pytest.raises(TooManySessionsError):
+            mgr.create("t3")
+        assert mgr.stats()["sessions_rejected_total"] == 1
+        assert mgr.ids() == ["t1", "t2"]
+        assert [s["id"] for s in mgr.list()] == ["t1", "t2"]
+        # routing: blank/default → the boot container, named → its own
+        assert mgr.resolve_di(None) is default_di
+        assert mgr.resolve_di("default") is default_di
+        assert mgr.resolve_di("t1") is not default_di
+        assert mgr.resolve_store("t1") is not default_di.cluster_store
+        with pytest.raises(UnknownSessionError):
+            mgr.resolve_di("nope")
+        mgr.destroy("t1")
+        with pytest.raises(UnknownSessionError):
+            mgr.destroy("t1")
+        with pytest.raises(InvalidSessionError):
+            mgr.destroy("default")
+        st = mgr.stats()
+        assert st["sessions_active"] == 1
+        assert st["sessions_created_total"] == 2
+        assert st["sessions_destroyed_total"] == 1
+    finally:
+        mgr.close()
+
+
+def test_manager_store_isolation(default_di):
+    mgr = SessionManager(default_di, use_batch="off")
+    try:
+        mgr.create("a")
+        mgr.create("b")
+        sa = mgr.resolve_store("a")
+        sb = mgr.resolve_store("b")
+        sa.create("nodes", {"metadata": {"name": "only-in-a"}})
+        assert [o["metadata"]["name"] for o in sa.list("nodes")] == ["only-in-a"]
+        assert sb.list("nodes") == []
+        assert default_di.cluster_store.list("nodes") == []
+    finally:
+        mgr.close()
+
+
+def test_manager_ttl_reaps_idle_sessions(monkeypatch, default_di):
+    monkeypatch.setenv("KSS_SESSION_TTL_S", "10")
+    now = [0.0]
+    mgr = SessionManager(default_di, clock=lambda: now[0], use_batch="off")
+    try:
+        mgr.create("old")
+        now[0] = 5.0
+        mgr.create("young")
+        assert mgr.sweep() == 0
+        now[0] = 12.0
+        mgr.resolve_di("young")  # touch: routing resets the idle clock
+        now[0] = 14.0
+        assert mgr.sweep() == 1  # "old" idle 14s > 10s; "young" idle 2s
+        assert mgr.ids() == ["young"]
+        assert mgr.stats()["sessions_expired_total"] == 1
+        # the default session never expires — nothing to sweep for it
+        now[0] = 1000.0
+        mgr.sweep()
+        assert mgr.resolve_di(None) is default_di
+    finally:
+        mgr.close()
+
+
+def test_manager_substrate_held_for_lifetime(default_di):
+    assert not SUBSTRATE.enabled
+    mgr = SessionManager(default_di, use_batch="off")
+    assert SUBSTRATE.enabled
+    mgr.close()
+    assert not SUBSTRATE.enabled
+
+
+# -------------------------------------------------- journal-namespace recovery
+
+
+def test_sessions_recover_from_journal_namespaces(tmp_path):
+    jdir = str(tmp_path / "journal")
+    di = DIContainer(use_batch="off", journal_dir=jdir)
+    mgr = SessionManager(di, use_batch="off")
+    mgr.create("t1", seed=7)
+    mgr.create("t2")
+    mgr.resolve_store("t1").create("nodes", {"metadata": {"name": "n1"}})
+    mgr.resolve_store("t2").create("pods", {"metadata": {"name": "p1", "namespace": "default"}})
+    di.cluster_store.create("nodes", {"metadata": {"name": "boot-node"}})
+    # crash: close keeps every namespace on disk
+    mgr.close()
+    di.close()
+
+    di2 = DIContainer(use_batch="off", journal_dir=jdir)
+    mgr2 = SessionManager(di2, use_batch="off")
+    try:
+        assert mgr2.ids() == ["t1", "t2"]
+        assert mgr2.stats()["sessions_recovered_total"] == 2
+        assert [o["metadata"]["name"] for o in mgr2.resolve_store("t1").list("nodes")] == ["n1"]
+        assert [o["metadata"]["name"] for o in mgr2.resolve_store("t2").list("pods")] == ["p1"]
+        assert [o["metadata"]["name"] for o in di2.cluster_store.list("nodes")] == ["boot-node"]
+        # the recovered manifest round-trips the boot parameters
+        t1 = {s["id"]: s for s in mgr2.list()}["t1"]
+        assert t1["seed"] == 7
+        # destroy purges the namespace durably: a THIRD boot must not
+        # resurrect it
+        mgr2.destroy("t1")
+        assert not os.path.isdir(os.path.join(jdir, "sessions", "t1"))
+    finally:
+        mgr2.close()
+        di2.close()
+
+    di3 = DIContainer(use_batch="off", journal_dir=jdir)
+    mgr3 = SessionManager(di3, use_batch="off")
+    try:
+        assert mgr3.ids() == ["t2"]
+    finally:
+        mgr3.close()
+        di3.close()
+
+
+# ------------------------------------------------------------------- HTTP
+
+
+@pytest.fixture()
+def server():
+    di = DIContainer(use_batch="off")
+    srv = SimulatorServer(di, port=0, kube_api_port=0)
+    srv.start(background=True)
+    yield srv, di
+    srv.shutdown()
+
+
+def _req(port: int, method: str, path: str, body: "Obj | None" = None, headers: "Obj | None" = None):
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}", data=data, method=method, headers=h)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            raw = resp.read()
+            ctype = resp.headers.get("Content-Type", "")
+            return resp.status, (json.loads(raw) if "json" in ctype else raw.decode())
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        try:
+            return e.code, json.loads(raw)
+        except ValueError:
+            return e.code, raw.decode()
+
+
+def test_http_sessions_crud_and_routing(server):
+    srv, di = server
+    p = srv.port
+
+    code, body = _req(p, "GET", "/api/v1/sessions")
+    assert code == 200 and body["items"] == [] and body["sessions_active"] == 0
+
+    code, s1 = _req(p, "POST", "/api/v1/sessions", {"id": "t1"})
+    assert code == 201 and s1["id"] == "t1"
+    code, _ = _req(p, "POST", "/api/v1/sessions", {"id": "t1"})
+    assert code == 409
+    code, _ = _req(p, "POST", "/api/v1/sessions", {"id": "Bad!"})
+    assert code == 400
+    code, info = _req(p, "GET", "/api/v1/sessions/t1")
+    assert code == 200 and info["id"] == "t1"
+    code, dflt = _req(p, "GET", "/api/v1/sessions/default")
+    assert code == 200 and dflt.get("default") is True
+    code, _ = _req(p, "GET", "/api/v1/sessions/ghost")
+    assert code == 404
+
+    # prefix routing: the session's store, not the boot store
+    code, _ = _req(p, "POST", "/api/v1/sessions/t1/resources/nodes",
+                   {"metadata": {"name": "t1-node"}})
+    assert code == 201
+    code, lst = _req(p, "GET", "/api/v1/sessions/t1/resources/nodes")
+    assert code == 200 and [o["metadata"]["name"] for o in lst["items"]] == ["t1-node"]
+    code, lst = _req(p, "GET", "/api/v1/resources/nodes")
+    assert code == 200 and lst["items"] == []
+    assert di.cluster_store.list("nodes") == []
+
+    # header routing reaches the same container
+    code, lst = _req(p, "GET", "/api/v1/resources/nodes", headers={"X-KSS-Session": "t1"})
+    assert code == 200 and [o["metadata"]["name"] for o in lst["items"]] == ["t1-node"]
+    code, _ = _req(p, "GET", "/api/v1/resources/nodes", headers={"X-KSS-Session": "ghost"})
+    assert code == 404
+
+    code, _ = _req(p, "DELETE", "/api/v1/sessions/t1")
+    assert code == 200
+    code, _ = _req(p, "DELETE", "/api/v1/sessions/t1")
+    assert code == 404
+    code, _ = _req(p, "DELETE", "/api/v1/sessions/default")
+    assert code == 400
+
+
+def test_http_session_cap_is_429(monkeypatch):
+    monkeypatch.setenv("KSS_MAX_SESSIONS", "1")
+    di = DIContainer(use_batch="off")
+    srv = SimulatorServer(di, port=0, kube_api_port=0)
+    srv.start(background=True)
+    try:
+        code, _ = _req(srv.port, "POST", "/api/v1/sessions", {"id": "t1"})
+        assert code == 201
+        code, body = _req(srv.port, "POST", "/api/v1/sessions", {"id": "t2"})
+        assert code == 429 and "KSS_MAX_SESSIONS" in json.dumps(body)
+    finally:
+        srv.shutdown()
+
+
+def test_http_kube_api_session_routing(server):
+    srv, _di = server
+    _req(srv.port, "POST", "/api/v1/sessions", {"id": "k1"})
+    kp = srv.kube_api_port
+    code, _ = _req(kp, "POST", "/sessions/k1/api/v1/nodes", {"metadata": {"name": "kn"}})
+    assert code == 201
+    code, lst = _req(kp, "GET", "/sessions/k1/api/v1/nodes")
+    assert code == 200 and [o["metadata"]["name"] for o in lst["items"]] == ["kn"]
+    code, lst = _req(kp, "GET", "/api/v1/nodes")
+    assert code == 200 and lst["items"] == []
+    code, _ = _req(kp, "GET", "/sessions/ghost/api/v1/nodes")
+    assert code == 404
+
+
+def test_http_session_metrics_labels_and_default_purity(server):
+    srv, _di = server
+    p = srv.port
+    code, before = _req(p, "GET", "/metrics")
+    assert code == 200
+    # an unused session plane leaves the default scrape byte-identical:
+    # no session labels, no session-plane series
+    assert 'session="' not in before and "simulator_sessions_active" not in before
+
+    _req(p, "POST", "/api/v1/sessions", {"id": "m1"})
+    code, labeled = _req(p, "GET", "/api/v1/sessions/m1/metrics")
+    assert code == 200 and 'session="m1"' in labeled
+
+    code, after = _req(p, "GET", "/metrics")
+    assert code == 200
+    assert "simulator_sessions_active 1" in after
+    assert "simulator_substrate_fn_entries" in after
+
+
+def test_http_simulator_kinds_disabled_in_sessions(server):
+    srv, _di = server
+    _req(srv.port, "POST", "/api/v1/sessions", {"id": "nosim"})
+    code, _ = _req(srv.port, "GET", "/api/v1/sessions/nosim/resources/simulators")
+    assert code == 404
+    # ...but still served by the default session
+    code, _ = _req(srv.port, "GET", "/api/v1/resources/simulators")
+    assert code == 200
