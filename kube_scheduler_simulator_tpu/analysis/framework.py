@@ -96,6 +96,7 @@ class SourceFile:
         for slug, rule in (
             ("kss_dtype", "KSS-DTYPE"),
             ("kss_host_sync", "KSS-HOST-SYNC"),
+            ("kss_hot_render", "KSS-HOT-RENDER"),
             ("kss_donate", "KSS-DONATE"),
             ("kss_env", "KSS-ENV"),
             ("kss_lock", "KSS-LOCK"),
@@ -303,9 +304,10 @@ def default_rules() -> "list[Rule]":
     from kube_scheduler_simulator_tpu.analysis.rules_dtype import DtypeRule
     from kube_scheduler_simulator_tpu.analysis.rules_env import EnvRule
     from kube_scheduler_simulator_tpu.analysis.rules_host_sync import HostSyncRule
+    from kube_scheduler_simulator_tpu.analysis.rules_hot_render import HotRenderRule
     from kube_scheduler_simulator_tpu.analysis.rules_lock import LockRule
 
-    return [DtypeRule(), HostSyncRule(), DonateRule(), EnvRule(), LockRule()]
+    return [DtypeRule(), HostSyncRule(), HotRenderRule(), DonateRule(), EnvRule(), LockRule()]
 
 
 def run_analysis(

@@ -592,7 +592,13 @@ class _PendingRun:
         if failed is None:
             pool.breaker.success()
             return out
-        if self._redispatched == 0 and pool._handle_worker_failure(*failed):
+        # supervision runs on EVERY failed wait — a retried wave's second
+        # failure must still tear down / respawn the ensemble, or a stale
+        # reply frame from the aborted dispatch sits in a worker's pipe
+        # and corrupts the NEXT wave's first read (the load-dependent
+        # flake the single-worker e2e used to hit under CPU contention)
+        recovered = pool._handle_worker_failure(*failed)
+        if self._redispatched == 0 and recovered:
             # the in-flight wave was abandoned pre-commit; re-dispatch
             # it whole on the fresh ensemble (one retry — a second
             # failure falls back to the in-process path for the wave)

@@ -244,31 +244,50 @@ class ResultStore:
 
     # ------------------------------------------------------------------ read
 
+    @staticmethod
+    def _result_locked(e: dict) -> dict[str, str]:
+        # annotation keys are the shared ``anno`` constants and the
+        # marshal memos return THE SAME str object for category maps
+        # shared across a wave's pods — the per-pod dict here is fresh,
+        # but everything inside it is interned
+        out = {
+            anno.PREFILTER_RESULT: _memo_marshal(e["preFilterResult"]),
+            anno.PREFILTER_STATUS_RESULT: _memo_marshal(e["preFilterStatus"]),
+            anno.FILTER_RESULT: _pre_or_marshal(e["filter"]),
+            anno.POSTFILTER_RESULT: _memo_marshal(e["postFilter"]),
+            anno.PRESCORE_RESULT: _memo_marshal(e["preScore"]),
+            anno.SCORE_RESULT: _pre_or_marshal(e["score"]),
+            anno.FINALSCORE_RESULT: _pre_or_marshal(e["finalScore"]),
+            anno.RESERVE_RESULT: _memo_marshal(e["reserve"]),
+            anno.PERMIT_TIMEOUT_RESULT: _memo_marshal(e["permitTimeout"]),
+            anno.PERMIT_STATUS_RESULT: _memo_marshal(e["permit"]),
+            anno.PREBIND_RESULT: _memo_marshal(e["prebind"]),
+            anno.BIND_RESULT: _memo_marshal(e["bind"]),
+        }
+        for key, val in e["custom"].items():
+            out.setdefault(key, val)
+        out[anno.SELECTED_NODE] = e["selectedNode"]
+        return out
+
+    @staticmethod
+    def _escs_locked(e: dict) -> dict[str, str]:
+        out = {}
+        for cat, key in (
+            ("filter", anno.FILTER_RESULT),
+            ("score", anno.SCORE_RESULT),
+            ("finalScore", anno.FINALSCORE_RESULT),
+        ):
+            v = e[cat]
+            if isinstance(v, tuple) and v[1] is not None:
+                out[key] = v[1]
+        return out
+
     def get_stored_result(self, pod: Obj) -> dict[str, str]:
         """The annotation map (reference GetStoredResult, store.go:133-198)."""
         with self._mu:
             k = self._key(pod["metadata"].get("namespace", "default"), pod["metadata"]["name"])
             e = self._results.get(k)
-            if e is None:
-                return {}
-            out = {
-                anno.PREFILTER_RESULT: _memo_marshal(e["preFilterResult"]),
-                anno.PREFILTER_STATUS_RESULT: _memo_marshal(e["preFilterStatus"]),
-                anno.FILTER_RESULT: _pre_or_marshal(e["filter"]),
-                anno.POSTFILTER_RESULT: _memo_marshal(e["postFilter"]),
-                anno.PRESCORE_RESULT: _memo_marshal(e["preScore"]),
-                anno.SCORE_RESULT: _pre_or_marshal(e["score"]),
-                anno.FINALSCORE_RESULT: _pre_or_marshal(e["finalScore"]),
-                anno.RESERVE_RESULT: _memo_marshal(e["reserve"]),
-                anno.PERMIT_TIMEOUT_RESULT: _memo_marshal(e["permitTimeout"]),
-                anno.PERMIT_STATUS_RESULT: _memo_marshal(e["permit"]),
-                anno.PREBIND_RESULT: _memo_marshal(e["prebind"]),
-                anno.BIND_RESULT: _memo_marshal(e["bind"]),
-            }
-            for key, val in e["custom"].items():
-                out.setdefault(key, val)
-            out[anno.SELECTED_NODE] = e["selectedNode"]
-            return out
+            return {} if e is None else self._result_locked(e)
 
     def get_stored_escs(self, pod: Obj) -> dict[str, str]:
         """History-escaped twins for the (pair-form) batch categories of
@@ -277,18 +296,29 @@ class ResultStore:
         with self._mu:
             k = self._key(pod["metadata"].get("namespace", "default"), pod["metadata"]["name"])
             e = self._results.get(k)
-            if e is None:
-                return {}
-            out = {}
-            for cat, key in (
-                ("filter", anno.FILTER_RESULT),
-                ("score", anno.SCORE_RESULT),
-                ("finalScore", anno.FINALSCORE_RESULT),
-            ):
-                v = e[cat]
-                if isinstance(v, tuple) and v[1] is not None:
-                    out[key] = v[1]
-            return out
+            return {} if e is None else self._escs_locked(e)
+
+    def drain_wave_results(self, pods: "list[Obj]") -> "list[tuple[dict, dict] | None]":
+        """Columnar read-and-delete for a whole commit wave under ONE
+        lock acquisition: a list aligned with ``pods`` whose cells are
+        ``None`` (no results for that pod) or an owned ``(results,
+        escs)`` pair — exactly ``get_stored_result`` +
+        ``get_stored_escs`` + ``delete_data``, without the four per-pod
+        lock round-trips each.  The reflector's wave flush consumes the
+        cells in place (built fresh here, never aliased into the
+        store)."""
+        out: "list[tuple[dict, dict] | None]" = []
+        with self._mu:
+            for pod in pods:
+                k = self._key(
+                    pod["metadata"].get("namespace", "default"),
+                    pod["metadata"]["name"],
+                )
+                e = self._results.pop(k, None)
+                out.append(
+                    None if e is None else (self._result_locked(e), self._escs_locked(e))
+                )
+        return out
 
     def has_result(self, pod: Obj) -> bool:
         with self._mu:

@@ -13,6 +13,7 @@ for the kube-backed adapter.
 from __future__ import annotations
 
 import json
+from sys import intern
 from typing import Any
 
 from kube_scheduler_simulator_tpu.native import fastjson as _fastjson
@@ -171,31 +172,65 @@ class StoreReflector:
         mid-wave conflict (the per-pod path's retry_on_conflict case)
         cannot occur; pods deleted since the kernel decided are skipped,
         exactly as flush_pod's vanished-pod path does."""
-        muts: list[tuple[str, str, Any]] = []
-        keys: list[str] = []
+        wave: list[Obj] = []
+        wave_keys: list[str] = []
         for pod in pods:
             ns = pod["metadata"].get("namespace", "default")
             name = pod["metadata"]["name"]
-            key = f"{ns}/{name}"
+            # interned: the same pods retry across waves, and the key
+            # doubles as the _history_written index — one str object
+            # per pod for the store's whole lifetime
+            key = intern(f"{ns}/{name}")
             if key in self._in_flush:
                 continue
-            merged: dict[str, str] = {}
-            escs: dict[str, str] = {}
-            had_any = False
-            for store in self._stores.values():
-                if not store.has_result(pod):
-                    continue
-                result = store.get_stored_result(pod)
-                if result:
-                    had_any = True
-                    merged.update(result)
-                    getter = getattr(store, "get_stored_escs", None)
-                    if getter is not None:
-                        escs.update(getter(pod))
-            if not had_any:
+            wave.append(pod)
+            wave_keys.append(key)
+        if not wave:
+            return
+        # columnar drain: ONE lock round-trip per result store for the
+        # whole wave (get_stored_result + escs + delete_data fused),
+        # cells owned by this frame.  Foreign duck-typed stores without
+        # the wave API keep the per-pod path, in registration order so
+        # later stores still override earlier keys.
+        stores = list(self._stores.values())
+        cols: list[Any] = [
+            drain(wave)
+            if (drain := getattr(store, "drain_wave_results", None)) is not None
+            else store
+            for store in stores
+        ]
+        muts: list[tuple[str, str, Any]] = []
+        keys: list[str] = []
+        for i, pod in enumerate(wave):
+            ns = pod["metadata"].get("namespace", "default")
+            name = pod["metadata"]["name"]
+            key = wave_keys[i]
+            merged: "dict[str, str] | None" = None
+            escs: "dict[str, str] | None" = None
+            for col in cols:
+                if isinstance(col, list):
+                    cell = col[i]
+                    if cell is None:
+                        continue
+                    if merged is None:
+                        merged, escs = cell  # owned: adopt without copy
+                    else:
+                        merged.update(cell[0])
+                        escs.update(cell[1])
+                elif col.has_result(pod):
+                    result = col.get_stored_result(pod)
+                    if result:
+                        if merged is None:
+                            merged, escs = {}, {}
+                        merged.update(result)
+                        getter = getattr(col, "get_stored_escs", None)
+                        if getter is not None:
+                            escs.update(getter(pod))
+            if merged is None:
                 continue
-            for store in self._stores.values():
-                store.delete_data(pod)
+            for col, store in zip(cols, stores):
+                if col is store:  # drained cols already popped their data
+                    store.delete_data(pod)
 
             def mutate(cur: Obj, key=key, merged=merged, escs=escs) -> Obj:
                 # copy-on-write along the changed path only (bulk_update's

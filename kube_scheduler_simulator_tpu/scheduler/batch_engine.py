@@ -1990,6 +1990,18 @@ class BatchEngine:
             # lost wave so /metrics can tell policy from incident.
             if not (pool.dead and pool.breaker.state == pool.breaker.OPEN):
                 procmesh.count_run_fallback("worker_lost")
+            # Deterministic in-wave retry: rebuild the LOCAL executable
+            # and finish the wave with the same dp.  donate=False is
+            # load-bearing, not a pessimization — the wave's planes were
+            # already tree-mapped to host numpy for the ensemble, and the
+            # caller still holds dp for this very call; a donating
+            # executable would consume those bank-resident buffers and a
+            # contention-retried wave could not re-run them.  The retry
+            # is counted per-seam so /metrics distinguishes "ensemble
+            # lost, wave still served locally" from a silent slow path.
+            from kube_scheduler_simulator_tpu.resilience.policy import note_retry
+
+            note_retry("procmesh_local_rebuild")
             local = eng._aot.load_scan(meta, donate=False) if eng._aot else None
             if local is None:
                 local = B.build_batch_fn(cfg, dims, donate=False, ws0=ws0)

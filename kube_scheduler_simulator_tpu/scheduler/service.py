@@ -253,6 +253,11 @@ class SchedulerService:
         from kube_scheduler_simulator_tpu.ops.profile import WaveProfiler
 
         self.profiler = WaveProfiler()
+        # the store stamps its mutation bodies (store_mutate /
+        # journal_append) against the same profiler, ambiently — into
+        # the open wave record when one is current, else the orphan
+        # aggregate (ops/profile.py)
+        self.cluster_store.profiler = self.profiler
         # stream quiesce machinery (pause_streams): an exclusive store
         # operation — snapshot load, boot recovery — drains every active
         # StreamSession to a wave boundary (counted per reason) and holds
@@ -703,6 +708,7 @@ class SchedulerService:
         return [p for p in cands if _pod_key(p) in ready]
 
     def build_snapshot(self) -> Snapshot:
+        t0 = time.perf_counter()
         snap = Snapshot(
             self.cluster_store.list("nodes", copy_objects=False),
             self.cluster_store.list("pods", copy_objects=False),
@@ -714,6 +720,9 @@ class SchedulerService:
         for fw in self.frameworks.values():
             for w in fw.waiting_pods.values():
                 snap.assume(w.pod, w.node_name)
+        # snapshot builds run between wave records on the windowed path —
+        # ambient: the open record when current, else the orphan aggregate
+        self.profiler.ambient("snapshot_rv", time.perf_counter() - t0)
         return snap
 
     def _pods_with_waiting_assumed(self) -> list[Obj]:
@@ -969,7 +978,11 @@ class SchedulerService:
         sequential path does per pod."""
         fw0 = self.framework
         assert fw0 is not None
+        tq = time.perf_counter()
         pending_all = fw0.sort_pods(self._ready_pending(respect_backoff))
+        # queue drain + QueueSort on the direct path runs between wave
+        # records — ambient stamp (orphan aggregate; ops/profile.py)
+        self.profiler.ambient("queue_maint", time.perf_counter() - tq)
         if not pending_all:
             return {}
         nodes = self.cluster_store.list("nodes", copy_objects=False)
@@ -1767,10 +1780,13 @@ class SchedulerService:
             bound.append((pod, ns, name, node_name))
         t_commit = time.perf_counter()
         prof.note(prof_rec, "annotate", t_commit - t_ann)
-        # ambient record for the ResultStore's own sub-stamp (its merge
-        # time reports as the informational "resultstore_s" series,
-        # INSIDE the commit stage — not a stage itself)
+        # ambient record for the store's mutation stamps (store_mutate /
+        # journal_append carve out of the commit interval below) and the
+        # ResultStore's own sub-stamp (its merge time reports as the
+        # informational "resultstore_s" series, INSIDE the commit stage —
+        # not a stage itself)
         rs.profiler = prof
+        nested0 = prof.nested(prof_rec)
         prof.current = prof_rec
         try:
             rs.add_wave_results(entries)
@@ -1794,7 +1810,10 @@ class SchedulerService:
                 )
         finally:
             prof.current = None
-        prof.note(prof_rec, "commit", time.perf_counter() - t_commit)
+        # the commit stamp is EXCLUSIVE of the store_mutate/journal_append
+        # seconds the block's mutations carved out — the stage vector
+        # stays a partition of the wall
+        prof.note_excl(prof_rec, "commit", time.perf_counter() - t_commit, nested0)
         prof.close(prof_rec, pods=len(js))
 
     def _commit_batch_pod(

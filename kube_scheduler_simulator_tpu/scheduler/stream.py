@@ -191,11 +191,19 @@ class StreamSession:
         ):
             self._feed_alive = bool(self.feed(self._tick))
             self._tick += 1
+        # queue maintenance carve-out: waiting-pod processing, backoff
+        # gates and QueueSort stamp as their own stage (exclusive of any
+        # store mutations they trigger — those stamp store_mutate)
+        prof = svc.profiler
+        rec = prof.current
+        tq = time.perf_counter()
+        n0 = prof.nested(rec)
         svc.process_waiting_pods()
         cands = svc._ready_pending(respect_backoff=False)
         if exclude:
             cands = [p for p in cands if _pod_key(p) not in exclude]
         pending = svc.framework.sort_pods(cands)
+        prof.note_excl(rec, "queue_maint", time.perf_counter() - tq, n0)
         if self.wave_pods is not None:
             pending = pending[: self.wave_pods]
         return pending
@@ -495,18 +503,28 @@ class StreamSession:
                 # simply dropped — nothing aggregates before note()
                 rec = svc.profiler.open()
                 ta = time.perf_counter()
-                pending = self._admit(frozenset())
+                # ambient record: the feed tick's store creates and the
+                # queue carve-out stamp into THIS wave while it admits
+                svc.profiler.current = rec
+                try:
+                    pending = self._admit(frozenset())
+                    gate = volumes = nodes = None
+                    if pending:
+                        nodes = svc.cluster_store.list("nodes", copy_objects=False)
+                        gate, volumes = self._gate(pending, nodes)
+                finally:
+                    svc.profiler.current = None
                 if not pending:
                     if not self._admitting():
                         break
                     time.sleep(self.idle_sleep_s)
                     continue
-                nodes = svc.cluster_store.list("nodes", copy_objects=False)
-                gate, volumes = self._gate(pending, nodes)
                 if gate is not None:
                     self._drain_round(gate)
                     continue
-                svc.profiler.note(rec, "admit", time.perf_counter() - ta)
+                # exclusive of the sub-stages carved out above — the
+                # record's stage vector stays a partition of its wall
+                svc.profiler.note_excl(rec, "admit", time.perf_counter() - ta)
                 fw = svc.framework
                 try:
                     flight = self._dispatch(
@@ -583,10 +601,16 @@ class StreamSession:
             elif self.streaming and self._waves_left(in_flight=1):
                 rec2 = svc.profiler.open()
                 ta2 = time.perf_counter()
-                pending2 = self._admit(flight["keys"])
+                svc.profiler.current = rec2
+                try:
+                    pending2 = self._admit(flight["keys"])
+                    gate = volumes = nodes = None
+                    if pending2:
+                        nodes = svc.cluster_store.list("nodes", copy_objects=False)
+                        gate, volumes = self._gate(pending2, nodes)
+                finally:
+                    svc.profiler.current = None
                 if pending2:
-                    nodes = svc.cluster_store.list("nodes", copy_objects=False)
-                    gate, volumes = self._gate(pending2, nodes)
                     if gate is None and self._node_fp(nodes) != flight["node_fp"]:
                         # the cluster changed under the in-flight wave:
                         # drain the pipeline (commit first, re-encode on
@@ -607,7 +631,7 @@ class StreamSession:
                             if s >= 0:
                                 binds[_pod_key(p)] = pb.node_names[s]
                         fw = flight["fw"]
-                        svc.profiler.note(
+                        svc.profiler.note_excl(
                             rec2, "admit", time.perf_counter() - ta2
                         )
                         t0 = time.perf_counter()

@@ -32,6 +32,17 @@ class DIContainer:
         journal_dir: "str | None" = None,
     ):
         self.cluster_store = cluster_store or ClusterStore()
+        # render-once wire-bytes cache (server/wirecache.py): every
+        # list/watch/get consumer of this store shares one render per
+        # object version.  KSS_WIRECACHE=0 keeps the pre-cache render
+        # path byte-for-byte; invalidation hooks live in the store.
+        from kube_scheduler_simulator_tpu.server.wirecache import (
+            WireCache,
+            wirecache_enabled,
+        )
+
+        if wirecache_enabled() and self.cluster_store.wirecache is None:
+            self.cluster_store.wirecache = WireCache()
         # Durability boot (opt-in via KSS_JOURNAL_DIR, state/journal.py):
         # recover any prior crash state into the store BEFORE any
         # component subscribes (replay must not fire watch callbacks),
@@ -97,6 +108,9 @@ class DIContainer:
             autoscale=autoscale,
             autoscaler_opts=autoscaler_opts,
         )
+        if self.cluster_store.wirecache is not None:
+            # miss renders stamp the profiler's watch_render stage
+            self.cluster_store.wirecache.profiler = self._scheduler_service.profiler
         if self._journal is not None:
             from kube_scheduler_simulator_tpu.state.recovery import (
                 scheduler_meta_provider,

@@ -258,7 +258,9 @@ def _make_handler(server: KubeAPIServer):
             pass
 
         def _send_json(self, code: int, body: Obj) -> None:
-            data = json.dumps(body).encode()
+            self._send_raw(code, json.dumps(body).encode())
+
+        def _send_raw(self, code: int, data: bytes) -> None:
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
@@ -385,6 +387,33 @@ def _make_handler(server: KubeAPIServer):
                             return
                         self._watch(store, rt, rv, sel)
                     else:
+                        wc = store.wirecache
+                        if wc is not None:
+                            # render-once path: live objects (no deep
+                            # copies — they're frozen by the store's
+                            # replacement contract), per-item bytes from
+                            # the cache, the List document spliced —
+                            # byte-identical to the json.dumps below
+                            items = store.list(
+                                rt.store_kind, rt.namespace, copy_objects=False
+                            )
+                            if sel is not None:
+                                items = [o for o in items if sel(o)]
+                            self._send_raw(
+                                200,
+                                wc.list_doc(
+                                    f"{rt.kind}List",
+                                    rt.api_version,
+                                    str(store.resource_version),
+                                    [
+                                        wc.obj_json(
+                                            rt.store_kind, o, rt.api_version, rt.kind
+                                        )
+                                        for o in items
+                                    ],
+                                ),
+                            )
+                            return
                         items = store.list(rt.store_kind, rt.namespace)
                         if sel is not None:
                             items = [o for o in items if sel(o)]
@@ -398,6 +427,14 @@ def _make_handler(server: KubeAPIServer):
                             },
                         )
                 else:
+                    wc = store.wirecache
+                    if wc is not None:
+                        with store.lock:
+                            obj = store._get_internal(rt.store_kind, rt.name, rt.namespace)
+                        self._send_raw(
+                            200, wc.obj_json(rt.store_kind, obj, rt.api_version, rt.kind).encode()
+                        )
+                        return
                     obj = store.get(rt.store_kind, rt.name, rt.namespace)
                     self._send_json(200, envelope(obj, rt.api_version, rt.kind))
             except NotFoundError as e:
@@ -436,11 +473,26 @@ def _make_handler(server: KubeAPIServer):
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
 
+                wc = store.wirecache
+
                 def write_event(type_: str, obj: Obj) -> None:
-                    line = (
-                        json.dumps({"type": type_, "object": envelope(obj, rt.api_version, rt.kind)})
-                        + "\n"
-                    ).encode()
+                    if wc is not None:
+                        # shared render across every watcher of this
+                        # object version; DELETED bytes are rendered but
+                        # never cached (their entry was just purged and
+                        # has no future readers)
+                        line = wc.event_line(
+                            type_,
+                            wc.obj_json(
+                                rt.store_kind, obj, rt.api_version, rt.kind,
+                                insert=type_ != "DELETED",
+                            ),
+                        )
+                    else:
+                        line = (
+                            json.dumps({"type": type_, "object": envelope(obj, rt.api_version, rt.kind)})
+                            + "\n"
+                        ).encode()
                     self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
                     self.wfile.flush()
 
@@ -450,7 +502,12 @@ def _make_handler(server: KubeAPIServer):
                     # with the list so queued events from the subscribe/list
                     # window aren't replayed twice out of order
                     with store.lock:
-                        items = store.list(rt.store_kind, rt.namespace)
+                        # with the wire cache on, render from the live
+                        # (frozen) objects — the sweep's bytes seed the
+                        # cache every later consumer shares
+                        items = store.list(
+                            rt.store_kind, rt.namespace, copy_objects=wc is None
+                        )
                         rv = store.resource_version
                     for o in items:
                         if sel is None or sel(o):

@@ -406,6 +406,16 @@ def render_metrics(di: Any, session: "str | None" = None, sessions: Any = None) 
                     typ="gauge",
                 )
 
+    # render-once wire-bytes cache (server/wirecache.py) — present only
+    # once a DI container attached one (KSS_WIRECACHE=0 leaves it None)
+    wc = getattr(di.cluster_store, "wirecache", None)
+    if wc is not None:
+        wcs = wc.stats()
+        counter("wirecache_hits_total", "Wire renders served from the render-once byte cache (list items, watch events, single GETs).", wcs["hits"])
+        counter("wirecache_misses_total", "Wire renders that had to json.dumps (first serve of an object version per groupVersion).", wcs["misses"])
+        counter("wirecache_invalidations_total", "Cache entries purged by store mutations/replays (delete counts once; clear_for_replay counts each).", wcs["invalidations"])
+        counter("wirecache_entries", "Object versions currently cached.", wcs["entries"], typ="gauge")
+
     # journal shipping / read replica (replication/) — present only on
     # a store fed by a ReplicaApplier (stays None on a primary)
     rep = getattr(di.cluster_store, "replication_stats", None)

@@ -181,6 +181,43 @@ def _rfc3339(ts: float) -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
 
 
+def _profiled(fn):
+    """Stamp a mutating entry point as ``store_mutate`` (minus the
+    journal bytes inside it, carved out as ``journal_append``) against
+    the wave profiler's ambient record — nested entry points (patch ->
+    update, apply -> create) stamp once at the outermost frame, tracked
+    per thread so concurrent HTTP mutators can't cross-talk.  With no
+    profiler attached (``store.profiler is None``) the wrapper is two
+    attribute reads."""
+
+    def wrapper(self, *args, **kwargs):
+        prof = self.profiler
+        if prof is None or not prof.enabled:
+            return fn(self, *args, **kwargs)
+        tl = self._stamp_tl
+        if getattr(tl, "depth", 0):
+            return fn(self, *args, **kwargs)
+        tl.depth = 1
+        t0 = time.perf_counter()
+        j0 = self._journal_s
+        try:
+            return fn(self, *args, **kwargs)
+        finally:
+            tl.depth = 0
+            dt = time.perf_counter() - t0
+            dj = self._journal_s - j0
+            if dj > 0.0:
+                prof.ambient("journal_append", dj)
+                dt -= dj
+            if dt > 0.0:
+                prof.ambient("store_mutate", dt)
+
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    wrapper.__wrapped__ = fn
+    return wrapper
+
+
 # kube's generateName suffix alphabet (no vowels/ambiguous chars)
 _SUFFIX_ALPHABET = "bcdfghjklmnpqrstvwxz2456789"
 
@@ -218,6 +255,16 @@ class ClusterStore:
         # live journal-shipping counters (replication/apply.py): set by a
         # ReplicaApplier feeding this store; stays None on a primary
         self.replication_stats: "dict[str, Any] | None" = None
+        # wave profiler seam (ops/profile.py): SchedulerService points
+        # this at its profiler so mutating entry points stamp
+        # store_mutate/journal_append; None = unprofiled store, zero cost
+        self.profiler: Any = None
+        self._journal_s = 0.0  # cumulative journal-append seconds
+        self._stamp_tl = threading.local()  # per-thread _profiled depth
+        # render-once wire-bytes cache (server/wirecache.py), attached by
+        # the serving layer; the store's only duty is invalidation on
+        # mutation/replay so stale bytes can never be served
+        self.wirecache: Any = None
         # per-THREAD transaction buffer: a journal_txn groups only the
         # events its own thread emits (other threads' concurrent
         # mutations are their own transactions), and holding no lock
@@ -285,7 +332,9 @@ class ClusterStore:
         # lock-free: self.journal is written once at attach (boot) and
         # never cleared; the append itself takes the store lock inside
         if self.journal is not None:
+            t0 = time.perf_counter()
             self.journal.append(rtype, extra=extra)
+            self._journal_s += time.perf_counter() - t0
 
     @contextlib.contextmanager
     def journal_txn(self, label: str = "txn"):
@@ -326,7 +375,9 @@ class ClusterStore:
                 with self._lock:
                     self._active_txns -= 1
                     if events:
+                        t0 = time.perf_counter()
                         self.journal.append(label, events=events)
+                        self._journal_s += time.perf_counter() - t0
 
     def durability_counters(self) -> dict[str, int]:
         """The store counters a byte-identical recovery must restore
@@ -355,6 +406,8 @@ class ClusterStore:
             meta = o.setdefault("metadata", {})
             if kind in NAMESPACED_KINDS:
                 meta.setdefault("namespace", "default")
+            if self.wirecache is not None:
+                self.wirecache.invalidate(kind, meta, deleted=False)
             self._bucket(kind)[_key(o)] = o
             rv = int(meta.get("resourceVersion") or 0)
             self._rv = max(self._rv, rv)
@@ -372,13 +425,19 @@ class ClusterStore:
             bucket = self._bucket(kind)
             o = _clone(dict(obj))
             k = _key(o)
+            if self.wirecache is not None:
+                self.wirecache.invalidate(
+                    kind, o.get("metadata") or {}, deleted=type_ == EVENT_DELETED
+                )
             if type_ == EVENT_DELETED:
                 bucket.pop(k, None)
             else:
                 bucket[k] = o
             rv = int(o["metadata"].get("resourceVersion") or 0)
             self._rv = max(self._rv, rv)
-            ev = Event(kind, type_, _clone(o), rv)
+            # the event shares the replayed object (frozen once placed —
+            # same replacement contract as _emit)
+            ev = Event(kind, type_, o, rv)
             log = self._event_log[kind]
             if log.maxlen is not None and len(log) == log.maxlen:
                 self._evicted_rv[kind] = log[0].resource_version
@@ -395,6 +454,8 @@ class ClusterStore:
         are kept — ``restore_durability_counters`` max-merges, so the
         resourceVersions connected watchers hold never regress."""
         with self._lock:
+            if self.wirecache is not None:
+                self.wirecache.clear()
             for kind in KINDS:
                 self._objs[kind].clear()
                 self._event_log[kind].clear()
@@ -409,13 +470,19 @@ class ClusterStore:
                 self._evicted_rv[kind] = max(self._evicted_rv[kind], int(rv))
 
     def _emit(self, kind: str, type_: str, obj: Obj, old: Obj | None = None) -> None:
-        # ONE clone serves the event log, subscribers, and update hooks:
-        # consumers receive a shared read-only snapshot (all in-tree
-        # consumers serialize or read it; mutating it would corrupt the
-        # event log, exactly as mutating an informer-cache object would).
+        # ZERO clones: the event shares the stored object itself as a
+        # read-only snapshot.  Safe by the store's own replacement
+        # contract — mutations never write into a stored object in
+        # place, they replace the bucket entry with a fresh dict (update/
+        # bulk_update/patch all rebuild; delete clones before stamping) —
+        # so the object an event references is frozen for its lifetime,
+        # exactly like an informer-cache object.  Consumers serialize or
+        # read it; mutating it would corrupt the event log AND the store.
         # ``old`` is the replaced object the store no longer references,
-        # so it needs no copy at all.
-        ev = Event(kind, type_, _clone(obj), int(obj["metadata"]["resourceVersion"]), old_obj=old)
+        # so it needs no copy either.
+        if self.wirecache is not None:
+            self.wirecache.invalidate(kind, obj["metadata"], deleted=type_ == EVENT_DELETED)
+        ev = Event(kind, type_, obj, int(obj["metadata"]["resourceVersion"]), old_obj=old)
         log = self._event_log[kind]
         if log.maxlen is not None and len(log) == log.maxlen:
             self._evicted_rv[kind] = log[0].resource_version
@@ -440,7 +507,9 @@ class ClusterStore:
             if getattr(self._txn_local, "depth", 0) > 0:
                 self._txn_local.events.append(triple)
             else:
+                t0 = time.perf_counter()
                 self.journal.append("event", events=[triple])
+                self._journal_s += time.perf_counter() - t0
 
     def subscribe(self, kinds: Iterable[str], cb: Callable[[Event], None]) -> Callable[[], None]:
         """Register a synchronous event callback; returns an unsubscribe fn."""
@@ -506,10 +575,16 @@ class ClusterStore:
         except KeyError:
             raise NotFoundError(f"unknown resource kind {kind!r}") from None
 
-    def create(self, kind: str, obj: Mapping[str, Any]) -> Obj:
+    @_profiled
+    def create(self, kind: str, obj: Mapping[str, Any], owned: bool = False) -> Obj:
+        """``owned=True``: the caller transfers ownership of ``obj`` (a
+        fresh dict it drops after the call — a parsed request body, a
+        generator's output) — skips the defensive input clone AND the
+        return clone: the caller receives the stored object itself and
+        must treat it as read-only."""
         with self._lock:
             bucket = self._bucket(kind)
-            o = _clone(dict(obj))
+            o = dict(obj) if owned else _clone(dict(obj))
             meta = o.setdefault("metadata", {})
             if kind in NAMESPACED_KINDS:
                 meta.setdefault("namespace", "default")
@@ -540,7 +615,7 @@ class ClusterStore:
                 self._admit_priority(o)
             bucket[k] = o
             self._emit(kind, EVENT_ADDED, o)
-            return _clone(o)
+            return o if owned else _clone(o)
 
     # The ONE admission plugin the reference keeps enabled is Priority
     # (reference simulator/k8sapiserver/k8sapiserver.go:158-163): it
@@ -577,6 +652,7 @@ class ClusterStore:
             raise ValueError(f"no PriorityClass with name {name} was found")
         spec["priority"] = int(pc.get("value") or 0)
 
+    @_profiled
     def update(self, kind: str, obj: Mapping[str, Any], owned: bool = False) -> Obj:
         """``owned=True``: the caller transfers ownership of ``obj`` (built
         from its own copy, dropped after the call) — skips the defensive
@@ -604,6 +680,7 @@ class ClusterStore:
             self._emit(kind, EVENT_MODIFIED, o, old=old)
             return _clone(o)
 
+    @_profiled
     def apply(self, kind: str, obj: Mapping[str, Any]) -> Obj:
         """Upsert, ignoring any stale uid/resourceVersion on the input.
 
@@ -623,6 +700,7 @@ class ClusterStore:
                 return self.update(kind, o, owned=True)
             return self.create(kind, o)
 
+    @_profiled
     def bulk_update(
         self,
         kind: str,
@@ -703,6 +781,8 @@ class ClusterStore:
                     if not allow_delete:
                         continue
                     del bucket[k]
+                    # hot-render-ok: the delete event's rv stamp must not
+                    # mutate the (shared, frozen) stored object
                     dead = _clone(cur)
                     dead["metadata"]["resourceVersion"] = str(self._next_rv())
                     events.append((EVENT_DELETED, dead, None))
@@ -719,6 +799,7 @@ class ClusterStore:
                 self._emit(kind, type_, o, old=old)
         return applied
 
+    @_profiled
     def patch(self, kind: str, name: str, patch: Mapping[str, Any], namespace: str | None = None) -> Obj:
         """Strategic-merge-lite patch: dicts merge recursively, None deletes."""
         with self._lock:
@@ -756,11 +837,14 @@ class ClusterStore:
         with self._lock:
             bucket = self._bucket(kind)
             return [
+                # hot-render-ok: compat default — copy_objects=False is
+                # the hot-path read every serving consumer opts into
                 (_clone(o) if copy_objects else o)
                 for _, o in sorted(bucket.items())
                 if namespace is None or o["metadata"].get("namespace") == namespace
             ]
 
+    @_profiled
     def delete(self, kind: str, name: str, namespace: str | None = None) -> Obj:
         with self._lock:
             obj = self._get_internal(kind, name, namespace)
@@ -776,12 +860,21 @@ class ClusterStore:
 
     # ----------------------------------------------------------- pod helpers
 
+    @_profiled
     def bind_pod(self, namespace: str, name: str, node_name: str) -> Obj:
         """Bind a pod to a node (the Binding-subresource POST of the
         reference's bind phase, SURVEY.md section 3.2)."""
         with self._lock:
-            pod = _clone(self._get_internal("pods", name, namespace))
-            pod.setdefault("spec", {})["nodeName"] = node_name
+            cur = self._get_internal("pods", name, namespace)
+            # copy-on-write along the changed path only: fresh top-level,
+            # metadata (update stamps uid/rv into it) and spec dicts;
+            # everything else — megabyte annotation maps included — is
+            # shared with the frozen previous version
+            pod = {
+                **cur,
+                "metadata": dict(cur["metadata"]),
+                "spec": {**(cur.get("spec") or {}), "nodeName": node_name},
+            }
             # The Binding subresource only sets spec.nodeName; with no kubelet
             # in the simulator, bound pods stay Pending (as in the reference).
             return self.update("pods", pod, owned=True)
@@ -790,6 +883,7 @@ class ClusterStore:
 
     def dump(self) -> dict[str, list[Obj]]:
         with self._lock:
+            # hot-render-ok: snapshot/reset surface, never the commit path
             return {k: [_clone(o) for _, o in sorted(b.items())] for k, b in self._objs.items()}
 
     def restore(self, data: Mapping[str, list[Obj]], preserve: "Iterable[str]" = ()) -> None:
@@ -851,4 +945,6 @@ def _merge(dst: dict[str, Any], patch: Mapping[str, Any]) -> None:
         elif isinstance(v, Mapping) and isinstance(dst.get(k), dict):
             _merge(dst[k], v)
         else:
+            # hot-render-ok: merge-patch semantics — the stored object
+            # must own its values, never alias the caller's patch body
             dst[k] = _clone(v)

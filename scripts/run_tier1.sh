@@ -77,9 +77,11 @@ if ! timeout -k 10 420 env JAX_PLATFORMS=cpu python scripts/tenant_smoke.py; the
 # Host-path perf smoke (docs/batch-engine.md "Where the wall goes"):
 # the fused streamed path vs the serial per-tick loop at smoke size,
 # min-of-3 walls, byte parity + per-wave stage profiles asserted, and
-# the fused/serial ratio pinned above a generous committed floor —
-# a host-path perf regression fails tier-1 loudly (scripts/perf_smoke.py;
-# bench cfg13-hostpath / BENCH_hostpath.json is the at-scale row).
+# the fused/serial ratio pinned above a generous committed floor, plus
+# the attribution-coverage invariant (named stages >= 95% of fused span)
+# — a host-path perf regression OR a new unattributed hot-path cost
+# fails tier-1 loudly (scripts/perf_smoke.py; bench cfg13b-hostpath-v2
+# / BENCH_hostpath.json is the at-scale row).
 if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/perf_smoke.py; then rc=1; fi
 # Kernel-contract checker (docs/static-analysis.md): FIRST the fixture
 # self-test (every rule must fire on its known-bad fixtures and stay

@@ -45,7 +45,7 @@ Obj = dict[str, Any]
 
 ROOT = repo_root()
 FIXDIR = os.path.join(ROOT, PACKAGE, "analysis", "fixtures")
-RULES = ("KSS-DTYPE", "KSS-HOST-SYNC", "KSS-DONATE", "KSS-ENV", "KSS-LOCK")
+RULES = ("KSS-DTYPE", "KSS-HOST-SYNC", "KSS-HOT-RENDER", "KSS-DONATE", "KSS-ENV", "KSS-LOCK")
 
 
 # ---------------------------------------------------------- fixture matrix

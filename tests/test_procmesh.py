@@ -210,7 +210,23 @@ def test_single_worker_ensemble_end_to_end(pm_state, monkeypatch, tmp_path):
     # every worker (a compile inside a worker is structurally impossible
     # — procmesh_worker.py has no build path)
     assert st["pool"]["scans_loaded"] >= 1
-    assert st["run_fallbacks_by_reason"] == {}, st
+    # Under CPU contention a worker wait may time out mid-run; the wave
+    # then finishes through the engine's counted donate=False local
+    # rebuild (parity already asserted above).  Deterministic either
+    # way: a quiet host shows zero run fallbacks; a loaded host shows
+    # ONLY contention verdicts, each matched by a counted local-rebuild
+    # retry — anything else (artifact_missing, breaker_open) still
+    # fails.
+    contention = {"worker_lost", "timeout"}
+    unexpected = {
+        r: n for r, n in st["run_fallbacks_by_reason"].items()
+        if r.split(":", 1)[0] not in contention
+    }
+    assert unexpected == {}, st
+    if st["run_fallbacks_by_reason"]:
+        from kube_scheduler_simulator_tpu.resilience.policy import retry_stats
+
+        assert retry_stats().get("procmesh_local_rebuild", 0) >= 1
 
 
 def test_multiprocess_ensemble_parity_or_loud_skip(pm_state, monkeypatch, tmp_path):

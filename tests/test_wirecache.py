@@ -1,0 +1,362 @@
+"""Render-once wire-bytes cache (server/wirecache.py): byte parity is
+the whole contract — every surface that serves cached bytes (single
+GET, List documents, watch-event lines) must emit EXACTLY what the
+pre-cache ``json.dumps`` render path emits, across rv bumps,
+label/annotation mutations, SSA and JSON-patch writes, per-session
+fan-out, and journal recovery.  Also pinned: the lookup's own
+resourceVersion compare (a stale entry can never serve even without an
+invalidation hook), DELETED renders never inserting, eviction,
+hit/miss/invalidation counters, and their /metrics wiring."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import urllib.request
+from typing import Any
+
+import pytest
+
+from kube_scheduler_simulator_tpu.server import DIContainer, SimulatorServer
+from kube_scheduler_simulator_tpu.server.wirecache import WireCache, wirecache_enabled
+from kube_scheduler_simulator_tpu.state.store import ClusterStore
+
+Obj = dict[str, Any]
+
+
+def _env(obj: Obj, api_version: str, kind: str) -> Obj:
+    # the HTTP layer's envelope, verbatim (server/kubeapi.py)
+    out = dict(obj)
+    out.setdefault("apiVersion", api_version)
+    out.setdefault("kind", kind)
+    return out
+
+
+def _uncached_obj(obj: Obj, api_version: str, kind: str) -> bytes:
+    return json.dumps(_env(obj, api_version, kind)).encode()
+
+
+def _uncached_list(store, store_kind: str, api_version: str, kind: str,
+                   namespace: "str | None" = None) -> bytes:
+    with store.lock:
+        items = store.list(store_kind, namespace)
+        rv = store.resource_version
+    return json.dumps(
+        {
+            "kind": f"{kind}List",
+            "apiVersion": api_version,
+            "metadata": {"resourceVersion": str(rv)},
+            "items": [_env(o, api_version, kind) for o in items],
+        }
+    ).encode()
+
+
+def _raw(port: int, method: str, path: str, body: Any = None,
+         ctype: str = "application/json"):
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method,
+        headers={"Content-Type": ctype},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+def _pod(name: str, **labels) -> Obj:
+    return {
+        "metadata": {"name": name, "namespace": "default",
+                     "labels": dict(labels) or {"app": "a"}},
+        "spec": {"containers": [{"name": "c"}]},
+    }
+
+
+# ------------------------------------------------------------------ unit
+
+
+def test_obj_json_parity_counters_and_rv_self_check():
+    store = ClusterStore()
+    wc = WireCache(max_entries=16)
+    store.wirecache = wc
+    store.create("pods", _pod("p1"))
+    obj = store.get("pods", "p1", "default")
+
+    s1 = wc.obj_json("pods", obj, "v1", "Pod")
+    assert s1.encode() == _uncached_obj(obj, "v1", "Pod")
+    s2 = wc.obj_json("pods", obj, "v1", "Pod")
+    assert s2 is s1  # literally the shared render
+    assert wc.stats()["misses"] == 1 and wc.stats()["hits"] == 1
+
+    # the lookup compares the entry rv against the OBJECT'S OWN rv:
+    # a newer version re-renders even if no invalidation hook ever ran
+    newer = json.loads(json.dumps(obj))  # no apiVersion/kind baked in
+    newer["metadata"]["resourceVersion"] = str(
+        int(newer["metadata"]["resourceVersion"]) + 7
+    )
+    s3 = wc.obj_json("pods", newer, "v1", "Pod")
+    assert s3 != s1 and json.loads(s3)["metadata"]["resourceVersion"] == newer["metadata"]["resourceVersion"]
+    # per-groupVersion variants render lazily under the same entry
+    s_ev = wc.obj_json("pods", newer, "events.k8s.io/v1", "Pod")
+    assert json.loads(s_ev)["apiVersion"] == "events.k8s.io/v1"
+    assert wc.stats()["entries"] == 1
+
+
+def test_event_line_and_list_doc_splice_parity():
+    wc = WireCache(max_entries=16)
+    obj = {"metadata": {"name": "n1", "resourceVersion": "3"},
+           "status": {"allocatable": {"cpu": "1"}}}
+    s = wc.obj_json("nodes", obj, "v1", "Node")
+    assert wc.event_line("ADDED", s) == (
+        json.dumps({"type": "ADDED", "object": _env(obj, "v1", "Node")}) + "\n"
+    ).encode()
+    doc = wc.list_doc("NodeList", "v1", "17", [s, s])
+    expect = json.dumps(
+        {"kind": "NodeList", "apiVersion": "v1",
+         "metadata": {"resourceVersion": "17"},
+         "items": [_env(obj, "v1", "Node"), _env(obj, "v1", "Node")]}
+    ).encode()
+    assert doc == expect
+    # empty list splices to an empty items array, same bytes
+    assert wc.list_doc("NodeList", "v1", "0", []) == json.dumps(
+        {"kind": "NodeList", "apiVersion": "v1",
+         "metadata": {"resourceVersion": "0"}, "items": []}
+    ).encode()
+
+
+def test_deleted_never_inserted_eviction_and_backlog_guard():
+    wc = WireCache(max_entries=2)
+    a = {"metadata": {"name": "a", "resourceVersion": "1"}}
+    # DELETED events render but never cache (entry just purged)
+    wc.obj_json("pods", a, "v1", "Pod", insert=False)
+    assert wc.stats()["entries"] == 0
+    wc.obj_json("pods", a, "v1", "Pod")
+    wc.obj_json("pods", {"metadata": {"name": "b", "resourceVersion": "2"}}, "v1", "Pod")
+    wc.obj_json("pods", {"metadata": {"name": "c", "resourceVersion": "3"}}, "v1", "Pod")
+    assert wc.stats()["entries"] == 2  # oldest ("a") evicted
+    # a backlog replay rendering an OLDER version must not overwrite
+    # the live entry
+    wc.obj_json("pods", {"metadata": {"name": "b", "resourceVersion": "9"}}, "v1", "Pod")
+    wc.obj_json("pods", {"metadata": {"name": "b", "resourceVersion": "4"}}, "v1", "Pod")
+    hit = wc.obj_json("pods", {"metadata": {"name": "b", "resourceVersion": "9"}}, "v1", "Pod")
+    assert json.loads(hit)["metadata"]["resourceVersion"] == "9"
+
+
+def test_store_mutations_invalidate(monkeypatch):
+    store = ClusterStore()
+    wc = WireCache()
+    store.wirecache = wc
+    store.create("pods", _pod("p1"))
+    obj = store.get("pods", "p1", "default")
+    wc.obj_json("pods", obj, "v1", "Pod")
+    inv0 = wc.stats()["invalidations"]
+    store.patch("pods", "p1", {"metadata": {"labels": {"app": "b"}}}, "default")
+    assert wc.stats()["invalidations"] == inv0 + 1
+    fresh = store.get("pods", "p1", "default")
+    assert wc.obj_json("pods", fresh, "v1", "Pod").encode() == _uncached_obj(fresh, "v1", "Pod")
+    store.delete("pods", "p1", "default")
+    assert wc.stats()["invalidations"] == inv0 + 2
+    # clear_for_replay purges (and counts) everything
+    store.create("pods", _pod("p2"))
+    wc.obj_json("pods", store.get("pods", "p2", "default"), "v1", "Pod")
+    store.clear_for_replay()
+    assert wc.stats()["entries"] == 0
+
+
+def test_kss_wirecache_zero_disables(monkeypatch):
+    monkeypatch.setenv("KSS_WIRECACHE", "0")
+    assert not wirecache_enabled()
+    di = DIContainer(use_batch="off")
+    try:
+        assert di.cluster_store.wirecache is None
+    finally:
+        di.close()
+
+
+# ------------------------------------------------------------------- http
+
+
+@pytest.fixture()
+def server():
+    di = DIContainer(use_batch="off")
+    srv = SimulatorServer(di, port=0, kube_api_port=0)
+    srv.start(background=True)
+    yield srv, di
+    srv.shutdown()
+
+
+def test_http_get_and_list_byte_parity(server):
+    srv, di = server
+    p = srv.kube_api_port
+    store = di.cluster_store
+    assert store.wirecache is not None  # default-on
+    store.create("pods", _pod("p1", app="x"))
+    store.create("pods", _pod("p2", app="y"))
+
+    code, raw = _raw(p, "GET", "/api/v1/namespaces/default/pods/p1")
+    assert code == 200
+    assert raw == _uncached_obj(store.get("pods", "p1", "default"), "v1", "Pod")
+
+    h0 = store.wirecache.stats()["hits"]
+    code, raw2 = _raw(p, "GET", "/api/v1/namespaces/default/pods/p1")
+    assert raw2 == raw and store.wirecache.stats()["hits"] > h0
+
+    code, lst = _raw(p, "GET", "/api/v1/pods")
+    assert code == 200
+    assert lst == _uncached_list(store, "pods", "v1", "Pod")
+
+    # rv bump: a write anywhere re-renders the List envelope AND the
+    # touched item; untouched items still serve the same bytes
+    store.patch("pods", "p2", {"metadata": {"labels": {"app": "z"}}}, "default")
+    code, lst2 = _raw(p, "GET", "/api/v1/pods")
+    assert lst2 != lst
+    assert lst2 == _uncached_list(store, "pods", "v1", "Pod")
+
+
+def test_http_ssa_and_json_patch_byte_parity(server):
+    srv, di = server
+    p = srv.kube_api_port
+    store = di.cluster_store
+    store.create("pods", _pod("p1", app="x"))
+    _raw(p, "GET", "/api/v1/namespaces/default/pods/p1")  # warm the cache
+
+    # server-side apply (JSON is valid YAML for the apply body)
+    code, raw = _raw(
+        p, "PATCH",
+        "/api/v1/namespaces/default/pods/p1?fieldManager=wiretest",
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "p1", "namespace": "default",
+                      "annotations": {"ssa": "1"}}},
+        ctype="application/apply-patch+yaml",
+    )
+    assert code in (200, 201)
+    code, got = _raw(p, "GET", "/api/v1/namespaces/default/pods/p1")
+    fresh = store.get("pods", "p1", "default")
+    assert fresh["metadata"]["annotations"]["ssa"] == "1"
+    assert got == _uncached_obj(fresh, "v1", "Pod")
+
+    # RFC 6902 JSON patch
+    code, raw = _raw(
+        p, "PATCH", "/api/v1/namespaces/default/pods/p1",
+        json.dumps([{"op": "replace", "path": "/metadata/labels/app",
+                     "value": "patched"}]).encode(),
+        ctype="application/json-patch+json",
+    )
+    assert code == 200
+    code, got = _raw(p, "GET", "/api/v1/namespaces/default/pods/p1")
+    fresh = store.get("pods", "p1", "default")
+    assert fresh["metadata"]["labels"]["app"] == "patched"
+    assert got == _uncached_obj(fresh, "v1", "Pod")
+
+
+def test_http_watch_event_byte_parity(server):
+    # nodes, not pods: the background scheduler/controllers never touch
+    # them here, so the store state between event and assertion is stable
+    srv, di = server
+    p = srv.kube_api_port
+    store = di.cluster_store
+    conn = http.client.HTTPConnection("127.0.0.1", p, timeout=10)
+    conn.request("GET", "/api/v1/nodes?watch=true")
+    resp = conn.getresponse()
+    assert resp.status == 200
+    store.create("nodes", {"metadata": {"name": "w1"},
+                           "status": {"allocatable": {"cpu": "1", "memory": "1Gi", "pods": "10"}}})
+    line = resp.readline()
+    obj = store.get("nodes", "w1")
+    assert line == (
+        json.dumps({"type": "ADDED", "object": _env(obj, "v1", "Node")}) + "\n"
+    ).encode()
+    # MODIFIED and DELETED lines share the same render contract
+    store.patch("nodes", "w1", {"metadata": {"labels": {"app": "m"}}})
+    mod = store.get("nodes", "w1")
+    assert resp.readline() == (
+        json.dumps({"type": "MODIFIED", "object": _env(mod, "v1", "Node")}) + "\n"
+    ).encode()
+    store.delete("nodes", "w1")
+    delline = json.loads(resp.readline())
+    assert delline["type"] == "DELETED"
+    assert delline["object"]["metadata"]["name"] == "w1"
+    # the delete-stamped render was not cached: no entry for w1 remains
+    assert ("nodes", None, "w1") not in store.wirecache._map
+    conn.close()
+
+
+# ---------------------------------------------------------------- sessions
+
+
+def test_session_scoped_caches_are_isolated():
+    from kube_scheduler_simulator_tpu.tenancy.manager import SessionManager
+
+    di = DIContainer(use_batch="off")
+    mgr = SessionManager(di, use_batch="off")
+    try:
+        mgr.create("t1")
+        s_default = di.cluster_store
+        s_t1 = mgr.resolve_store("t1")
+        assert s_t1 is not s_default
+        assert s_t1.wirecache is not None
+        assert s_t1.wirecache is not s_default.wirecache
+        # same name, different content per session → different bytes,
+        # each byte-identical to its own session's uncached render
+        s_default.create("pods", _pod("p", tenant="default"))
+        s_t1.create("pods", _pod("p", tenant="t1"))
+        a = s_default.wirecache.obj_json(
+            "pods", s_default.get("pods", "p", "default"), "v1", "Pod"
+        )
+        b = s_t1.wirecache.obj_json(
+            "pods", s_t1.get("pods", "p", "default"), "v1", "Pod"
+        )
+        assert a != b
+        assert a.encode() == _uncached_obj(s_default.get("pods", "p", "default"), "v1", "Pod")
+        assert b.encode() == _uncached_obj(s_t1.get("pods", "p", "default"), "v1", "Pod")
+        # a tenant write never touches the default session's counters
+        inv0 = s_default.wirecache.stats()["invalidations"]
+        s_t1.patch("pods", "p", {"metadata": {"labels": {"x": "y"}}}, "default")
+        assert s_default.wirecache.stats()["invalidations"] == inv0
+    finally:
+        mgr.close()
+        di.close()
+
+
+# ---------------------------------------------------------------- recovery
+
+
+def test_journal_recovery_serves_parity_bytes(tmp_path):
+    jdir = str(tmp_path / "wal")
+    di = DIContainer(use_batch="off", journal_dir=jdir)
+    di.cluster_store.create("pods", _pod("p1", app="x"))
+    di.cluster_store.patch(
+        "pods", "p1", {"metadata": {"annotations": {"k": "v"}}}, "default"
+    )
+    expect = _uncached_obj(di.cluster_store.get("pods", "p1", "default"), "v1", "Pod")
+    di.close()
+
+    di2 = DIContainer(use_batch="off", journal_dir=jdir)
+    try:
+        wc = di2.cluster_store.wirecache
+        assert wc is not None
+        rec = di2.cluster_store.get("pods", "p1", "default")
+        assert wc.obj_json("pods", rec, "v1", "Pod").encode() == expect
+    finally:
+        di2.close()
+
+
+# ----------------------------------------------------------------- metrics
+
+
+def test_wirecache_metrics_wiring(server):
+    from kube_scheduler_simulator_tpu.server.metrics import render_metrics
+
+    srv, di = server
+    p = srv.kube_api_port
+    di.cluster_store.create("pods", _pod("p1"))
+    _raw(p, "GET", "/api/v1/namespaces/default/pods/p1")
+    _raw(p, "GET", "/api/v1/namespaces/default/pods/p1")
+    di.cluster_store.patch("pods", "p1", {"metadata": {"labels": {"a": "b"}}}, "default")
+    text = render_metrics(di)
+    st = di.cluster_store.wirecache.stats()
+    assert f"wirecache_hits_total {st['hits']}" in text
+    assert f"wirecache_misses_total {st['misses']}" in text
+    assert f"wirecache_invalidations_total {st['invalidations']}" in text
+    assert "wirecache_entries" in text
+    assert st["hits"] >= 1 and st["invalidations"] >= 1
